@@ -1,0 +1,19 @@
+"""corrosion-tpu: a TPU-native rebuild of Corrosion's capabilities.
+
+Gossip-replicated eventually-consistent SQLite state (SWIM membership, CRDT
+changesets, epidemic broadcast, anti-entropy sync) re-architected around
+JAX/XLA: the cluster is a node×changeset-version matrix on device, gossip
+rounds are jitted scatter/gather kernels, and a thin host agent sharing the
+same protocol core serves the real HTTP/SQL surface.
+
+Layout (SURVEY.md §7):
+- ``core``     — protocol types + interval/CRDT algebra (the shared spec)
+- ``native``   — C++ fast path (CRDT merge core) with Python fallback
+- ``agent``    — host agent: SQLite CRR store, transport, broadcast, sync, API
+- ``sim``      — the TPU epidemic simulator (SWIM/broadcast/sync kernels)
+- ``parallel`` — mesh/sharding helpers (pjit/shard_map over the node axis)
+- ``ops``      — fixed-K interval tensor ops and other kernel building blocks
+- ``cli``      — operator command-line surface
+"""
+
+__version__ = "0.1.0"

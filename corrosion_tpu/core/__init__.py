@@ -1,0 +1,55 @@
+"""Protocol core: the L1/L2 types and algebra shared by the host agent and
+the TPU simulator (see SURVEY.md §1 layers L1-L2)."""
+
+from .intervals import Range, RangeSet
+from .types import (
+    Actor,
+    ActorId,
+    BroadcastV1,
+    Change,
+    ChangeSource,
+    Changeset,
+    ChangesetPart,
+    ClusterId,
+    SqliteValue,
+    SyncNeed,
+    SyncState,
+)
+from .bookkeeping import BookedVersions, PartialVersion, VersionsSnapshot
+from .changes import MAX_CHANGES_BYTE_SIZE, MIN_CHANGES_BYTE_SIZE, ChunkedChanges
+from .crdt import MergeOutcome, merge_cell, merge_row_cl, row_alive, value_cmp
+from .hlc import HLC, ClockDriftError, ntp64_from_unix_ns, ntp64_to_unix_ns
+from .sync import compute_available_needs, generate_sync
+
+__all__ = [
+    "Actor",
+    "ActorId",
+    "BookedVersions",
+    "BroadcastV1",
+    "Change",
+    "ChangeSource",
+    "Changeset",
+    "ChangesetPart",
+    "ChunkedChanges",
+    "ClusterId",
+    "ClockDriftError",
+    "HLC",
+    "MAX_CHANGES_BYTE_SIZE",
+    "MIN_CHANGES_BYTE_SIZE",
+    "MergeOutcome",
+    "PartialVersion",
+    "Range",
+    "RangeSet",
+    "SqliteValue",
+    "SyncNeed",
+    "SyncState",
+    "VersionsSnapshot",
+    "compute_available_needs",
+    "generate_sync",
+    "merge_cell",
+    "merge_row_cl",
+    "ntp64_from_unix_ns",
+    "ntp64_to_unix_ns",
+    "row_alive",
+    "value_cmp",
+]

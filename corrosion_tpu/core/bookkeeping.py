@@ -1,0 +1,221 @@
+"""Version bookkeeping: which (actor, db_version) ranges a node has, needs,
+or holds partially.
+
+Rebuild of the reference's L2 layer (`corro-types/src/agent.rs:1057-1444`):
+``BookedVersions`` (per-origin-actor needed-gap set + partials + max),
+``VersionsSnapshot`` (the transactional mutation view whose gap algebra is
+persisted alongside the data commit), ``PartialVersion`` (seq-range tracking
+for chunked large changesets).
+
+The reference persists gap changes to the `__corro_bookkeeping_gaps` SQLite
+table inside the same transaction as the data write (`agent.rs:1108-1168`);
+here that's the pluggable ``GapsSink`` so the pure algebra is testable and the
+host store provides the SQLite-backed sink.  The algebra itself
+(`compute_gaps_change`, `agent.rs:1170-1235`) is reproduced exactly — the
+reference's own unit test (`agent.rs:1600-1922`) is ported in
+`tests/core/test_bookkeeping.py` and must stay green.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Protocol, Tuple
+
+from .intervals import Range, RangeSet
+from .types import ActorId
+
+
+@dataclass
+class PartialVersion:
+    """Seq ranges received so far for one buffered (actor, db_version)
+    (reference `agent.rs:1057-1075`)."""
+
+    seqs: RangeSet = field(default_factory=RangeSet)
+    last_seq: int = 0
+    ts: int = 0
+
+    def is_complete(self) -> bool:
+        # NOTE: the reference checks gaps over CrsqlSeq(1)..=last_seq
+        # (`full_range`, agent.rs:1072) even though seqs start at 0 — seq 0
+        # presence is implied by receipt.  We keep 0..=last_seq which is
+        # strictly stronger and matches actual usage (sync.rs:324 gaps over
+        # 0..=last_seq).
+        return next(self.seqs.gaps(0, self.last_seq), None) is None
+
+    def gap_list(self) -> List[Range]:
+        return list(self.seqs.gaps(0, self.last_seq))
+
+
+class GapsSink(Protocol):
+    """Persistence hook for gap mutations (the `__corro_bookkeeping_gaps`
+    table writes in the reference)."""
+
+    def delete_gap(self, actor_id: ActorId, lo: int, hi: int) -> None: ...
+
+    def insert_gap(self, actor_id: ActorId, lo: int, hi: int) -> None: ...
+
+
+class NullSink:
+    def delete_gap(self, actor_id: ActorId, lo: int, hi: int) -> None:
+        pass
+
+    def insert_gap(self, actor_id: ActorId, lo: int, hi: int) -> None:
+        pass
+
+
+NULL_SINK = NullSink()
+
+
+@dataclass
+class _GapsChanges:
+    """Reference `agent.rs:1439-1444` GapsChanges."""
+
+    max: Optional[int]
+    insert_set: RangeSet = field(default_factory=RangeSet)
+    remove_ranges: set = field(default_factory=set)  # set[Range] — exact stored ranges
+
+
+class VersionsSnapshot:
+    """Mutable copy of a BookedVersions taken for the duration of one write
+    transaction; committed back on success (reference `agent.rs:1092-1236`)."""
+
+    def __init__(
+        self,
+        actor_id: ActorId,
+        needed: RangeSet,
+        partials: Dict[int, PartialVersion],
+        max_: Optional[int],
+    ):
+        self.actor_id = actor_id
+        self.needed = needed
+        self.partials = partials
+        self.max = max_
+
+    def insert_gaps(self, ranges: Iterable[Range]) -> None:
+        self.needed.extend(ranges)
+
+    def insert_db(self, sink: GapsSink, db_versions: RangeSet) -> None:
+        """Record [ranges of] db_versions as known/applied, updating the
+        needed-gap set and persisting gap deletions/insertions through
+        ``sink`` (reference `insert_db`, agent.rs:1108-1168)."""
+        changes = self._compute_gaps_change(db_versions)
+
+        for lo, hi in changes.remove_ranges:
+            sink.delete_gap(self.actor_id, lo, hi)
+            for v in range(lo, hi + 1):
+                self.partials.pop(v, None)
+            self.needed.remove(lo, hi)
+
+        for lo, hi in changes.insert_set:
+            sink.insert_gap(self.actor_id, lo, hi)
+            self.needed.insert(lo, hi)
+
+        self.max = changes.max
+
+    def _compute_gaps_change(self, versions: RangeSet) -> _GapsChanges:
+        """Exact port of reference `compute_gaps_change` (agent.rs:1170-1235)."""
+        changes = _GapsChanges(max=self.max)
+
+        for vlo, vhi in versions:
+            if changes.max is None or vhi > changes.max:
+                changes.max = vhi
+
+            # stored gap ranges overlapping the inserted range get rewritten
+            for r in self.needed.overlapping(vlo, vhi):
+                changes.insert_set.insert(*r)
+                changes.remove_ranges.add(r)
+
+            # collapse an adjacent previous range (end == start - 1)
+            prev = self.needed.get(vlo - 1)
+            if prev is not None:
+                changes.insert_set.insert(*prev)
+                changes.remove_ranges.add(prev)
+
+            # collapse an adjacent next range (start == end + 1)
+            nxt = self.needed.get(vhi + 1)
+            if nxt is not None:
+                changes.insert_set.insert(*nxt)
+                changes.remove_ranges.add(nxt)
+
+            # a gap opens between the current max and the inserted start
+            current_max = self.max if self.max is not None else 0
+            gap_start = current_max + 1
+            if gap_start < vlo:
+                changes.insert_set.insert(gap_start, vlo)
+                for r in self.needed.overlapping(gap_start, vlo):
+                    changes.insert_set.insert(*r)
+                    changes.remove_ranges.add(r)
+
+        for vlo, vhi in versions:
+            # the inserted versions themselves are now known
+            changes.insert_set.remove(vlo, vhi)
+
+        return changes
+
+
+class BookedVersions:
+    """Per-origin-actor version knowledge (reference `agent.rs:1260-1437`)."""
+
+    def __init__(self, actor_id: ActorId):
+        self.actor_id = actor_id
+        self.partials: Dict[int, PartialVersion] = {}
+        self._needed = RangeSet()
+        self._max: Optional[int] = None
+
+    # -- snapshots --------------------------------------------------------
+
+    def snapshot(self) -> VersionsSnapshot:
+        return VersionsSnapshot(
+            self.actor_id,
+            self._needed.copy(),
+            dict(self.partials),
+            self._max,
+        )
+
+    def commit_snapshot(self, snap: VersionsSnapshot) -> None:
+        self._needed = snap.needed
+        self.partials = snap.partials
+        self._max = snap.max
+
+    # -- queries ----------------------------------------------------------
+
+    def contains_version(self, version: int) -> bool:
+        """Reference `agent.rs:1353-1362`: known iff not needed and <= max."""
+        return not self._needed.contains(version) and (self._max or 0) >= version
+
+    def contains(self, version: int, seqs: Optional[Range] = None) -> bool:
+        if not self.contains_version(version):
+            return False
+        if seqs is None:
+            return True
+        partial = self.partials.get(version)
+        if partial is None:
+            # known but not partial → fully applied or cleared
+            return True
+        return partial.seqs.covers(*seqs)
+
+    def contains_all(self, versions: Range, seqs: Optional[Range] = None) -> bool:
+        return all(self.contains(v, seqs) for v in range(versions[0], versions[1] + 1))
+
+    def last(self) -> Optional[int]:
+        return self._max
+
+    def needed(self) -> RangeSet:
+        return self._needed
+
+    def get_partial(self, version: int) -> Optional[PartialVersion]:
+        return self.partials.get(version)
+
+    # -- mutation ---------------------------------------------------------
+
+    def insert_partial(self, version: int, partial: PartialVersion) -> PartialVersion:
+        """Merge newly received seq ranges for a buffered version
+        (reference `agent.rs:1414-1432`)."""
+        existing = self.partials.get(version)
+        if existing is None:
+            if self._max is None or version > self._max:
+                self._max = version
+            self.partials[version] = partial
+            return partial
+        existing.seqs.extend(partial.seqs)
+        return existing

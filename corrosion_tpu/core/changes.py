@@ -1,0 +1,84 @@
+"""Changeset chunking for wire transfer.
+
+Rebuild of the reference's ``ChunkedChanges`` iterator
+(`corro-types/src/change.rs:66-180`): splits one transaction's ordered
+column-change stream into chunks of at most ``max_buf_size`` estimated wire
+bytes, each tagged with the exact inclusive seq range it covers so receivers
+can gap-track partial versions.  Matches the reference's edge cases (ported
+test change.rs:262-402 lives in `tests/core/test_chunker.py`):
+
+- an empty stream still yields one (empty, start..=last_seq) chunk;
+- the final chunk's range always extends to ``last_seq``;
+- seq gaps inside the stream are absorbed into the chunk ranges;
+- a chunk closes early when the next peeked item is absent.
+
+``MAX_CHANGES_BYTE_SIZE`` = 8 KiB (change.rs:180); senders adapt down to
+``MIN_CHANGES_BYTE_SIZE`` for slow peers (peer/mod.rs:365-368).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Tuple
+
+from .types import Change, Range
+
+MAX_CHANGES_BYTE_SIZE = 8 * 1024
+MIN_CHANGES_BYTE_SIZE = 1024
+
+
+class ChunkedChanges:
+    """Iterator of ``(changes, (start_seq, end_seq))`` chunks."""
+
+    def __init__(
+        self,
+        changes: Iterable[Change],
+        start_seq: int,
+        last_seq: int,
+        max_buf_size: int = MAX_CHANGES_BYTE_SIZE,
+    ):
+        self._iter = iter(changes)
+        self._peeked: List[Change] = []
+        self._start_seq = start_seq
+        self._last_seq = last_seq
+        self.max_buf_size = max_buf_size
+        self._done = False
+
+    def _next_change(self):
+        if self._peeked:
+            return self._peeked.pop()
+        return next(self._iter, None)
+
+    def _peek(self):
+        if not self._peeked:
+            nxt = next(self._iter, None)
+            if nxt is None:
+                return None
+            self._peeked.append(nxt)
+        return self._peeked[-1]
+
+    def __iter__(self) -> Iterator[Tuple[List[Change], Range]]:
+        return self
+
+    def __next__(self) -> Tuple[List[Change], Range]:
+        if self._done:
+            raise StopIteration
+        buf: List[Change] = []
+        buffered_size = 0
+        last_pushed_seq = 0
+        while True:
+            change = self._next_change()
+            if change is None:
+                break
+            last_pushed_seq = change.seq
+            buffered_size += change.estimated_byte_size()
+            buf.append(change)
+            if last_pushed_seq == self._last_seq:
+                break  # that was the last seq of the transaction
+            if buffered_size >= self.max_buf_size:
+                if self._peek() is None:
+                    break  # no more rows: fall through to final chunk
+                start = self._start_seq
+                self._start_seq = last_pushed_seq + 1
+                return buf, (start, last_pushed_seq)
+        self._done = True
+        return buf, (self._start_seq, self._last_seq)

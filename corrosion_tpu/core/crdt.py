@@ -1,0 +1,124 @@
+"""CRDT merge semantics: per-column last-write-wins + causal-length rows.
+
+This is the rebuild's replacement for the cr-sqlite C extension's merge rules
+(reference `doc/crdts.md:15-17,235-248`; loaded at
+`corro-types/src/sqlite.rs:121-139`).  The same rules are implemented three
+times, deliberately kept in exact agreement:
+
+1. here (Python reference implementation; the spec),
+2. `corrosion_tpu/native/crdt_core.cpp` (C++ fast path for bulk applies),
+3. `corrosion_tpu/sim/` (vectorised: max-reduction over packed
+   (col_version, value_rank, site_id) keys).
+
+Rules for an existing (table, pk, cid) cell receiving an incoming change
+(doc/crdts.md:237 — "The order in which crsql checks for which value is
+'larger' is: col_version, followed by the value, and finally the site_id"):
+
+1. bigger ``col_version`` wins;
+2. tie → bigger value, per SQLite value ordering
+   (NULL < INTEGER/REAL numeric < TEXT < BLOB);
+3. tie → bigger ``site_id``.
+
+Row existence is governed by causal length ``cl`` (Causal-Length CRDT,
+doc/crdts.md:13): odd = alive, even = deleted; bigger cl wins; a delete
+resets column state so a resurrected row starts fresh.
+
+With ``merge_equal_values`` (reference `crsql_config_set('merge-equal-values',1)`,
+`agent.rs:358-362`): an incoming change that compares exactly equal in
+(col_version, value) but loses on site_id is still *recorded* as the winner's
+metadata (keeps clocks identical across nodes without dirtying the row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .types import ActorId, SqliteValue
+
+# SQLite storage-class ranks (BINARY collation semantics).
+_RANK_NULL = 0
+_RANK_NUMERIC = 1
+_RANK_TEXT = 2
+_RANK_BLOB = 3
+
+
+def value_rank(v: SqliteValue) -> int:
+    if v is None:
+        return _RANK_NULL
+    if isinstance(v, bool):  # bools are ints in SQLite
+        return _RANK_NUMERIC
+    if isinstance(v, (int, float)):
+        return _RANK_NUMERIC
+    if isinstance(v, str):
+        return _RANK_TEXT
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return _RANK_BLOB
+    raise TypeError(f"not a SQLite value: {type(v)!r}")
+
+
+def value_cmp(a: SqliteValue, b: SqliteValue) -> int:
+    """SQLite ORDER BY semantics: -1/0/+1.
+
+    NULL < numbers (int/real compared numerically) < text (memcmp of UTF-8,
+    BINARY collation) < blob (memcmp).
+    """
+    ra, rb = value_rank(a), value_rank(b)
+    if ra != rb:
+        return -1 if ra < rb else 1
+    if ra == _RANK_NULL:
+        return 0
+    if ra == _RANK_NUMERIC:
+        return -1 if a < b else (1 if a > b else 0)
+    if ra == _RANK_TEXT:
+        ab, bb = a.encode("utf-8"), b.encode("utf-8")
+    else:
+        ab, bb = bytes(a), bytes(b)
+    return -1 if ab < bb else (1 if ab > bb else 0)
+
+
+class MergeOutcome:
+    """What to do with an incoming change against the current cell state."""
+
+    LOSE = 0  # drop it; local state stands
+    WIN = 1  # incoming replaces the cell (value + clock)
+    EQUAL_METADATA = 2  # equal (col_version, value): record clock metadata only
+
+
+def merge_cell(
+    existing: Optional[Tuple[int, SqliteValue, ActorId]],
+    incoming: Tuple[int, SqliteValue, ActorId],
+    merge_equal_values: bool = True,
+) -> int:
+    """Decide a per-column merge.
+
+    ``existing``/``incoming`` are ``(col_version, value, site_id)``;
+    ``existing is None`` means the cell has no recorded clock → incoming wins.
+    Returns a MergeOutcome constant.
+    """
+    if existing is None:
+        return MergeOutcome.WIN
+    e_ver, e_val, e_site = existing
+    i_ver, i_val, i_site = incoming
+    if i_ver != e_ver:
+        return MergeOutcome.WIN if i_ver > e_ver else MergeOutcome.LOSE
+    c = value_cmp(i_val, e_val)
+    if c != 0:
+        return MergeOutcome.WIN if c > 0 else MergeOutcome.LOSE
+    # equal (col_version, value): site id breaks the tie
+    if i_site.bytes_ > e_site.bytes_:
+        return MergeOutcome.WIN
+    if merge_equal_values:
+        return MergeOutcome.EQUAL_METADATA
+    return MergeOutcome.LOSE
+
+
+def merge_row_cl(existing_cl: int, incoming_cl: int) -> int:
+    """Causal-length merge for row existence: the larger cl wins.
+
+    Returns the merged cl.  Row is alive iff merged cl is odd.
+    """
+    return max(existing_cl, incoming_cl)
+
+
+def row_alive(cl: int) -> bool:
+    return cl % 2 == 1

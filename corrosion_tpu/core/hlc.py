@@ -1,0 +1,76 @@
+"""Hybrid Logical Clock.
+
+Rebuild of the reference's `uhlc`-based clock (`corro-types/src/broadcast.rs:292`
+`Timestamp` = NTP64 wrapper; agent setup at `corro-agent/src/agent/setup.rs:101-106`
+creates the HLC with the actor id and a 300 ms max drift delta).
+
+Timestamps are u64 NTP64: upper 32 bits = seconds since UNIX epoch, lower
+32 bits = fraction of a second.  The logical component rides in the lowest
+bits of the fraction — physical time quantised, bumped monotonically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# Max accepted drift of a remote timestamp ahead of local wall clock
+# (reference setup.rs:104: 300 ms).
+DEFAULT_MAX_DELTA_NS = 300_000_000
+
+# Low bits of the fraction reserved for the logical counter (uhlc uses the
+# full NTP64 with a counter in the low bits; 8 bits = 256 events per ~60ns).
+_CMASK = 0xF
+
+
+def ntp64_from_unix_ns(ns: int) -> int:
+    secs, rem = divmod(ns, 1_000_000_000)
+    frac = (rem << 32) // 1_000_000_000
+    return ((secs & 0xFFFFFFFF) << 32) | (frac & 0xFFFFFFFF)
+
+
+def ntp64_to_unix_ns(ts: int) -> int:
+    secs = ts >> 32
+    frac = ts & 0xFFFFFFFF
+    return secs * 1_000_000_000 + ((frac * 1_000_000_000) >> 32)
+
+
+class ClockDriftError(Exception):
+    def __init__(self, delta_ns: int):
+        super().__init__(f"remote timestamp ahead of local clock by {delta_ns} ns")
+        self.delta_ns = delta_ns
+
+
+class HLC:
+    """Monotonic hybrid logical clock producing NTP64 ints."""
+
+    def __init__(self, max_delta_ns: int = DEFAULT_MAX_DELTA_NS, _now_ns=None):
+        self._last = 0
+        self._lock = threading.Lock()
+        self.max_delta_ns = max_delta_ns
+        self._now_ns = _now_ns or time.time_ns
+
+    def now(self) -> int:
+        """A new timestamp strictly greater than any previously issued."""
+        with self._lock:
+            phys = ntp64_from_unix_ns(self._now_ns()) & ~_CMASK
+            if phys > self._last:
+                self._last = phys
+            else:
+                self._last += 1
+            return self._last
+
+    def peek(self) -> int:
+        return self._last
+
+    def update(self, remote_ts: int) -> None:
+        """Merge a remote timestamp (reference updates the clock on every
+        received change / sync handshake).  Raises ClockDriftError when the
+        remote is too far ahead of local wall time."""
+        with self._lock:
+            local_ns = self._now_ns()
+            remote_ns = ntp64_to_unix_ns(remote_ts)
+            if remote_ns > local_ns + self.max_delta_ns:
+                raise ClockDriftError(remote_ns - local_ns)
+            if remote_ts > self._last:
+                self._last = remote_ts

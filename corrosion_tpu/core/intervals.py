@@ -1,0 +1,153 @@
+"""Inclusive integer interval-set algebra.
+
+This is the rebuild's equivalent of the reference's `rangemap::RangeInclusiveSet`
+(used throughout `crates/corro-types/src/agent.rs` and `sync.rs` for version-gap
+and sequence-gap tracking). Semantics matched:
+
+- ``insert`` coalesces overlapping *and adjacent* ranges (1..=3 + 4..=6 -> 1..=6).
+- ``remove`` splits stored ranges.
+- ``gaps(lo, hi)`` yields maximal uncovered subranges inside [lo, hi].
+- ``overlapping(lo, hi)`` yields stored ranges intersecting [lo, hi].
+- ``get(v)`` returns the stored range containing v, if any.
+
+Stored ranges are plain ``(lo, hi)`` int tuples, always disjoint,
+non-adjacent, and sorted.  All bounds are inclusive.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, Iterator, Optional, Tuple
+
+Range = Tuple[int, int]
+
+
+class RangeSet:
+    """A set of disjoint, coalesced, inclusive integer ranges."""
+
+    __slots__ = ("_starts", "_ends")
+
+    def __init__(self, ranges: Iterable[Range] = ()):  # noqa: D107
+        self._starts: list[int] = []
+        self._ends: list[int] = []
+        for lo, hi in ranges:
+            self.insert(lo, hi)
+
+    # -- construction -----------------------------------------------------
+
+    def copy(self) -> "RangeSet":
+        rs = RangeSet()
+        rs._starts = list(self._starts)
+        rs._ends = list(self._ends)
+        return rs
+
+    def insert(self, lo: int, hi: int) -> None:
+        """Insert [lo, hi], coalescing with overlapping/adjacent ranges."""
+        if hi < lo:
+            raise ValueError(f"invalid range {lo}..={hi}")
+        # find all ranges touching [lo-1, hi+1] (adjacency coalesces)
+        i = bisect.bisect_left(self._ends, lo - 1)
+        j = bisect.bisect_right(self._starts, hi + 1)
+        if i < j:
+            lo = min(lo, self._starts[i])
+            hi = max(hi, self._ends[j - 1])
+            del self._starts[i:j]
+            del self._ends[i:j]
+        self._starts.insert(i, lo)
+        self._ends.insert(i, hi)
+
+    def extend(self, other: "RangeSet | Iterable[Range]") -> None:
+        for lo, hi in other:
+            self.insert(lo, hi)
+
+    def remove(self, lo: int, hi: int) -> None:
+        """Remove [lo, hi], splitting stored ranges as needed."""
+        if hi < lo:
+            raise ValueError(f"invalid range {lo}..={hi}")
+        i = bisect.bisect_left(self._ends, lo)
+        j = bisect.bisect_right(self._starts, hi)
+        if i >= j:
+            return
+        left: list[Range] = []
+        if self._starts[i] < lo:
+            left.append((self._starts[i], lo - 1))
+        if self._ends[j - 1] > hi:
+            left.append((hi + 1, self._ends[j - 1]))
+        del self._starts[i:j]
+        del self._ends[i:j]
+        for k, (s, e) in enumerate(left):
+            self._starts.insert(i + k, s)
+            self._ends.insert(i + k, e)
+
+    def clear(self) -> None:
+        self._starts.clear()
+        self._ends.clear()
+
+    # -- queries ----------------------------------------------------------
+
+    def contains(self, v: int) -> bool:
+        return self.get(v) is not None
+
+    def get(self, v: int) -> Optional[Range]:
+        """The stored range containing v, if any."""
+        i = bisect.bisect_left(self._ends, v)
+        if i < len(self._starts) and self._starts[i] <= v <= self._ends[i]:
+            return (self._starts[i], self._ends[i])
+        return None
+
+    def overlapping(self, lo: int, hi: int) -> Iterator[Range]:
+        """Stored ranges intersecting [lo, hi] (strict overlap, not adjacency)."""
+        i = bisect.bisect_left(self._ends, lo)
+        while i < len(self._starts) and self._starts[i] <= hi:
+            yield (self._starts[i], self._ends[i])
+            i += 1
+
+    def gaps(self, lo: int, hi: int) -> Iterator[Range]:
+        """Maximal subranges of [lo, hi] not covered by the set."""
+        cur = lo
+        for s, e in self.overlapping(lo, hi):
+            if s > cur:
+                yield (cur, min(s - 1, hi))
+            cur = max(cur, e + 1)
+            if cur > hi:
+                return
+        if cur <= hi:
+            yield (cur, hi)
+
+    def covers(self, lo: int, hi: int) -> bool:
+        """True if every integer of [lo, hi] is in the set."""
+        r = self.get(lo)
+        return r is not None and r[1] >= hi
+
+    def span_count(self) -> int:
+        """Total count of integers covered."""
+        return sum(e - s + 1 for s, e in self)
+
+    def first(self) -> Optional[int]:
+        return self._starts[0] if self._starts else None
+
+    def last(self) -> Optional[int]:
+        return self._ends[-1] if self._ends else None
+
+    # -- dunder -----------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Range]:
+        return iter(zip(self._starts, self._ends))
+
+    def __len__(self) -> int:
+        return len(self._starts)
+
+    def __bool__(self) -> bool:
+        return bool(self._starts)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, RangeSet):
+            return NotImplemented
+        return self._starts == other._starts and self._ends == other._ends
+
+    def __hash__(self):  # pragma: no cover - sets aren't hashable containers
+        return hash((tuple(self._starts), tuple(self._ends)))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{s}..={e}" for s, e in self)
+        return f"RangeSet[{inner}]"

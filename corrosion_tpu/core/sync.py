@@ -1,0 +1,109 @@
+"""Anti-entropy need computation.
+
+Rebuild of the reference's sync-state algebra (`corro-types/src/sync.rs`):
+``compute_available_needs`` (sync.rs:127-249) decides, given our frontier and
+a peer's advertised frontier, exactly which version ranges and partial seq
+ranges the peer can supply.  ``generate_sync`` (sync.rs:284-333) builds our
+advertisement from the bookie.  The reference's unit test
+(sync.rs:380-501) is ported in `tests/core/test_sync_needs.py`.
+
+The same algebra runs vectorised on device in `corrosion_tpu.sim.sync`
+(fixed-K gap tensors); this module is the scalar spec.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping
+
+from .bookkeeping import BookedVersions
+from .intervals import RangeSet
+from .types import ActorId, SyncNeed, SyncState
+
+
+def compute_available_needs(
+    ours: SyncState, other: SyncState
+) -> Dict[ActorId, List[SyncNeed]]:
+    """What *we* need that *other* can actually provide.
+
+    Exact port of reference sync.rs:127-249: for each origin actor in the
+    peer's heads, build the peer's definitely-fully-held set
+    (1..=head minus their needs minus their partials), intersect with our
+    needs / partial gaps, then add the head catch-up range.
+    """
+    needs: Dict[ActorId, List[SyncNeed]] = {}
+
+    def push(actor: ActorId, need: SyncNeed) -> None:
+        needs.setdefault(actor, []).append(need)
+
+    for actor_id, head in other.heads.items():
+        if actor_id == ours.actor_id:
+            continue
+        if head == 0:
+            continue
+
+        # versions the peer fully has
+        other_haves = RangeSet([(1, head)])
+        for lo, hi in other.need.get(actor_id, ()):
+            other_haves.remove(lo, hi)
+        for v in other.partial_need.get(actor_id, {}):
+            other_haves.remove(v, v)
+
+        # full-version needs they can serve
+        for rlo, rhi in ours.need.get(actor_id, ()):
+            for olo, ohi in other_haves.overlapping(rlo, rhi):
+                push(actor_id, SyncNeed.full(max(rlo, olo), min(rhi, ohi)))
+
+        # partial (seq-gap) needs
+        for v, seqs in ours.partial_need.get(actor_id, {}).items():
+            if other_haves.contains(v):
+                push(actor_id, SyncNeed.partial(v, list(seqs)))
+            else:
+                other_seqs = other.partial_need.get(actor_id, {}).get(v)
+                if other_seqs is None:
+                    continue
+                max_other = max((hi for _, hi in other_seqs), default=None)
+                max_ours = max((hi for _, hi in seqs), default=None)
+                ends = [e for e in (max_other, max_ours) if e is not None]
+                if not ends:
+                    continue
+                end = max(ends)
+                # seqs the peer has within the version = 0..=end minus their gaps
+                other_seq_haves = RangeSet([(0, end)])
+                for lo, hi in other_seqs:
+                    other_seq_haves.remove(lo, hi)
+                overlap_seqs = [
+                    (max(rlo, olo), min(rhi, ohi))
+                    for rlo, rhi in seqs
+                    for olo, ohi in other_seq_haves.overlapping(rlo, rhi)
+                ]
+                if overlap_seqs:
+                    push(actor_id, SyncNeed.partial(v, overlap_seqs))
+
+        # head catch-up
+        our_head = ours.heads.get(actor_id)
+        if our_head is None:
+            push(actor_id, SyncNeed.full(1, head))
+        elif head > our_head:
+            push(actor_id, SyncNeed.full(our_head + 1, head))
+
+    return needs
+
+
+def generate_sync(
+    booked_by_actor: Mapping[ActorId, BookedVersions], self_actor_id: ActorId
+) -> SyncState:
+    """Build our frontier advertisement (reference sync.rs:284-333)."""
+    state = SyncState(actor_id=self_actor_id)
+    for actor_id, booked in booked_by_actor.items():
+        last = booked.last()
+        if last is None:
+            continue
+        need = list(booked.needed())
+        if need:
+            state.need[actor_id] = need
+        for v, partial in booked.partials.items():
+            if partial.is_complete():
+                continue
+            state.partial_need.setdefault(actor_id, {})[v] = partial.gap_list()
+        state.heads[actor_id] = last
+    return state

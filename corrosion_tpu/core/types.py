@@ -1,0 +1,267 @@
+"""Core protocol types shared by the host agent and the TPU simulator.
+
+Rebuild of the reference's L1 layer (`crates/corro-types/src/actor.rs`,
+`broadcast.rs`, `change.rs`, `corro-base-types/src/lib.rs`,
+`corro-api-types/src/lib.rs`) as plain Python dataclasses.  These are the
+types that become on-device tensors in `corrosion_tpu.sim` — the host agent
+and the simulator share this single protocol definition, which is the
+rebuild's version of the reference's "same types above the transport seam"
+design.
+
+Versions and sequences are plain ints (the reference's `CrsqlDbVersion` /
+`CrsqlSeq` u64 newtypes); ranges are inclusive ``(lo, hi)`` tuples matching
+`corrosion_tpu.core.intervals.RangeSet` entries.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Tuple, Union
+
+Range = Tuple[int, int]
+
+# SQLite-compatible value: the reference's `SqliteValue`
+# (corro-api-types/src/lib.rs:422) — Null / Integer / Real / Text / Blob.
+SqliteValue = Union[None, int, float, str, bytes]
+
+
+# ---------------------------------------------------------------------------
+# Identity
+
+
+@dataclass(frozen=True, order=True)
+class ActorId:
+    """16-byte unique node identity (reference `actor.rs:26`, crsql site_id)."""
+
+    bytes_: bytes = b"\x00" * 16
+
+    def __post_init__(self):
+        if len(self.bytes_) != 16:
+            raise ValueError("ActorId must be 16 bytes")
+
+    @classmethod
+    def random(cls) -> "ActorId":
+        return cls(uuid.uuid4().bytes)
+
+    @classmethod
+    def from_hex(cls, s: str) -> "ActorId":
+        return cls(bytes.fromhex(s))
+
+    def hex(self) -> str:
+        return self.bytes_.hex()
+
+    def short(self) -> str:
+        return self.bytes_.hex()[:8]
+
+    def __repr__(self) -> str:
+        return f"ActorId({self.short()})"
+
+    def __bool__(self) -> bool:
+        return self.bytes_ != b"\x00" * 16
+
+
+@dataclass(frozen=True, order=True)
+class ClusterId:
+    """u16 cluster discriminator (reference `actor.rs:222`)."""
+
+    value: int = 0
+
+
+@dataclass(frozen=True)
+class Actor:
+    """A cluster member as carried by SWIM (reference `actor.rs:133`)."""
+
+    id: ActorId
+    addr: str  # "host:port" gossip address
+    ts: int = 0  # HLC timestamp of identity creation/renewal
+    cluster_id: ClusterId = ClusterId(0)
+
+    def renew(self, ts: int) -> "Actor":
+        """Fresh identity so a down node can rejoin (reference `actor.rs:199`)."""
+        return Actor(self.id, self.addr, ts, self.cluster_id)
+
+
+# ---------------------------------------------------------------------------
+# Changes
+
+
+# Row-deletion sentinel column id.  cr-sqlite uses a special cid for row
+# deletes; we use this marker (doc/crdts.md:84 — causal length `cl` tracks
+# delete/resurrect; even cl = deleted).
+DELETE_SENTINEL = "__crdt_del"
+# Pk-only row creation (INSERT with only the primary key, no other columns).
+PKONLY_SENTINEL = "__crdt_pko"
+
+
+@dataclass(frozen=True)
+class Change:
+    """One column-level CRDT change (reference `change.rs:20`, crsql_changes row).
+
+    ``pk`` is the encoded primary key (opaque bytes on the wire);
+    ``cl`` is the causal length: odd = row alive, even = row deleted.
+    """
+
+    table: str
+    pk: bytes
+    cid: str
+    val: SqliteValue
+    col_version: int
+    db_version: int
+    seq: int
+    site_id: ActorId
+    cl: int = 1
+
+    def estimated_byte_size(self) -> int:
+        """Rough wire-size estimate used for chunking (reference
+        `change.rs:100-130` estimate_bytes)."""
+        v = self.val
+        if v is None:
+            vsz = 1
+        elif isinstance(v, (int, float)):
+            vsz = 8
+        elif isinstance(v, str):
+            vsz = len(v.encode("utf-8"))
+        else:
+            vsz = len(v)
+        return (
+            len(self.table)
+            + len(self.pk)
+            + len(self.cid)
+            + vsz
+            + 8 * 4  # col_version, db_version, seq, cl
+            + 16  # site_id
+        )
+
+
+class ChangesetPart(Enum):
+    FULL = "full"
+    PARTIAL = "partial"
+    EMPTY = "empty"
+
+
+@dataclass(frozen=True)
+class Changeset:
+    """A (possibly partial) set of changes for one (actor, db_version)
+    (reference `broadcast.rs:128` `Changeset::{Empty,Full}` / ChangeV1).
+
+    - FULL: ``changes`` carries the seq range ``seqs``; ``last_seq`` is the
+      final seq of the originating transaction — when ``seqs`` spans 0..last_seq
+      the version is complete.
+    - EMPTY: versions known-cleared (compacted); carries no changes.
+    """
+
+    actor_id: ActorId
+    version: int  # db_version (lo of `versions` for EMPTY ranges)
+    changes: Tuple[Change, ...] = ()
+    seqs: Range = (0, 0)
+    last_seq: int = 0
+    ts: int = 0
+    part: ChangesetPart = ChangesetPart.FULL
+    # EMPTY uses an inclusive version range (cleared compaction)
+    versions_hi: Optional[int] = None
+
+    def is_complete(self) -> bool:
+        return self.part is ChangesetPart.EMPTY or (
+            self.seqs[0] == 0 and self.seqs[1] == self.last_seq
+        )
+
+    @property
+    def versions(self) -> Range:
+        return (self.version, self.versions_hi if self.versions_hi is not None else self.version)
+
+    def processing_cost(self) -> int:
+        """Ingest batching cost (reference `broadcast.rs:182-193`)."""
+        if self.part is ChangesetPart.EMPTY:
+            lo, hi = self.versions
+            return min(hi - lo + 1, 20)
+        return len(self.changes)
+
+
+class ChangeSource(Enum):
+    BROADCAST = "broadcast"
+    SYNC = "sync"
+
+
+# ---------------------------------------------------------------------------
+# Sync protocol
+
+
+@dataclass(frozen=True)
+class SyncNeed:
+    """One need entry (reference `sync.rs:253` SyncNeedV1)."""
+
+    kind: str  # "full" | "partial" | "empty"
+    versions: Range = (0, 0)  # for full
+    version: int = 0  # for partial
+    seqs: Tuple[Range, ...] = ()  # for partial
+    ts: Optional[int] = None  # for empty
+
+    @classmethod
+    def full(cls, lo: int, hi: int) -> "SyncNeed":
+        return cls(kind="full", versions=(lo, hi))
+
+    @classmethod
+    def partial(cls, version: int, seqs: List[Range]) -> "SyncNeed":
+        return cls(kind="partial", version=version, seqs=tuple(seqs))
+
+    def count(self) -> int:
+        """Reference `sync.rs:267-273`."""
+        if self.kind == "full":
+            return self.versions[1] - self.versions[0] + 1
+        return 1
+
+
+@dataclass
+class SyncState:
+    """A node's replication frontier advertisement (reference `sync.rs:80`
+    SyncStateV1): per-origin heads, needed version ranges, and partial
+    (seq-gapped) versions."""
+
+    actor_id: ActorId = field(default_factory=ActorId)
+    heads: Dict[ActorId, int] = field(default_factory=dict)
+    need: Dict[ActorId, List[Range]] = field(default_factory=dict)
+    partial_need: Dict[ActorId, Dict[int, List[Range]]] = field(default_factory=dict)
+    last_cleared_ts: Optional[int] = None
+
+    def need_len(self) -> int:
+        """Reference `sync.rs:90-109`."""
+        full = sum(hi - lo + 1 for v in self.need.values() for lo, hi in v)
+        partial = sum(
+            hi - lo + 1
+            for partials in self.partial_need.values()
+            for ranges in partials.values()
+            for lo, hi in ranges
+        )
+        return full + partial // 50
+
+    def need_len_for_actor(self, actor_id: ActorId) -> int:
+        """Reference `sync.rs:111-125`."""
+        return sum(hi - lo + 1 for lo, hi in self.need.get(actor_id, ())) + len(
+            self.partial_need.get(actor_id, {})
+        )
+
+
+# ---------------------------------------------------------------------------
+# Gossip payloads (the transport-seam messages; reference broadcast.rs:40-148)
+
+
+@dataclass(frozen=True)
+class BroadcastV1:
+    """Uni-stream gossip payload: a changeset being disseminated."""
+
+    changeset: Changeset
+
+
+@dataclass(frozen=True)
+class SwimPayload:
+    """Datagram payload: opaque SWIM bytes (the reference hands Foca's bytes
+    straight to the wire; our host SWIM does the same)."""
+
+    data: bytes
+
+
+class MemberEventKind(Enum):
+    UP = "up"
+    DOWN = "down"

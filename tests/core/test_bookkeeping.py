@@ -1,0 +1,156 @@
+"""Gap-algebra spec test, ported from reference
+`corro-types/src/agent.rs:1605-1922` (`test_booked_insert_db`).
+
+The reference persists gaps to the `__corro_bookkeeping_gaps` SQLite table
+(PK (actor_id, start), so scans come back start-ordered); our sink here is a
+dict keyed the same way, checked after every insert exactly like the
+reference's `expect_gaps`."""
+
+from corrosion_tpu.core.bookkeeping import BookedVersions, PartialVersion
+from corrosion_tpu.core.intervals import RangeSet
+from corrosion_tpu.core.types import ActorId
+
+
+class DictSink:
+    """Stand-in for the gaps table; enforces the reference's invariants:
+    deletions must hit exactly one stored row, insertions must not collide."""
+
+    def __init__(self):
+        self.rows = {}  # (actor, start) -> end
+
+    def delete_gap(self, actor_id, lo, hi):
+        assert self.rows.pop((actor_id, lo), None) == hi, (
+            f"ineffective deletion of gap {lo}..={hi}"
+        )
+
+    def insert_gap(self, actor_id, lo, hi):
+        assert (actor_id, lo) not in self.rows, f"already had gaps entry at {lo}"
+        self.rows[(actor_id, lo)] = hi
+
+    def sorted_gaps(self):
+        return [(lo, hi) for (_, lo), hi in sorted(self.rows.items())]
+
+
+def insert_everywhere(sink, bv, all_versions, versions):
+    for r in versions:
+        all_versions.insert(*r)
+    snap = bv.snapshot()
+    snap.insert_db(sink, RangeSet(versions))
+    bv.commit_snapshot(snap)
+
+
+def expect_gaps(sink, bv, all_versions, expected):
+    assert sink.sorted_gaps() == expected
+    for r in all_versions:
+        assert bv.contains_all(r, None)
+    for lo, hi in expected:
+        for v in range(lo, hi + 1):
+            assert not bv.contains(v, None), f"expected not to contain {v}"
+            assert bv.needed().contains(v), f"expected needed to contain {v}"
+    assert bv.last() == all_versions.last(), "expected last version not to increment"
+
+
+def test_booked_insert_db():
+    actor_id = ActorId()
+
+    sink = DictSink()
+    bv = BookedVersions(actor_id)
+    all_v = RangeSet()
+
+    insert_everywhere(sink, bv, all_v, [(1, 20)])
+    expect_gaps(sink, bv, all_v, [])
+
+    insert_everywhere(sink, bv, all_v, [(1, 10)])
+    expect_gaps(sink, bv, all_v, [])
+
+    # from an empty state again
+    sink = DictSink()
+    bv = BookedVersions(actor_id)
+    all_v = RangeSet()
+
+    # create 2..=3 gap
+    insert_everywhere(sink, bv, all_v, [(1, 1), (4, 4)])
+    expect_gaps(sink, bv, all_v, [(2, 3)])
+
+    # fill gap
+    insert_everywhere(sink, bv, all_v, [(3, 3), (2, 2)])
+    expect_gaps(sink, bv, all_v, [])
+
+    # from an empty state again
+    sink = DictSink()
+    bv = BookedVersions(actor_id)
+    all_v = RangeSet()
+
+    # insert a non-1 first version
+    insert_everywhere(sink, bv, all_v, [(5, 20)])
+    expect_gaps(sink, bv, all_v, [(1, 4)])
+
+    # further change not overlapping a gap
+    insert_everywhere(sink, bv, all_v, [(6, 7)])
+    expect_gaps(sink, bv, all_v, [(1, 4)])
+
+    # further change overlapping a gap
+    insert_everywhere(sink, bv, all_v, [(3, 7)])
+    expect_gaps(sink, bv, all_v, [(1, 2)])
+
+    insert_everywhere(sink, bv, all_v, [(1, 2)])
+    expect_gaps(sink, bv, all_v, [])
+
+    insert_everywhere(sink, bv, all_v, [(25, 25)])
+    expect_gaps(sink, bv, all_v, [(21, 24)])
+
+    insert_everywhere(sink, bv, all_v, [(30, 35)])
+    expect_gaps(sink, bv, all_v, [(21, 24), (26, 29)])
+
+    # overlapping partially from the end
+    insert_everywhere(sink, bv, all_v, [(19, 22)])
+    expect_gaps(sink, bv, all_v, [(23, 24), (26, 29)])
+
+    # overlapping partially from the start
+    insert_everywhere(sink, bv, all_v, [(24, 25)])
+    expect_gaps(sink, bv, all_v, [(23, 23), (26, 29)])
+
+    # overlapping 2 ranges
+    insert_everywhere(sink, bv, all_v, [(23, 27)])
+    expect_gaps(sink, bv, all_v, [(28, 29)])
+
+    # ineffective insert of already known ranges
+    insert_everywhere(sink, bv, all_v, [(1, 20)])
+    expect_gaps(sink, bv, all_v, [(28, 29)])
+
+    # overlapping no ranges, but encompassing a full range
+    insert_everywhere(sink, bv, all_v, [(27, 30)])
+    expect_gaps(sink, bv, all_v, [])
+
+    # touching multiple ranges, partially
+    insert_everywhere(sink, bv, all_v, [(40, 45)])  # creates gap 36..=39
+    insert_everywhere(sink, bv, all_v, [(50, 55)])  # creates gap 46..=49
+    insert_everywhere(sink, bv, all_v, [(38, 47)])
+    expect_gaps(sink, bv, all_v, [(36, 37), (48, 49)])
+
+    # rebuild from the persisted sink state ("from_conn" equivalence)
+    bv2 = BookedVersions(actor_id)
+    snap = bv2.snapshot()
+    snap.insert_gaps(sink.sorted_gaps())
+    snap.max = 55
+    bv2.commit_snapshot(snap)
+    assert bv2.needed() == bv.needed()
+    assert bv2.last() == bv.last()
+
+
+def test_partials():
+    actor = ActorId()
+    bv = BookedVersions(actor)
+    p = bv.insert_partial(5, PartialVersion(seqs=RangeSet([(0, 10)]), last_seq=100))
+    assert not p.is_complete()
+    assert bv.last() == 5
+    assert bv.get_partial(5) is not None
+    # merging more seqs
+    p = bv.insert_partial(5, PartialVersion(seqs=RangeSet([(11, 100)]), last_seq=100))
+    assert p.is_complete()
+    assert p.gap_list() == []
+    # contains() with seq ranges consults the partial
+    snap = bv.snapshot()
+    snap.insert_db(__import__("corrosion_tpu.core.bookkeeping", fromlist=["NULL_SINK"]).NULL_SINK, RangeSet([(5, 5)]))
+    bv.commit_snapshot(snap)
+    assert bv.contains(5, (0, 100))

@@ -1,0 +1,55 @@
+"""Chunker spec test, ported from reference
+`corro-types/src/change.rs:266-401` (`test_change_chunker`)."""
+
+from corrosion_tpu.core.changes import ChunkedChanges
+from corrosion_tpu.core.types import ActorId, Change
+
+
+def mk(seq):
+    return Change(
+        table="", pk=b"", cid="", val=None,
+        col_version=0, db_version=0, seq=seq, site_id=ActorId(), cl=0,
+    )
+
+
+def test_change_chunker():
+    # empty iterator
+    chunks = list(ChunkedChanges([], 0, 100, 50))
+    assert chunks == [([], (0, 100))]
+
+    changes = [mk(seq) for seq in range(100)]
+    sz = changes[0].estimated_byte_size()
+
+    # 2 iterations
+    chunks = list(
+        ChunkedChanges([changes[0], changes[1], changes[2]], 0, 100, 2 * sz)
+    )
+    assert chunks == [
+        ([changes[0], changes[1]], (0, 1)),
+        ([changes[2]], (2, 100)),
+    ]
+
+    # last_seq reached: stop early even with more rows buffered
+    chunks = list(ChunkedChanges([changes[0], changes[1]], 0, 0, sz))
+    assert chunks == [([changes[0]], (0, 0))]
+
+    # gaps absorbed into a single chunk
+    chunks = list(ChunkedChanges([changes[0], changes[2]], 0, 100, 2 * sz))
+    assert chunks == [([changes[0], changes[2]], (0, 100))]
+
+    # gaps, everything fits
+    chunks = list(
+        ChunkedChanges(
+            [changes[2], changes[4], changes[7], changes[8]], 0, 100, 100000
+        )
+    )
+    assert chunks == [([changes[2], changes[4], changes[7], changes[8]], (0, 100))]
+
+    # gaps, split in two
+    chunks = list(
+        ChunkedChanges([changes[2], changes[4], changes[7], changes[8]], 0, 10, 2 * sz)
+    )
+    assert chunks == [
+        ([changes[2], changes[4]], (0, 4)),
+        ([changes[7], changes[8]], (5, 10)),
+    ]

@@ -1,0 +1,40 @@
+"""HLC tests: monotonicity, remote merge, drift guard
+(reference setup.rs:101-106: ±300 ms max delta)."""
+
+import pytest
+
+from corrosion_tpu.core.hlc import (
+    HLC,
+    ClockDriftError,
+    ntp64_from_unix_ns,
+    ntp64_to_unix_ns,
+)
+
+
+def test_ntp64_roundtrip():
+    for ns in [0, 1, 1_000_000_000, 1_721_000_000_123_456_789]:
+        assert abs(ntp64_to_unix_ns(ntp64_from_unix_ns(ns)) - ns) < 2
+
+
+def test_monotonic_even_with_frozen_wall_clock():
+    t = [1_000_000_000_000]
+    clock = HLC(_now_ns=lambda: t[0])
+    stamps = [clock.now() for _ in range(100)]
+    assert stamps == sorted(set(stamps)), "timestamps must be strictly increasing"
+
+
+def test_update_advances_past_remote():
+    t = [1_000_000_000_000]
+    clock = HLC(_now_ns=lambda: t[0])
+    local = clock.now()
+    remote = local + 1000  # slightly ahead, within drift
+    clock.update(remote)
+    assert clock.now() > remote
+
+
+def test_update_rejects_large_drift():
+    t = [1_000_000_000_000]
+    clock = HLC(_now_ns=lambda: t[0])
+    too_far = ntp64_from_unix_ns(t[0] + 10_000_000_000)  # 10 s ahead
+    with pytest.raises(ClockDriftError):
+        clock.update(too_far)

@@ -1,0 +1,81 @@
+"""RangeSet algebra tests — semantics must match rangemap::RangeInclusiveSet
+as used by the reference (coalescing adjacency, splitting removes, gaps)."""
+
+from corrosion_tpu.core.intervals import RangeSet
+
+
+def test_insert_coalesces_overlapping_and_adjacent():
+    rs = RangeSet()
+    rs.insert(1, 3)
+    rs.insert(5, 7)
+    assert list(rs) == [(1, 3), (5, 7)]
+    rs.insert(4, 4)  # adjacent on both sides -> one range
+    assert list(rs) == [(1, 7)]
+    rs.insert(7, 10)  # overlapping
+    assert list(rs) == [(1, 10)]
+    rs.insert(12, 12)
+    assert list(rs) == [(1, 10), (12, 12)]
+    rs.insert(11, 11)
+    assert list(rs) == [(1, 12)]
+
+
+def test_remove_splits():
+    rs = RangeSet([(1, 10)])
+    rs.remove(4, 6)
+    assert list(rs) == [(1, 3), (7, 10)]
+    rs.remove(1, 1)
+    assert list(rs) == [(2, 3), (7, 10)]
+    rs.remove(8, 20)
+    assert list(rs) == [(2, 3), (7, 7)]
+    rs.remove(0, 100)
+    assert list(rs) == []
+
+
+def test_remove_noop_outside():
+    rs = RangeSet([(5, 8)])
+    rs.remove(1, 4)
+    rs.remove(9, 12)
+    assert list(rs) == [(5, 8)]
+
+
+def test_get_contains():
+    rs = RangeSet([(2, 4), (8, 9)])
+    assert rs.get(3) == (2, 4)
+    assert rs.get(8) == (8, 9)
+    assert rs.get(5) is None
+    assert rs.contains(2) and rs.contains(9)
+    assert not rs.contains(1) and not rs.contains(7)
+
+
+def test_overlapping():
+    rs = RangeSet([(1, 3), (5, 7), (10, 12)])
+    assert list(rs.overlapping(3, 10)) == [(1, 3), (5, 7), (10, 12)]
+    assert list(rs.overlapping(4, 4)) == []
+    assert list(rs.overlapping(8, 9)) == []
+    assert list(rs.overlapping(6, 6)) == [(5, 7)]
+
+
+def test_gaps():
+    rs = RangeSet([(3, 5), (8, 9)])
+    assert list(rs.gaps(1, 12)) == [(1, 2), (6, 7), (10, 12)]
+    assert list(rs.gaps(3, 9)) == [(6, 7)]
+    assert list(rs.gaps(4, 8)) == [(6, 7)]
+    assert list(RangeSet().gaps(1, 5)) == [(1, 5)]
+    full = RangeSet([(0, 100)])
+    assert list(full.gaps(0, 100)) == []
+
+
+def test_covers_and_span():
+    rs = RangeSet([(1, 5), (7, 8)])
+    assert rs.covers(2, 5)
+    assert not rs.covers(4, 7)
+    assert rs.span_count() == 7
+    assert rs.first() == 1 and rs.last() == 8
+
+
+def test_copy_independent():
+    rs = RangeSet([(1, 5)])
+    c = rs.copy()
+    c.remove(1, 5)
+    assert list(rs) == [(1, 5)]
+    assert list(c) == []

@@ -1,0 +1,63 @@
+"""Need-computation spec test, ported from reference
+`corro-types/src/sync.rs:386-500` (`test_compute_available_needs`).
+Every assertion mirrors the original exactly."""
+
+from corrosion_tpu.core.sync import compute_available_needs
+from corrosion_tpu.core.types import ActorId, SyncNeed, SyncState
+
+
+def test_compute_available_needs():
+    actor1 = ActorId.random()
+
+    ours = SyncState()
+    ours.heads[actor1] = 10
+
+    other = SyncState()
+    other.heads[actor1] = 13
+
+    assert compute_available_needs(ours, other) == {
+        actor1: [SyncNeed.full(11, 13)]
+    }
+
+    ours.need.setdefault(actor1, []).append((2, 5))
+    ours.need.setdefault(actor1, []).append((7, 7))
+
+    assert compute_available_needs(ours, other) == {
+        actor1: [
+            SyncNeed.full(2, 5),
+            SyncNeed.full(7, 7),
+            SyncNeed.full(11, 13),
+        ]
+    }
+
+    ours.partial_need[actor1] = {9: [(100, 120), (130, 132)]}
+
+    assert compute_available_needs(ours, other) == {
+        actor1: [
+            SyncNeed.full(2, 5),
+            SyncNeed.full(7, 7),
+            SyncNeed.partial(9, [(100, 120), (130, 132)]),
+            SyncNeed.full(11, 13),
+        ]
+    }
+
+    other.partial_need[actor1] = {9: [(100, 110), (130, 130)]}
+
+    assert compute_available_needs(ours, other) == {
+        actor1: [
+            SyncNeed.full(2, 5),
+            SyncNeed.full(7, 7),
+            SyncNeed.partial(9, [(111, 120), (131, 132)]),
+            SyncNeed.full(11, 13),
+        ]
+    }
+
+
+def test_own_actor_and_zero_head_skipped():
+    me = ActorId.random()
+    peer = ActorId.random()
+    ours = SyncState(actor_id=me)
+    other = SyncState(actor_id=peer)
+    other.heads[me] = 50  # their view of us: never request our own origin
+    other.heads[peer] = 0  # zero head: ignored
+    assert compute_available_needs(ours, other) == {}
