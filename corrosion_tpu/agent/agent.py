@@ -1,0 +1,625 @@
+"""The host agent runtime: local writes → broadcast; gossip receive → ingest;
+periodic anti-entropy sync.
+
+Rebuild of the reference's corro-agent runtime re-architected for asyncio:
+
+- local commit path = `make_broadcastable_changes` + `broadcast_changes`
+  (api/public/mod.rs:53-138, broadcast.rs:511-579);
+- `handle_changes` ingest loop with dedup, known-version check, rebroadcast
+  decision, queue-overflow drop (agent/handlers.rs:548-786);
+- partial/buffered change tracking (`process_incomplete_version` /
+  `process_fully_buffered_changes`, agent/util.rs:487-1303);
+- broadcast dissemination with ring-0-first fan-out, max_transmissions decay
+  and 500 ms flush (broadcast/mod.rs:410-1042);
+- anti-entropy `sync_loop`/`parallel_sync`/`serve_sync` with need
+  computation (api/peer/mod.rs:1003-1649, util.rs:347-393).
+
+SWIM membership rides the datagram verb (corrosion_tpu.agent.swim); with it
+disabled membership is static (bootstrap list), which is the M1 slice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.bookkeeping import PartialVersion
+from ..core.changes import ChunkedChanges
+from ..core.intervals import RangeSet
+from ..core.sync import compute_available_needs, generate_sync
+from ..core.types import (
+    Actor,
+    ActorId,
+    Change,
+    ChangeSource,
+    Changeset,
+    ChangesetPart,
+    SyncNeed,
+)
+from ..core.hlc import HLC, ClockDriftError
+from ..utils.backoff import Backoff
+from . import codec
+from .bookie import Bookie
+from .config import Config
+from .members import Members
+from .store import CommitInfo, CrrStore
+from .transport import BiStream, Transport
+
+
+@dataclass
+class _PendingBroadcast:
+    frame: bytes
+    send_count: int = 0
+    is_local: bool = True
+
+
+class Agent:
+    """One node: storage + bookkeeping + gossip runtime."""
+
+    def __init__(self, config: Config, transport: Transport):
+        self.config = config
+        self.clock = HLC()
+        self.store = CrrStore(config.db_path, ActorId.random(), self.clock)
+        self.actor_id = self.store.site_id
+        self.bookie = Bookie(self.store)
+        self.members = Members(self.actor_id)
+        self.transport = transport
+        transport.set_handlers(self._on_datagram, self._on_uni, self._on_bi)
+
+        self._bcast_q: deque = deque()  # _PendingBroadcast
+        self._ingest_q: asyncio.Queue = asyncio.Queue()
+        self._seen: OrderedDict = OrderedDict()  # dedup cache (handlers.rs:671)
+        self._sync_inbound = 0
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+        self._rng = random.Random(self.actor_id.bytes_)
+        self.swim = None  # attached by SwimRuntime.attach()
+        # metrics counters (metrics facade analog)
+        self.stats = {
+            "changes_committed": 0, "changes_applied": 0, "changes_deduped": 0,
+            "broadcasts_sent": 0, "broadcasts_recv": 0, "sync_rounds": 0,
+            "ingest_dropped": 0, "empties_recv": 0,
+        }
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self):
+        for path in self.config.schema_paths:
+            from ..utils.files import read_sql_files
+
+            for sql in read_sql_files(path):
+                self.store.execute_schema(sql)
+        # static bootstrap membership (M1; SWIM replaces this when attached)
+        for i, addr in enumerate(self.config.bootstrap):
+            if addr != self.transport.addr:
+                self.members.add_member(
+                    Actor(id=ActorId(bytes([0] * 15 + [i + 1])), addr=addr, ts=0)
+                )
+        self._tasks.append(asyncio.create_task(self._broadcast_loop()))
+        self._tasks.append(asyncio.create_task(self._ingest_loop()))
+        self._tasks.append(asyncio.create_task(self._sync_loop()))
+
+    async def stop(self):
+        self._stopped.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.transport.close()
+        self.store.close()
+
+    # -- write path (L10 → L6) -------------------------------------------
+
+    def exec_transaction(
+        self, statements: Sequence[Tuple[str, Sequence]]
+    ) -> Optional[CommitInfo]:
+        """Apply local writes and queue the changeset for broadcast
+        (reference api_v1_transactions → make_broadcastable_changes)."""
+        return self.exec_transaction_cursors(statements)[1]
+
+    def exec_transaction_cursors(self, statements: Sequence[Tuple[str, Sequence]]):
+        booked = self.bookie.for_actor(self.actor_id)
+        snap = booked.snapshot()
+
+        def pre_commit(conn, info: CommitInfo):
+            self.bookie.record_versions(
+                self.actor_id, snap, RangeSet([(info.db_version, info.db_version)])
+            )
+
+        cursors, info = self.store.transact(statements, pre_commit=pre_commit)
+        if info is None:
+            return cursors, None
+        booked.commit_snapshot(snap)
+        self.stats["changes_committed"] += info.last_seq + 1
+        self._queue_local_broadcast(info)
+        return cursors, info
+
+    def _queue_local_broadcast(self, info: CommitInfo):
+        """Chunk the committed version and queue frames (broadcast_changes,
+        broadcast.rs:511-579)."""
+        changes = self.store.changes_for_version(self.actor_id, info.db_version)
+        for chunk, seqs in ChunkedChanges(
+            changes, 0, info.last_seq, self.config.perf.max_changes_byte_size
+        ):
+            cs = Changeset(
+                actor_id=self.actor_id,
+                version=info.db_version,
+                changes=tuple(chunk),
+                seqs=seqs,
+                last_seq=info.last_seq,
+                ts=info.ts,
+                part=ChangesetPart.FULL,
+            )
+            frame = codec.encode_message(
+                "bcast", codec.encode_changeset(cs), ts=self.clock.now()
+            )
+            self._bcast_q.append(_PendingBroadcast(frame=frame, is_local=True))
+
+    # -- broadcast dissemination (L6) ------------------------------------
+
+    async def _broadcast_loop(self):
+        """Flush tick: ring0 first for local payloads, then random fan-out,
+        decrementing a per-payload transmission budget
+        (broadcast/mod.rs:589-778)."""
+        perf = self.config.perf
+        interval = perf.broadcast_flush_interval_s
+        while not self._stopped.is_set():
+            await asyncio.sleep(interval)
+            budget = perf.broadcast_rate_limit_bytes_s * interval
+            requeue = []
+            while self._bcast_q and budget > 0:
+                item = self._bcast_q.popleft()
+                targets = self._choose_targets(item)
+                for st in targets:
+                    try:
+                        await self.transport.send_uni(st.addr, item.frame)
+                        self.stats["broadcasts_sent"] += 1
+                        budget -= len(item.frame)
+                    except (ConnectionError, OSError):
+                        continue
+                item.send_count += 1
+                if targets and item.send_count < perf.swim_max_transmissions:
+                    requeue.append(item)
+            # re-queue with remaining budget; overflow drops most-sent-oldest
+            self._bcast_q.extend(requeue)
+            cap = perf.broadcast_max_inflight
+            while len(self._bcast_q) > cap:
+                self._bcast_q.remove(
+                    max(self._bcast_q, key=lambda it: it.send_count)
+                )
+
+    def _choose_targets(self, item: _PendingBroadcast):
+        members = self.members.up_members()
+        if not members:
+            return []
+        perf = self.config.perf
+        chosen: dict = {}
+        if item.is_local and item.send_count == 0:
+            for st in self.members.ring0():
+                chosen[st.actor.id] = st
+        rest = [st for st in members if st.actor.id not in chosen]
+        # choose_count formula, broadcast/mod.rs:653-680
+        n = max(
+            perf.swim_num_indirect_probes,
+            len(rest) // (perf.swim_max_transmissions * 10),
+        )
+        for st in self._rng.sample(rest, min(n, len(rest))):
+            chosen[st.actor.id] = st
+        return list(chosen.values())
+
+    # -- receive path (L8) ------------------------------------------------
+
+    async def _on_datagram(self, src: str, data: bytes):
+        if self.swim is not None:
+            await self.swim.handle_datagram(src, data)
+
+    async def _on_uni(self, src: str, data: bytes):
+        kind, body, ts = codec.decode_message(data)
+        if kind != "bcast":
+            return
+        if ts is not None:
+            try:
+                self.clock.update(ts)
+            except ClockDriftError:
+                return
+        cs = codec.decode_changeset(body)
+        self.stats["broadcasts_recv"] += 1
+        await self._enqueue_changeset(cs, ChangeSource.BROADCAST, raw=data)
+
+    async def _enqueue_changeset(
+        self, cs: Changeset, source: ChangeSource, raw: Optional[bytes] = None
+    ):
+        """handle_changes front half (handlers.rs:548-786): self-skip, dedup,
+        known-check, overflow drop, rebroadcast decision."""
+        if cs.actor_id == self.actor_id:
+            return
+        key = (cs.actor_id, cs.versions, cs.seqs, cs.part)
+        if key in self._seen:
+            self.stats["changes_deduped"] += 1
+            return
+        booked = self.bookie.for_actor(cs.actor_id)
+        seqs = cs.seqs if cs.part is ChangesetPart.FULL else None
+        if booked.contains_all(cs.versions, seqs):
+            self.stats["changes_deduped"] += 1
+            return  # already known: stop disseminating
+        self._seen[key] = True
+        if len(self._seen) > 4096:
+            self._seen.popitem(last=False)
+        if self._ingest_q.qsize() >= self.config.perf.changes_queue_cap:
+            # overflow: drop oldest (handlers.rs:729-749)
+            try:
+                self._ingest_q.get_nowait()
+                self.stats["ingest_dropped"] += 1
+            except asyncio.QueueEmpty:
+                pass
+        await self._ingest_q.put(cs)
+        if source is ChangeSource.BROADCAST and cs.changes and raw is not None:
+            # epidemic relay (handlers.rs:768-779)
+            self._bcast_q.append(
+                _PendingBroadcast(frame=raw, send_count=1, is_local=False)
+            )
+
+    async def _ingest_loop(self):
+        """Batched apply (process_multiple_changes, util.rs:691-1037)."""
+        while not self._stopped.is_set():
+            cs = await self._ingest_q.get()
+            batch = [cs]
+            cost = cs.processing_cost()
+            while cost < self.config.perf.apply_queue_cost:
+                try:
+                    nxt = self._ingest_q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                batch.append(nxt)
+                cost += nxt.processing_cost()
+            try:
+                self._process_changesets(batch)
+            except Exception:  # keep the loop alive; reference logs + drops
+                import traceback
+
+                traceback.print_exc()
+
+    def _process_changesets(self, batch: List[Changeset]):
+        """One snapshot per origin actor for the whole batch, committed to
+        memory only after the data transaction lands (util.rs:691-1037,
+        892-932)."""
+        store = self.store
+        snaps: Dict[ActorId, Tuple] = {}  # actor -> (booked, snap)
+
+        def snap_for(actor_id: ActorId):
+            if actor_id not in snaps:
+                booked = self.bookie.for_actor(actor_id)
+                snaps[actor_id] = (booked, booked.snapshot())
+            return snaps[actor_id][1]
+
+        partials: List[Changeset] = []
+        store.begin_apply()
+        try:
+            for cs in batch:
+                snap = snap_for(cs.actor_id)
+                if cs.part is ChangesetPart.EMPTY:
+                    lo, hi = cs.versions
+                    self.bookie.record_versions(cs.actor_id, snap, RangeSet([(lo, hi)]))
+                    self.stats["empties_recv"] += 1
+                    continue
+                if snap.contains_all(cs.versions, cs.seqs):
+                    continue
+                # a version already tracked partial must go through the
+                # buffered-merge path even if this chunk claims completeness:
+                # a partial-need reply's last_seq only spans the served range,
+                # and the authoritative last_seq lives in our existing partial
+                if cs.is_complete() and snap.partials.get(cs.version) is None:
+                    impacted = store.apply_changes(cs.changes, in_tx=True)
+                    self.bookie.record_versions(
+                        cs.actor_id, snap, RangeSet([(cs.version, cs.version)])
+                    )
+                    snap.partials.pop(cs.version, None)
+                    self.bookie.clear_partial(cs.actor_id, cs.version)
+                    self._clear_buffered(cs.actor_id, cs.version)
+                    self.stats["changes_applied"] += impacted
+                else:
+                    # merge seq coverage into the snapshot so later chunks of
+                    # the same version in this batch aren't mistaken for known
+                    p = snap.partials.get(cs.version)
+                    if p is None:
+                        p = PartialVersion(
+                            seqs=RangeSet([cs.seqs]), last_seq=cs.last_seq, ts=cs.ts
+                        )
+                        snap.partials[cs.version] = p
+                    else:
+                        p.seqs.insert(*cs.seqs)
+                    self._buffer_rows(cs)
+                    self.bookie.persist_partial(cs.actor_id, cs.version, p)
+                    # version-level knowledge is recorded even when incomplete
+                    # (the reference insert_db's partial versions too,
+                    # util.rs:892-932); seq gaps ride partial_need instead
+                    self.bookie.record_versions(
+                        cs.actor_id, snap, RangeSet([(cs.version, cs.version)])
+                    )
+                    partials.append((cs.actor_id, cs.version))
+            store.end_apply(commit=True)
+        except Exception:
+            store.end_apply(commit=False)
+            raise
+        # in-memory bookkeeping only after the data commit succeeded
+        for booked, snap in snaps.values():
+            booked.commit_snapshot(snap)
+        for actor_id, version in dict.fromkeys(partials):
+            partial = self.bookie.for_actor(actor_id).get_partial(version)
+            if partial is not None and partial.is_complete():
+                self._apply_fully_buffered(actor_id, version)
+
+    def _buffer_rows(self, cs: Changeset):
+        """process_incomplete_version row staging (util.rs:1053-1186):
+        stash rows, applied only once every seq arrived."""
+        self.store.conn.executemany(
+            'INSERT OR REPLACE INTO __corro_buffered_changes '
+            '("table", pk, cid, val, col_version, db_version, seq, site_id, cl, ts) '
+            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            [
+                (ch.table, ch.pk, ch.cid, ch.val, ch.col_version, ch.db_version,
+                 ch.seq, ch.site_id.bytes_, ch.cl, cs.ts)
+                for ch in cs.changes
+            ],
+        )
+
+    def _apply_fully_buffered(self, actor_id: ActorId, version: int):
+        """process_fully_buffered_changes (util.rs:541-688)."""
+        conn = self.store.conn
+        rows = conn.execute(
+            'SELECT "table", pk, cid, val, col_version, db_version, seq, site_id, cl '
+            "FROM __corro_buffered_changes WHERE site_id = ? AND db_version = ? "
+            "ORDER BY seq",
+            (actor_id.bytes_, version),
+        ).fetchall()
+        changes = [
+            Change(
+                table=r[0], pk=r[1], cid=r[2], val=r[3], col_version=r[4],
+                db_version=r[5], seq=r[6], site_id=ActorId(r[7]), cl=r[8],
+            )
+            for r in rows
+        ]
+        booked = self.bookie.for_actor(actor_id)
+        self.store.begin_apply()
+        try:
+            impacted = self.store.apply_changes(changes, in_tx=True)
+            snap = booked.snapshot()
+            self.bookie.record_versions(actor_id, snap, RangeSet([(version, version)]))
+            self.bookie.clear_partial(actor_id, version)
+            self._clear_buffered(actor_id, version)
+            self.store.end_apply(commit=True)
+        except Exception:
+            self.store.end_apply(commit=False)
+            raise
+        booked.commit_snapshot(snap)
+        booked.partials.pop(version, None)
+        self.stats["changes_applied"] += impacted
+
+    def _clear_buffered(self, actor_id: ActorId, version: int):
+        self.store.conn.execute(
+            "DELETE FROM __corro_buffered_changes WHERE site_id = ? AND db_version = ?",
+            (actor_id.bytes_, version),
+        )
+
+    # -- anti-entropy sync (L7) -------------------------------------------
+
+    def sync_state(self):
+        return generate_sync(self.bookie.by_actor, self.actor_id)
+
+    async def _sync_loop(self):
+        """Periodic client-side sync with decorrelated backoff
+        (util.rs:347-393, handlers.rs:793-894)."""
+        perf = self.config.perf
+        backoff = Backoff(
+            perf.sync_backoff_min_s, perf.sync_backoff_max_s, rng=self._rng
+        )
+        while not self._stopped.is_set():
+            await asyncio.sleep(next(backoff))
+            try:
+                synced = await self.parallel_sync()
+                if synced:
+                    backoff.reset()
+            except Exception:
+                continue
+
+    def _choose_sync_peers(self) -> List:
+        """(candidates/100).clamp(3,10) peers, need-first then rtt ring
+        (handlers.rs:808-863)."""
+        candidates = self.members.up_members()
+        if not candidates:
+            return []
+        state = self.sync_state()
+        desired = max(3, min(10, len(candidates) // 100 or 3))
+        pool = self._rng.sample(candidates, min(len(candidates), desired * 2))
+        pool.sort(key=lambda st: (-state.need_len_for_actor(st.actor.id), st.ring or 0))
+        return pool[:desired]
+
+    async def parallel_sync(self) -> int:
+        """One client sync round against chosen peers (peer/mod.rs:1003-1403).
+        Returns number of changesets ingested."""
+        peers = self._choose_sync_peers()
+        if not peers:
+            return 0
+        self.stats["sync_rounds"] += 1
+        results = await asyncio.gather(
+            *(self._sync_with(st.addr) for st in peers), return_exceptions=True
+        )
+        return sum(r for r in results if isinstance(r, int))
+
+    async def _sync_with(self, addr: str, timeout: float = 30.0) -> int:
+        ours = self.sync_state()
+        bi = await self.transport.open_bi(addr)
+        try:
+            await bi.send(
+                codec.encode_message(
+                    "sync_start", codec.encode_sync_state(ours), ts=self.clock.now()
+                )
+            )
+            frame = await bi.recv(timeout)
+            if not frame:
+                return 0
+            kind, body, ts = codec.decode_message(frame)
+            if kind == "sync_reject":
+                return 0
+            if kind != "sync_state":
+                return 0
+            if ts is not None:
+                try:
+                    self.clock.update(ts)
+                except ClockDriftError:
+                    return 0
+            theirs = codec.decode_sync_state(body)
+            needs = compute_available_needs(ours, theirs)
+            if not needs:
+                await bi.send(codec.encode_message("sync_request", {}))
+                return 0
+            await bi.send(codec.encode_message("sync_request", codec.encode_needs(needs)))
+            count = 0
+            while True:
+                frame = await bi.recv(timeout)
+                if not frame:
+                    break
+                kind, body, _ = codec.decode_message(frame)
+                if kind == "sync_done" or kind == "":
+                    break
+                if kind == "changeset":
+                    cs = codec.decode_changeset(body)
+                    await self._enqueue_changeset(cs, ChangeSource.SYNC)
+                    count += 1
+            return count
+        finally:
+            bi.close()
+
+    async def _on_bi(self, src: str, bi: BiStream):
+        """serve_sync (peer/mod.rs:1406-1649)."""
+        if self._sync_inbound >= self.config.perf.sync_max_concurrent_inbound:
+            await bi.send(codec.encode_message("sync_reject", "max_concurrency"))
+            bi.close()
+            return
+        self._sync_inbound += 1
+        try:
+            frame = await bi.recv(30.0)
+            if not frame:
+                return
+            kind, body, ts = codec.decode_message(frame)
+            if kind != "sync_start":
+                return
+            if ts is not None:
+                try:
+                    self.clock.update(ts)
+                except ClockDriftError:
+                    return
+            await bi.send(
+                codec.encode_message(
+                    "sync_state",
+                    codec.encode_sync_state(self.sync_state()),
+                    ts=self.clock.now(),
+                )
+            )
+            frame = await bi.recv(30.0)
+            if not frame:
+                return
+            kind, body, _ = codec.decode_message(frame)
+            if kind != "sync_request" or not body:
+                return
+            needs = codec.decode_needs(body)
+            for actor_id, need_list in needs.items():
+                for need in need_list:
+                    await self._serve_need(bi, actor_id, need)
+            await bi.send(codec.encode_message("sync_done", None))
+        except ConnectionError:
+            pass
+        finally:
+            self._sync_inbound -= 1
+            bi.close()
+
+    async def _serve_need(self, bi: BiStream, actor_id: ActorId, need: SyncNeed):
+        """handle_need (peer/mod.rs:371-790): stream chunked changesets,
+        newest version first; versions with no remaining rows are Cleared
+        (Empty changesets)."""
+        perf = self.config.perf
+        if need.kind == "full":
+            lo, hi = need.versions
+            by_version = self.store.changes_for_version_range(actor_id, lo, hi)
+            booked = self.bookie.for_actor(actor_id)
+            # versions we know but hold no rows for → cleared (Empty) runs,
+            # computed with range algebra instead of a per-version scan
+            known_hi = min(hi, booked.last() or 0)
+            empty_runs = RangeSet([(lo, known_hi)] if lo <= known_hi else [])
+            for glo, ghi in list(booked.needed().overlapping(lo, hi)):
+                empty_runs.remove(glo, ghi)
+            for v in by_version:
+                empty_runs.remove(v, v)
+            for version in sorted(by_version, reverse=True):  # newest first
+                changes = by_version[version]
+                last_seq = max(ch.seq for ch in changes)
+                for chunk, seqs in ChunkedChanges(
+                    changes, 0, last_seq, perf.max_changes_byte_size
+                ):
+                    cs = Changeset(
+                        actor_id=actor_id, version=version, changes=tuple(chunk),
+                        seqs=seqs, last_seq=last_seq, part=ChangesetPart.FULL,
+                    )
+                    await bi.send(
+                        codec.encode_message("changeset", codec.encode_changeset(cs))
+                    )
+            for elo, ehi in empty_runs:
+                cs = Changeset(
+                    actor_id=actor_id, version=elo, versions_hi=ehi,
+                    part=ChangesetPart.EMPTY,
+                )
+                await bi.send(
+                    codec.encode_message("changeset", codec.encode_changeset(cs))
+                )
+        elif need.kind == "partial":
+            version = need.version
+            for slo, shi in need.seqs:
+                changes = self.store.changes_for_version(
+                    actor_id, version, seq_range=(slo, shi)
+                )
+                changes += self._buffered_changes(actor_id, version, (slo, shi))
+                if not changes:
+                    continue
+                last_seq = self._partial_last_seq(actor_id, version, changes)
+                for chunk, seqs in ChunkedChanges(
+                    sorted(changes, key=lambda c: c.seq), slo, shi,
+                    perf.max_changes_byte_size,
+                ):
+                    cs = Changeset(
+                        actor_id=actor_id, version=version, changes=tuple(chunk),
+                        seqs=seqs, last_seq=last_seq, part=ChangesetPart.FULL,
+                    )
+                    await bi.send(
+                        codec.encode_message("changeset", codec.encode_changeset(cs))
+                    )
+
+    def _buffered_changes(
+        self, actor_id: ActorId, version: int, seq_range: Tuple[int, int]
+    ) -> List[Change]:
+        rows = self.store.conn.execute(
+            'SELECT "table", pk, cid, val, col_version, db_version, seq, site_id, cl '
+            "FROM __corro_buffered_changes WHERE site_id = ? AND db_version = ? "
+            "AND seq BETWEEN ? AND ? ORDER BY seq",
+            (actor_id.bytes_, version, seq_range[0], seq_range[1]),
+        ).fetchall()
+        return [
+            Change(
+                table=r[0], pk=r[1], cid=r[2], val=r[3], col_version=r[4],
+                db_version=r[5], seq=r[6], site_id=ActorId(r[7]), cl=r[8],
+            )
+            for r in rows
+        ]
+
+    def _partial_last_seq(
+        self, actor_id: ActorId, version: int, changes: List[Change]
+    ) -> int:
+        partial = self.bookie.for_actor(actor_id).get_partial(version)
+        if partial is not None:
+            return partial.last_seq
+        row = self.store.conn.execute(
+            "SELECT last_seq FROM __corro_seq_bookkeeping WHERE site_id = ? AND db_version = ? LIMIT 1",
+            (actor_id.bytes_, version),
+        ).fetchone()
+        return row[0] if row else max(ch.seq for ch in changes)

@@ -1,0 +1,143 @@
+"""Bookie: per-origin-actor version bookkeeping persisted in the store.
+
+Rebuild of the reference's `Booked`/`Bookie` (`corro-types/src/agent.rs:
+1446-1598`) minus the async lock machinery (our agent runs one asyncio loop
+per node; SQLite writes are already serialized by the store's writer lock).
+Persists to the same tables the reference uses: `__corro_bookkeeping_gaps`
+(gap algebra, via the GapsSink hook) and `__corro_seq_bookkeeping`
+(partial seq ranges), and mirrors per-site max versions in
+`__crdt_db_versions` (the crsql_db_versions analog) so state survives reboot
+(checkpoint/resume is "reload from tables", SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Dict, Iterable, Optional
+
+from ..core.bookkeeping import BookedVersions, PartialVersion, VersionsSnapshot
+from ..core.intervals import RangeSet
+from ..core.types import ActorId
+from .store import CrrStore
+
+
+class SqliteGapsSink:
+    """GapsSink writing `__corro_bookkeeping_gaps` rows inside the caller's
+    transaction (reference agent.rs:1119-1162)."""
+
+    def __init__(self, conn: sqlite3.Connection):
+        self.conn = conn
+
+    def delete_gap(self, actor_id: ActorId, lo: int, hi: int) -> None:
+        cur = self.conn.execute(
+            "DELETE FROM __corro_bookkeeping_gaps WHERE actor_id = ? AND start = ? AND end = ?",
+            (actor_id.bytes_, lo, hi),
+        )
+        if cur.rowcount != 1:
+            raise RuntimeError(f"ineffective deletion of gap {lo}..={hi}")
+
+    def insert_gap(self, actor_id: ActorId, lo: int, hi: int) -> None:
+        self.conn.execute(
+            "INSERT INTO __corro_bookkeeping_gaps (actor_id, start, end) VALUES (?, ?, ?)",
+            (actor_id.bytes_, lo, hi),
+        )
+
+
+class Bookie:
+    """All per-actor BookedVersions for one node."""
+
+    def __init__(self, store: CrrStore):
+        self.store = store
+        self.by_actor: Dict[ActorId, BookedVersions] = {}
+        self._load()
+
+    def _load(self):
+        """Reboot = reload from tables (reference BookedVersions::from_conn,
+        agent.rs:1282-1351, driven per-actor in run_root.rs:133-203)."""
+        conn = self.store.conn
+        actors = {
+            ActorId(r[0])
+            for r in conn.execute("SELECT site_id FROM __crdt_db_versions")
+        } | {
+            ActorId(r[0])
+            for r in conn.execute("SELECT DISTINCT actor_id FROM __corro_bookkeeping_gaps")
+        } | {
+            ActorId(r[0])
+            for r in conn.execute("SELECT DISTINCT site_id FROM __corro_seq_bookkeeping")
+        }
+        for actor in actors:
+            bv = BookedVersions(actor)
+            row = conn.execute(
+                "SELECT db_version FROM __crdt_db_versions WHERE site_id = ?",
+                (actor.bytes_,),
+            ).fetchone()
+            snap = bv.snapshot()
+            if row:
+                snap.max = row[0]
+            for dbv, s, e, last, ts in conn.execute(
+                "SELECT db_version, start_seq, end_seq, last_seq, ts "
+                "FROM __corro_seq_bookkeeping WHERE site_id = ?",
+                (actor.bytes_,),
+            ):
+                snap.partials.setdefault(
+                    dbv, PartialVersion(seqs=RangeSet(), last_seq=last, ts=ts)
+                ).seqs.insert(s, e)
+                if snap.max is None or dbv > snap.max:
+                    snap.max = dbv
+            for s, e in conn.execute(
+                "SELECT start, end FROM __corro_bookkeeping_gaps WHERE actor_id = ?",
+                (actor.bytes_,),
+            ):
+                snap.needed.insert(s, e)
+            bv.commit_snapshot(snap)
+            self.by_actor[actor] = bv
+
+    def for_actor(self, actor_id: ActorId) -> BookedVersions:
+        if actor_id not in self.by_actor:
+            self.by_actor[actor_id] = BookedVersions(actor_id)
+        return self.by_actor[actor_id]
+
+    def sink(self) -> SqliteGapsSink:
+        return SqliteGapsSink(self.store.conn)
+
+    # -- persistence helpers (run inside the caller's transaction) --------
+
+    def record_versions(
+        self,
+        actor_id: ActorId,
+        snap: VersionsSnapshot,
+        versions: RangeSet,
+    ) -> None:
+        """insert_db + mirror the origin's max version (the reference's
+        process_multiple_changes bookkeeping step, util.rs:892-932)."""
+        snap.insert_db(self.sink(), versions)
+        self.store.conn.execute(
+            "INSERT INTO __crdt_db_versions (site_id, db_version) VALUES (?, ?) "
+            "ON CONFLICT (site_id) DO UPDATE SET db_version = MAX(db_version, excluded.db_version)",
+            (actor_id.bytes_, snap.max or 0),
+        )
+
+    def persist_partial(
+        self, actor_id: ActorId, db_version: int, partial: PartialVersion
+    ) -> None:
+        """Rewrite `__corro_seq_bookkeeping` rows for one partial version
+        with the coalesced seq ranges (reference util.rs:1053-1186)."""
+        conn = self.store.conn
+        conn.execute(
+            "DELETE FROM __corro_seq_bookkeeping WHERE site_id = ? AND db_version = ?",
+            (actor_id.bytes_, db_version),
+        )
+        conn.executemany(
+            "INSERT INTO __corro_seq_bookkeeping "
+            "(site_id, db_version, start_seq, end_seq, last_seq, ts) VALUES (?, ?, ?, ?, ?, ?)",
+            [
+                (actor_id.bytes_, db_version, lo, hi, partial.last_seq, partial.ts)
+                for lo, hi in partial.seqs
+            ],
+        )
+
+    def clear_partial(self, actor_id: ActorId, db_version: int) -> None:
+        self.store.conn.execute(
+            "DELETE FROM __corro_seq_bookkeeping WHERE site_id = ? AND db_version = ?",
+            (actor_id.bytes_, db_version),
+        )

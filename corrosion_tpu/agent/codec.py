@@ -1,0 +1,149 @@
+"""Wire codec for gossip payloads.
+
+The reference speedy-encodes enums into length-delimited frames
+(`UniPayload`/`BiPayload`/`SyncMessage`, corro-types/src/broadcast.rs +
+sync.rs).  Ours is a compact JSON encoding (bytes as base64) — both ends are
+this framework, the framing/verb split carries the semantics, and the hot
+path (the simulator) never touches this codec.  A binary C++ codec can slot
+in here later without touching callers.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.types import (
+    ActorId,
+    Change,
+    Changeset,
+    ChangesetPart,
+    SyncNeed,
+    SyncState,
+)
+
+
+def _b(data: bytes) -> str:
+    return base64.b64encode(data).decode("ascii")
+
+
+def _ub(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def _enc_val(v):
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {"$b": _b(bytes(v))}
+    return v
+
+
+def _dec_val(v):
+    if isinstance(v, dict) and "$b" in v:
+        return _ub(v["$b"])
+    return v
+
+
+def encode_change(ch: Change) -> list:
+    return [
+        ch.table, _b(ch.pk), ch.cid, _enc_val(ch.val), ch.col_version,
+        ch.db_version, ch.seq, ch.site_id.hex(), ch.cl,
+    ]
+
+
+def decode_change(row: list) -> Change:
+    return Change(
+        table=row[0], pk=_ub(row[1]), cid=row[2], val=_dec_val(row[3]),
+        col_version=row[4], db_version=row[5], seq=row[6],
+        site_id=ActorId.from_hex(row[7]), cl=row[8],
+    )
+
+
+def encode_changeset(cs: Changeset) -> dict:
+    return {
+        "actor": cs.actor_id.hex(),
+        "v": cs.version,
+        "vhi": cs.versions_hi,
+        "part": cs.part.value,
+        "seqs": list(cs.seqs),
+        "last_seq": cs.last_seq,
+        "ts": cs.ts,
+        "changes": [encode_change(c) for c in cs.changes],
+    }
+
+
+def decode_changeset(d: dict) -> Changeset:
+    return Changeset(
+        actor_id=ActorId.from_hex(d["actor"]),
+        version=d["v"],
+        versions_hi=d.get("vhi"),
+        part=ChangesetPart(d["part"]),
+        seqs=tuple(d["seqs"]),
+        last_seq=d["last_seq"],
+        ts=d["ts"],
+        changes=tuple(decode_change(c) for c in d["changes"]),
+    )
+
+
+def encode_sync_state(s: SyncState) -> dict:
+    return {
+        "actor": s.actor_id.hex(),
+        "heads": {a.hex(): v for a, v in s.heads.items()},
+        "need": {a.hex(): [list(r) for r in v] for a, v in s.need.items()},
+        "partial": {
+            a.hex(): {str(ver): [list(r) for r in seqs] for ver, seqs in m.items()}
+            for a, m in s.partial_need.items()
+        },
+        "cleared_ts": s.last_cleared_ts,
+    }
+
+
+def decode_sync_state(d: dict) -> SyncState:
+    return SyncState(
+        actor_id=ActorId.from_hex(d["actor"]),
+        heads={ActorId.from_hex(a): v for a, v in d["heads"].items()},
+        need={
+            ActorId.from_hex(a): [tuple(r) for r in v] for a, v in d["need"].items()
+        },
+        partial_need={
+            ActorId.from_hex(a): {int(ver): [tuple(r) for r in seqs] for ver, seqs in m.items()}
+            for a, m in d["partial"].items()
+        },
+        last_cleared_ts=d.get("cleared_ts"),
+    )
+
+
+def encode_needs(needs: Dict[ActorId, List[SyncNeed]]) -> dict:
+    out = {}
+    for actor, lst in needs.items():
+        out[actor.hex()] = [
+            {"k": n.kind, "v": list(n.versions), "ver": n.version,
+             "seqs": [list(r) for r in n.seqs]}
+            for n in lst
+        ]
+    return out
+
+
+def decode_needs(d: dict) -> Dict[ActorId, List[SyncNeed]]:
+    out = {}
+    for a, lst in d.items():
+        out[ActorId.from_hex(a)] = [
+            SyncNeed(
+                kind=n["k"], versions=tuple(n["v"]), version=n["ver"],
+                seqs=tuple(tuple(r) for r in n["seqs"]),
+            )
+            for n in lst
+        ]
+    return out
+
+
+def encode_message(kind: str, body: Any, ts: Optional[int] = None) -> bytes:
+    """One framed gossip message: {"t": kind, "ts": clock, "b": body}."""
+    return json.dumps(
+        {"t": kind, "ts": ts, "b": body}, separators=(",", ":")
+    ).encode("utf-8")
+
+
+def decode_message(data: bytes) -> Tuple[str, Any, Optional[int]]:
+    d = json.loads(data)
+    return d["t"], d.get("b"), d.get("ts")

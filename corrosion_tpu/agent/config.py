@@ -1,0 +1,104 @@
+"""Agent configuration.
+
+Rebuild of the reference's TOML config (`corro-types/src/config.rs:62-329`)
+including the PerfConfig envelope (config.rs:197-253) whose defaults are the
+operating constants in BASELINE.md.  Loaded from TOML (stdlib tomllib) with
+``CORRO__SECTION__KEY`` env-var overrides, or built programmatically for
+tests (the reference's ConfigBuilder, config.rs:331-452).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class PerfConfig:
+    """Every tunable the reference exposes (config.rs:10-59,197-253)."""
+
+    # broadcast (broadcast/mod.rs:401-463)
+    broadcast_flush_interval_s: float = 0.5
+    broadcast_buffer_cutoff: int = 64 * 1024
+    broadcast_rate_limit_bytes_s: int = 10 * 1024 * 1024
+    broadcast_max_inflight: int = 500
+    # sync cadence (config.rs:49-59, util.rs:367-369)
+    sync_backoff_min_s: float = 1.0
+    sync_backoff_max_s: float = 15.0
+    sync_round_timeout_s: float = 300.0
+    sync_max_concurrent_inbound: int = 3  # agent.rs:143
+    # ingest (config.rs:15-47, handlers.rs:561-613)
+    apply_queue_cost: int = 50
+    apply_queue_timeout_s: float = 0.01
+    changes_queue_cap: int = 20000
+    max_concurrent_applies: int = 5
+    # chunking (change.rs:180, peer/mod.rs:365-368)
+    max_changes_byte_size: int = 8 * 1024
+    min_changes_byte_size: int = 1024
+    # SWIM (broadcast/mod.rs:951-960)
+    swim_probe_interval_s: float = 1.0
+    swim_probe_timeout_s: float = 0.5
+    swim_suspect_timeout_s: float = 3.0
+    swim_num_indirect_probes: int = 3
+    swim_max_transmissions: int = 10
+    swim_max_packet_size: int = 1178
+    swim_down_gc_s: float = 48 * 3600.0
+
+
+@dataclass
+class Config:
+    db_path: str = ":memory:"
+    gossip_addr: str = ""
+    api_addr: str = ""  # "host:port" or "" to disable HTTP
+    bootstrap: List[str] = field(default_factory=list)
+    schema_paths: List[str] = field(default_factory=list)
+    cluster_id: int = 0
+    perf: PerfConfig = field(default_factory=PerfConfig)
+    admin_path: str = ""  # unix socket path; "" disables
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        """TOML + `CORRO__SECTION__KEY` env overrides (config.rs:315-329)."""
+        import tomllib
+
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Config":
+        db = raw.get("db", {})
+        api = raw.get("api", {})
+        gossip = raw.get("gossip", {})
+        admin = raw.get("admin", {})
+        perf_raw = {**raw.get("perf", {})}
+        cfg = cls(
+            db_path=db.get("path", ":memory:"),
+            schema_paths=db.get("schema_paths", []),
+            api_addr=api.get("addr", ""),
+            gossip_addr=gossip.get("addr", ""),
+            bootstrap=gossip.get("bootstrap", []),
+            cluster_id=gossip.get("cluster_id", 0),
+            admin_path=admin.get("path", ""),
+        )
+        for k, v in perf_raw.items():
+            if hasattr(cfg.perf, k):
+                setattr(cfg.perf, k, v)
+        cfg._apply_env()
+        return cfg
+
+    def _apply_env(self):
+        for key, val in os.environ.items():
+            if not key.startswith("CORRO__"):
+                continue
+            parts = key[len("CORRO__"):].lower().split("__")
+            if len(parts) == 2 and parts[0] == "perf" and hasattr(self.perf, parts[1]):
+                cur = getattr(self.perf, parts[1])
+                setattr(self.perf, parts[1], type(cur)(val))
+            elif len(parts) == 2 and parts[0] == "db" and parts[1] == "path":
+                self.db_path = val
+            elif len(parts) == 2 and parts[0] == "gossip" and parts[1] == "addr":
+                self.gossip_addr = val
+            elif len(parts) == 2 and parts[0] == "api" and parts[1] == "addr":
+                self.api_addr = val

@@ -1,0 +1,99 @@
+"""Cluster membership view with RTT locality rings.
+
+Rebuild of the reference's `Members` (`corro-types/src/members.rs:38-179`):
+known actor states keyed by id, an addr index, and per-member RTT summaries
+bucketed into rings — ring 0 (lowest RTT) gets local broadcasts first
+(broadcast/mod.rs:589-651).  Ring bucket boundaries match members.rs:38:
+[0,6) [6,15) [15,50) [50,100) [100,200) [200,300) ms.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core.types import Actor, ActorId
+
+RING_BUCKETS_MS = [(0, 6), (6, 15), (15, 50), (50, 100), (100, 200), (200, 300)]
+
+
+@dataclass
+class MemberState:
+    actor: Actor
+    is_up: bool = True
+    ring: Optional[int] = None
+    rtts: deque = field(default_factory=lambda: deque(maxlen=20))
+
+    @property
+    def addr(self) -> str:
+        return self.actor.addr
+
+
+class Members:
+    def __init__(self, self_actor_id: ActorId):
+        self.self_id = self_actor_id
+        self.states: Dict[ActorId, MemberState] = {}
+        self.by_addr: Dict[str, ActorId] = {}
+
+    def add_member(self, actor: Actor) -> bool:
+        """Returns True if this made the member newly up (reference
+        members.rs add_member)."""
+        if actor.id == self.self_id:
+            return False
+        existing = self.states.get(actor.id)
+        if existing is not None:
+            was_up = existing.is_up
+            if actor.ts >= existing.actor.ts:
+                existing.actor = actor
+            existing.is_up = True
+            self.by_addr[actor.addr] = actor.id
+            return not was_up
+        self.states[actor.id] = MemberState(actor=actor)
+        self.by_addr[actor.addr] = actor.id
+        return True
+
+    def remove_member(self, actor: Actor) -> bool:
+        """Mark down; True if it was up (we keep state for RTT history)."""
+        st = self.states.get(actor.id)
+        if st is None or not st.is_up:
+            return False
+        if actor.ts < st.actor.ts:
+            return False  # stale notification about an older identity
+        st.is_up = False
+        return True
+
+    def record_rtt(self, addr: str, rtt_ms: float) -> None:
+        actor_id = self.by_addr.get(addr)
+        if actor_id is None:
+            return
+        st = self.states.get(actor_id)
+        if st is None:
+            return
+        st.rtts.append(rtt_ms)
+        avg = sum(st.rtts) / len(st.rtts)
+        st.ring = len(RING_BUCKETS_MS)  # beyond last bucket
+        for i, (lo, hi) in enumerate(RING_BUCKETS_MS):
+            if lo <= avg < hi:
+                st.ring = i
+                break
+
+    def up_members(self) -> List[MemberState]:
+        return [s for s in self.states.values() if s.is_up]
+
+    def ring0(self) -> List[MemberState]:
+        """Lowest-populated-ring members (reference broadcast/mod.rs:589-651
+        sends local broadcasts here first).  Members with unmeasured RTT
+        default to ring 0 so fresh clusters still broadcast."""
+        ups = self.up_members()
+        if not ups:
+            return []
+        rings = [s.ring if s.ring is not None else 0 for s in ups]
+        lowest = min(rings)
+        return [s for s, r in zip(ups, rings) if r == lowest]
+
+    def get(self, actor_id: ActorId) -> Optional[MemberState]:
+        return self.states.get(actor_id)
+
+    def __len__(self) -> int:
+        return len(self.up_members())

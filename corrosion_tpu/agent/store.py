@@ -1,0 +1,551 @@
+"""CRR SQLite store: CRDT-replicated tables without cr-sqlite.
+
+This is the rebuild's L0 layer — the equivalent of the prebuilt cr-sqlite C
+extension the reference loads (`corro-types/src/sqlite.rs:121-139`) plus the
+`crsql_*` API surface it consumes (`crsql_as_crr`, `crsql_changes`,
+`crsql_site_id`, `crsql_db_version`, `crsql_peek_next_db_version`,
+`crsql_set_ts`, `crsql_rows_impacted`; see SURVEY.md §2.2).  Implemented
+natively on sqlite3 with:
+
+- a per-table clock table ``{T}__crdt_clock(pk, cid, val, col_version,
+  db_version, seq, site_id, ts)`` — like cr-sqlite's ``__crsql_clock`` but
+  denormalised with the current winning value so the changes feed is one scan;
+- a per-table row table ``{T}__crdt_rows(pk, cl)`` holding causal length
+  (odd = alive, even = deleted; tombstones survive row deletion);
+- SQL triggers on the base table that capture **local** writes (gated on the
+  ``crdt_applying()`` app function so remote merges don't re-trigger);
+- Python-side merge application implementing the cr-sqlite rules via
+  ``corrosion_tpu.core.crdt`` (optionally accelerated by the C++ core).
+
+Like the reference (doc/crdts.md:29), all writes must go through the agent:
+one writer connection, db_version allocated per committed write transaction,
+seq = ordinal of the column change inside the transaction.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sqlite3
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.crdt import MergeOutcome, merge_cell, row_alive
+from ..core.hlc import HLC
+from ..core.pkcodec import decode_pk, encode_pk
+from ..core.types import Change, DELETE_SENTINEL, PKONLY_SENTINEL, ActorId, SqliteValue
+
+
+@dataclass
+class TableInfo:
+    name: str
+    pk_cols: Tuple[str, ...]
+    non_pk_cols: Tuple[str, ...]
+
+    @property
+    def clock(self) -> str:
+        return f"{self.name}__crdt_clock"
+
+    @property
+    def rows(self) -> str:
+        return f"{self.name}__crdt_rows"
+
+
+@dataclass
+class CommitInfo:
+    db_version: int
+    last_seq: int
+    ts: int
+
+
+_CREATE_TABLE_RE = re.compile(r"(?is)^\s*create\s+table\s+(?:if\s+not\s+exists\s+)?[\"'`]?(\w+)")
+
+
+class CrrStore:
+    """One node's storage: base tables + CRDT clocks + bookkeeping tables."""
+
+    def __init__(self, path: str, site_id: ActorId, clock: Optional[HLC] = None):
+        self.path = path
+        self.clock = clock or HLC()
+        self.conn = sqlite3.connect(path, check_same_thread=False, isolation_level=None)
+        self.conn.row_factory = sqlite3.Row
+        self.conn.execute("PRAGMA journal_mode = WAL")
+        self.conn.execute("PRAGMA synchronous = NORMAL")
+        self._lock = threading.RLock()  # the ONE writer lane (agent.rs:97 write_sema)
+        self._tables: Dict[str, TableInfo] = {}
+        self._applying = False
+        self._pending_dbv = 0
+        self._seq = 0
+        self._pending_ts = 0
+        self._register_functions()
+        self._migrate()
+        self.site_id = self._init_site_id(site_id)
+        self._load_tables()
+        # read-only connection for client queries (the reference's RO pool,
+        # agent.rs:419-498): keeps arbitrary SQL off the trigger-armed writer
+        if path not in (":memory:", ""):
+            self.read_conn = sqlite3.connect(
+                f"file:{path}?mode=ro", uri=True, check_same_thread=False
+            )
+            self.read_conn.row_factory = sqlite3.Row
+        else:
+            self.read_conn = self.conn  # in-memory: single-conn fallback
+
+    # -- setup ------------------------------------------------------------
+
+    def _register_functions(self):
+        c = self.conn
+        c.create_function("crdt_applying", 0, lambda: 1 if self._applying else 0)
+        c.create_function("crdt_dbv", 0, lambda: self._pending_dbv)
+        c.create_function("crdt_ts", 0, lambda: self._pending_ts)
+        c.create_function("crdt_site", 0, lambda: self.site_id.bytes_)
+        c.create_function("crdt_seq", 0, self._next_seq)
+        c.create_function(
+            "crdt_pk", -1, lambda *vals: encode_pk(vals), deterministic=True
+        )
+
+    def _next_seq(self) -> int:
+        s = self._seq
+        self._seq += 1
+        return s
+
+    def _migrate(self):
+        """Internal tables (reference migrate(), corro-types/agent.rs:282-365)."""
+        self.conn.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS __corro_state (key TEXT PRIMARY KEY, value);
+            CREATE TABLE IF NOT EXISTS __crdt_tables (
+                name TEXT PRIMARY KEY, pks TEXT NOT NULL, cols TEXT NOT NULL);
+            CREATE TABLE IF NOT EXISTS __crdt_db_versions (
+                site_id BLOB PRIMARY KEY, db_version INTEGER NOT NULL);
+            CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
+                actor_id BLOB, start INTEGER, end INTEGER,
+                PRIMARY KEY (actor_id, start)) WITHOUT ROWID;
+            CREATE TABLE IF NOT EXISTS __corro_seq_bookkeeping (
+                site_id BLOB, db_version INTEGER, start_seq INTEGER,
+                end_seq INTEGER, last_seq INTEGER, ts INTEGER,
+                PRIMARY KEY (site_id, db_version, start_seq)) WITHOUT ROWID;
+            CREATE TABLE IF NOT EXISTS __corro_buffered_changes (
+                "table" TEXT, pk BLOB, cid TEXT, val, col_version INTEGER,
+                db_version INTEGER, seq INTEGER, site_id BLOB, cl INTEGER,
+                ts INTEGER,
+                PRIMARY KEY (site_id, db_version, seq)) WITHOUT ROWID;
+            CREATE TABLE IF NOT EXISTS __corro_members (
+                actor_id BLOB PRIMARY KEY, address TEXT NOT NULL,
+                doomed INTEGER DEFAULT 0, foca_state TEXT);
+            CREATE TABLE IF NOT EXISTS __corro_subs (
+                id TEXT PRIMARY KEY, sql TEXT NOT NULL);
+            """
+        )
+
+    def _init_site_id(self, site_id: ActorId) -> ActorId:
+        row = self.conn.execute(
+            "SELECT value FROM __corro_state WHERE key = 'site_id'"
+        ).fetchone()
+        if row is not None:
+            return ActorId(row[0])
+        self.conn.execute(
+            "INSERT INTO __corro_state (key, value) VALUES ('site_id', ?)",
+            (site_id.bytes_,),
+        )
+        return site_id
+
+    def _load_tables(self):
+        for name, pks, cols in self.conn.execute(
+            "SELECT name, pks, cols FROM __crdt_tables"
+        ):
+            info = TableInfo(name, tuple(json.loads(pks)), tuple(json.loads(cols)))
+            self._tables[name] = info
+            self._create_triggers(info)
+
+    # -- schema -----------------------------------------------------------
+
+    def execute_schema(self, schema_sql: str) -> List[str]:
+        """Create tables from SQL and mark each as a CRR (the reference's
+        file-based schema + `crsql_as_crr`, corro-utils + schema.rs).
+
+        Returns the list of table names now replicated."""
+        created = []
+        with self._lock:
+            for stmt in _split_statements(schema_sql):
+                m = _CREATE_TABLE_RE.match(stmt)
+                if not m:
+                    self.conn.execute(stmt)
+                    continue
+                name = m.group(1)
+                if name in self._tables:
+                    continue  # live migration diffing lands with M6
+                self.conn.execute(stmt)
+                created.append(self.create_crr(name))
+        return [t.name for t in created]
+
+    def create_crr(self, name: str) -> TableInfo:
+        """`crsql_as_crr` equivalent: attach clock/rows tables + triggers."""
+        cols = self.conn.execute(f'PRAGMA table_info("{name}")').fetchall()
+        if not cols:
+            raise ValueError(f"no such table: {name}")
+        pk_cols = tuple(r["name"] for r in sorted(
+            (r for r in cols if r["pk"] > 0), key=lambda r: r["pk"]
+        ))
+        if not pk_cols:
+            raise ValueError(f"CRR table {name} must have a primary key")
+        non_pk = tuple(r["name"] for r in cols if r["pk"] == 0)
+        info = TableInfo(name, pk_cols, non_pk)
+        self.conn.execute(
+            f'''CREATE TABLE IF NOT EXISTS "{info.clock}" (
+                pk BLOB NOT NULL, cid TEXT NOT NULL, val,
+                col_version INTEGER NOT NULL, db_version INTEGER NOT NULL,
+                seq INTEGER NOT NULL, site_id BLOB NOT NULL,
+                ts INTEGER NOT NULL DEFAULT 0,
+                PRIMARY KEY (pk, cid)) WITHOUT ROWID'''
+        )
+        self.conn.execute(
+            f'CREATE INDEX IF NOT EXISTS "{info.clock}_dbv" ON "{info.clock}" (site_id, db_version)'
+        )
+        self.conn.execute(
+            f'''CREATE TABLE IF NOT EXISTS "{info.rows}" (
+                pk BLOB PRIMARY KEY, cl INTEGER NOT NULL) WITHOUT ROWID'''
+        )
+        self.conn.execute(
+            "INSERT OR REPLACE INTO __crdt_tables (name, pks, cols) VALUES (?, ?, ?)",
+            (name, json.dumps(pk_cols), json.dumps(non_pk)),
+        )
+        self._tables[name] = info
+        self._create_triggers(info)
+        return info
+
+    def _create_triggers(self, info: TableInfo):
+        """Local-write capture (cr-sqlite's generated triggers equivalent).
+        Gated on crdt_applying() so remote merge writes don't loop."""
+        t, q = info.name, lambda s: f'"{s}"'
+        new_pk = "crdt_pk(" + ", ".join(f'NEW.{q(c)}' for c in info.pk_cols) + ")"
+        old_pk = "crdt_pk(" + ", ".join(f'OLD.{q(c)}' for c in info.pk_cols) + ")"
+
+        clock_upsert = (
+            f'INSERT INTO {q(info.clock)} (pk, cid, val, col_version, db_version, seq, site_id, ts) '
+            "VALUES ({pk}, {cid}, {val}, 1, crdt_dbv(), crdt_seq(), crdt_site(), crdt_ts()) "
+            "ON CONFLICT (pk, cid) DO UPDATE SET col_version = col_version + 1, "
+            "val = excluded.val, db_version = excluded.db_version, "
+            "seq = excluded.seq, site_id = excluded.site_id, ts = excluded.ts;"
+        )
+
+        # INSERT: bump causal length to alive, clock every non-pk column
+        body = [
+            f'INSERT INTO {q(info.rows)} (pk, cl) VALUES ({new_pk}, 1) '
+            "ON CONFLICT (pk) DO UPDATE SET cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END;"
+        ]
+        if info.non_pk_cols:
+            for c in info.non_pk_cols:
+                body.append(clock_upsert.format(pk=new_pk, cid=f"'{c}'", val=f"NEW.{q(c)}"))
+        else:
+            body.append(clock_upsert.format(pk=new_pk, cid=f"'{PKONLY_SENTINEL}'", val="NULL"))
+        self._trigger(f"{t}__crdt_ins", f'AFTER INSERT ON {q(t)}', body)
+
+        # UPDATE: one trigger per column, only when the value actually changed
+        for c in info.non_pk_cols:
+            self._trigger(
+                f"{t}__crdt_upd_{c}",
+                f'AFTER UPDATE OF {q(c)} ON {q(t)}',
+                [clock_upsert.format(pk=new_pk, cid=f"'{c}'", val=f"NEW.{q(c)}")],
+                extra_when=f'OLD.{q(c)} IS NOT NEW.{q(c)}',
+            )
+
+        # DELETE: even causal length, clear column clocks, write tombstone clock
+        self._trigger(
+            f"{t}__crdt_delt",
+            f'AFTER DELETE ON {q(t)}',
+            [
+                f'UPDATE {q(info.rows)} SET cl = cl + 1 WHERE pk = {old_pk} AND cl % 2 = 1;',
+                f'DELETE FROM {q(info.clock)} WHERE pk = {old_pk};',
+                clock_upsert.format(pk=old_pk, cid=f"'{DELETE_SENTINEL}'", val="NULL"),
+            ],
+        )
+
+    def _trigger(self, name: str, event: str, body: List[str], extra_when: str = ""):
+        when = "crdt_applying() = 0" + (f" AND ({extra_when})" if extra_when else "")
+        self.conn.execute(f'DROP TRIGGER IF EXISTS "{name}"')
+        self.conn.execute(
+            f'CREATE TRIGGER "{name}" {event} WHEN {when} BEGIN\n'
+            + "\n".join(body)
+            + "\nEND"
+        )
+
+    # -- versions ---------------------------------------------------------
+
+    def db_version(self, site_id: Optional[ActorId] = None) -> int:
+        """Max applied db_version for a site (crsql_db_version equivalent)."""
+        site = (site_id or self.site_id).bytes_
+        row = self.conn.execute(
+            "SELECT db_version FROM __crdt_db_versions WHERE site_id = ?", (site,)
+        ).fetchone()
+        return row[0] if row else 0
+
+    def peek_next_db_version(self) -> int:
+        return self.db_version() + 1
+
+    # -- local writes -----------------------------------------------------
+
+    def transact(
+        self,
+        statements: Sequence[Tuple[str, Sequence[SqliteValue]]],
+        pre_commit: Optional[Callable[[sqlite3.Connection, CommitInfo], None]] = None,
+    ) -> Tuple[List[sqlite3.Cursor], Optional[CommitInfo]]:
+        """Run write statements in one transaction; triggers capture CRDT
+        changes under a freshly allocated db_version (the reference's
+        `make_broadcastable_changes`, api/public/mod.rs:53-138).
+
+        ``pre_commit`` runs inside the transaction after changes exist —
+        the agent uses it to persist bookkeeping atomically with the data
+        (insert_local_changes, change.rs:189-260)."""
+        with self._lock:
+            self._pending_dbv = self.peek_next_db_version()
+            self._seq = 0
+            self._pending_ts = self.clock.now()
+            self._applying = False
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                results = []
+                for sql, params in statements:
+                    results.append(self.conn.execute(sql, tuple(params)))
+                info = None
+                if self._seq > 0:  # at least one captured change
+                    info = CommitInfo(
+                        db_version=self._pending_dbv,
+                        last_seq=self._seq - 1,
+                        ts=self._pending_ts,
+                    )
+                    self.conn.execute(
+                        "INSERT INTO __crdt_db_versions (site_id, db_version) VALUES (?, ?) "
+                        "ON CONFLICT (site_id) DO UPDATE SET db_version = excluded.db_version",
+                        (self.site_id.bytes_, info.db_version),
+                    )
+                    if pre_commit:
+                        pre_commit(self.conn, info)
+                self.conn.execute("COMMIT")
+                return results, info
+            except Exception:
+                self.conn.execute("ROLLBACK")
+                raise
+
+    # -- reads ------------------------------------------------------------
+
+    def query(self, sql: str, params: Sequence[SqliteValue] = ()) -> List[sqlite3.Row]:
+        return self.conn.execute(sql, tuple(params)).fetchall()
+
+    def changes_for_version(
+        self, site_id: ActorId, db_version: int,
+        seq_range: Optional[Tuple[int, int]] = None,
+    ) -> List[Change]:
+        """The `crsql_changes` feed for one (origin, version), seq-ordered
+        (reference broadcast_changes / handle_need read path)."""
+        out: List[Change] = []
+        for info in self._tables.values():
+            sql = (
+                f'SELECT c.pk, c.cid, c.val, c.col_version, c.seq, c.ts, '
+                f'COALESCE(r.cl, 1) AS cl '
+                f'FROM "{info.clock}" c LEFT JOIN "{info.rows}" r ON r.pk = c.pk '
+                f'WHERE c.site_id = ? AND c.db_version = ?'
+            )
+            args: List = [site_id.bytes_, db_version]
+            if seq_range:
+                sql += " AND c.seq BETWEEN ? AND ?"
+                args += [seq_range[0], seq_range[1]]
+            for row in self.conn.execute(sql, args):
+                out.append(
+                    Change(
+                        table=info.name, pk=row["pk"], cid=row["cid"],
+                        val=row["val"], col_version=row["col_version"],
+                        db_version=db_version, seq=row["seq"],
+                        site_id=site_id, cl=row["cl"],
+                    )
+                )
+        out.sort(key=lambda ch: ch.seq)
+        return out
+
+    def changes_for_version_range(
+        self, site_id: ActorId, lo: int, hi: int
+    ) -> Dict[int, List[Change]]:
+        """All changes for an inclusive version range in ONE scan per table,
+        grouped by db_version (the serve-side sync read, newest first)."""
+        out: Dict[int, List[Change]] = {}
+        for info in self._tables.values():
+            sql = (
+                f'SELECT c.pk, c.cid, c.val, c.col_version, c.db_version, '
+                f'c.seq, COALESCE(r.cl, 1) AS cl '
+                f'FROM "{info.clock}" c LEFT JOIN "{info.rows}" r ON r.pk = c.pk '
+                f'WHERE c.site_id = ? AND c.db_version BETWEEN ? AND ?'
+            )
+            for row in self.conn.execute(sql, (site_id.bytes_, lo, hi)):
+                out.setdefault(row["db_version"], []).append(
+                    Change(
+                        table=info.name, pk=row["pk"], cid=row["cid"],
+                        val=row["val"], col_version=row["col_version"],
+                        db_version=row["db_version"], seq=row["seq"],
+                        site_id=site_id, cl=row["cl"],
+                    )
+                )
+        for changes in out.values():
+            changes.sort(key=lambda ch: ch.seq)
+        return out
+
+    # -- remote change application ---------------------------------------
+
+    def apply_changes(
+        self,
+        changes: Iterable[Change],
+        in_tx: bool = False,
+    ) -> int:
+        """Merge remote changes (the crsql_changes INSERT + C-extension merge
+        in the reference, util.rs:1225-1245).  Returns rows impacted
+        (crsql_rows_impacted equivalent).  Trigger capture is disabled for
+        the duration; caller may already hold an open transaction."""
+        with self._lock:
+            self._applying = True
+            own_tx = not in_tx
+            if own_tx:
+                self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                impacted = 0
+                for ch in changes:
+                    if self._apply_one(ch):
+                        impacted += 1
+                if own_tx:
+                    self.conn.execute("COMMIT")
+                return impacted
+            except Exception:
+                if own_tx:
+                    self.conn.execute("ROLLBACK")
+                raise
+            finally:
+                self._applying = False
+
+    def begin_apply(self):
+        with self._lock:
+            self._applying = True
+            self.conn.execute("BEGIN IMMEDIATE")
+
+    def end_apply(self, commit: bool = True):
+        with self._lock:
+            try:
+                self.conn.execute("COMMIT" if commit else "ROLLBACK")
+            finally:
+                self._applying = False
+
+    def _apply_one(self, ch: Change) -> bool:
+        info = self._tables.get(ch.table)
+        if info is None:
+            return False  # unknown table: skipped (schema not yet applied here)
+        q = lambda s: f'"{s}"'
+        row = self.conn.execute(
+            f'SELECT cl FROM {q(info.rows)} WHERE pk = ?', (ch.pk,)
+        ).fetchone()
+        local_cl = row[0] if row else 0
+
+        if ch.cid == DELETE_SENTINEL:
+            if ch.cl <= local_cl or row_alive(ch.cl):
+                return False  # stale delete
+            self._set_cl(info, ch.pk, ch.cl)
+            self._delete_base_row(info, ch.pk)
+            self.conn.execute(f'DELETE FROM {q(info.clock)} WHERE pk = ?', (ch.pk,))
+            self._upsert_clock(info, ch, force=True)
+            return True
+
+        if not row_alive(ch.cl) or ch.cl < local_cl:
+            return False  # column change from a dead or stale lifecycle
+
+        if ch.cl > local_cl:
+            # new causal lifecycle: reset clocks, (re)create the base row
+            self.conn.execute(f'DELETE FROM {q(info.clock)} WHERE pk = ?', (ch.pk,))
+            self._set_cl(info, ch.pk, ch.cl)
+            self._ensure_base_row(info, ch.pk)
+        elif row is None:
+            self._set_cl(info, ch.pk, ch.cl)
+            self._ensure_base_row(info, ch.pk)
+
+        existing_row = self.conn.execute(
+            f'SELECT col_version, val, site_id FROM {q(info.clock)} WHERE pk = ? AND cid = ?',
+            (ch.pk, ch.cid),
+        ).fetchone()
+        existing = (
+            (existing_row[0], existing_row[1], ActorId(existing_row[2]))
+            if existing_row
+            else None
+        )
+        outcome = merge_cell(existing, (ch.col_version, ch.val, ch.site_id))
+        if outcome == MergeOutcome.LOSE:
+            return False
+        self._upsert_clock(info, ch, force=True)
+        if outcome == MergeOutcome.WIN and ch.cid != PKONLY_SENTINEL:
+            self._ensure_base_row(info, ch.pk)
+            self.conn.execute(
+                f'UPDATE {q(info.name)} SET {q(ch.cid)} = ? WHERE '
+                + " AND ".join(f'{q(c)} IS ?' for c in info.pk_cols),
+                (ch.val, *decode_pk(ch.pk)),
+            )
+            return True
+        return outcome == MergeOutcome.WIN
+
+    def _set_cl(self, info: TableInfo, pk: bytes, cl: int):
+        self.conn.execute(
+            f'INSERT INTO "{info.rows}" (pk, cl) VALUES (?, ?) '
+            "ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+            (pk, cl),
+        )
+
+    def _ensure_base_row(self, info: TableInfo, pk: bytes):
+        cols = ", ".join(f'"{c}"' for c in info.pk_cols)
+        ph = ", ".join("?" for _ in info.pk_cols)
+        self.conn.execute(
+            f'INSERT OR IGNORE INTO "{info.name}" ({cols}) VALUES ({ph})',
+            decode_pk(pk),
+        )
+
+    def _delete_base_row(self, info: TableInfo, pk: bytes):
+        self.conn.execute(
+            f'DELETE FROM "{info.name}" WHERE '
+            + " AND ".join(f'"{c}" IS ?' for c in info.pk_cols),
+            decode_pk(pk),
+        )
+
+    def _upsert_clock(self, info: TableInfo, ch: Change, force: bool):
+        self.conn.execute(
+            f'INSERT INTO "{info.clock}" (pk, cid, val, col_version, db_version, seq, site_id, ts) '
+            "VALUES (?, ?, ?, ?, ?, ?, ?, ?) "
+            "ON CONFLICT (pk, cid) DO UPDATE SET "
+            "val = excluded.val, col_version = excluded.col_version, "
+            "db_version = excluded.db_version, seq = excluded.seq, "
+            "site_id = excluded.site_id, ts = excluded.ts",
+            (ch.pk, ch.cid, ch.val, ch.col_version, ch.db_version, ch.seq,
+             ch.site_id.bytes_, 0),
+        )
+
+    def close(self):
+        if self.read_conn is not self.conn:
+            self.read_conn.close()
+        self.conn.close()
+
+
+def _split_statements(sql: str) -> List[str]:
+    """Split a schema file into statements (semicolons outside quotes)."""
+    out, buf, in_str = [], [], None
+    for chsym in sql:
+        if in_str:
+            buf.append(chsym)
+            if chsym == in_str:
+                in_str = None
+            continue
+        if chsym in ("'", '"'):
+            in_str = chsym
+            buf.append(chsym)
+        elif chsym == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+        else:
+            buf.append(chsym)
+    stmt = "".join(buf).strip()
+    if stmt:
+        out.append(stmt)
+    return out

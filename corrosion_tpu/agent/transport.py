@@ -1,0 +1,328 @@
+"""The transport seam: three verbs over pluggable backends.
+
+This is the plugin boundary the north star names (SURVEY.md L4,
+`corro-agent/src/transport.rs:79-162`): SWIM rides fire-and-forget
+datagrams, broadcast rides uni-directional streams, sync rides
+bi-directional streams.  Backends:
+
+- ``MemoryTransport`` — in-process cluster (the reference's
+  `launch_test_agent` loopback analog) with an optional deterministic
+  latency/loss model, used by tests and as ground truth for the simulator;
+- ``UdpTcpTransport`` — real sockets: UDP datagrams + TCP streams (the
+  reference uses QUIC/Quinn; TCP gives us the same three verbs without
+  pulling a QUIC stack into the image);
+- the ``tpu-sim`` backend lives in `corrosion_tpu.sim` — same verbs, entries
+  in per-round message tensors.
+
+Addresses are opaque strings ("host:port" for sockets, any token in memory).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+DatagramHandler = Callable[[str, bytes], Awaitable[None]]
+UniHandler = Callable[[str, bytes], Awaitable[None]]
+BiHandler = Callable[[str, "BiStream"], Awaitable[None]]
+
+
+class BiStream:
+    """One side of a bidirectional message stream (QUIC bi analog):
+    length-delimited frames both ways."""
+
+    def __init__(self):
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self.peer: Optional["BiStream"] = None
+        self.closed = False
+
+    @staticmethod
+    def pair() -> Tuple["BiStream", "BiStream"]:
+        a, b = BiStream(), BiStream()
+        a.peer, b.peer = b, a
+        return a, b
+
+    async def send(self, frame: bytes) -> None:
+        if self.peer is None or self.peer.closed:
+            raise ConnectionError("peer closed")
+        await self.peer._inbox.put(frame)
+
+    async def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        try:
+            frame = await asyncio.wait_for(self._inbox.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        return frame
+
+    def close(self) -> None:
+        self.closed = True
+        if self.peer is not None:
+            self.peer._inbox.put_nowait(b"")  # EOF marker
+
+
+@dataclass
+class LinkModel:
+    """Deterministic latency/loss injection for in-memory clusters (stands in
+    for the WAN conditions Antithesis injects around the reference)."""
+
+    latency_s: float = 0.0
+    loss: float = 0.0  # datagram/uni loss probability; bi streams are reliable
+    seed: int = 0
+    _rng: random.Random = field(init=False)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def drop(self) -> bool:
+        return self.loss > 0 and self._rng.random() < self.loss
+
+
+class Transport:
+    """Abstract transport verbs (reference transport.rs:79-162)."""
+
+    addr: str
+
+    async def send_datagram(self, addr: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    async def send_uni(self, addr: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    async def open_bi(self, addr: str) -> BiStream:
+        raise NotImplementedError
+
+    def set_handlers(
+        self,
+        on_datagram: DatagramHandler,
+        on_uni: UniHandler,
+        on_bi: BiHandler,
+    ) -> None:
+        self.on_datagram = on_datagram
+        self.on_uni = on_uni
+        self.on_bi = on_bi
+
+    async def close(self) -> None:
+        pass
+
+
+class MemoryNetwork:
+    """Shared registry for in-process transports, with per-edge link models."""
+
+    def __init__(self, default_link: Optional[LinkModel] = None):
+        self.nodes: Dict[str, "MemoryTransport"] = {}
+        self.links: Dict[Tuple[str, str], LinkModel] = {}
+        self.default_link = default_link or LinkModel()
+        self.partitioned: set = set()  # {(a, b)} directed blocked edges
+
+    def transport(self, addr: str) -> "MemoryTransport":
+        t = MemoryTransport(self, addr)
+        self.nodes[addr] = t
+        return t
+
+    def link(self, src: str, dst: str) -> LinkModel:
+        return self.links.get((src, dst), self.default_link)
+
+    def partition(self, a: str, b: str, bidirectional: bool = True):
+        self.partitioned.add((a, b))
+        if bidirectional:
+            self.partitioned.add((b, a))
+
+    def heal(self):
+        self.partitioned.clear()
+
+    def reachable(self, src: str, dst: str) -> bool:
+        return (src, dst) not in self.partitioned and dst in self.nodes
+
+
+class MemoryTransport(Transport):
+    def __init__(self, net: MemoryNetwork, addr: str):
+        self.net = net
+        self.addr = addr
+        self.on_datagram: Optional[DatagramHandler] = None
+        self.on_uni: Optional[UniHandler] = None
+        self.on_bi: Optional[BiHandler] = None
+        self._tasks: set = set()
+
+    def _spawn(self, coro):
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _deliver(self, addr: str, kind: str, payload) -> bool:
+        if not self.net.reachable(self.addr, addr):
+            return False
+        link = self.net.link(self.addr, addr)
+        if kind in ("datagram", "uni") and link.drop():
+            return False
+        dst = self.net.nodes[addr]
+
+        async def run():
+            if link.latency_s:
+                await asyncio.sleep(link.latency_s)
+            handler = getattr(dst, f"on_{kind}")
+            if handler is not None:
+                await handler(self.addr, payload)
+
+        self._spawn(run())
+        return True
+
+    async def send_datagram(self, addr: str, data: bytes) -> None:
+        await self._deliver(addr, "datagram", data)
+
+    async def send_uni(self, addr: str, data: bytes) -> None:
+        await self._deliver(addr, "uni", data)
+
+    async def open_bi(self, addr: str) -> BiStream:
+        if not self.net.reachable(self.addr, addr):
+            raise ConnectionError(f"{addr} unreachable")
+        ours, theirs = BiStream.pair()
+        link = self.net.link(self.addr, addr)
+        dst = self.net.nodes[addr]
+
+        async def run():
+            if link.latency_s:
+                await asyncio.sleep(link.latency_s)
+            if dst.on_bi is not None:
+                await dst.on_bi(self.addr, theirs)
+
+        self._spawn(run())
+        return ours
+
+    async def close(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        self.net.nodes.pop(self.addr, None)
+
+
+# ---------------------------------------------------------------------------
+# Real sockets: UDP datagrams + TCP framed streams
+
+
+def _frame(data: bytes) -> bytes:
+    return struct.pack(">I", len(data)) + data
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
+    try:
+        hdr = await reader.readexactly(4)
+        (n,) = struct.unpack(">I", hdr)
+        return await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+
+
+class _TcpBiStream(BiStream):
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        super().__init__()
+        self.reader = reader
+        self.writer = writer
+
+    async def send(self, frame: bytes) -> None:
+        self.writer.write(_frame(frame))
+        await self.writer.drain()
+
+    async def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        try:
+            return await asyncio.wait_for(_read_frame(self.reader), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class UdpTcpTransport(Transport):
+    """Datagrams over UDP, uni/bi streams over TCP, one port each.
+
+    A uni stream is a TCP connection opened with a 1-byte tag; a bi stream
+    stays open for framed request/response exchange (the reference's QUIC
+    uni/bi distinction, api/peer/mod.rs:118-339)."""
+
+    TAG_UNI = b"u"
+    TAG_BI = b"b"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self.addr = ""
+        self.on_datagram = None
+        self.on_uni = None
+        self.on_bi = None
+        self._udp = None
+        self._tcp_server = None
+        self._tasks: set = set()
+
+    async def start(self) -> str:
+        loop = asyncio.get_running_loop()
+
+        outer = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                if outer.on_datagram is not None:
+                    task = loop.create_task(outer.on_datagram(f"{addr[0]}:{addr[1]}", data))
+                    outer._tasks.add(task)
+                    task.add_done_callback(outer._tasks.discard)
+
+        self._tcp_server = await asyncio.start_server(
+            self._on_tcp, self._host, self._port
+        )
+        self._port = self._tcp_server.sockets[0].getsockname()[1]
+        self._udp, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(self._host, self._port)
+        )
+        self.addr = f"{self._host}:{self._port}"
+        return self.addr
+
+    async def _on_tcp(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer = writer.get_extra_info("peername")
+        peer_addr = f"{peer[0]}:{peer[1]}" if peer else "?"
+        try:
+            tag = await reader.readexactly(1)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        if tag == self.TAG_UNI:
+            data = await _read_frame(reader)
+            writer.close()
+            if data is not None and self.on_uni is not None:
+                await self.on_uni(peer_addr, data)
+        elif tag == self.TAG_BI:
+            if self.on_bi is not None:
+                await self.on_bi(peer_addr, _TcpBiStream(reader, writer))
+        else:
+            writer.close()
+
+    async def send_datagram(self, addr: str, data: bytes) -> None:
+        host, port = addr.rsplit(":", 1)
+        self._udp.sendto(data, (host, int(port)))
+
+    async def send_uni(self, addr: str, data: bytes) -> None:
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(self.TAG_UNI + _frame(data))
+        await writer.drain()
+        writer.close()
+
+    async def open_bi(self, addr: str) -> BiStream:
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(self.TAG_BI)
+        await writer.drain()
+        return _TcpBiStream(reader, writer)
+
+    async def close(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        if self._udp:
+            self._udp.close()
+        if self._tcp_server:
+            self._tcp_server.close()
+            await self._tcp_server.wait_closed()

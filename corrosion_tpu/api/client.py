@@ -1,0 +1,162 @@
+"""Async HTTP client for the agent API.
+
+Rebuild of corro-client (`crates/corro-client/src/lib.rs:32-360`):
+execute/query/schema against one agent, plus a pooled multi-address client
+with failover (`CorrosionPooledClient`, lib.rs:400+).  Stdlib asyncio;
+NDJSON streams decoded line-wise (the LinesBytesCodec analog, sub.rs:423).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import AsyncIterator, List, Optional, Sequence
+
+
+class ApiClient:
+    def __init__(self, addr: str, authz_token: Optional[str] = None):
+        self.addr = addr
+        self.authz_token = authz_token
+
+    async def _request(self, method: str, path: str, body: Optional[bytes]):
+        host, port = self.addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            headers = f"{method} {path} HTTP/1.1\r\nhost: {self.addr}\r\n"
+            if self.authz_token:
+                headers += f"authorization: Bearer {self.authz_token}\r\n"
+            if body:
+                headers += f"content-length: {len(body)}\r\ncontent-type: application/json\r\n"
+            writer.write(headers.encode() + b"\r\n" + (body or b""))
+            await writer.drain()
+
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            resp_headers = {}
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = h.decode("latin-1").partition(":")
+                resp_headers[k.strip().lower()] = v.strip()
+            return status, resp_headers, reader, writer
+        except Exception:
+            writer.close()
+            raise
+
+    async def _read_body(self, resp_headers, reader) -> bytes:
+        if resp_headers.get("transfer-encoding") == "chunked":
+            chunks = []
+            while True:
+                size_line = await reader.readline()
+                n = int(size_line.strip(), 16)
+                if n == 0:
+                    await reader.readline()
+                    break
+                chunks.append(await reader.readexactly(n))
+                await reader.readline()
+            return b"".join(chunks)
+        n = int(resp_headers.get("content-length", 0))
+        return await reader.readexactly(n) if n else b""
+
+    async def execute(self, statements: Sequence) -> dict:
+        status, headers, reader, writer = await self._request(
+            "POST", "/v1/transactions", json.dumps(list(statements)).encode()
+        )
+        try:
+            body = await self._read_body(headers, reader)
+            payload = json.loads(body)
+            if status != 200:
+                raise RuntimeError(f"execute failed ({status}): {payload}")
+            return payload
+        finally:
+            writer.close()
+
+    async def query(self, statement) -> List[list]:
+        """Collect all rows of an NDJSON query stream."""
+        rows = []
+        async for event in self.query_stream(statement):
+            if "row" in event:
+                rows.append(event["row"][1])
+            elif "error" in event:
+                raise RuntimeError(event["error"])
+        return rows
+
+    async def query_stream(self, statement) -> AsyncIterator[dict]:
+        """Incremental NDJSON consumption: events yield as chunks arrive,
+        never buffering the whole result set."""
+        status, headers, reader, writer = await self._request(
+            "POST", "/v1/queries", json.dumps(statement).encode()
+        )
+        try:
+            if status != 200:
+                body = await self._read_body(headers, reader)
+                raise RuntimeError(f"query failed ({status}): {body!r}")
+            if headers.get("transfer-encoding") == "chunked":
+                buf = b""
+                while True:
+                    size_line = await reader.readline()
+                    n = int(size_line.strip(), 16)
+                    if n == 0:
+                        await reader.readline()
+                        break
+                    buf += await reader.readexactly(n)
+                    await reader.readline()
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if line.strip():
+                            yield json.loads(line)
+                if buf.strip():
+                    yield json.loads(buf)
+            else:
+                body = await self._read_body(headers, reader)
+                for line in body.splitlines():
+                    if line.strip():
+                        yield json.loads(line)
+        finally:
+            writer.close()
+
+    async def schema(self, statements: Sequence[str]) -> dict:
+        status, headers, reader, writer = await self._request(
+            "POST", "/v1/migrations", json.dumps(list(statements)).encode()
+        )
+        try:
+            body = await self._read_body(headers, reader)
+            if status != 200:
+                raise RuntimeError(f"migrations failed ({status})")
+            return json.loads(body)
+        finally:
+            writer.close()
+
+    async def table_stats(self) -> dict:
+        status, headers, reader, writer = await self._request("GET", "/v1/table_stats", None)
+        try:
+            body = await self._read_body(headers, reader)
+            return json.loads(body)
+        finally:
+            writer.close()
+
+
+class PooledClient:
+    """Multi-address failover client (CorrosionPooledClient analog)."""
+
+    def __init__(self, addrs: Sequence[str], authz_token: Optional[str] = None):
+        self.clients = [ApiClient(a, authz_token) for a in addrs]
+        self._i = 0
+
+    async def _try(self, fn):
+        last_err: Optional[Exception] = None
+        for _ in range(len(self.clients)):
+            client = self.clients[self._i % len(self.clients)]
+            try:
+                return await fn(client)
+            except (OSError, RuntimeError, asyncio.IncompleteReadError) as e:
+                last_err = e
+                self._i += 1  # failover to the next address
+        raise last_err if last_err else RuntimeError("no clients")
+
+    async def execute(self, statements):
+        return await self._try(lambda c: c.execute(statements))
+
+    async def query(self, statement):
+        return await self._try(lambda c: c.query(statement))

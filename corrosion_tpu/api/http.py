@@ -1,0 +1,266 @@
+"""HTTP client API.
+
+Rebuild of the reference's public API layer (`corro-agent/src/api/public/`,
+router in `agent/util.rs:171-339`): `POST /v1/transactions` (write path →
+broadcast), `POST /v1/queries` (NDJSON row stream), `POST /v1/migrations`
+(schema apply), `GET /v1/table_stats`, plus bearer-token authz and a
+concurrency limit (util.rs:184-192,318-339).  Subscriptions/updates endpoints
+attach here when the pubsub engine lands (M6).
+
+Implemented as a small asyncio HTTP/1.1 server — the framework's API
+payloads are plain JSON/NDJSON and stdlib keeps the image dependency-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from ..agent.agent import Agent
+
+MAX_BODY = 64 * 1024 * 1024
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class ApiServer:
+    def __init__(
+        self,
+        agent: Agent,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authz_token: Optional[str] = None,
+        max_concurrency: int = 128,
+    ):
+        self.agent = agent
+        self._host = host
+        self._port = port
+        self.addr = ""
+        self.authz_token = authz_token
+        self._sem = asyncio.Semaphore(max_concurrency)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._extra_routes: Dict[Tuple[str, str], Callable] = {}
+
+    def route(self, method: str, path: str, handler: Callable) -> None:
+        """Extension point for subscription/updates endpoints."""
+        self._extra_routes[(method, path)] = handler
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(self._on_conn, self._host, self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        self.addr = f"{self._host}:{self._port}"
+        return self.addr
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- plumbing ---------------------------------------------------------
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except HttpError as e:
+                    await _respond_json(writer, e.status, {"error": e.message})
+                    break
+                except ValueError as e:  # malformed header values
+                    await _respond_json(writer, 400, {"error": str(e)})
+                    break
+                if req is None:
+                    break
+                method, path, headers, body = req
+                async with self._sem:
+                    keep_alive = await self._dispatch(method, path, headers, body, writer)
+                if not keep_alive:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, path, _ = line.decode("latin-1").split(" ", 2)
+        except ValueError:
+            return None
+        headers = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode("latin-1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", 0))
+        if n > MAX_BODY:
+            raise HttpError(413, "body too large")
+        body = await reader.readexactly(n) if n else b""
+        return method.upper(), path, headers, body
+
+    def _authz(self, headers):
+        if self.authz_token is None:
+            return
+        if headers.get("authorization") != f"Bearer {self.authz_token}":
+            raise HttpError(401, "unauthorized")
+
+    async def _dispatch(self, method, path, headers, body, writer) -> bool:
+        try:
+            self._authz(headers)
+            handler = self._extra_routes.get((method, path.split("?")[0]))
+            if handler is not None:
+                await handler(path, headers, body, writer)
+                return False  # streaming handlers own the connection
+            if method == "POST" and path == "/v1/transactions":
+                resp = self._transactions(json.loads(body))
+            elif method == "POST" and path == "/v1/queries":
+                await self._queries(json.loads(body), writer)
+                return True
+            elif method == "POST" and path == "/v1/migrations":
+                resp = self._migrations(json.loads(body))
+            elif method == "GET" and path == "/v1/table_stats":
+                resp = self._table_stats()
+            else:
+                raise HttpError(404, "not found")
+            await _respond_json(writer, 200, resp)
+            return True
+        except HttpError as e:
+            await _respond_json(writer, e.status, {"error": e.message})
+            return True
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            await _respond_json(writer, 400, {"error": str(e)})
+            return True
+        except Exception as e:  # sqlite errors etc.
+            await _respond_json(writer, 500, {"error": str(e)})
+            return True
+
+    # -- handlers ---------------------------------------------------------
+
+    def _transactions(self, stmts) -> dict:
+        """api_v1_transactions (api/public/mod.rs:177): a JSON array of
+        statements, each "sql" or ["sql", [params]] or {"query","params"}."""
+        parsed = [_parse_statement(s) for s in stmts]
+        import time
+
+        t0 = time.monotonic()
+        cursors, info = self.agent.exec_transaction_cursors(parsed)
+        elapsed = time.monotonic() - t0
+        return {
+            "results": [{"rows_affected": max(c.rowcount, 0)} for c in cursors],
+            "time": elapsed,
+            "version": info.db_version if info else None,
+        }
+
+    async def _queries(self, stmt, writer):
+        """api_v1_queries (api/public/mod.rs:468): NDJSON event stream —
+        {"columns":[...]} then {"row":[id,[vals]]}* then {"eoq":{"time":t}}.
+        Runs on the read-only connection; errors after the stream opened are
+        emitted as an {"error":...} event, never a second HTTP response."""
+        sql, params = _parse_statement(stmt)
+        import time
+
+        t0 = time.monotonic()
+        # errors before the stream starts surface as a normal HTTP error
+        cur = self.agent.store.read_conn.execute(sql, tuple(params))
+        cols = [d[0] for d in cur.description] if cur.description else []
+        await _start_ndjson(writer)
+        try:
+            await _send_ndjson(writer, {"columns": cols})
+            for i, row in enumerate(cur):
+                await _send_ndjson(writer, {"row": [i + 1, _json_row(row)]})
+            await _send_ndjson(writer, {"eoq": {"time": time.monotonic() - t0}})
+        except ConnectionError:
+            raise
+        except Exception as e:  # mid-iteration SQLite errors
+            await _send_ndjson(writer, {"error": str(e)})
+        finally:
+            await _end_ndjson(writer)
+
+    def _migrations(self, stmts) -> dict:
+        for s in stmts:
+            sql, _ = _parse_statement(s)
+            self.agent.store.execute_schema(sql)
+        return {"results": "ok"}
+
+    def _table_stats(self) -> dict:
+        out = {}
+        for name in self.agent.store._tables:
+            n = self.agent.store.conn.execute(
+                f'SELECT COUNT(*) FROM "{name}"'
+            ).fetchone()[0]
+            out[name] = {"count": n}
+        return out
+
+
+def _parse_statement(s) -> Tuple[str, tuple]:
+    if isinstance(s, str):
+        return s, ()
+    if isinstance(s, list):
+        if len(s) == 1:
+            return s[0], ()
+        return s[0], tuple(s[1]) if isinstance(s[1], list) else tuple(s[1:])
+    if isinstance(s, dict):
+        return s["query"], tuple(s.get("params", ()))
+    raise HttpError(400, f"bad statement: {s!r}")
+
+
+def _json_row(row):
+    out = []
+    for v in row:
+        if isinstance(v, bytes):
+            import base64
+
+            out.append({"$b": base64.b64encode(v).decode("ascii")})
+        else:
+            out.append(v)
+    return out
+
+
+async def _respond_json(writer, status: int, payload) -> None:
+    body = json.dumps(payload).encode("utf-8")
+    writer.write(
+        f"HTTP/1.1 {status} {_reason(status)}\r\n"
+        f"content-type: application/json\r\n"
+        f"content-length: {len(body)}\r\n\r\n".encode("latin-1") + body
+    )
+    await writer.drain()
+
+
+async def _start_ndjson(writer) -> None:
+    writer.write(
+        b"HTTP/1.1 200 OK\r\n"
+        b"content-type: application/x-ndjson\r\n"
+        b"transfer-encoding: chunked\r\n\r\n"
+    )
+    await writer.drain()
+
+
+async def _send_ndjson(writer, obj) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8") + b"\n"
+    writer.write(f"{len(data):x}\r\n".encode("latin-1") + data + b"\r\n")
+    await writer.drain()
+
+
+async def _end_ndjson(writer) -> None:
+    writer.write(b"0\r\n\r\n")
+    await writer.drain()
+
+
+def _reason(status: int) -> str:
+    return {
+        200: "OK", 400: "Bad Request", 401: "Unauthorized",
+        404: "Not Found", 413: "Payload Too Large", 500: "Internal Server Error",
+    }.get(status, "Unknown")

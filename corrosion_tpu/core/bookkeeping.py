@@ -66,6 +66,49 @@ class NullSink:
 NULL_SINK = NullSink()
 
 
+def _contains(
+    needed: RangeSet,
+    partials: Dict[int, "PartialVersion"],
+    max_: Optional[int],
+    version: int,
+    seqs: Optional[Range],
+) -> bool:
+    """Shared known-check (reference `agent.rs:1353-1390`): a version is known
+    iff it is not in the needed-gap set and <= max; when a seq range is given
+    and the version is held partially, the partial must cover it."""
+    if needed.contains(version) or (max_ or 0) < version:
+        return False
+    if seqs is None:
+        return True
+    partial = partials.get(version)
+    if partial is None:
+        return True  # fully applied or cleared
+    return partial.seqs.covers(*seqs)
+
+
+def _contains_all(
+    needed: RangeSet,
+    partials: Dict[int, "PartialVersion"],
+    max_: Optional[int],
+    versions: Range,
+    seqs: Optional[Range],
+) -> bool:
+    """Range variant in O(log n + partials-in-range), not O(range width) —
+    EMPTY changesets can span millions of versions."""
+    lo, hi = versions
+    if (max_ or 0) < hi:
+        return False
+    if next(needed.overlapping(lo, hi), None) is not None:
+        return False
+    if seqs is None:
+        return True
+    return all(
+        p.seqs.covers(*seqs)
+        for v, p in partials.items()
+        if lo <= v <= hi
+    )
+
+
 @dataclass
 class _GapsChanges:
     """Reference `agent.rs:1439-1444` GapsChanges."""
@@ -93,6 +136,18 @@ class VersionsSnapshot:
 
     def insert_gaps(self, ranges: Iterable[Range]) -> None:
         self.needed.extend(ranges)
+
+    def contains_version(self, version: int) -> bool:
+        return not self.needed.contains(version) and (self.max or 0) >= version
+
+    def contains(self, version: int, seqs: Optional[Range] = None) -> bool:
+        """Same known-check as BookedVersions.contains, against this
+        in-transaction view (the reference re-checks inside
+        process_multiple_changes, util.rs:704-739)."""
+        return _contains(self.needed, self.partials, self.max, version, seqs)
+
+    def contains_all(self, versions: Range, seqs: Optional[Range] = None) -> bool:
+        return _contains_all(self.needed, self.partials, self.max, versions, seqs)
 
     def insert_db(self, sink: GapsSink, db_versions: RangeSet) -> None:
         """Record [ranges of] db_versions as known/applied, updating the
@@ -165,10 +220,15 @@ class BookedVersions:
     # -- snapshots --------------------------------------------------------
 
     def snapshot(self) -> VersionsSnapshot:
+        # deep-copy partials: the snapshot mutates them mid-transaction and
+        # must not leak into the committed view before commit_snapshot
         return VersionsSnapshot(
             self.actor_id,
             self._needed.copy(),
-            dict(self.partials),
+            {
+                v: PartialVersion(seqs=p.seqs.copy(), last_seq=p.last_seq, ts=p.ts)
+                for v, p in self.partials.items()
+            },
             self._max,
         )
 
@@ -184,18 +244,10 @@ class BookedVersions:
         return not self._needed.contains(version) and (self._max or 0) >= version
 
     def contains(self, version: int, seqs: Optional[Range] = None) -> bool:
-        if not self.contains_version(version):
-            return False
-        if seqs is None:
-            return True
-        partial = self.partials.get(version)
-        if partial is None:
-            # known but not partial → fully applied or cleared
-            return True
-        return partial.seqs.covers(*seqs)
+        return _contains(self._needed, self.partials, self._max, version, seqs)
 
     def contains_all(self, versions: Range, seqs: Optional[Range] = None) -> bool:
-        return all(self.contains(v, seqs) for v in range(versions[0], versions[1] + 1))
+        return _contains_all(self._needed, self.partials, self._max, versions, seqs)
 
     def last(self) -> Optional[int]:
         return self._max

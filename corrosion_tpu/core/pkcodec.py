@@ -1,0 +1,69 @@
+"""Canonical binary encoding for primary keys and SQLite values.
+
+The reference ships pk bytes in cr-sqlite's internal format (opaque on the
+wire, e.g. ``x'010901'`` in doc/crdts.md:70).  Ours is a tagged
+self-delimiting encoding with the property that equal value tuples encode to
+equal bytes (pk identity on the wire and in clock tables).  Not
+order-preserving — only equality matters for pks.
+
+Layout per value: 1 tag byte + payload
+  0x00 NULL | 0x01 int (8B signed BE) | 0x02 float (8B IEEE BE)
+  0x03 str (u32 len + utf8) | 0x04 bytes (u32 len + raw)
+A tuple is count byte + concatenated values (pks have <=255 columns).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Sequence, Tuple
+
+from .types import SqliteValue
+
+
+def encode_value(v: SqliteValue) -> bytes:
+    if v is None:
+        return b"\x00"
+    if isinstance(v, bool) or isinstance(v, int):
+        return b"\x01" + struct.pack(">q", int(v))
+    if isinstance(v, float):
+        return b"\x02" + struct.pack(">d", v)
+    if isinstance(v, str):
+        b = v.encode("utf-8")
+        return b"\x03" + struct.pack(">I", len(b)) + b
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        return b"\x04" + struct.pack(">I", len(b)) + b
+    raise TypeError(f"not a SQLite value: {type(v)!r}")
+
+
+def decode_value(buf: bytes, offset: int = 0) -> Tuple[SqliteValue, int]:
+    tag = buf[offset]
+    offset += 1
+    if tag == 0x00:
+        return None, offset
+    if tag == 0x01:
+        return struct.unpack_from(">q", buf, offset)[0], offset + 8
+    if tag == 0x02:
+        return struct.unpack_from(">d", buf, offset)[0], offset + 8
+    if tag in (0x03, 0x04):
+        (n,) = struct.unpack_from(">I", buf, offset)
+        offset += 4
+        raw = bytes(buf[offset : offset + n])
+        return (raw.decode("utf-8") if tag == 0x03 else raw), offset + n
+    raise ValueError(f"bad value tag {tag:#x}")
+
+
+def encode_pk(values: Sequence[SqliteValue]) -> bytes:
+    if len(values) > 255:
+        raise ValueError("pk too wide")
+    return bytes([len(values)]) + b"".join(encode_value(v) for v in values)
+
+
+def decode_pk(buf: bytes) -> Tuple[SqliteValue, ...]:
+    n = buf[0]
+    out = []
+    offset = 1
+    for _ in range(n):
+        v, offset = decode_value(buf, offset)
+        out.append(v)
+    return tuple(out)

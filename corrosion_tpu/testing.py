@@ -1,0 +1,119 @@
+"""Test fixtures: in-process agent clusters.
+
+Rebuild of the reference's corro-tests crate (`corro-tests/src/lib.rs:63-88`
+`launch_test_agent`): boot complete real agents on an in-memory network (the
+loopback-port-0 analog), tempdir DBs, shared schema — the workhorse for
+multi-node integration tests (SURVEY.md §4.2) and the simulator's
+ground-truth tier.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import tempfile
+from typing import List, Optional, Sequence
+
+from .agent.agent import Agent
+from .agent.config import Config, PerfConfig
+from .agent.transport import LinkModel, MemoryNetwork
+
+TEST_SCHEMA = """
+CREATE TABLE tests (
+    id INTEGER PRIMARY KEY NOT NULL,
+    text TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE tests2 (
+    id INTEGER PRIMARY KEY NOT NULL,
+    text TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+def fast_perf() -> PerfConfig:
+    """Aggressive timers so convergence tests run in wall-clock seconds."""
+    return PerfConfig(
+        broadcast_flush_interval_s=0.02,
+        sync_backoff_min_s=0.05,
+        sync_backoff_max_s=0.3,
+    )
+
+
+class Cluster:
+    """N in-process agents with full mesh (or custom bootstrap) membership."""
+
+    def __init__(
+        self,
+        n: int,
+        schema: str = TEST_SCHEMA,
+        link: Optional[LinkModel] = None,
+        connectivity: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.n = n
+        self.schema = schema
+        self.net = MemoryNetwork(default_link=link or LinkModel())
+        self.agents: List[Agent] = []
+        self.tmp = tempfile.TemporaryDirectory()
+        self.connectivity = connectivity
+        self.seed = seed
+
+    async def start(self):
+        import random
+
+        rng = random.Random(self.seed)
+        addrs = [f"node{i}" for i in range(self.n)]
+        for i, addr in enumerate(addrs):
+            if self.connectivity is None or self.connectivity >= self.n - 1:
+                bootstrap = [a for a in addrs if a != addr]
+            else:
+                # random bootstrap graph (configurable_stress_test analog)
+                bootstrap = rng.sample(
+                    [a for a in addrs if a != addr], self.connectivity
+                )
+            cfg = Config(
+                db_path=f"{self.tmp.name}/node{i}.db",
+                gossip_addr=addr,
+                bootstrap=bootstrap,
+                perf=fast_perf(),
+            )
+            agent = Agent(cfg, self.net.transport(addr))
+            agent.store.execute_schema(self.schema)
+            self.agents.append(agent)
+        for agent in self.agents:
+            await agent.start()
+
+    async def stop(self):
+        for agent in self.agents:
+            await agent.stop()
+        self.tmp.cleanup()
+
+    def converged(self) -> bool:
+        """The cluster-wide convergence property the reference checks in
+        check_bookkeeping.py:6-27: all needs empty, all heads equal."""
+        heads = {}
+        for agent in self.agents:
+            s = agent.sync_state()
+            if s.need or s.partial_need:
+                return False
+            for actor, head in s.heads.items():
+                if heads.setdefault(actor, head) != head:
+                    return False
+        # every node must know every writer's head
+        writers = {a for a in heads}
+        for agent in self.agents:
+            s = agent.sync_state()
+            for w in writers:
+                if w != agent.actor_id and s.heads.get(w) != heads[w]:
+                    return False
+        return True
+
+    async def wait_converged(self, timeout: float = 30.0) -> bool:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if self.converged():
+                return True
+            await asyncio.sleep(0.05)
+        return self.converged()
+
+    def rows(self, i: int, sql: str, params: Sequence = ()) -> list:
+        return [tuple(r) for r in self.agents[i].store.query(sql, params)]

@@ -1,0 +1,4 @@
+from .backoff import Backoff
+from .files import read_sql_files
+
+__all__ = ["Backoff", "read_sql_files"]

@@ -1,0 +1,39 @@
+"""Decorrelated-jitter exponential backoff.
+
+Rebuild of the reference's `backoff` crate (`crates/backoff/src/lib.rs:7-90`),
+used by the sync cadence, announcer, and client reconnect loops."""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+class Backoff:
+    """Iterator of sleep durations: decorrelated jitter between min and max.
+
+    next = min(max_s, uniform(min_s, prev * 3)), starting at min_s."""
+
+    def __init__(
+        self,
+        min_s: float,
+        max_s: float,
+        factor: float = 3.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.min_s = min_s
+        self.max_s = max_s
+        self.factor = factor
+        self._rng = rng or random.Random()
+        self._prev = min_s
+
+    def reset(self):
+        self._prev = self.min_s
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> float:
+        nxt = min(self.max_s, self._rng.uniform(self.min_s, self._prev * self.factor))
+        self._prev = nxt
+        return nxt

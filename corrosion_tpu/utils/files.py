@@ -1,0 +1,20 @@
+"""Schema-file loading (reference corro-utils/src/lib.rs:5
+`read_files_from_paths`): read .sql files from paths/dirs, sorted."""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+
+def read_sql_files(path: str) -> List[str]:
+    if os.path.isfile(path):
+        with open(path) as f:
+            return [f.read()]
+    out = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".sql"):
+                with open(os.path.join(path, name)) as f:
+                    out.append(f.read())
+    return out

@@ -1,0 +1,172 @@
+"""In-process multi-agent integration tests — ports of the reference's
+workhorse tests (corro-agent/src/agent/tests.rs): insert_rows_and_gossip,
+large_tx_sync (chunked catch-up of a cold node), sync-driven convergence
+under lossy links, and a small stress test."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.core.types import ChangesetPart
+from corrosion_tpu.agent.transport import LinkModel
+from corrosion_tpu.testing import Cluster
+
+
+async def _with_cluster(n, fn, **kw):
+    cluster = Cluster(n, **kw)
+    await cluster.start()
+    try:
+        await fn(cluster)
+    finally:
+        await cluster.stop()
+
+
+def test_insert_rows_and_gossip():
+    """tests.rs:52 — write on A, row appears on B; update propagates too."""
+
+    async def body(cluster: Cluster):
+        a, b = cluster.agents
+        a.exec_transaction(
+            [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "hello"))]
+        )
+        for _ in range(200):
+            if cluster.rows(1, "SELECT id, text FROM tests") == [(1, "hello")]:
+                break
+            await asyncio.sleep(0.05)
+        assert cluster.rows(1, "SELECT id, text FROM tests") == [(1, "hello")]
+
+        b.exec_transaction(
+            [("INSERT INTO tests (id, text) VALUES (?, ?)", (2, "world"))]
+        )
+        assert await cluster.wait_converged(10)
+        assert cluster.rows(0, "SELECT id, text FROM tests ORDER BY id") == [
+            (1, "hello"), (2, "world"),
+        ]
+
+    asyncio.run(_with_cluster(2, body))
+
+
+def test_gossip_with_loss_converges_via_sync():
+    """Broadcast loss forces the anti-entropy path to fill gaps."""
+
+    async def body(cluster: Cluster):
+        a = cluster.agents[0]
+        for i in range(20):
+            a.exec_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"t{i}"))]
+            )
+        assert await cluster.wait_converged(20)
+        for node in range(3):
+            assert len(cluster.rows(node, "SELECT id FROM tests")) == 20
+
+    asyncio.run(_with_cluster(3, body, link=LinkModel(loss=0.4, seed=42)))
+
+
+def test_large_tx_sync_cold_node():
+    """tests.rs:602 large_tx_sync — a big chunked transaction reaches a node
+    that joins late (pure sync catch-up, no broadcast)."""
+
+    async def body(cluster: Cluster):
+        a = cluster.agents[0]
+        stmts = [
+            ("INSERT INTO tests (id, text) VALUES (?, ?)", (i, "x" * 64))
+            for i in range(2000)
+        ]
+        info = a.exec_transaction(stmts)
+        assert info.last_seq + 1 == 2000  # one change per column write
+        # multiple chunks were necessarily produced (8 KiB cap)
+        assert len(a._bcast_q) > 1
+
+        assert await cluster.wait_converged(30)
+        for node in range(3):
+            assert cluster.rows(node, "SELECT COUNT(*) FROM tests") == [(2000,)]
+
+    asyncio.run(_with_cluster(3, body))
+
+
+def test_partial_buffering_and_completion():
+    """Drop-heavy link: partial chunks buffer in __corro_buffered_changes and
+    only apply once every seq range arrived (util.rs:1053-1186 behavior)."""
+
+    async def body(cluster: Cluster):
+        a = cluster.agents[0]
+        a.exec_transaction(
+            [
+                ("INSERT INTO tests (id, text) VALUES (?, ?)", (i, "y" * 128))
+                for i in range(500)
+            ]
+        )
+        assert await cluster.wait_converged(30)
+        b = cluster.agents[1]
+        assert cluster.rows(1, "SELECT COUNT(*) FROM tests") == [(500,)]
+        # buffered staging is cleaned up after full application
+        assert b.store.query("SELECT COUNT(*) FROM __corro_buffered_changes")[0][0] == 0
+        assert b.store.query("SELECT COUNT(*) FROM __corro_seq_bookkeeping")[0][0] == 0
+
+    asyncio.run(_with_cluster(2, body, link=LinkModel(loss=0.5, seed=7)))
+
+
+def test_concurrent_writers_converge():
+    """Every node writes; all converge to identical full state."""
+
+    async def body(cluster: Cluster):
+        for i, agent in enumerate(cluster.agents):
+            for j in range(10):
+                agent.exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                      (i * 100 + j, f"n{i}w{j}"))]
+                )
+        assert await cluster.wait_converged(30)
+        ref = cluster.rows(0, "SELECT id, text FROM tests ORDER BY id")
+        assert len(ref) == 50
+        for node in range(1, 5):
+            assert cluster.rows(node, "SELECT id, text FROM tests ORDER BY id") == ref
+
+    asyncio.run(_with_cluster(5, body))
+
+
+def test_conflict_update_lww_everywhere():
+    """Conflicting updates on the same cell settle identically cluster-wide."""
+
+    async def body(cluster: Cluster):
+        a, b, c = cluster.agents
+        a.exec_transaction([("INSERT INTO tests (id, text) VALUES (1, 'base')", ())])
+        assert await cluster.wait_converged(10)
+        # concurrent conflicting updates
+        a.exec_transaction([("UPDATE tests SET text = 'started' WHERE id = 1", ())])
+        b.exec_transaction([("UPDATE tests SET text = 'destroyed' WHERE id = 1", ())])
+        assert await cluster.wait_converged(10)
+        vals = {cluster.rows(i, "SELECT text FROM tests WHERE id = 1")[0][0] for i in range(3)}
+        assert vals == {"started"}
+
+    asyncio.run(_with_cluster(3, body))
+
+
+def test_delete_propagates():
+    async def body(cluster: Cluster):
+        a, b = cluster.agents
+        a.exec_transaction([("INSERT INTO tests (id, text) VALUES (1, 'gone')", ())])
+        assert await cluster.wait_converged(10)
+        a.exec_transaction([("DELETE FROM tests WHERE id = 1", ())])
+        assert await cluster.wait_converged(10)
+        assert cluster.rows(1, "SELECT * FROM tests") == []
+
+    asyncio.run(_with_cluster(2, body))
+
+
+@pytest.mark.slow
+def test_stress_small():
+    """configurable_stress_test analog (tests.rs:286) at a CI-friendly size:
+    10 nodes, connectivity 3, 100 writes spread across writers."""
+
+    async def body(cluster: Cluster):
+        for i in range(100):
+            agent = cluster.agents[i % 10]
+            agent.exec_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"s{i}"))]
+            )
+        assert await cluster.wait_converged(60)
+        for node in range(10):
+            assert cluster.rows(node, "SELECT COUNT(*) FROM tests") == [(100,)]
+
+    asyncio.run(_with_cluster(10, body, connectivity=3, seed=1))

@@ -1,0 +1,101 @@
+"""HTTP API tests: transactions write path, NDJSON queries, migrations,
+authz, failover client — against real agents over real TCP sockets, gossiping
+through the API like the reference's CLI black-box test
+(integration-tests/tests/cli_test.rs:24)."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.api.client import ApiClient, PooledClient
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.testing import Cluster
+
+
+async def _with_api_cluster(n, fn, token=None):
+    cluster = Cluster(n)
+    await cluster.start()
+    servers = []
+    clients = []
+    try:
+        for agent in cluster.agents:
+            srv = ApiServer(agent, authz_token=token)
+            await srv.start()
+            servers.append(srv)
+            clients.append(ApiClient(srv.addr, authz_token=token))
+        await fn(cluster, servers, clients)
+    finally:
+        for srv in servers:
+            await srv.stop()
+        await cluster.stop()
+
+
+def test_transactions_and_queries_roundtrip():
+    async def body(cluster, servers, clients):
+        resp = await clients[0].execute(
+            [["INSERT INTO tests (id, text) VALUES (?, ?)", [1, "via-http"]]]
+        )
+        assert resp["version"] == 1
+        rows = await clients[0].query("SELECT id, text FROM tests")
+        assert rows == [[1, "via-http"]]
+
+    asyncio.run(_with_api_cluster(1, body))
+
+
+def test_write_on_a_read_on_b_over_http():
+    async def body(cluster, servers, clients):
+        await clients[0].execute(
+            [["INSERT INTO tests (id, text) VALUES (?, ?)", [7, "gossip"]]]
+        )
+        for _ in range(100):
+            rows = await clients[1].query("SELECT id, text FROM tests")
+            if rows:
+                break
+            await asyncio.sleep(0.05)
+        assert rows == [[7, "gossip"]]
+
+    asyncio.run(_with_api_cluster(2, body))
+
+
+def test_migrations_endpoint():
+    async def body(cluster, servers, clients):
+        await clients[0].schema(
+            ["CREATE TABLE extra (pk INTEGER PRIMARY KEY NOT NULL, v TEXT DEFAULT '')"]
+        )
+        await clients[0].execute([["INSERT INTO extra (pk, v) VALUES (1, 'x')", []]])
+        rows = await clients[0].query("SELECT pk, v FROM extra")
+        assert rows == [[1, "x"]]
+        stats = await clients[0].table_stats()
+        assert stats["extra"]["count"] == 1
+
+    asyncio.run(_with_api_cluster(1, body))
+
+
+def test_authz_bearer_token():
+    async def body(cluster, servers, clients):
+        bad = ApiClient(servers[0].addr, authz_token="wrong")
+        with pytest.raises(RuntimeError, match="401"):
+            await bad.query("SELECT 1")
+        ok = await clients[0].query("SELECT 1")
+        assert ok == [[1]]
+
+    asyncio.run(_with_api_cluster(1, body, token="sekrit"))
+
+
+def test_bad_sql_is_400_500_not_crash():
+    async def body(cluster, servers, clients):
+        with pytest.raises(RuntimeError):
+            await clients[0].execute([["INSERT INTO nope VALUES (1)", []]])
+        # server still serves afterwards
+        assert await clients[0].query("SELECT 42") == [[42]]
+
+    asyncio.run(_with_api_cluster(1, body))
+
+
+def test_pooled_client_failover():
+    async def body(cluster, servers, clients):
+        pooled = PooledClient(["127.0.0.1:1", servers[0].addr])  # first addr dead
+        await pooled.execute([["INSERT INTO tests (id, text) VALUES (9, 'po')", []]])
+        assert await pooled.query("SELECT text FROM tests WHERE id = 9") == [["po"]]
+
+    asyncio.run(_with_api_cluster(1, body))

@@ -1,0 +1,150 @@
+"""CRR store tests: local write capture via triggers, changes feed, remote
+merge application, delete/resurrect lifecycles, conflict convergence —
+the behaviors the reference gets from cr-sqlite (doc/crdts.md)."""
+
+import pytest
+
+from corrosion_tpu.agent.store import CrrStore
+from corrosion_tpu.core.types import ActorId, DELETE_SENTINEL
+
+SCHEMA = """
+CREATE TABLE machines (
+    id INTEGER PRIMARY KEY NOT NULL,
+    name TEXT NOT NULL DEFAULT '',
+    status TEXT NOT NULL DEFAULT 'broken'
+);
+"""
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = CrrStore(str(tmp_path / "a.db"), ActorId.random())
+    s.execute_schema(SCHEMA)
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def store2(tmp_path):
+    s = CrrStore(str(tmp_path / "b.db"), ActorId.random())
+    s.execute_schema(SCHEMA)
+    yield s
+    s.close()
+
+
+def test_local_write_captures_changes(store):
+    _, info = store.transact(
+        [("INSERT INTO machines (id, name, status) VALUES (?, ?, ?)", (1, "meow", "created")),
+         ("INSERT INTO machines (id, name, status) VALUES (?, ?, ?)", (2, "woof", "created"))]
+    )
+    assert info.db_version == 1
+    # 2 rows x 2 non-pk columns = 4 changes, seqs 0..3 (doc/crdts.md:66-74 shape)
+    assert info.last_seq == 3
+    changes = store.changes_for_version(store.site_id, 1)
+    assert [c.seq for c in changes] == [0, 1, 2, 3]
+    assert {(c.cid, c.val) for c in changes} == {
+        ("name", "meow"), ("status", "created"), ("name", "woof"), ("status", "created"),
+    }
+    assert all(c.col_version == 1 and c.cl == 1 for c in changes)
+
+
+def test_update_bumps_col_version_and_db_version(store):
+    store.transact([("INSERT INTO machines (id, name) VALUES (1, 'meow')", ())])
+    _, info = store.transact(
+        [("UPDATE machines SET status = 'started' WHERE id = 1", ())]
+    )
+    assert info.db_version == 2
+    changes = store.changes_for_version(store.site_id, 2)
+    assert len(changes) == 1
+    assert changes[0].cid == "status" and changes[0].col_version == 2
+
+
+def test_noop_update_captures_nothing(store):
+    store.transact([("INSERT INTO machines (id, status) VALUES (1, 'x')", ())])
+    _, info = store.transact([("UPDATE machines SET status = 'x' WHERE id = 1", ())])
+    assert info is None  # no change, no db_version burned
+
+
+def test_replication_roundtrip(store, store2):
+    store.transact(
+        [("INSERT INTO machines (id, name, status) VALUES (1, 'meow', 'created')", ())]
+    )
+    changes = store.changes_for_version(store.site_id, 1)
+    impacted = store2.apply_changes(changes)
+    assert impacted == 2
+    rows = store2.query("SELECT id, name, status FROM machines")
+    assert [(r["id"], r["name"], r["status"]) for r in rows] == [(1, "meow", "created")]
+    # idempotent redelivery
+    assert store2.apply_changes(changes) == 0
+
+
+def test_lww_conflict_converges(store, store2):
+    base = [("INSERT INTO machines (id, name, status) VALUES (1, 'meow', 'created')", ())]
+    store.transact(base)
+    store2.apply_changes(store.changes_for_version(store.site_id, 1))
+
+    # concurrent conflicting updates (both at col_version 2)
+    store.transact([("UPDATE machines SET status = 'started' WHERE id = 1", ())])
+    store2.transact([("UPDATE machines SET status = 'destroyed' WHERE id = 1", ())])
+
+    a_changes = store.changes_for_version(store.site_id, 2)
+    b_changes = store2.changes_for_version(store2.site_id, 2)
+    store2.apply_changes(a_changes)
+    store.apply_changes(b_changes)
+
+    sa = store.query("SELECT status FROM machines WHERE id = 1")[0][0]
+    sb = store2.query("SELECT status FROM machines WHERE id = 1")[0][0]
+    # doc/crdts.md:235-248 — 'started' > 'destroyed'
+    assert sa == sb == "started"
+
+
+def test_delete_propagates_and_stale_insert_loses(store, store2):
+    store.transact([("INSERT INTO machines (id, name) VALUES (1, 'meow')", ())])
+    store2.apply_changes(store.changes_for_version(store.site_id, 1))
+
+    _, info = store.transact([("DELETE FROM machines WHERE id = 1", ())])
+    dels = store.changes_for_version(store.site_id, info.db_version)
+    assert [c.cid for c in dels] == [DELETE_SENTINEL]
+    assert dels[0].cl == 2
+
+    store2.apply_changes(dels)
+    assert store2.query("SELECT * FROM machines") == []
+
+    # a change from the dead lifecycle (cl=1) must not resurrect the row
+    stale = store.changes_for_version(store.site_id, 1)
+    assert store2.apply_changes(stale) == 0
+    assert store2.query("SELECT * FROM machines") == []
+
+
+def test_resurrect_after_delete(store, store2):
+    store.transact([("INSERT INTO machines (id, name) VALUES (1, 'meow')", ())])
+    store.transact([("DELETE FROM machines WHERE id = 1", ())])
+    store.transact([("INSERT INTO machines (id, name) VALUES (1, 'reborn')", ())])
+    # cl back to odd (3), fresh col_versions
+    changes = store.changes_for_version(store.site_id, 3)
+    assert all(c.cl == 3 for c in changes)
+
+    for v in (1, 2, 3):
+        store2.apply_changes(store.changes_for_version(store.site_id, v))
+    rows = store2.query("SELECT id, name FROM machines")
+    assert [(r[0], r[1]) for r in rows] == [(1, "reborn")]
+
+
+def test_out_of_order_delivery_converges(store, store2):
+    store.transact([("INSERT INTO machines (id, name) VALUES (1, 'meow')", ())])
+    store.transact([("UPDATE machines SET name = 'grr' WHERE id = 1", ())])
+    v1 = store.changes_for_version(store.site_id, 1)
+    v2 = store.changes_for_version(store.site_id, 2)
+    # newest first: v2's col_version=2 must survive v1's late arrival
+    store2.apply_changes(v2)
+    store2.apply_changes(v1)
+    assert store2.query("SELECT name FROM machines WHERE id = 1")[0][0] == "grr"
+
+
+def test_site_id_persisted(tmp_path):
+    sid = ActorId.random()
+    s = CrrStore(str(tmp_path / "p.db"), sid)
+    s.close()
+    s2 = CrrStore(str(tmp_path / "p.db"), ActorId.random())
+    assert s2.site_id == sid  # identity survives reboot (doc/crdts.md:42)
+    s2.close()

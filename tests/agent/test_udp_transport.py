@@ -1,0 +1,46 @@
+"""Real-socket transport: 2 agents over UDP/TCP on loopback converge
+(the devcluster-style tier without spawning processes)."""
+
+import asyncio
+import tempfile
+
+from corrosion_tpu.agent.agent import Agent
+from corrosion_tpu.agent.config import Config
+from corrosion_tpu.agent.transport import UdpTcpTransport
+from corrosion_tpu.testing import TEST_SCHEMA, fast_perf
+
+
+def test_two_agents_over_sockets():
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            transports = [UdpTcpTransport(), UdpTcpTransport()]
+            addrs = [await t.start() for t in transports]
+            agents = []
+            for i, t in enumerate(transports):
+                cfg = Config(
+                    db_path=f"{tmp}/n{i}.db",
+                    gossip_addr=addrs[i],
+                    bootstrap=[a for a in addrs if a != addrs[i]],
+                    perf=fast_perf(),
+                )
+                agent = Agent(cfg, t)
+                agent.store.execute_schema(TEST_SCHEMA)
+                agents.append(agent)
+            for a in agents:
+                await a.start()
+            try:
+                agents[0].exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (1, 'sock')", ())]
+                )
+                rows = []
+                for _ in range(200):
+                    rows = agents[1].store.query("SELECT id, text FROM tests")
+                    if rows:
+                        break
+                    await asyncio.sleep(0.05)
+                assert [tuple(r) for r in rows] == [(1, "sock")]
+            finally:
+                for a in agents:
+                    await a.stop()
+
+    asyncio.run(body())
