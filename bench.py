@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Headline benchmark: 100k-node epidemic write-storm convergence.
+
+BASELINE.json north star: simulate 100k-node p99 time-to-convergence in
+<60 s wall-clock, matching 3-node ground truth.  This runs config #5
+(16 writers, 4-chunk versions, broadcast + anti-entropy) to full
+convergence on the real chip and prints ONE JSON line:
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+value = steady-state wall-clock seconds for the full convergence run
+(compile excluded: an identically-shaped warmup run primes the XLA cache,
+matching how the reference's long-lived agents amortise startup).
+vs_baseline = 60 / value (>1 ⇒ beating the 60 s target); 0 if unconverged.
+
+Env overrides: BENCH_NODES, BENCH_PAYLOADS, BENCH_PLATFORM=cpu (debug).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    if os.environ.get("BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    n_nodes = int(os.environ.get("BENCH_NODES", "100000"))
+    n_payloads = int(os.environ.get("BENCH_PAYLOADS", "512"))
+
+    from corrosion_tpu.sim.runner import config_write_storm_100k
+
+    # warmup: AOT lower+compile only (primes the cache without running a
+    # whole convergence loop)
+    config_write_storm_100k(
+        seed=0, n_nodes=n_nodes, n_payloads=n_payloads, compile_only=True
+    )
+    # measured steady-state run
+    m = config_write_storm_100k(seed=1, n_nodes=n_nodes, n_payloads=n_payloads)
+
+    value = round(m["wall_clock_s"], 3)
+    converged = bool(m["converged"])
+    out = {
+        "metric": f"sim_write_storm_{n_nodes // 1000}k_p99_convergence_wallclock",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": round(60.0 / value, 3) if converged and value > 0 else 0.0,
+    }
+    print(json.dumps(out))
+    # context for humans on stderr (driver reads stdout only)
+    print(
+        f"# rounds={m['rounds']} p99_payload_latency={m['p99_payload_latency_rounds']}r "
+        f"p99_node_conv_round={m['p99_node_convergence_round']} "
+        f"converged={converged} nodes={n_nodes} payloads={n_payloads}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""Broadcast dissemination kernel (L6).
+
+Vectorized rebuild of `handle_broadcasts` (broadcast/mod.rs:410-1042): every
+round, each node holding payloads with remaining transmission budget picks
+``fanout`` random up targets and sends its whole eligible buffer to them
+(the reference drains its queue to one chosen member set per 500 ms flush
+tick, so shared targets per round is the faithful model).  Receivers start
+relaying with one transmission already spent (the rebroadcast path,
+handlers.rs:768-779).  A per-node byte budget models the 10 MiB/s governor;
+payloads beyond the budget wait (prefix-sum mask).
+
+Delivery is a scatter-or over sampled edges — `at[dst].max` — into the
+latency ring buffer slot matching the edge's delay class.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .state import ALIVE, PayloadMeta, SimConfig, SimState
+from .topology import Topology, edge_alive, edge_delay, edge_drop
+
+
+def broadcast_step(
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    region: jnp.ndarray,
+    key: jax.Array,
+) -> SimState:
+    n, p = state.have.shape
+    f = cfg.fanout
+    k_targets, k_drop = jax.random.split(key)
+
+    active = (state.injected > 0)[None, :]  # [1, P]
+    # what each node would send: held, budget left, payload active
+    eligible = (state.have > 0) & (state.relay_left > 0) & active  # [N, P]
+
+    # rate limit: FIFO prefix (payload-index == injection order) within the
+    # per-round byte budget — the reference drains its broadcast queue
+    # oldest-first under the governor (broadcast/mod.rs:453-463)
+    cost = jnp.where(eligible, meta.nbytes[None, :], 0)  # [N, P]
+    cum = jnp.cumsum(cost, axis=1)
+    within_budget = cum <= cfg.rate_limit_bytes_round
+    sending = eligible & within_budget  # [N, P]
+
+    # sample fanout targets per node (uniform over the id space; down or
+    # partitioned targets are masked at the edge level, matching SWIM's
+    # lagging membership view rather than an oracle)
+    targets = jax.random.randint(k_targets, (n, f), 0, n, jnp.int32)  # [N, F]
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
+    dst = targets.reshape(-1)  # [E]
+
+    ok = edge_alive(state.group, state.alive, src, dst)
+    ok &= ~edge_drop(topo, k_drop, src.shape[0])
+    ok &= dst != src
+    delay = edge_delay(topo, region, src, dst)  # [E]
+
+    payload = state.have.dtype
+    sent = jnp.where(ok[:, None], sending[src], 0).astype(payload)  # [E, P]
+
+    # scatter into the delay ring: slot (t + delay) mod D per edge
+    d_slots = state.inflight.shape[0]
+    slot = (state.t + delay) % d_slots  # [E]
+    flat_idx = slot * n + dst  # [E] into [D*N]
+    inflight = state.inflight.reshape(d_slots * n, p)
+    inflight = inflight.at[flat_idx].max(sent)
+    inflight = inflight.reshape(d_slots, n, p)
+
+    # transmission budget decays once per flush that actually sent
+    any_edge_ok = ok.reshape(n, f).any(axis=1)  # [N]
+    spent = sending & any_edge_ok[:, None]
+    relay_left = state.relay_left - spent.astype(state.relay_left.dtype)
+
+    return state._replace(inflight=inflight, relay_left=relay_left)
+
+
+def deliver_step(state: SimState, cfg: SimConfig) -> SimState:
+    """Pop this round's delay slot: newly received payloads become held and
+    start relaying with one transmission spent (rebroadcast semantics)."""
+    d_slots = state.inflight.shape[0]
+    slot = state.t % d_slots
+    arriving = state.inflight[slot]  # [N, P]
+    newly = (arriving > 0) & (state.have == 0)
+    have = jnp.maximum(state.have, arriving)
+    relay_init = max(cfg.max_transmissions - 1, 1)
+    relay_left = jnp.where(
+        newly, jnp.uint8(relay_init), state.relay_left
+    ).astype(state.relay_left.dtype)
+    inflight = state.inflight.at[slot].set(0)
+    return state._replace(have=have, relay_left=relay_left, inflight=inflight)
+
+
+def inject_step(state: SimState, meta: PayloadMeta, cfg: SimConfig) -> SimState:
+    """Origin nodes learn their own commits the round they're injected
+    (the local write path: commit → broadcast queue, broadcast.rs:511)."""
+    n, p = state.have.shape
+    injecting = (meta.round == state.t) & (state.alive[meta.actor] == ALIVE)
+    own = jnp.zeros((n, p), state.have.dtype)
+    own = own.at[meta.actor, jnp.arange(p)].max(injecting.astype(state.have.dtype))
+    newly = (own > 0) & (state.have == 0)
+    have = jnp.maximum(state.have, own)
+    relay_left = jnp.where(
+        newly, jnp.uint8(cfg.max_transmissions), state.relay_left
+    ).astype(state.relay_left.dtype)
+    injected = jnp.maximum(state.injected, injecting.astype(state.injected.dtype))
+    return state._replace(have=have, relay_left=relay_left, injected=injected)

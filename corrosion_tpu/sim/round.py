@@ -1,0 +1,122 @@
+"""The round step: one gossip tick for the whole cluster, jit-compiled.
+
+Composition per round t (order matters — intra-region delay 0 means
+same-round delivery, so delivery pops after send):
+
+    inject → broadcast → sync → deliver(slot t) → SWIM → convergence record
+
+The run driver is a `lax.while_loop` over rounds with a convergence
+early-exit, so an entire simulation (the reference's minutes of wall-clock
+per convergence experiment) is ONE XLA computation on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .broadcast import broadcast_step, deliver_step, inject_step
+from .state import ALIVE, PayloadMeta, SimConfig, SimState, init_state
+from .swim import swim_step
+from .sync import sync_step
+from .topology import Topology, regions
+
+
+class RunMetrics(NamedTuple):
+    """Per-run convergence record (device)."""
+
+    coverage_at: jnp.ndarray  # i32[P] round when payload reached every up node
+    converged_at: jnp.ndarray  # i32[N] round when node held all active payloads
+
+
+def new_metrics(cfg: SimConfig) -> RunMetrics:
+    return RunMetrics(
+        coverage_at=jnp.full((cfg.n_payloads,), -1, jnp.int32),
+        converged_at=jnp.full((cfg.n_nodes,), -1, jnp.int32),
+    )
+
+
+def validate(cfg: SimConfig, topo: Topology) -> None:
+    """Trace-time sanity: the delay ring must be able to represent every
+    edge delay (a wrapped slot delivers EARLY, silently)."""
+    max_delay = max(topo.intra_delay, topo.inter_delay, 1)  # sync uses t+1
+    if max_delay >= cfg.n_delay_slots:
+        raise ValueError(
+            f"max edge delay {max_delay} rounds needs n_delay_slots > "
+            f"{max_delay}, got {cfg.n_delay_slots}"
+        )
+
+
+def round_step(
+    state: SimState,
+    metrics: RunMetrics,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    region: jnp.ndarray,
+) -> Tuple[SimState, RunMetrics]:
+    validate(cfg, topo)
+    key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
+    state = state._replace(key=key)
+
+    state = inject_step(state, meta, cfg)
+    state = broadcast_step(state, meta, cfg, topo, region, k_bcast)
+    state = sync_step(state, meta, cfg, topo, k_sync)
+    state = deliver_step(state, cfg)
+    state = swim_step(state, cfg, topo, k_swim)
+
+    # convergence bookkeeping: only payloads that actually entered the
+    # system count (a dead origin's commits never existed cluster-wide)
+    up = (state.alive == ALIVE)[:, None]  # [N, 1]
+    active = (state.injected > 0)[None, :]  # [1, P]
+    held = state.have > 0
+
+    payload_done = jnp.all(held | ~up | ~active, axis=0) & active[0]  # [P]
+    coverage_at = jnp.where(
+        (metrics.coverage_at < 0) & payload_done, state.t, metrics.coverage_at
+    )
+    node_done = jnp.all(held | ~active, axis=1) & up[:, 0]  # [N]
+    all_injected = jnp.all(meta.round <= state.t)
+    converged_at = jnp.where(
+        (metrics.converged_at < 0) & node_done & all_injected,
+        state.t,
+        metrics.converged_at,
+    )
+
+    state = state._replace(t=state.t + 1)
+    return state, RunMetrics(coverage_at=coverage_at, converged_at=converged_at)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "topo", "max_rounds"))
+def run_to_convergence(
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    max_rounds: int = 1000,
+) -> Tuple[SimState, RunMetrics]:
+    """Advance rounds until every up node holds every payload (the
+    check_bookkeeping.py property: need == 0 ∧ equal heads) or max_rounds."""
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+
+    def cond(carry):
+        state, metrics = carry
+        all_injected = jnp.all(meta.round <= state.t)
+        done = all_injected & jnp.all(
+            (metrics.converged_at >= 0) | (state.alive != ALIVE)
+        )
+        return (state.t < max_rounds) & ~done
+
+    def body(carry):
+        state, metrics = carry
+        return round_step(state, metrics, meta, cfg, topo, region)
+
+    return jax.lax.while_loop(cond, body, (state, metrics))
+
+
+def new_sim(cfg: SimConfig, seed: int = 0) -> SimState:
+    return init_state(cfg, jax.random.PRNGKey(seed))

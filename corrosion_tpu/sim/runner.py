@@ -1,0 +1,216 @@
+"""Benchmark scenario runner: the five BASELINE.json configs.
+
+1. 3-node single-writer ground truth (validated against the host agent
+   cluster in tests/sim/test_ground_truth.py);
+2. 64-node SWIM membership churn (no payload);
+3. 1k-node changeset broadcast sweep;
+4. 10k-node WAN partition + heal;
+5. 100k-node write storm (multi-writer, chunked versions).
+
+Each returns a metrics dict with rounds-to-convergence percentiles and
+wall-clock; `ROUND_SECONDS` converts rounds to simulated time (one round =
+the 500 ms broadcast flush tick, BASELINE.md)."""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .round import new_metrics, new_sim, round_step, run_to_convergence
+from .state import ALIVE, DOWN, PayloadMeta, SimConfig, uniform_payloads
+from .topology import Topology, regions
+
+ROUND_SECONDS = 0.5
+
+
+def _percentile(arr: np.ndarray, q: float) -> float:
+    valid = arr[arr >= 0]
+    if valid.size == 0:
+        return float("nan")
+    return float(np.percentile(valid, q))
+
+
+def run_scenario(
+    cfg: SimConfig,
+    meta: PayloadMeta,
+    topo: Topology = Topology(),
+    seed: int = 0,
+    max_rounds: int = 2000,
+    state_mutator=None,
+    compile_only: bool = False,
+) -> Optional[Dict[str, float]]:
+    """Run one scenario to convergence.  ``compile_only`` lowers and
+    compiles the whole run without executing it (cheap warmup for
+    benchmarks — priming the XLA cache costs compile time, not a full
+    convergence run)."""
+    state = new_sim(cfg, seed)
+    if state_mutator is not None:
+        state = state_mutator(state)
+
+    if compile_only:
+        run_to_convergence.lower(state, meta, cfg, topo, max_rounds).compile()
+        return None
+
+    t0 = time.monotonic()
+    final, metrics = run_to_convergence(state, meta, cfg, topo, max_rounds)
+    jax.block_until_ready(final.t)
+    wall = time.monotonic() - t0
+
+    cov = np.asarray(metrics.coverage_at)
+    inj = np.asarray(meta.round)
+    lat = np.where(cov >= 0, cov - inj, -1)
+    node_conv = np.asarray(metrics.converged_at)
+    alive = np.asarray(final.alive)
+    rounds = int(final.t)
+    unconverged = int(((node_conv < 0) & (alive == ALIVE)).sum())
+    return {
+        "n_nodes": cfg.n_nodes,
+        "n_payloads": cfg.n_payloads,
+        "rounds": rounds,
+        "wall_clock_s": wall,
+        "converged": unconverged == 0,
+        "unconverged_nodes": unconverged,
+        "p50_payload_latency_rounds": _percentile(lat, 50),
+        "p99_payload_latency_rounds": _percentile(lat, 99),
+        "p99_payload_latency_sim_s": _percentile(lat, 99) * ROUND_SECONDS,
+        "p99_node_convergence_round": _percentile(node_conv, 99),
+        "rounds_per_sec": rounds / wall if wall > 0 else float("inf"),
+        "node_rounds_per_sec": rounds * cfg.n_nodes / wall if wall > 0 else 0.0,
+    }
+
+
+# -- the five configs -------------------------------------------------------
+
+
+def config_ground_truth_3node(seed: int = 0) -> Dict[str, float]:
+    cfg = SimConfig(n_nodes=3, n_payloads=64, fanout=2, sync_interval_rounds=4)
+    meta = uniform_payloads(cfg, n_writers=1, inject_every=1)
+    return run_scenario(cfg, meta, seed=seed)
+
+
+def config_swim_churn_64(seed: int = 0, max_rounds: int = 400) -> Dict[str, float]:
+    """Config #2: membership only — kill a third of the cluster, measure
+    rounds until every survivor marks every dead node DOWN."""
+    n = 64
+    cfg = SimConfig(n_nodes=n, n_payloads=1, swim_full_view=True)
+    topo = Topology()
+    region = regions(n, topo.n_regions)
+    meta = uniform_payloads(cfg, n_writers=1)
+
+    state = new_sim(cfg, seed)
+    kill = jnp.arange(n) % 3 == 0  # a third die at t=0
+    state = state._replace(
+        alive=jnp.where(kill, jnp.uint8(DOWN), jnp.uint8(ALIVE))
+    )
+    metrics = new_metrics(cfg)
+
+    @jax.jit
+    def ten_rounds(state, metrics):
+        def body(_, carry):
+            return round_step(*carry, meta, cfg, topo, region)
+
+        return jax.lax.fori_loop(0, 10, body, (state, metrics))
+
+    t0 = time.monotonic()
+    detect_round = -1
+    for _ in range(max_rounds // 10):
+        state, metrics = ten_rounds(state, metrics)
+        view = np.asarray(state.view)
+        up = np.asarray(state.alive) == ALIVE
+        dead = ~up
+        if (view[np.ix_(up, dead)] == DOWN).all():
+            detect_round = int(state.t)
+            break
+    wall = time.monotonic() - t0
+    view = np.asarray(state.view)
+    up = np.asarray(state.alive) == ALIVE
+    dead = ~up
+    return {
+        "n_nodes": n,
+        "detect_round": detect_round,
+        "detect_sim_s": detect_round * ROUND_SECONDS if detect_round >= 0 else -1,
+        "detected_fraction": float((view[np.ix_(up, dead)] == DOWN).mean()),
+        "wall_clock_s": wall,
+        "converged": detect_round >= 0,
+        "false_positive_downs": int((view[np.ix_(up, up)] == DOWN).sum()),
+    }
+
+
+def config_broadcast_1k(seed: int = 0) -> Dict[str, float]:
+    cfg = SimConfig(n_nodes=1000, n_payloads=256, fanout=3)
+    meta = uniform_payloads(cfg, n_writers=8, inject_every=2)
+    return run_scenario(cfg, meta, seed=seed)
+
+
+def config_partition_heal_10k(seed: int = 0) -> Dict[str, float]:
+    """Config #4: two halves partitioned for the first 60 rounds, writers on
+    both sides, convergence measured after heal."""
+    cfg = SimConfig(n_nodes=10_000, n_payloads=256, fanout=3)
+    meta = uniform_payloads(cfg, n_writers=4, inject_every=1)
+    topo = Topology(n_regions=2, inter_delay=2)
+    region = regions(cfg.n_nodes, topo.n_regions)
+
+    state = new_sim(cfg, seed)
+    group = (jnp.arange(cfg.n_nodes) >= cfg.n_nodes // 2).astype(jnp.int32)
+    state = state._replace(group=group)
+    metrics = new_metrics(cfg)
+
+    @jax.jit
+    def run_partitioned(state, metrics):
+        def body(_, carry):
+            return round_step(*carry, meta, cfg, topo, region)
+
+        return jax.lax.fori_loop(0, 60, body, (state, metrics))
+
+    t0 = time.monotonic()
+    state, metrics = run_partitioned(state, metrics)
+    state = state._replace(group=jnp.zeros((cfg.n_nodes,), jnp.int32))
+    heal_round = int(state.t)
+    final, metrics = run_to_convergence(state, meta, cfg, topo, 2000)
+    jax.block_until_ready(final.t)
+    wall = time.monotonic() - t0
+
+    node_conv = np.asarray(metrics.converged_at)
+    alive = np.asarray(final.alive)
+    unconverged = int(((node_conv < 0) & (alive == ALIVE)).sum())
+    return {
+        "n_nodes": cfg.n_nodes,
+        "heal_round": heal_round,
+        "rounds": int(final.t),
+        "rounds_after_heal": int(final.t) - heal_round,
+        "p99_node_convergence_round": _percentile(node_conv, 99),
+        "converged": unconverged == 0,
+        "unconverged_nodes": unconverged,
+        "wall_clock_s": wall,
+    }
+
+
+def _write_storm(n_nodes: int, n_payloads: int):
+    cfg = SimConfig(
+        n_nodes=n_nodes,
+        n_payloads=n_payloads,
+        fanout=3,
+        sync_interval_rounds=8,
+        sync_peers=3,
+    )
+    meta = uniform_payloads(cfg, n_writers=16, chunks_per_version=4, inject_every=2)
+    return cfg, meta
+
+
+def config_write_storm_100k(
+    seed: int = 0,
+    n_nodes: int = 100_000,
+    n_payloads: int = 512,
+    compile_only: bool = False,
+) -> Optional[Dict[str, float]]:
+    """Config #5: the north-star scale — 100k nodes, multi-writer chunked
+    write storm (consul-service style), p99 time-to-convergence."""
+    cfg, meta = _write_storm(n_nodes, n_payloads)
+    return run_scenario(
+        cfg, meta, seed=seed, max_rounds=3000, compile_only=compile_only
+    )

@@ -1,0 +1,169 @@
+"""Simulator state: the cluster as device arrays.
+
+This is the north star's "node×changeset-version matrix" (BASELINE.json):
+the reference's per-node `BookedVersions`/broadcast queues/SWIM state
+(SURVEY.md §2.3) become node-major tensors, and one jitted `round_step`
+advances every node at once.
+
+State layout:
+- ``have[N, P] u8``     — node n holds payload p (a changeset chunk).  This is
+  the on-device form of corro-types' `Changeset` dissemination state: L6
+  broadcast marks bits via sampled fan-out edges, L7 sync fills them via
+  pairwise need pulls (need = ~have[i] & have[j], which is exactly
+  `compute_available_needs` restricted to the active window).
+- ``relay_left[N, P] u8`` — remaining epidemic retransmissions
+  (`max_transmissions` decay, broadcast/mod.rs:653-778).
+- ``inflight[D, N, P] u8`` — latency ring buffer: deliveries scheduled d
+  rounds ahead (RTT-ring classes, members.rs:38).
+- SWIM (full-view mode, for N ≤ a few thousand):
+  ``view[N, N] i8`` (what i believes about j: 0 alive / 1 suspect / 2 down),
+  ``vinc[N, N] i32`` believed incarnations, ``suspect_since[N, N] i32``.
+  At 100k nodes the sim runs ground-truth membership (alive mask only) —
+  the dissemination question doesn't need per-node views at that scale.
+- ``alive[N] u8`` ground truth up/down; ``incarnation[N] u32``.
+- ``group[N] i32`` partition group (edges across groups are cut).
+
+Payload metadata (static per scenario): ``p_actor[P]``, ``p_version[P]``,
+``p_chunk[P]``, ``p_nchunks[P]``, ``p_bytes[P]``, ``p_round[P]`` (injection
+round; a payload activates once the sim reaches it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+ALIVE, SUSPECT, DOWN = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static configuration (hashable: goes into jit closure).
+
+    Defaults mirror the reference's operating envelope (BASELINE.md):
+    fanout from `choose_count` (broadcast/mod.rs:653-680), max_transmissions
+    and WAN SWIM parameters from foca's config (broadcast/mod.rs:951-960),
+    sync cadence from config.rs:49-59, 10 MiB/s rate limit from
+    broadcast/mod.rs:460-463.  One round ≈ one broadcast flush tick (500 ms).
+    """
+
+    n_nodes: int
+    n_payloads: int
+    # broadcast (L6)
+    fanout: int = 3  # num_indirect_probes floor of choose_count
+    max_transmissions: int = 10
+    rate_limit_bytes_round: int = 5 * 1024 * 1024  # 10 MiB/s * 0.5 s tick
+    # sync (L7) — cadence in rounds: backoff 1-15 s ≈ 2-30 rounds
+    sync_interval_rounds: int = 8
+    sync_peers: int = 3  # (n/100).clamp(3,10)
+    sync_budget_bytes: int = 4 * 1024 * 1024
+    # SWIM (L5)
+    swim_full_view: bool = False
+    probe_period_rounds: int = 2  # probe every ~1 s
+    suspect_timeout_rounds: int = 6  # ~3 s suspicion
+    indirect_probes: int = 3
+    # latency model: delivery delay in rounds per latency class
+    n_delay_slots: int = 4
+    # payload byte size assumed when metadata gives none
+    default_payload_bytes: int = 8 * 1024
+
+    def sync_peers_clamped(self) -> int:
+        return max(3, min(10, self.n_nodes // 100 or 3))
+
+
+class PayloadMeta(NamedTuple):
+    """Static per-payload metadata arrays (device)."""
+
+    actor: jnp.ndarray  # i32[P] origin node index
+    version: jnp.ndarray  # i32[P] db_version
+    chunk: jnp.ndarray  # i32[P] chunk index within version
+    nchunks: jnp.ndarray  # i32[P]
+    nbytes: jnp.ndarray  # i32[P]
+    round: jnp.ndarray  # i32[P] injection round
+
+
+class SimState(NamedTuple):
+    """Dynamic per-round state (device pytree)."""
+
+    t: jnp.ndarray  # i32 scalar round counter
+    key: jnp.ndarray  # PRNG key
+    have: jnp.ndarray  # u8[N, P]
+    injected: jnp.ndarray  # u8[P] payload entered the system (origin was up)
+    relay_left: jnp.ndarray  # u8[N, P]
+    inflight: jnp.ndarray  # u8[D, N, P]
+    sync_countdown: jnp.ndarray  # i32[N]
+    alive: jnp.ndarray  # u8[N] ground truth (0 = up!  uses ALIVE/DOWN consts)
+    incarnation: jnp.ndarray  # u32[N]
+    group: jnp.ndarray  # i32[N] partition group
+    # SWIM full-view mode (zero-sized when disabled)
+    view: jnp.ndarray  # i8[N, N] or [0, 0]
+    vinc: jnp.ndarray  # i32[N, N] or [0, 0]
+    suspect_since: jnp.ndarray  # i32[N, N] or [0, 0]
+    # per-node converged-at round (-1 while not converged) for p99 stats
+    converged_at: jnp.ndarray  # i32[N]
+
+
+def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
+    n, p = cfg.n_nodes, cfg.n_payloads
+    swim_n = cfg.n_nodes if cfg.swim_full_view else 0
+    key, sub = jax.random.split(key)
+    return SimState(
+        t=jnp.zeros((), jnp.int32),
+        key=key,
+        have=jnp.zeros((n, p), jnp.uint8),
+        injected=jnp.zeros((p,), jnp.uint8),
+        relay_left=jnp.zeros((n, p), jnp.uint8),
+        inflight=jnp.zeros((cfg.n_delay_slots, n, p), jnp.uint8),
+        sync_countdown=jax.random.randint(
+            sub, (n,), 0, cfg.sync_interval_rounds, jnp.int32
+        ),
+        alive=jnp.zeros((n,), jnp.uint8),
+        incarnation=jnp.zeros((n,), jnp.uint32),
+        group=jnp.zeros((n,), jnp.int32),
+        view=jnp.zeros((swim_n, swim_n), jnp.int8),
+        vinc=jnp.zeros((swim_n, swim_n), jnp.int32),
+        suspect_since=jnp.full((swim_n, swim_n), -1, jnp.int32),
+        converged_at=jnp.full((n,), -1, jnp.int32),
+    )
+
+
+def uniform_payloads(
+    cfg: SimConfig,
+    n_writers: int = 1,
+    versions_per_writer: Optional[int] = None,
+    chunks_per_version: int = 1,
+    inject_every: int = 1,
+    payload_bytes: Optional[int] = None,
+) -> PayloadMeta:
+    """A write-storm scenario: ``n_writers`` origins each commit versions of
+    ``chunks_per_version`` chunks, injected ``inject_every`` rounds apart."""
+    p = cfg.n_payloads
+    if n_writers > p:
+        raise ValueError(
+            f"n_writers={n_writers} exceeds n_payloads={p}: every writer "
+            "needs at least one payload"
+        )
+    per_writer = p // n_writers
+    vpw = versions_per_writer or max(1, per_writer // chunks_per_version)
+    idx = jnp.arange(p, dtype=jnp.int32)
+    within = idx % per_writer
+    actor = jnp.minimum(idx // per_writer, n_writers - 1)
+    version = 1 + within // chunks_per_version
+    chunk = within % chunks_per_version
+    # writers spread across the node id space
+    actor_node = (actor * max(1, cfg.n_nodes // n_writers)) % cfg.n_nodes
+    return PayloadMeta(
+        actor=actor_node.astype(jnp.int32),
+        version=jnp.minimum(version, vpw).astype(jnp.int32),
+        chunk=chunk.astype(jnp.int32),
+        nchunks=jnp.full((p,), chunks_per_version, jnp.int32),
+        nbytes=jnp.full(
+            (p,), payload_bytes or cfg.default_payload_bytes, jnp.int32
+        ),
+        round=((version - 1) * inject_every).astype(jnp.int32),
+    )
+
+

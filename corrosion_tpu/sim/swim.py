@@ -1,0 +1,130 @@
+"""SWIM membership kernel (L5).
+
+Vectorized rebuild of the Foca-driven `runtime_loop` (broadcast/mod.rs:
+122-386) in full-view mode: per-node belief matrices instead of per-node
+state machines.
+
+- ``view[i, j]``: what i believes about j (ALIVE/SUSPECT/DOWN),
+- ``vinc[i, j]``: the incarnation that belief refers to,
+- ``suspect_since[i, j]``: round when i started suspecting j.
+
+Round phases (each a masked tensor update):
+1. **Probe** — every up node probes one sampled target; an unreachable
+   target (down, partitioned, or lossy) falls back to ``indirect_probes``
+   sampled relays; if none reach it either, the prober marks SUSPECT.
+2. **Suspicion timeout** — SUSPECT older than ``suspect_timeout_rounds``
+   becomes DOWN (foca's WAN-tuned suspicion window).
+3. **Gossip merge** — sampled edges push belief rows; the receiver keeps,
+   per column, whichever belief has the higher incarnation, or at equal
+   incarnation the worse state (DOWN > SUSPECT > ALIVE) — SWIM's refutation
+   ordering.
+4. **Refute** — a live node that sees itself suspected bumps its own
+   incarnation and re-asserts ALIVE (Actor::renew's auto-rejoin analog,
+   actor.rs:199-209).
+
+Full-view SWIM is O(N²) state — right for the 64-4096-node membership-churn
+configs; the 100k dissemination configs run ground-truth membership.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .state import ALIVE, DOWN, SUSPECT, SimConfig, SimState
+from .topology import Topology
+
+
+def _reachable(
+    state: SimState, topo: Topology, key: jax.Array, src: jnp.ndarray, dst: jnp.ndarray
+) -> jnp.ndarray:
+    """Ground-truth reachability of a probe message src→dst."""
+    ok = (
+        (state.group[src] == state.group[dst])
+        & (state.alive[src] == ALIVE)
+        & (state.alive[dst] == ALIVE)
+    )
+    if topo.loss > 0:
+        ok &= ~jax.random.bernoulli(key, topo.loss, src.shape)
+    return ok
+
+
+def swim_step(
+    state: SimState, cfg: SimConfig, topo: Topology, key: jax.Array
+) -> SimState:
+    if not cfg.swim_full_view:
+        return state
+    n = state.alive.shape[0]
+    k_probe, k_ploss, k_relay, k_rloss, k_gossip, k_gloss = jax.random.split(key, 6)
+    me = jnp.arange(n, dtype=jnp.int32)
+    up = state.alive == ALIVE
+
+    view, vinc, since = state.view, state.vinc, state.suspect_since
+
+    # -- 1. probe ---------------------------------------------------------
+    do_probe = up & (state.t % cfg.probe_period_rounds == 0)
+    target = jax.random.randint(k_probe, (n,), 0, n, jnp.int32)
+    direct = _reachable(state, topo, k_ploss, me, target)
+    # indirect probes through sampled relays (handlers: ping-req path)
+    relays = jax.random.randint(k_relay, (n, cfg.indirect_probes), 0, n, jnp.int32)
+    hop_keys = jax.random.split(k_rloss, 2)
+    leg1 = _reachable(
+        state, topo, hop_keys[0],
+        jnp.repeat(me, cfg.indirect_probes), relays.reshape(-1),
+    ).reshape(n, cfg.indirect_probes)
+    leg2 = _reachable(
+        state, topo, hop_keys[1],
+        relays.reshape(-1), jnp.repeat(target, cfg.indirect_probes),
+    ).reshape(n, cfg.indirect_probes)
+    indirect = (leg1 & leg2).any(axis=1)
+    acked = direct | indirect
+    probe_failed = do_probe & ~acked & (target != me)
+
+    # mark suspect (only if we currently think it alive at that incarnation)
+    cur = view[me, target]
+    newly_suspect = probe_failed & (cur == ALIVE)
+    view = view.at[me, target].set(
+        jnp.where(newly_suspect, jnp.int8(SUSPECT), cur)
+    )
+    since = since.at[me, target].set(
+        jnp.where(newly_suspect, state.t, since[me, target])
+    )
+
+    # -- 2. suspicion timeout --------------------------------------------
+    expired = (view == SUSPECT) & (since >= 0) & (
+        state.t - since >= cfg.suspect_timeout_rounds
+    )
+    view = jnp.where(expired, jnp.int8(DOWN), view)
+
+    # -- 3. gossip merge --------------------------------------------------
+    # Parallel scatter-max over sampled edges.  Beliefs are encoded as a
+    # single key inc*4 + state so that max() implements SWIM precedence:
+    # higher incarnation wins; at equal incarnation the worse state wins
+    # (DOWN=2 > SUSPECT=1 > ALIVE=0).
+    g_targets = jax.random.randint(k_gossip, (n, cfg.fanout), 0, n, jnp.int32)
+    gsrc = jnp.repeat(me, cfg.fanout)
+    gdst = g_targets.reshape(-1)
+    g_ok = _reachable(state, topo, k_gloss, gsrc, gdst)
+
+    belief_key = vinc.astype(jnp.int32) * 4 + view.astype(jnp.int32)  # [N, N]
+    contrib = jnp.where(g_ok[:, None], belief_key[gsrc], jnp.int32(-1))  # [E, N]
+    merged = belief_key.at[gdst].max(contrib)
+    changed = merged > belief_key
+    new_view = (merged % 4).astype(jnp.int8)
+    view = jnp.where(changed, new_view, view)
+    vinc = jnp.where(changed, (merged // 4).astype(jnp.int32), vinc)
+    since = jnp.where(changed & (new_view == SUSPECT), state.t, since)
+
+    # -- 4. refute --------------------------------------------------------
+    self_belief = view[me, me]
+    refuting = up & (self_belief != ALIVE)
+    incarnation = state.incarnation + refuting.astype(jnp.uint32)
+    new_inc = incarnation.astype(jnp.int32)
+    view = view.at[me, me].set(
+        jnp.where(refuting, jnp.int8(ALIVE), self_belief)
+    )
+    vinc = vinc.at[me, me].set(jnp.where(refuting, new_inc, vinc[me, me]))
+
+    return state._replace(
+        view=view, vinc=vinc, suspect_since=since, incarnation=incarnation
+    )

@@ -1,0 +1,77 @@
+"""Anti-entropy sync kernel (L7).
+
+Vectorized rebuild of `sync_loop`/`parallel_sync` (util.rs:347-393,
+peer/mod.rs:1003-1403): each node counts down to its next sync round
+(decorrelated 1-15 s backoff ≈ uniform re-arm over the interval); when due,
+it samples ``sync_peers`` peers and pulls what they can serve:
+
+    pulled = ~have[i] & have[peer] & active      (per payload)
+
+— which is the active-window form of `compute_available_needs`
+(sync.rs:127-249): the peer's fully-held set intersected with our needs.
+Transfers respect a per-round sync byte budget with oldest-version-first
+priority (the reference requests needs in version order and chunks at
+8 KiB); leftovers are picked up next round.  Sync delivery takes one round
+(the bi-stream RTT).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .state import PayloadMeta, SimConfig, SimState
+from .topology import Topology, edge_alive, edge_drop
+
+
+def sync_step(
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    key: jax.Array,
+) -> SimState:
+    n, p = state.have.shape
+    s = cfg.sync_peers
+    k_peers, k_drop, k_rearm = jax.random.split(key, 3)
+
+    due = state.sync_countdown <= 0  # [N]
+    active = (state.injected > 0)[None, :]
+
+    peers = jax.random.randint(k_peers, (n, s), 0, n, jnp.int32)  # [N, S]
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s)  # [E] the puller
+    dst = peers.reshape(-1)  # [E] the server
+
+    ok = edge_alive(state.group, state.alive, src, dst)
+    ok &= ~edge_drop(topo, k_drop, src.shape[0])
+    ok &= due[src]
+    ok &= dst != src
+
+    # need computation per edge: what the server has that the puller lacks
+    need = (state.have[dst] > 0) & (state.have[src] == 0) & active  # [E, P]
+    need &= ok[:, None]
+
+    # oldest-first budget: payloads are laid out in version order per writer;
+    # prioritise by global version then actor (matches request ordering)
+    order = jnp.argsort(meta.version * (n + 1) + meta.actor)
+    cost_ord = jnp.where(need[:, order], meta.nbytes[order][None, :], 0)
+    cum = jnp.cumsum(cost_ord, axis=1)
+    within = cum <= cfg.sync_budget_bytes
+    granted_ord = need[:, order] & within
+    granted = jnp.zeros_like(need).at[:, order].set(granted_ord)
+
+    # deliver next round via the delay ring (bi-stream round trip)
+    d_slots = state.inflight.shape[0]
+    slot = (state.t + 1) % d_slots
+    flat_idx = slot * n + src  # pulls arrive at the puller
+    inflight = state.inflight.reshape(d_slots * n, p)
+    inflight = inflight.at[flat_idx].max(granted.astype(state.have.dtype))
+    inflight = inflight.reshape(d_slots, n, p)
+
+    # re-arm countdowns: due nodes pick a fresh uniform backoff
+    rearm = jax.random.randint(
+        k_rearm, (n,), 1, cfg.sync_interval_rounds + 1, jnp.int32
+    )
+    countdown = jnp.where(due, rearm, state.sync_countdown - 1)
+
+    return state._replace(inflight=inflight, sync_countdown=countdown)

@@ -1,0 +1,64 @@
+"""Topology and link model for the simulator.
+
+The reference tiers peers into RTT rings (members.rs:38: [0,6) [6,15) [15,50)
+[50,100) [100,200) [200,300) ms) and broadcasts ring-0 first; the sim maps
+rings onto round-delay classes (one round ≈ the 500 ms flush tick, so WAN
+rings land in delay 1-2 rounds, ICI-local in 0).
+
+Nodes get a static ``region[N]`` label; the delay class of an edge is 0
+within a region and grows with region distance.  Partitions cut edges whose
+endpoints are in different ``group``s (healing resets groups to 0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Static per-scenario topology parameters."""
+
+    n_regions: int = 1
+    intra_delay: int = 0  # rounds
+    inter_delay: int = 1  # rounds
+    loss: float = 0.0  # per-message drop probability
+
+
+def regions(n_nodes: int, n_regions: int) -> jnp.ndarray:
+    """Contiguous region assignment (Fly.io-style geographic pools)."""
+    per = max(1, n_nodes // n_regions)
+    return jnp.minimum(jnp.arange(n_nodes, dtype=jnp.int32) // per, n_regions - 1)
+
+
+def edge_delay(
+    topo: Topology, region: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray
+) -> jnp.ndarray:
+    """Delay class (rounds) per edge, from region distance."""
+    same = region[src] == region[dst]
+    return jnp.where(same, topo.intra_delay, topo.inter_delay).astype(jnp.int32)
+
+
+def edge_alive(
+    group: jnp.ndarray, alive: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray
+) -> jnp.ndarray:
+    """Reachability mask per edge: same partition group, both endpoints up."""
+    from .state import ALIVE
+
+    return (
+        (group[src] == group[dst])
+        & (alive[src] == ALIVE)
+        & (alive[dst] == ALIVE)
+    )
+
+
+def edge_drop(
+    topo: Topology, key: jax.Array, n_edges: int
+) -> jnp.ndarray:
+    """Per-edge Bernoulli loss (the Antithesis-style fault injection knob)."""
+    if topo.loss <= 0.0:
+        return jnp.zeros((n_edges,), jnp.bool_)
+    return jax.random.bernoulli(key, topo.loss, (n_edges,))

@@ -1,18 +1,25 @@
 """Test-wide environment: force JAX onto a virtual 8-device CPU mesh so
 multi-chip sharding paths are exercised without TPU hardware (the driver
-separately dry-runs `__graft_entry__.dryrun_multichip`).
+separately dry-runs `__graft_entry__.dryrun_multichip`; bench.py keeps the
+real chip).
 
-Must run before jax is imported anywhere.
+Must run before jax is used anywhere.  NOTE: this image's profile pins
+JAX_PLATFORMS=axon and the plugin wins over the env var, so the platform is
+forced via jax.config, which does take precedence.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
