@@ -92,18 +92,26 @@ class Agent:
 
             for sql in read_sql_files(path):
                 self.store.execute_schema(sql)
-        # static bootstrap membership (M1; SWIM replaces this when attached)
-        for i, addr in enumerate(self.config.bootstrap):
-            if addr != self.transport.addr:
-                self.members.add_member(
-                    Actor(id=ActorId(bytes([0] * 15 + [i + 1])), addr=addr, ts=0)
-                )
+        if self.config.use_swim:
+            from .swim import SwimRuntime
+
+            SwimRuntime.attach(self)
+            await self.swim.start()
+        else:
+            # static membership straight from the bootstrap list
+            for i, addr in enumerate(self.config.bootstrap):
+                if addr != self.transport.addr:
+                    self.members.add_member(
+                        Actor(id=ActorId(bytes([0] * 15 + [i + 1])), addr=addr, ts=0)
+                    )
         self._tasks.append(asyncio.create_task(self._broadcast_loop()))
         self._tasks.append(asyncio.create_task(self._ingest_loop()))
         self._tasks.append(asyncio.create_task(self._sync_loop()))
 
     async def stop(self):
         self._stopped.set()
+        if self.swim is not None:
+            await self.swim.stop()
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)

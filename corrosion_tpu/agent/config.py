@@ -54,6 +54,8 @@ class Config:
     bootstrap: List[str] = field(default_factory=list)
     schema_paths: List[str] = field(default_factory=list)
     cluster_id: int = 0
+    # SWIM membership (L5); False = static membership from the bootstrap list
+    use_swim: bool = True
     perf: PerfConfig = field(default_factory=PerfConfig)
     admin_path: str = ""  # unix socket path; "" disables
 

@@ -1,0 +1,330 @@
+"""Host SWIM membership runtime (L5).
+
+Rebuild of the reference's Foca-driven `runtime_loop`
+(`corro-agent/src/broadcast/mod.rs:122-386`; Foca is the SWIM library the
+reference embeds) on the transport's datagram verb:
+
+- periodic **probe** of a sampled member, falling back to
+  ``num_indirect_probes`` ping-req relays (SWIM's indirect probe);
+- **suspect → down** after a timeout, with suspicion disseminated;
+- **refutation**: a node seeing itself suspected bumps its incarnation and
+  re-asserts ALIVE (the reference's `Actor::renew` auto-rejoin pattern,
+  actor.rs:199-209);
+- **piggyback dissemination**: membership updates ride probe/ack datagrams,
+  each retransmitted up to ``max_transmissions`` times, datagrams capped at
+  ``swim_max_packet_size`` (1178 B, broadcast/mod.rs:958);
+- **join**: announce to bootstrap addresses; peers answer with a membership
+  snapshot (foca's Announce/feed);
+- member state persisted to ``__corro_members`` and replayed on boot
+  (broadcast/mod.rs:889-948, util.rs:66-101).
+
+State per known member: (addr, incarnation, status, hlc_ts).  Status
+precedence for merging is SWIM's: higher incarnation wins; at equal
+incarnation DOWN > SUSPECT > ALIVE.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from ..core.types import Actor, ActorId
+
+if TYPE_CHECKING:
+    from .agent import Agent
+
+ALIVE, SUSPECT, DOWN = 0, 1, 2
+
+
+@dataclass
+class MemberInfo:
+    actor_id: ActorId
+    addr: str
+    incarnation: int = 0
+    status: int = ALIVE
+    ts: int = 0  # identity timestamp (renew() bumps)
+    suspect_since: float = -1.0
+
+    def key(self):
+        return (self.incarnation, self.status)
+
+
+@dataclass
+class _Update:
+    """A disseminating membership update with a retransmission budget."""
+
+    info: MemberInfo
+    sends_left: int
+
+
+def _encode_member(m: MemberInfo) -> list:
+    return [m.actor_id.hex(), m.addr, m.incarnation, m.status, m.ts]
+
+
+def _decode_member(row: list) -> MemberInfo:
+    return MemberInfo(
+        actor_id=ActorId.from_hex(row[0]), addr=row[1],
+        incarnation=row[2], status=row[3], ts=row[4],
+    )
+
+
+class SwimRuntime:
+    def __init__(self, agent: "Agent"):
+        self.agent = agent
+        self.transport = agent.transport
+        self.incarnation = 0
+        self.members: Dict[ActorId, MemberInfo] = {}
+        self._updates: List[_Update] = []
+        self._pending_acks: Dict[int, asyncio.Event] = {}
+        self._seq = 0
+        self._rng = random.Random(agent.actor_id.bytes_ + b"swim")
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    @classmethod
+    def attach(cls, agent: "Agent") -> "SwimRuntime":
+        rt = cls(agent)
+        agent.swim = rt
+        return rt
+
+    async def start(self):
+        self._load_members()
+        for addr in self.agent.config.bootstrap:
+            if addr != self.transport.addr:
+                await self._send(addr, {"k": "join", "me": self._self_member()})
+        self._tasks.append(asyncio.create_task(self._probe_loop()))
+
+    async def stop(self):
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._persist_members()
+
+    def _self_member(self) -> list:
+        return _encode_member(
+            MemberInfo(
+                actor_id=self.agent.actor_id, addr=self.transport.addr,
+                incarnation=self.incarnation, status=ALIVE,
+                ts=self.agent.clock.peek(),
+            )
+        )
+
+    # -- persistence (reference __corro_members) --------------------------
+
+    def _load_members(self):
+        for row in self.agent.store.conn.execute(
+            "SELECT actor_id, address, foca_state FROM __corro_members"
+        ):
+            try:
+                info = _decode_member(json.loads(row[2]))
+            except (TypeError, json.JSONDecodeError):
+                continue
+            if info.actor_id != self.agent.actor_id:
+                # replayed members start as suspects until a probe confirms
+                info.status = min(info.status, SUSPECT)
+                info.suspect_since = time.monotonic()
+                self.members[info.actor_id] = info
+                self._apply_to_agent(info)
+
+    def _persist_members(self):
+        conn = self.agent.store.conn
+        conn.execute("DELETE FROM __corro_members")
+        conn.executemany(
+            "INSERT OR REPLACE INTO __corro_members (actor_id, address, foca_state) "
+            "VALUES (?, ?, ?)",
+            [
+                (m.actor_id.bytes_, m.addr, json.dumps(_encode_member(m)))
+                for m in self.members.values()
+            ],
+        )
+
+    # -- wire -------------------------------------------------------------
+
+    async def _send(self, addr: str, msg: dict):
+        msg["gossip"] = self._pick_gossip()
+        data = json.dumps(msg, separators=(",", ":")).encode()
+        # stay under the SWIM datagram budget by shedding gossip entries
+        while len(data) > self.agent.config.perf.swim_max_packet_size and msg["gossip"]:
+            msg["gossip"].pop()
+            data = json.dumps(msg, separators=(",", ":")).encode()
+        try:
+            await self.transport.send_datagram(addr, data)
+        except (ConnectionError, OSError):
+            pass
+
+    def _pick_gossip(self) -> list:
+        out = []
+        for upd in list(self._updates):
+            if upd.sends_left <= 0:
+                self._updates.remove(upd)
+                continue
+            upd.sends_left -= 1
+            out.append(_encode_member(upd.info))
+            if len(out) >= 6:
+                break
+        return out
+
+    def _disseminate(self, info: MemberInfo):
+        self._updates.insert(
+            0,
+            _Update(
+                info=info,
+                sends_left=self.agent.config.perf.swim_max_transmissions,
+            ),
+        )
+
+    async def handle_datagram(self, src: str, data: bytes):
+        try:
+            msg = json.loads(data)
+        except json.JSONDecodeError:
+            return
+        kind = msg.get("k")
+        for row in msg.get("gossip", []):
+            self._merge(_decode_member(row))
+        if kind == "join":
+            joiner = _decode_member(msg["me"])
+            self._merge(joiner)
+            # feed the joiner a membership snapshot (foca Announce reply)
+            snapshot = [self._self_member()] + [
+                _encode_member(m)
+                for m in self.members.values()
+                if m.status == ALIVE
+            ][:12]
+            await self._send(joiner.addr, {"k": "feed", "members": snapshot})
+        elif kind == "feed":
+            for row in msg.get("members", []):
+                self._merge(_decode_member(row))
+        elif kind == "ping":
+            await self._send(msg["from"], {"k": "ack", "seq": msg["seq"]})
+        elif kind == "ping_req":
+            # relay: probe the target on behalf of the requester
+            seq, target, back = msg["seq"], msg["target"], msg["from"]
+
+            async def relay():
+                ok = await self._probe_once(target)
+                if ok:
+                    await self._send(back, {"k": "ack", "seq": seq})
+
+            self._tasks.append(asyncio.create_task(relay()))
+        elif kind == "ack":
+            ev = self._pending_acks.get(msg["seq"])
+            if ev is not None:
+                ev.set()
+
+    # -- merge rules ------------------------------------------------------
+
+    def _merge(self, info: MemberInfo):
+        if info.actor_id == self.agent.actor_id:
+            # refutation: someone thinks we're suspect/down
+            if info.status != ALIVE and info.incarnation >= self.incarnation:
+                self.incarnation = info.incarnation + 1
+                me = _decode_member(self._self_member())
+                self._disseminate(me)
+            return
+        cur = self.members.get(info.actor_id)
+        if cur is not None and cur.key() >= info.key():
+            return  # stale
+        if cur is None:
+            info = MemberInfo(**{**info.__dict__})
+        else:
+            cur.incarnation = info.incarnation
+            cur.status = info.status
+            cur.addr = info.addr
+            cur.ts = max(cur.ts, info.ts)
+            info = cur
+        if info.status == SUSPECT and info.suspect_since < 0:
+            info.suspect_since = time.monotonic()
+        if info.status == ALIVE:
+            info.suspect_since = -1.0
+        self.members[info.actor_id] = info
+        self._apply_to_agent(info)
+        self._disseminate(info)
+
+    def _apply_to_agent(self, info: MemberInfo):
+        """Bridge to the agent's Members (the reference's DispatchRuntime →
+        MemberEvent notifications path, handlers.rs:279-366)."""
+        actor = Actor(id=info.actor_id, addr=info.addr, ts=info.ts)
+        if info.status == DOWN:
+            self.agent.members.remove_member(actor)
+        else:
+            self.agent.members.add_member(actor)
+
+    # -- probing ----------------------------------------------------------
+
+    async def _probe_once(self, addr: str) -> bool:
+        self._seq += 1
+        seq = self._seq
+        ev = asyncio.Event()
+        self._pending_acks[seq] = ev
+        try:
+            await self._send(
+                addr, {"k": "ping", "seq": seq, "from": self.transport.addr}
+            )
+            try:
+                await asyncio.wait_for(
+                    ev.wait(), self.agent.config.perf.swim_probe_timeout_s
+                )
+                return True
+            except asyncio.TimeoutError:
+                return False
+        finally:
+            self._pending_acks.pop(seq, None)
+
+    async def _probe_loop(self):
+        perf = self.agent.config.perf
+        while not self._stopped:
+            await asyncio.sleep(perf.swim_probe_interval_s)
+            self._expire_suspects()
+            candidates = [
+                m for m in self.members.values() if m.status != DOWN
+            ]
+            if not candidates:
+                continue
+            target = self._rng.choice(candidates)
+            ok = await self._probe_once(target.addr)
+            if not ok:
+                # indirect probes through sampled relays
+                relays = [
+                    m for m in candidates
+                    if m.actor_id != target.actor_id
+                ]
+                self._rng.shuffle(relays)
+                self._seq += 1
+                seq = self._seq
+                ev = asyncio.Event()
+                self._pending_acks[seq] = ev
+                for relay in relays[: perf.swim_num_indirect_probes]:
+                    await self._send(
+                        relay.addr,
+                        {
+                            "k": "ping_req", "seq": seq,
+                            "target": target.addr, "from": self.transport.addr,
+                        },
+                    )
+                try:
+                    await asyncio.wait_for(ev.wait(), perf.swim_probe_timeout_s * 2)
+                    ok = True
+                except asyncio.TimeoutError:
+                    ok = False
+                finally:
+                    self._pending_acks.pop(seq, None)
+            if not ok and target.status == ALIVE:
+                target.status = SUSPECT
+                target.suspect_since = time.monotonic()
+                self._disseminate(target)
+
+    def _expire_suspects(self):
+        timeout = self.agent.config.perf.swim_suspect_timeout_s
+        now = time.monotonic()
+        for m in self.members.values():
+            if m.status == SUSPECT and now - m.suspect_since > timeout:
+                m.status = DOWN
+                self._apply_to_agent(m)
+                self._disseminate(m)

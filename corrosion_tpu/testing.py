@@ -35,6 +35,9 @@ def fast_perf() -> PerfConfig:
         broadcast_flush_interval_s=0.02,
         sync_backoff_min_s=0.05,
         sync_backoff_max_s=0.3,
+        swim_probe_interval_s=0.05,
+        swim_probe_timeout_s=0.1,
+        swim_suspect_timeout_s=0.5,
     )
 
 
@@ -48,6 +51,7 @@ class Cluster:
         link: Optional[LinkModel] = None,
         connectivity: Optional[int] = None,
         seed: int = 0,
+        use_swim: bool = True,
     ):
         self.n = n
         self.schema = schema
@@ -56,6 +60,7 @@ class Cluster:
         self.tmp = tempfile.TemporaryDirectory()
         self.connectivity = connectivity
         self.seed = seed
+        self.use_swim = use_swim
 
     async def start(self):
         import random
@@ -74,6 +79,7 @@ class Cluster:
                 db_path=f"{self.tmp.name}/node{i}.db",
                 gossip_addr=addr,
                 bootstrap=bootstrap,
+                use_swim=self.use_swim,
                 perf=fast_perf(),
             )
             agent = Agent(cfg, self.net.transport(addr))

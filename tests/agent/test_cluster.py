@@ -59,7 +59,7 @@ def test_gossip_with_loss_converges_via_sync():
         for node in range(3):
             assert len(cluster.rows(node, "SELECT id FROM tests")) == 20
 
-    asyncio.run(_with_cluster(3, body, link=LinkModel(loss=0.4, seed=42)))
+    asyncio.run(_with_cluster(3, body, link=LinkModel(loss=0.4, seed=42), use_swim=False))
 
 
 def test_large_tx_sync_cold_node():
@@ -103,7 +103,7 @@ def test_partial_buffering_and_completion():
         assert b.store.query("SELECT COUNT(*) FROM __corro_buffered_changes")[0][0] == 0
         assert b.store.query("SELECT COUNT(*) FROM __corro_seq_bookkeeping")[0][0] == 0
 
-    asyncio.run(_with_cluster(2, body, link=LinkModel(loss=0.5, seed=7)))
+    asyncio.run(_with_cluster(2, body, link=LinkModel(loss=0.5, seed=7), use_swim=False))
 
 
 def test_concurrent_writers_converge():
