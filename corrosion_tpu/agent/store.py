@@ -391,6 +391,9 @@ class CrrStore:
 
     # -- remote change application ---------------------------------------
 
+    # batches at least this large take the native-comparator bulk path
+    BATCH_APPLY_THRESHOLD = 16
+
     def apply_changes(
         self,
         changes: Iterable[Change],
@@ -399,17 +402,22 @@ class CrrStore:
         """Merge remote changes (the crsql_changes INSERT + C-extension merge
         in the reference, util.rs:1225-1245).  Returns rows impacted
         (crsql_rows_impacted equivalent).  Trigger capture is disabled for
-        the duration; caller may already hold an open transaction."""
+        the duration; caller may already hold an open transaction.
+
+        Large batches run the bulk path: one prefetch of existing clock
+        cells, merge decisions via the C++ core (native/crdt_core.cpp), and
+        executemany writes — the sync cold-catch-up hot loop."""
+        changes = list(changes)
         with self._lock:
             self._applying = True
             own_tx = not in_tx
             if own_tx:
                 self.conn.execute("BEGIN IMMEDIATE")
             try:
-                impacted = 0
-                for ch in changes:
-                    if self._apply_one(ch):
-                        impacted += 1
+                if len(changes) >= self.BATCH_APPLY_THRESHOLD:
+                    impacted = self._apply_batched(changes)
+                else:
+                    impacted = sum(1 for ch in changes if self._apply_one(ch))
                 if own_tx:
                     self.conn.execute("COMMIT")
                 return impacted
@@ -419,6 +427,140 @@ class CrrStore:
                 raise
             finally:
                 self._applying = False
+
+    def _apply_batched(self, changes: List[Change]) -> int:
+        """Bulk merge.  Lifecycle-changing rows (deletes, resurrections,
+        unknown pks) fall back to the sequential path; same-lifecycle column
+        changes are folded per cell (merge is a join-semilattice, so batch
+        order is irrelevant), decided in one native merge_batch call, and
+        written with executemany."""
+        from .. import native
+        from ..core.crdt import merge_cell
+
+        impacted = 0
+        by_table: Dict[str, List[Change]] = {}
+        for ch in changes:
+            by_table.setdefault(ch.table, []).append(ch)
+
+        for table, tchanges in by_table.items():
+            info = self._tables.get(table)
+            if info is None:
+                continue
+            # local causal lengths for every touched pk, one chunked query
+            pks = list({ch.pk for ch in tchanges})
+            local_cl: Dict[bytes, int] = {}
+            for i in range(0, len(pks), 500):
+                chunk = pks[i : i + 500]
+                ph = ",".join("?" for _ in chunk)
+                for row in self.conn.execute(
+                    f'SELECT pk, cl FROM "{info.rows}" WHERE pk IN ({ph})', chunk
+                ):
+                    local_cl[row[0]] = row[1]
+
+            # a pk with any lifecycle transition (delete, resurrection) takes
+            # the sequential path for ALL its changes — interleaving bulk
+            # column writes with lifecycle flips would resurrect zombies
+            lifecycle_pks = set()
+            for ch in tchanges:
+                cl = local_cl.get(ch.pk, 0)
+                if ch.cid == DELETE_SENTINEL or (0 < cl < ch.cl):
+                    lifecycle_pks.add(ch.pk)
+
+            slow: List[Change] = []
+            fold: Dict[Tuple[bytes, str], Change] = {}
+            for ch in tchanges:
+                if ch.pk in lifecycle_pks:
+                    slow.append(ch)
+                    continue
+                cl = local_cl.get(ch.pk, 0)
+                if not row_alive(ch.cl) or ch.cl < cl:
+                    continue  # dead lifecycle or stale
+                key = (ch.pk, ch.cid)
+                prev = fold.get(key)
+                if prev is None:
+                    fold[key] = ch
+                elif (
+                    merge_cell(
+                        (prev.col_version, prev.val, prev.site_id),
+                        (ch.col_version, ch.val, ch.site_id),
+                    )
+                    == MergeOutcome.WIN
+                ):
+                    fold[key] = ch
+
+            for ch in slow:
+                if self._apply_one(ch):
+                    impacted += 1
+
+            if not fold:
+                continue
+            cells = list(fold.items())
+            # prefetch existing clock cells with row-value IN chunks
+            existing: Dict[Tuple[bytes, str], Tuple[int, SqliteValue, ActorId]] = {}
+            for i in range(0, len(cells), 250):
+                chunk = cells[i : i + 250]
+                ph = ",".join("(?,?)" for _ in chunk)
+                args: List = []
+                for (pk, cid), _ in chunk:
+                    args += [pk, cid]
+                for row in self.conn.execute(
+                    f'SELECT pk, cid, col_version, val, site_id FROM "{info.clock}" '
+                    f"WHERE (pk, cid) IN (VALUES {ph})",
+                    args,
+                ):
+                    existing[(row[0], row[1])] = (row[2], row[3], ActorId(row[4]))
+
+            e_list = [existing.get(key) for key, _ in cells]
+            i_list = [
+                (ch.col_version, ch.val, ch.site_id) for _, ch in cells
+            ]
+            outcomes = native.merge_batch(e_list, i_list)
+
+            clock_rows, base_updates, wins = [], [], []
+            for ((pk, cid), ch), out in zip(cells, outcomes):
+                if out == MergeOutcome.LOSE:
+                    continue
+                clock_rows.append(
+                    (pk, cid, ch.val, ch.col_version, ch.db_version, ch.seq,
+                     ch.site_id.bytes_, 0)
+                )
+                if out == MergeOutcome.WIN:
+                    wins.append(ch)
+                    if cid != PKONLY_SENTINEL:
+                        base_updates.append(ch)
+            if clock_rows:
+                self.conn.executemany(
+                    f'INSERT INTO "{info.clock}" '
+                    "(pk, cid, val, col_version, db_version, seq, site_id, ts) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT (pk, cid) DO UPDATE SET "
+                    "val = excluded.val, col_version = excluded.col_version, "
+                    "db_version = excluded.db_version, seq = excluded.seq, "
+                    "site_id = excluded.site_id, ts = excluded.ts",
+                    clock_rows,
+                )
+            if wins:
+                # rows-table entries + bare base rows for brand-new pks
+                new_pks = {ch.pk: ch.cl for ch in wins if ch.pk not in local_cl}
+                if new_pks:
+                    self.conn.executemany(
+                        f'INSERT OR IGNORE INTO "{info.rows}" (pk, cl) VALUES (?, ?)',
+                        list(new_pks.items()),
+                    )
+                    cols = ", ".join(f'"{c}"' for c in info.pk_cols)
+                    ph = ", ".join("?" for _ in info.pk_cols)
+                    self.conn.executemany(
+                        f'INSERT OR IGNORE INTO "{info.name}" ({cols}) VALUES ({ph})',
+                        [decode_pk(pk) for pk in new_pks],
+                    )
+                for ch in base_updates:
+                    self.conn.execute(
+                        f'UPDATE "{info.name}" SET "{ch.cid}" = ? WHERE '
+                        + " AND ".join(f'"{c}" IS ?' for c in info.pk_cols),
+                        (ch.val, *decode_pk(ch.pk)),
+                    )
+                impacted += len(wins)
+        return impacted
 
     def begin_apply(self):
         with self._lock:

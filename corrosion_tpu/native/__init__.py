@@ -1,0 +1,145 @@
+"""Native fast-path loader.
+
+Compiles `crdt_core.cpp` to a shared library on first use (g++, cached by
+source mtime under ``_build/``) and binds it via ctypes; every entry point
+has a pure-Python fallback (`corrosion_tpu.core.crdt` is the spec), so the
+framework runs without a toolchain.  Parity between the two is enforced by
+tests/agent/test_native_core.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.pkcodec import encode_value
+from ..core.types import ActorId, SqliteValue
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "crdt_core.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libcrdt_core.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _compile() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if (
+        os.path.exists(_LIB_PATH)
+        and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
+    ):
+        return _LIB_PATH
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB_PATH + ".tmp", _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+        return _LIB_PATH
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The compiled core, or None when unavailable (Python fallback used)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    path = _compile()
+    if path is None:
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        _load_failed = True
+        return None
+    lib.crdt_value_cmp.restype = ctypes.c_int
+    lib.crdt_value_cmp.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.crdt_merge_batch.restype = None
+    lib.crdt_merge_batch.argtypes = [
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.crdt_core_version.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def value_cmp_native(a: SqliteValue, b: SqliteValue) -> int:
+    lib = load()
+    if lib is None:
+        from ..core.crdt import value_cmp
+
+        return value_cmp(a, b)
+    ea, eb = encode_value(a), encode_value(b)
+    return lib.crdt_value_cmp(ea, len(ea), eb, len(eb))
+
+
+Cell = Tuple[int, SqliteValue, ActorId]  # (col_version, value, site_id)
+
+
+def _pack(cells: Sequence[Optional[Cell]]):
+    n = len(cells)
+    colver = (ctypes.c_int64 * n)()
+    off = (ctypes.c_int64 * (n + 1))()
+    sites = bytearray(16 * n)
+    vals = bytearray()
+    for i, cell in enumerate(cells):
+        if cell is None:
+            off[i + 1] = len(vals) + 1
+            vals += b"\x00"
+            continue
+        cv, val, site = cell
+        colver[i] = cv
+        enc = encode_value(val)
+        vals += enc
+        off[i + 1] = len(vals)
+        sites[16 * i : 16 * (i + 1)] = site.bytes_
+    return colver, bytes(vals), off, bytes(sites)
+
+
+def merge_batch(
+    existing: Sequence[Optional[Cell]],
+    incoming: Sequence[Cell],
+    merge_equal_values: bool = True,
+) -> List[int]:
+    """Vector of MergeOutcome ints for incoming[i] vs existing[i].
+    Uses the C++ core when available, else the Python spec."""
+    n = len(incoming)
+    lib = load()
+    if lib is None:
+        from ..core.crdt import merge_cell
+
+        return [
+            merge_cell(existing[i], incoming[i], merge_equal_values)
+            for i in range(n)
+        ]
+    mask = (ctypes.c_uint8 * n)(*[0 if e is None else 1 for e in existing])
+    e_cv, e_vals, e_off, e_sites = _pack(existing)
+    i_cv, i_vals, i_off, i_sites = _pack(incoming)
+    out = (ctypes.c_uint8 * n)()
+    lib.crdt_merge_batch(
+        n, mask, e_cv, e_vals, e_off, e_sites,
+        i_cv, i_vals, i_off, i_sites,
+        1 if merge_equal_values else 0, out,
+    )
+    return list(out)

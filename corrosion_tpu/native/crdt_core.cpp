@@ -1,0 +1,163 @@
+// Native CRDT merge core.
+//
+// The reference ships its merge semantics as a prebuilt C SQLite extension
+// (cr-sqlite, loaded at corro-types/src/sqlite.rs:121-139); this is the
+// rebuild's native tier: the same column-LWW comparison rules
+// (doc/crdts.md:235-248 — col_version, then SQLite value ordering, then
+// site_id) over the framework's tag-encoded values, exposed as a C ABI for
+// ctypes and used by the store's batched apply path.
+//
+// Values are tag-encoded (core/pkcodec.py):
+//   0x00 NULL | 0x01 int64 BE | 0x02 float64 BE | 0x03 str (u32 len + utf8)
+//   0x04 bytes (u32 len + raw)
+//
+// Build: g++ -O2 -shared -fPIC -o libcrdt_core.so crdt_core.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint8_t TAG_NULL = 0x00;
+constexpr uint8_t TAG_INT = 0x01;
+constexpr uint8_t TAG_FLOAT = 0x02;
+constexpr uint8_t TAG_TEXT = 0x03;
+constexpr uint8_t TAG_BLOB = 0x04;
+
+int rank(uint8_t tag) {
+  switch (tag) {
+    case TAG_NULL: return 0;
+    case TAG_INT:
+    case TAG_FLOAT: return 1;
+    case TAG_TEXT: return 2;
+    default: return 3;
+  }
+}
+
+uint64_t load_be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+double as_double(const uint8_t* v) {
+  if (v[0] == TAG_INT) {
+    return static_cast<double>(static_cast<int64_t>(load_be64(v + 1)));
+  }
+  uint64_t bits = load_be64(v + 1);
+  double d;
+  std::memcpy(&d, &bits, sizeof(d));
+  return d;
+}
+
+int64_t as_int(const uint8_t* v) {
+  return static_cast<int64_t>(load_be64(v + 1));
+}
+
+uint32_t load_be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+int bytes_cmp(const uint8_t* a, uint32_t alen, const uint8_t* b, uint32_t blen) {
+  uint32_t n = alen < blen ? alen : blen;
+  int c = n ? std::memcmp(a, b, n) : 0;
+  if (c != 0) return c < 0 ? -1 : 1;
+  if (alen == blen) return 0;
+  return alen < blen ? -1 : 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// SQLite ORDER BY semantics over tag-encoded values: -1 / 0 / +1.
+int crdt_value_cmp(const uint8_t* a, int64_t alen, const uint8_t* b,
+                   int64_t blen) {
+  (void)alen;
+  (void)blen;
+  int ra = rank(a[0]), rb = rank(b[0]);
+  if (ra != rb) return ra < rb ? -1 : 1;
+  switch (ra) {
+    case 0:
+      return 0;
+    case 1: {
+      if (a[0] == TAG_INT && b[0] == TAG_INT) {
+        int64_t x = as_int(a), y = as_int(b);
+        return x < y ? -1 : (x > y ? 1 : 0);
+      }
+      double x = as_double(a), y = as_double(b);
+      return x < y ? -1 : (x > y ? 1 : 0);
+    }
+    default: {
+      uint32_t la = load_be32(a + 1), lb = load_be32(b + 1);
+      return bytes_cmp(a + 5, la, b + 5, lb);
+    }
+  }
+}
+
+// Batch per-cell merge decisions.  For each i:
+//   existing_mask[i] == 0  -> no recorded cell, incoming WINs (1)
+//   otherwise compare (col_version, value, site_id):
+//     1 = WIN, 0 = LOSE, 2 = EQUAL_METADATA (only when merge_equal != 0).
+// Values are concatenated tag-encoded blobs delimited by off[i]..off[i+1].
+// Sites are 16-byte ids, concatenated.
+void crdt_merge_batch(int64_t n, const uint8_t* existing_mask,
+                      const int64_t* e_colver, const uint8_t* e_vals,
+                      const int64_t* e_off, const uint8_t* e_sites,
+                      const int64_t* i_colver, const uint8_t* i_vals,
+                      const int64_t* i_off, const uint8_t* i_sites,
+                      int32_t merge_equal, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    if (!existing_mask[i]) {
+      out[i] = 1;
+      continue;
+    }
+    if (i_colver[i] != e_colver[i]) {
+      out[i] = i_colver[i] > e_colver[i] ? 1 : 0;
+      continue;
+    }
+    int c = crdt_value_cmp(i_vals + i_off[i], i_off[i + 1] - i_off[i],
+                           e_vals + e_off[i], e_off[i + 1] - e_off[i]);
+    if (c != 0) {
+      out[i] = c > 0 ? 1 : 0;
+      continue;
+    }
+    int sc = std::memcmp(i_sites + 16 * i, e_sites + 16 * i, 16);
+    if (sc > 0) {
+      out[i] = 1;
+    } else {
+      out[i] = merge_equal ? 2 : 0;
+    }
+  }
+}
+
+// Reduce a run of incoming changes for the SAME cell to the single winner
+// (merge is a join-semilattice, so pairwise max is order-free).  Indices
+// idx[0..m) select rows from the batch arrays; returns the winning index.
+int64_t crdt_fold_cell(const int64_t* idx, int64_t m, const int64_t* colver,
+                       const uint8_t* vals, const int64_t* off,
+                       const uint8_t* sites) {
+  int64_t best = idx[0];
+  for (int64_t k = 1; k < m; k++) {
+    int64_t i = idx[k];
+    bool win;
+    if (colver[i] != colver[best]) {
+      win = colver[i] > colver[best];
+    } else {
+      int c = crdt_value_cmp(vals + off[i], off[i + 1] - off[i],
+                             vals + off[best], off[best + 1] - off[best]);
+      if (c != 0) {
+        win = c > 0;
+      } else {
+        win = std::memcmp(sites + 16 * i, sites + 16 * best, 16) > 0;
+      }
+    }
+    if (win) best = i;
+  }
+  return best;
+}
+
+int crdt_core_version() { return 1; }
+
+}  // extern "C"
