@@ -1,0 +1,254 @@
+"""Admin RPC: JSON-framed request/response over a Unix domain socket.
+
+Rebuild of corro-admin (`crates/corro-admin/src/lib.rs:49,103-148`): the
+operator side-channel for a running agent.  Framing is 4-byte big-endian
+length + JSON (the reference's LengthDelimitedCodec + serde_json).  Command
+surface mirrors the reference `Command` enum (lib.rs:103-148): Ping,
+Sync{Generate,ReconcileGaps}, Locks{top}, Cluster{Rejoin,Members,
+MembershipStates,SetId}, Actor{Version}, Subs{Info,List}, Log{Set,Reset}.
+
+Commands are JSON objects: {"cmd": "ping"}, {"cmd": "sync",
+"sub": "generate"}, {"cmd": "locks", "top": 10}, ...  Responses are
+{"ok": ...} | {"error": ...} | {"log": ...} frames, ending with an "ok"
+(the reference streams Reply::Log then Reply::Done).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+from typing import Optional
+
+from .core.types import ActorId
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        head = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    (n,) = struct.unpack(">I", head)
+    body = await reader.readexactly(n)
+    return json.loads(body)
+
+
+def _frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return struct.pack(">I", len(body)) + body
+
+
+class AdminServer:
+    def __init__(self, agent, path: str):
+        self.agent = agent
+        self.path = path
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self):
+        self._server = await asyncio.start_unix_server(self._on_conn, self.path)
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader, writer):
+        try:
+            while True:
+                req = await _read_frame(reader)
+                if req is None:
+                    break
+                try:
+                    resp = self._handle(req)
+                except Exception as e:
+                    resp = {"error": str(e)}
+                writer.write(_frame(resp))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    # -- command dispatch (corro-admin/src/lib.rs:150+) --------------------
+
+    def _handle(self, req: dict) -> dict:
+        agent = self.agent
+        cmd = req.get("cmd")
+        sub = req.get("sub")
+        if cmd == "ping":
+            return {"ok": "pong"}
+        if cmd == "sync" and sub == "generate":
+            return {"ok": self._sync_dump()}
+        if cmd == "sync" and sub == "reconcile_gaps":
+            return {"ok": self._reconcile_gaps()}
+        if cmd == "locks":
+            top = int(req.get("top", 10))
+            return {"ok": agent.locks.top(top)}
+        if cmd == "cluster" and sub == "members":
+            return {"ok": self._members()}
+        if cmd == "cluster" and sub == "membership_states":
+            return {"ok": self._membership_states()}
+        if cmd == "cluster" and sub == "rejoin":
+            if agent.swim is not None:
+                agent.swim.rejoin()
+                return {"ok": "rejoined"}
+            return {"error": "swim disabled"}
+        if cmd == "cluster" and sub == "set_id":
+            cid = int(req["id"])
+            agent.store.conn.execute(
+                "INSERT OR REPLACE INTO __corro_state (key, value) "
+                "VALUES ('cluster_id', ?)",
+                (cid,),
+            )
+            agent.config.cluster_id = cid
+            return {"ok": cid}
+        if cmd == "actor" and sub == "version":
+            return {"ok": self._actor_version(req)}
+        if cmd == "subs" and sub == "list":
+            return {
+                "ok": [
+                    {
+                        "id": h.id,
+                        "sql": h.matcher.sql,
+                        "mode": "keyed" if h.matcher.keyed else "full",
+                        "last_change_id": h.matcher.last_change_id,
+                        "subscribers": len(h.queues),
+                    }
+                    for h in agent.subs.by_id.values()
+                ]
+            }
+        if cmd == "subs" and sub == "info":
+            handle = agent.subs.get(req.get("id", ""))
+            if handle is None:
+                return {"error": "no such subscription"}
+            m = handle.matcher
+            nrows = m.state.execute("SELECT COUNT(*) FROM q").fetchone()[0]
+            return {
+                "ok": {
+                    "id": m.id, "sql": m.sql, "columns": m.columns,
+                    "mode": "keyed" if m.keyed else "full",
+                    "rows": nrows, "last_change_id": m.last_change_id,
+                    "tables": sorted(m.tables),
+                }
+            }
+        if cmd == "log" and sub == "set":
+            level = getattr(logging, req["filter"].upper(), None)
+            if level is None:
+                return {"error": f"bad level {req['filter']}"}
+            logging.getLogger("corrosion_tpu").setLevel(level)
+            return {"ok": req["filter"]}
+        if cmd == "log" and sub == "reset":
+            logging.getLogger("corrosion_tpu").setLevel(logging.NOTSET)
+            return {"ok": "reset"}
+        return {"error": f"unknown command: {req}"}
+
+    def _sync_dump(self) -> dict:
+        s = self.agent.sync_state()
+        return {
+            "actor_id": self.agent.actor_id.hex(),
+            "heads": {a.hex(): h for a, h in s.heads.items()},
+            "need": {a.hex(): list(rs) for a, rs in s.need.items()},
+            "partial_need": {
+                a.hex(): {str(v): list(p) for v, p in pn.items()}
+                for a, pn in s.partial_need.items()
+            },
+        }
+
+    def _reconcile_gaps(self) -> dict:
+        """`sync reconcile-gaps`: drop bookkeeping gaps whose versions are
+        actually present in the clock tables (gaps left behind by crashes
+        between data commit and bookkeeping write)."""
+        agent = self.agent
+        cleared = []
+        for actor_id, booked in list(agent.bookie.by_actor.items()):
+            for lo, hi in list(booked.needed()):
+                present = []
+                for v, changes in agent.store.changes_for_version_range(
+                    actor_id, lo, min(hi, lo + 10_000)
+                ).items():
+                    if changes:
+                        present.append(v)
+                for v in present:
+                    snap = booked.snapshot()
+                    from .core.intervals import RangeSet
+
+                    agent.bookie.record_versions(actor_id, snap, RangeSet([(v, v)]))
+                    booked.commit_snapshot(snap)
+                    cleared.append({"actor_id": actor_id.hex(), "version": v})
+        return {"cleared": cleared, "count": len(cleared)}
+
+    def _members(self) -> list:
+        out = []
+        for st in self.agent.members.states.values():
+            out.append(
+                {
+                    "actor_id": st.actor.id.hex(),
+                    "addr": st.actor.addr,
+                    "state": getattr(st, "state", "alive"),
+                    "rtt_ms": getattr(st, "rtt_avg", None),
+                    "ring": st.ring,
+                }
+            )
+        return out
+
+    def _membership_states(self) -> list:
+        swim = self.agent.swim
+        if swim is None:
+            return []
+        names = {0: "alive", 1: "suspect", 2: "down"}
+        return [
+            {
+                "actor_id": info.actor_id.hex(),
+                "addr": info.addr,
+                "state": names.get(info.status, "?"),
+                "incarnation": info.incarnation,
+            }
+            for info in swim.members.values()
+        ]
+
+    def _actor_version(self, req: dict) -> dict:
+        """`actor version`: classify a (actor, version) as the reference's
+        KnownDbVersion {Cleared, Current, Partial} (agent.rs:1085)."""
+        actor_id = ActorId.from_hex(req["actor_id"])
+        version = int(req["version"])
+        booked = self.agent.bookie.for_actor(actor_id)
+        partial = booked.get_partial(version)
+        if partial is not None:
+            return {
+                "kind": "partial",
+                "seqs": list(partial.seqs),
+                "last_seq": partial.last_seq,
+            }
+        if not booked.contains_all((version, version), None):
+            return {"kind": "unknown"}
+        changes = self.agent.store.changes_for_version(actor_id, version)
+        if not changes:
+            return {"kind": "cleared"}
+        return {
+            "kind": "current",
+            "changes": len(changes),
+            "last_seq": max(ch.seq for ch in changes),
+        }
+
+
+class AdminClient:
+    """Client side (the `corrosion` CLI's admin connection)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    async def send(self, req: dict) -> dict:
+        reader, writer = await asyncio.open_unix_connection(self.path)
+        try:
+            writer.write(_frame(req))
+            await writer.drain()
+            resp = await _read_frame(reader)
+            if resp is None:
+                raise ConnectionError("admin socket closed")
+            return resp
+        finally:
+            writer.close()
+
+    def send_sync(self, req: dict) -> dict:
+        return asyncio.run(self.send(req))

@@ -41,6 +41,7 @@ from ..core.types import (
 )
 from ..core.hlc import HLC, ClockDriftError
 from ..utils.backoff import Backoff
+from ..utils.locks import LockRegistry
 from . import codec
 from .bookie import Bookie
 from .config import Config
@@ -77,6 +78,16 @@ class Agent:
         self._stopped = asyncio.Event()
         self._rng = random.Random(self.actor_id.bytes_)
         self.swim = None  # attached by SwimRuntime.attach()
+        # labeled critical-section registry + watchdog (agent.rs:830-1055)
+        self.locks = LockRegistry()
+        # pubsub engine (L9): SQL subscriptions + per-table updates
+        from ..pubsub import SubsManager, UpdatesManager
+
+        subs_dir = (
+            None if config.db_path in (":memory:", "") else config.db_path + ".subs"
+        )
+        self.subs = SubsManager(self.store, subs_dir)
+        self.updates = UpdatesManager()
         # metrics counters (metrics facade analog)
         self.stats = {
             "changes_committed": 0, "changes_applied": 0, "changes_deduped": 0,
@@ -92,6 +103,7 @@ class Agent:
 
             for sql in read_sql_files(path):
                 self.store.execute_schema(sql)
+        self.subs.restore()
         if self.config.use_swim:
             from .swim import SwimRuntime
 
@@ -107,6 +119,19 @@ class Agent:
         self._tasks.append(asyncio.create_task(self._broadcast_loop()))
         self._tasks.append(asyncio.create_task(self._ingest_loop()))
         self._tasks.append(asyncio.create_task(self._sync_loop()))
+        self._tasks.append(asyncio.create_task(self._lock_watchdog()))
+
+    async def _lock_watchdog(self):
+        """Warn on long-held critical sections (setup.rs:188-246)."""
+        while not self._stopped.is_set():
+            await asyncio.sleep(5.0)
+            worst = self.locks.check()
+            if worst is not None:
+                import logging
+
+                logging.getLogger("corrosion_tpu.locks").warning(
+                    "long lock hold: %s", worst
+                )
 
     async def stop(self):
         self._stopped.set()
@@ -136,7 +161,8 @@ class Agent:
                 self.actor_id, snap, RangeSet([(info.db_version, info.db_version)])
             )
 
-        cursors, info = self.store.transact(statements, pre_commit=pre_commit)
+        with self.locks.track("make_broadcastable_changes"):
+            cursors, info = self.store.transact(statements, pre_commit=pre_commit)
         if info is None:
             return cursors, None
         booked.commit_snapshot(snap)
@@ -148,6 +174,7 @@ class Agent:
         """Chunk the committed version and queue frames (broadcast_changes,
         broadcast.rs:511-579)."""
         changes = self.store.changes_for_version(self.actor_id, info.db_version)
+        self._match_changes(changes)
         for chunk, seqs in ChunkedChanges(
             changes, 0, info.last_seq, self.config.perf.max_changes_byte_size
         ):
@@ -303,6 +330,20 @@ class Agent:
             return snaps[actor_id][1]
 
         partials: List[Changeset] = []
+        matched: List[Change] = []
+        with self.locks.track("process_multiple_changes"):
+            self._apply_batch_tx(batch, store, snap_for, partials, matched)
+        # in-memory bookkeeping only after the data commit succeeded
+        for booked, snap in snaps.values():
+            booked.commit_snapshot(snap)
+        # subscriptions match committed changes only (util.rs:1026-1030)
+        self._match_changes(matched)
+        for actor_id, version in dict.fromkeys(partials):
+            partial = self.bookie.for_actor(actor_id).get_partial(version)
+            if partial is not None and partial.is_complete():
+                self._apply_fully_buffered(actor_id, version)
+
+    def _apply_batch_tx(self, batch, store, snap_for, partials, matched):
         store.begin_apply()
         try:
             for cs in batch:
@@ -327,6 +368,7 @@ class Agent:
                     self.bookie.clear_partial(cs.actor_id, cs.version)
                     self._clear_buffered(cs.actor_id, cs.version)
                     self.stats["changes_applied"] += impacted
+                    matched.extend(cs.changes)
                 else:
                     # merge seq coverage into the snapshot so later chunks of
                     # the same version in this batch aren't mistaken for known
@@ -351,13 +393,6 @@ class Agent:
         except Exception:
             store.end_apply(commit=False)
             raise
-        # in-memory bookkeeping only after the data commit succeeded
-        for booked, snap in snaps.values():
-            booked.commit_snapshot(snap)
-        for actor_id, version in dict.fromkeys(partials):
-            partial = self.bookie.for_actor(actor_id).get_partial(version)
-            if partial is not None and partial.is_complete():
-                self._apply_fully_buffered(actor_id, version)
 
     def _buffer_rows(self, cs: Changeset):
         """process_incomplete_version row staging (util.rs:1053-1186):
@@ -404,6 +439,15 @@ class Agent:
         booked.commit_snapshot(snap)
         booked.partials.pop(version, None)
         self.stats["changes_applied"] += impacted
+        self._match_changes(changes)
+
+    def _match_changes(self, changes: List[Change]):
+        """Feed committed changes to subscriptions + updates notifiers
+        (match_changes, updates.rs:420; broadcast.rs:544-545)."""
+        if not changes:
+            return
+        self.subs.match_changes(changes)
+        self.updates.match_changes(changes)
 
     def _clear_buffered(self, actor_id: ActorId, version: int):
         self.store.conn.execute(

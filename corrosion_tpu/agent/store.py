@@ -459,11 +459,21 @@ class CrrStore:
 
             # a pk with any lifecycle transition (delete, resurrection) takes
             # the sequential path for ALL its changes — interleaving bulk
-            # column writes with lifecycle flips would resurrect zombies
+            # column writes with lifecycle flips would resurrect zombies.
+            # Changes at *different* causal lengths inside one batch are also
+            # a lifecycle transition even when the pk is locally unknown:
+            # folding them would compare col_versions across lifecycles
+            batch_cls: Dict[bytes, set] = {}
+            for ch in tchanges:
+                batch_cls.setdefault(ch.pk, set()).add(ch.cl)
             lifecycle_pks = set()
             for ch in tchanges:
                 cl = local_cl.get(ch.pk, 0)
-                if ch.cid == DELETE_SENTINEL or (0 < cl < ch.cl):
+                if (
+                    ch.cid == DELETE_SENTINEL
+                    or (0 < cl < ch.cl)
+                    or len(batch_cls[ch.pk]) > 1
+                ):
                     lifecycle_pks.add(ch.pk)
 
             slow: List[Change] = []

@@ -116,6 +116,21 @@ class SwimRuntime:
             )
         )
 
+    def rejoin(self):
+        """Explicit rejoin (`FocaCmd::Rejoin`, broadcast/mod.rs:263-274):
+        bump incarnation (a renewed identity, actor.rs:199-209), re-assert
+        ALIVE, and re-announce to the bootstrap set."""
+        self.incarnation += 1
+        me = _decode_member(self._self_member())
+        self._disseminate(me)
+        for addr in self.agent.config.bootstrap:
+            if addr != self.transport.addr:
+                self._tasks.append(
+                    asyncio.create_task(
+                        self._send(addr, {"k": "join", "me": self._self_member()})
+                    )
+                )
+
     # -- persistence (reference __corro_members) --------------------------
 
     def _load_members(self):

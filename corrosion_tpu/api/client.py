@@ -116,6 +116,71 @@ class ApiClient:
         finally:
             writer.close()
 
+    async def _ndjson_events(self, reader, headers) -> AsyncIterator[dict]:
+        if headers.get("transfer-encoding") == "chunked":
+            buf = b""
+            while True:
+                size_line = await reader.readline()
+                if not size_line:
+                    break
+                n = int(size_line.strip(), 16)
+                if n == 0:
+                    await reader.readline()
+                    break
+                buf += await reader.readexactly(n)
+                await reader.readline()
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        yield json.loads(line)
+            if buf.strip():
+                yield json.loads(buf)
+        else:
+            body = await self._read_body(headers, reader)
+            for line in body.splitlines():
+                if line.strip():
+                    yield json.loads(line)
+
+    async def subscribe(self, statement, from_change: Optional[int] = None):
+        """POST /v1/subscriptions → SubscriptionStream (corro-client
+        sub.rs:57): `.id` is the corro-query-id, iterate for NDJSON events,
+        reconnects with ?from=<last change id> on stream errors."""
+        path = "/v1/subscriptions"
+        if from_change is not None:
+            path += f"?from={from_change}"
+        status, headers, reader, writer = await self._request(
+            "POST", path, json.dumps(statement).encode()
+        )
+        if status != 200:
+            body = await self._read_body(headers, reader)
+            writer.close()
+            raise RuntimeError(f"subscribe failed ({status}): {body!r}")
+        sub_id = headers.get("corro-query-id", "")
+        return SubscriptionStream(self, statement, sub_id, reader, writer, headers)
+
+    async def resubscribe(self, sub_id: str, from_change: Optional[int] = None):
+        """GET /v1/subscriptions/:id re-attach."""
+        path = f"/v1/subscriptions/{sub_id}"
+        if from_change is not None:
+            path += f"?from={from_change}"
+        status, headers, reader, writer = await self._request("GET", path, None)
+        if status != 200:
+            body = await self._read_body(headers, reader)
+            writer.close()
+            raise RuntimeError(f"resubscribe failed ({status}): {body!r}")
+        return SubscriptionStream(self, None, sub_id, reader, writer, headers)
+
+    async def updates(self, table: str) -> "UpdatesStream":
+        """POST /v1/updates/:table → NotifyEvent stream (sub.rs:310)."""
+        status, headers, reader, writer = await self._request(
+            "POST", f"/v1/updates/{table}", b""
+        )
+        if status != 200:
+            body = await self._read_body(headers, reader)
+            writer.close()
+            raise RuntimeError(f"updates failed ({status}): {body!r}")
+        return UpdatesStream(reader, writer, headers, self)
+
     async def schema(self, statements: Sequence[str]) -> dict:
         status, headers, reader, writer = await self._request(
             "POST", "/v1/migrations", json.dumps(list(statements)).encode()
@@ -135,6 +200,71 @@ class ApiClient:
             return json.loads(body)
         finally:
             writer.close()
+
+
+class SubscriptionStream:
+    """Typed NDJSON subscription stream with reconnect/backoff
+    (corro-client sub.rs:57-300): tracks the last seen change id and
+    re-subscribes with ?from= on transport errors."""
+
+    def __init__(self, client: ApiClient, statement, sub_id: str, reader, writer, headers):
+        self.client = client
+        self.statement = statement
+        self.id = sub_id
+        self._reader = reader
+        self._writer = writer
+        self._headers = headers
+        self.last_change_id: Optional[int] = None
+        self.max_reconnects = 5
+
+    def __aiter__(self):
+        return self._iter()
+
+    async def _iter(self):
+        attempts = 0
+        while True:
+            try:
+                async for event in self.client._ndjson_events(
+                    self._reader, self._headers
+                ):
+                    attempts = 0
+                    if "change" in event:
+                        self.last_change_id = event["change"][3]
+                    elif "eoq" in event and isinstance(event["eoq"], dict):
+                        cid = event["eoq"].get("change_id")
+                        if cid is not None:
+                            # 0 is a real offset: reconnecting with ?from=0
+                            # replays changes, not a duplicate full snapshot
+                            self.last_change_id = cid
+                    yield event
+                return  # clean end of stream
+            except (OSError, asyncio.IncompleteReadError, ValueError):
+                attempts += 1
+                if attempts > self.max_reconnects:
+                    raise
+                await asyncio.sleep(min(0.1 * 2 ** attempts, 2.0))
+                stream = await self.client.resubscribe(self.id, self.last_change_id)
+                self._reader, self._writer = stream._reader, stream._writer
+                self._headers = stream._headers
+
+    def close(self):
+        self._writer.close()
+
+
+class UpdatesStream:
+    """NotifyEvent NDJSON stream (corro-client sub.rs:310-370)."""
+
+    def __init__(self, reader, writer, headers, client: ApiClient):
+        self._reader = reader
+        self._writer = writer
+        self._headers = headers
+        self._client = client
+
+    def __aiter__(self):
+        return self._client._ndjson_events(self._reader, self._headers)
+
+    def close(self):
+        self._writer.close()
 
 
 class PooledClient:

@@ -46,6 +46,7 @@ class ApiServer:
         self._sem = asyncio.Semaphore(max_concurrency)
         self._server: Optional[asyncio.AbstractServer] = None
         self._extra_routes: Dict[Tuple[str, str], Callable] = {}
+        self._conn_tasks: set = set()
 
     def route(self, method: str, path: str, handler: Callable) -> None:
         """Extension point for subscription/updates endpoints."""
@@ -60,11 +61,19 @@ class ApiServer:
     async def stop(self):
         if self._server:
             self._server.close()
+            # long-lived subscription streams block on their event queues;
+            # cancel them so wait_closed() can't hang
+            for t in list(self._conn_tasks):
+                t.cancel()
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
             await self._server.wait_closed()
 
     # -- plumbing ---------------------------------------------------------
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
         try:
             while True:
                 try:
@@ -78,13 +87,14 @@ class ApiServer:
                 if req is None:
                     break
                 method, path, headers, body = req
-                async with self._sem:
-                    keep_alive = await self._dispatch(method, path, headers, body, writer)
+                keep_alive = await self._dispatch(method, path, headers, body, writer)
                 if not keep_alive:
                     break
-        except (ConnectionError, asyncio.IncompleteReadError):
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.CancelledError):
             pass
         finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
             try:
                 writer.close()
             except Exception:
@@ -124,19 +134,34 @@ class ApiServer:
             if handler is not None:
                 await handler(path, headers, body, writer)
                 return False  # streaming handlers own the connection
-            if method == "POST" and path == "/v1/transactions":
-                resp = self._transactions(json.loads(body))
-            elif method == "POST" and path == "/v1/queries":
-                await self._queries(json.loads(body), writer)
+            base = path.split("?")[0]
+            # long-lived streams do NOT hold a concurrency slot — only the
+            # reference's short request routes sit behind the limit
+            # (util.rs:184-192); a full house of subscribers must not
+            # starve /v1/transactions
+            if method == "POST" and base == "/v1/subscriptions":
+                await self._subscribe_post(path, json.loads(body), writer)
+                return False  # stream owns the connection
+            elif method == "GET" and base.startswith("/v1/subscriptions/"):
+                await self._subscribe_get(path, writer)
+                return False
+            elif method == "POST" and base.startswith("/v1/updates/"):
+                await self._updates(path, writer)
+                return False
+            async with self._sem:
+                if method == "POST" and path == "/v1/transactions":
+                    resp = self._transactions(json.loads(body))
+                elif method == "POST" and path == "/v1/queries":
+                    await self._queries(json.loads(body), writer)
+                    return True
+                elif method == "POST" and path == "/v1/migrations":
+                    resp = self._migrations(json.loads(body))
+                elif method == "GET" and path == "/v1/table_stats":
+                    resp = self._table_stats()
+                else:
+                    raise HttpError(404, "not found")
+                await _respond_json(writer, 200, resp)
                 return True
-            elif method == "POST" and path == "/v1/migrations":
-                resp = self._migrations(json.loads(body))
-            elif method == "GET" and path == "/v1/table_stats":
-                resp = self._table_stats()
-            else:
-                raise HttpError(404, "not found")
-            await _respond_json(writer, 200, resp)
-            return True
         except HttpError as e:
             await _respond_json(writer, e.status, {"error": e.message})
             return True
@@ -189,6 +214,71 @@ class ApiServer:
         finally:
             await _end_ndjson(writer)
 
+    # -- subscriptions (api/public/pubsub.rs:37,135) ----------------------
+
+    async def _subscribe_post(self, path, stmt, writer):
+        """POST /v1/subscriptions[?from=N]: create (or share) a matcher and
+        stream NDJSON events, `corro-query-id` header carries the sub id."""
+        sql, params = _parse_statement(stmt)
+        from_id = _query_param(path, "from")
+        try:
+            from ..pubsub import MatcherError
+
+            handle, _created = self.agent.subs.get_or_insert(sql, params)
+        except MatcherError as e:
+            raise HttpError(400, str(e))
+        await self._stream_sub(handle, writer, from_id)
+
+    async def _subscribe_get(self, path, writer):
+        """GET /v1/subscriptions/:id[?from=N]: re-attach to a live sub."""
+        sub_id = path.split("?")[0].rsplit("/", 1)[1]
+        handle = self.agent.subs.get(sub_id)
+        if handle is None:
+            raise HttpError(404, "no such subscription")
+        await self._stream_sub(handle, writer, _query_param(path, "from"))
+
+    async def _stream_sub(self, handle, writer, from_id: Optional[str]):
+        # attach BEFORE computing the snapshot/catch-up (both synchronous)
+        # so no event can fall between snapshot and live tail
+        queue = handle.attach()
+        try:
+            if from_id is not None:
+                events = handle.matcher.changes_since(int(from_id))
+                events.insert(0, {"columns": handle.matcher.columns})
+            else:
+                events = handle.matcher.snapshot_events()
+            await _start_ndjson(writer, extra=f"corro-query-id: {handle.id}\r\n")
+            for e in events:
+                await _send_ndjson(writer, e)
+            while True:
+                event = await queue.get()
+                if writer.is_closing():
+                    break
+                await _send_ndjson(writer, event)
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        finally:
+            handle.detach(queue)
+
+    async def _updates(self, path, writer):
+        """POST /v1/updates/:table (api/public/update.rs): NotifyEvent
+        stream for one table."""
+        table = path.split("?")[0].rsplit("/", 1)[1]
+        if table not in self.agent.store._tables:
+            raise HttpError(404, f"no such table: {table}")
+        queue = self.agent.updates.attach(table)
+        try:
+            await _start_ndjson(writer)
+            while True:
+                event = await queue.get()
+                if writer.is_closing():
+                    break
+                await _send_ndjson(writer, event)
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        finally:
+            self.agent.updates.detach(table, queue)
+
     def _migrations(self, stmts) -> dict:
         for s in stmts:
             sql, _ = _parse_statement(s)
@@ -239,13 +329,24 @@ async def _respond_json(writer, status: int, payload) -> None:
     await writer.drain()
 
 
-async def _start_ndjson(writer) -> None:
+async def _start_ndjson(writer, extra: str = "") -> None:
     writer.write(
         b"HTTP/1.1 200 OK\r\n"
         b"content-type: application/x-ndjson\r\n"
-        b"transfer-encoding: chunked\r\n\r\n"
+        + extra.encode("latin-1")
+        + b"transfer-encoding: chunked\r\n\r\n"
     )
     await writer.drain()
+
+
+def _query_param(path: str, key: str) -> Optional[str]:
+    if "?" not in path:
+        return None
+    from urllib.parse import parse_qs
+
+    qs = parse_qs(path.split("?", 1)[1])
+    vals = qs.get(key)
+    return vals[0] if vals else None
 
 
 async def _send_ndjson(writer, obj) -> None:

@@ -59,6 +59,20 @@ uint32_t load_be32(const uint8_t* p) {
          (uint32_t(p[2]) << 8) | uint32_t(p[3]);
 }
 
+// Exact int64-vs-double comparison (sqlite3IntFloatCompare's algorithm):
+// converting the int to double loses precision above 2^53, so decide on the
+// truncated integer part first and only then on the fraction — this keeps
+// the native core bit-identical to the Python spec's exact comparison.
+int int_float_cmp(int64_t i, double r) {
+  if (r < -9223372036854775808.0) return 1;
+  if (r >= 9223372036854775808.0) return -1;
+  int64_t y = (int64_t)r;
+  if (i < y) return -1;
+  if (i > y) return 1;
+  double s = (double)i;  // exact here: i == trunc(r) which is representable
+  return s < r ? -1 : (s > r ? 1 : 0);
+}
+
 int bytes_cmp(const uint8_t* a, uint32_t alen, const uint8_t* b, uint32_t blen) {
   uint32_t n = alen < blen ? alen : blen;
   int c = n ? std::memcmp(a, b, n) : 0;
@@ -86,6 +100,8 @@ int crdt_value_cmp(const uint8_t* a, int64_t alen, const uint8_t* b,
         int64_t x = as_int(a), y = as_int(b);
         return x < y ? -1 : (x > y ? 1 : 0);
       }
+      if (a[0] == TAG_INT) return int_float_cmp(as_int(a), as_double(b));
+      if (b[0] == TAG_INT) return -int_float_cmp(as_int(b), as_double(a));
       double x = as_double(a), y = as_double(b);
       return x < y ? -1 : (x > y ? 1 : 0);
     }

@@ -1,0 +1,11 @@
+"""Streaming SQL subscriptions (L9: the reference's query/pubsub engine).
+
+Rebuild of `crates/corro-types/src/pubsub.rs` (the `Matcher` SQL-rewriting
+subscription engine + `SubsManager`) and `updates.rs` (`UpdatesManager`
+per-table notifier).  See matcher.py / manager.py for the design.
+"""
+
+from .manager import SubsManager, UpdatesManager
+from .matcher import Matcher, MatcherError
+
+__all__ = ["SubsManager", "UpdatesManager", "Matcher", "MatcherError"]

@@ -1,0 +1,192 @@
+"""SubsManager + UpdatesManager: subscription registries and change fan-out.
+
+Rebuild of `SubsManager::get_or_insert/restore` (corro-types/src/pubsub.rs:
+108-186) and the lighter per-table `UpdatesManager` (updates.rs:61-268).
+``match_changes`` is the hook the agent calls after every committed batch
+(updates.rs:420-481); subscribers attach asyncio queues that receive the
+NDJSON-protocol event dicts (the broadcast::channel fanout, agent/mod.rs:39).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.pkcodec import decode_pk
+from ..core.types import Change, DELETE_SENTINEL, SqliteValue
+from .matcher import Matcher, MatcherError, _enc_cell
+
+
+class SubHandle:
+    """One active subscription: matcher + attached subscriber queues."""
+
+    def __init__(self, matcher: Matcher):
+        self.matcher = matcher
+        self.id = matcher.id
+        self.queues: List[asyncio.Queue] = []
+        matcher.subscribe(self._on_event)
+
+    def _on_event(self, event: dict):
+        for q in list(self.queues):
+            q.put_nowait(event)
+
+    def attach(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self.queues.append(q)
+        return q
+
+    def detach(self, q: asyncio.Queue):
+        if q in self.queues:
+            self.queues.remove(q)
+
+
+class SubsManager:
+    """Registry of live subscriptions, keyed by id and by normalized SQL
+    hash so identical queries share one matcher (pubsub.rs:108-186)."""
+
+    def __init__(self, store, state_dir: Optional[str] = None):
+        self.store = store
+        self.state_dir = state_dir
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+        self.by_id: Dict[str, SubHandle] = {}
+        self.by_hash: Dict[str, str] = {}  # sql hash -> sub id
+
+    def _crr_tables(self) -> Dict[str, Tuple[str, ...]]:
+        return {name: info.pk_cols for name, info in self.store._tables.items()}
+
+    @staticmethod
+    def _hash(sql: str, params: Sequence[SqliteValue]) -> str:
+        norm = " ".join(sql.split()).lower()
+        return hashlib.sha256(
+            (norm + "\x00" + json.dumps([_enc_cell(p) for p in params])).encode()
+        ).hexdigest()
+
+    def _state_path(self, sub_id: str) -> str:
+        if self.state_dir:
+            return os.path.join(self.state_dir, f"{sub_id}.db")
+        return ":memory:"
+
+    def get_or_insert(
+        self, sql: str, params: Sequence[SqliteValue] = ()
+    ) -> Tuple[SubHandle, bool]:
+        """Returns (handle, created).  A matching live subscription is
+        shared; otherwise a new matcher runs its initial query."""
+        h = self._hash(sql, params)
+        sub_id = self.by_hash.get(h)
+        if sub_id is not None and sub_id in self.by_id:
+            return self.by_id[sub_id], False
+        sub_id = str(uuid.uuid4())
+        matcher = Matcher(
+            sub_id, sql, params, self.store.conn, self._crr_tables(),
+            state_path=self._state_path(sub_id),
+        )
+        matcher.run_initial()
+        handle = SubHandle(matcher)
+        self.by_id[sub_id] = handle
+        self.by_hash[h] = sub_id
+        self.store.conn.execute(
+            "INSERT OR REPLACE INTO __corro_subs (id, sql) VALUES (?, ?)",
+            (sub_id, json.dumps([sql, [_enc_cell(p) for p in params]])),
+        )
+        return handle, True
+
+    def get(self, sub_id: str) -> Optional[SubHandle]:
+        return self.by_id.get(sub_id)
+
+    def remove(self, sub_id: str):
+        handle = self.by_id.pop(sub_id, None)
+        if handle is None:
+            return
+        self.by_hash = {h: i for h, i in self.by_hash.items() if i != sub_id}
+        handle.matcher.close()
+        self.store.conn.execute("DELETE FROM __corro_subs WHERE id = ?", (sub_id,))
+        path = self._state_path(sub_id)
+        if path != ":memory:" and os.path.exists(path):
+            os.unlink(path)
+
+    def restore(self):
+        """Recreate persisted subscriptions at boot (pubsub.rs:822-858,
+        setup.rs:296-349); each matcher resyncs its snapshot so changes
+        applied while down appear in the change log."""
+        import base64
+
+        for sub_id, blob in self.store.conn.execute(
+            "SELECT id, sql FROM __corro_subs"
+        ).fetchall():
+            if sub_id in self.by_id:
+                continue
+            sql, enc_params = json.loads(blob)
+            params = tuple(
+                base64.b64decode(p["$b"]) if isinstance(p, dict) and "$b" in p else p
+                for p in enc_params
+            )
+            try:
+                matcher = Matcher(
+                    sub_id, sql, params, self.store.conn, self._crr_tables(),
+                    state_path=self._state_path(sub_id),
+                )
+                matcher.run_initial()
+            except MatcherError:
+                self.store.conn.execute(
+                    "DELETE FROM __corro_subs WHERE id = ?", (sub_id,)
+                )
+                continue
+            self.by_id[sub_id] = SubHandle(matcher)
+            self.by_hash[self._hash(sql, params)] = sub_id
+
+    def match_changes(self, changes: Sequence[Change]):
+        """Feed a committed batch to every live matcher (updates.rs:420-481,
+        called from the commit paths in broadcast.rs:544-545 and
+        util.rs:1026-1030)."""
+        if not changes:
+            return
+        for handle in list(self.by_id.values()):
+            try:
+                handle.matcher.handle_changes(changes)
+            except Exception:
+                # a broken matcher must not poison the apply path; the
+                # reference parks the sub in an errored state
+                import traceback
+
+                traceback.print_exc()
+
+
+class UpdatesManager:
+    """Per-table change notifier (updates.rs:61-268): no SQL matching, just
+    "this pk in this table changed" NotifyEvents
+    ({"notify": [type, [pk values...]]})."""
+
+    def __init__(self):
+        self.by_table: Dict[str, List[asyncio.Queue]] = {}
+
+    def attach(self, table: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self.by_table.setdefault(table, []).append(q)
+        return q
+
+    def detach(self, table: str, q: asyncio.Queue):
+        if table in self.by_table and q in self.by_table[table]:
+            self.by_table[table].remove(q)
+
+    def match_changes(self, changes: Sequence[Change]):
+        """updates.rs:278-300: type = delete when the causal length went
+        even (or the delete sentinel rode in), update otherwise."""
+        touched: Dict[str, Dict[bytes, str]] = {}
+        for ch in changes:
+            if ch.table not in self.by_table:
+                continue
+            typ = "delete" if (ch.cid == DELETE_SENTINEL or ch.cl % 2 == 0) else "update"
+            touched.setdefault(ch.table, {})[ch.pk] = typ
+        for table, pks in touched.items():
+            queues = self.by_table.get(table, [])
+            if not queues:
+                continue
+            for pk, typ in pks.items():
+                event = {"notify": [typ, [_enc_cell(v) for v in decode_pk(pk)]]}
+                for q in list(queues):
+                    q.put_nowait(event)

@@ -1,0 +1,590 @@
+"""Matcher: incremental materialization of one SQL subscription.
+
+Rebuild of the reference's `Matcher` (`corro-types/src/pubsub.rs:544-1750`):
+parse the subscribed SELECT, find the replicated tables it reads, inject
+aliased primary-key columns (`__corro_pk_<table>_<pk>`, pubsub.rs:604-648),
+and keep a per-subscription SQLite state DB (`query` result snapshot +
+`changes` log + `meta`/`columns`, pubsub.rs:893-926).  When committed changes
+touch a referenced table, the rewritten query is re-run restricted to the
+changed primary keys and diffed against the snapshot, appending
+insert/update/delete rows to the change log (pubsub.rs:1434-1750).
+
+Differences from the reference, by design:
+
+- the reference parses with `sqlite3-parser` and rewrites ASTs; we use
+  SQLite's own authorizer callback to discover referenced tables (the
+  compiler's ground truth) plus a small tokenizer for the FROM-clause
+  aliases, and splice the pk aliases textually;
+- queries the keyed rewrite can't handle (DISTINCT, GROUP BY, aggregates,
+  compound SELECTs, FROM subqueries, LIMIT, a table joined twice) fall back
+  to a full re-run + ordinal diff instead of erroring
+  (`MatcherError::UnsupportedStatement`, pubsub.rs:588 — we degrade where
+  the reference rejects);
+- events are plain dicts matching the NDJSON protocol of
+  doc/api/subscriptions.md:50-135 exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sqlite3
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core.pkcodec import decode_pk
+from ..core.types import Change, SqliteValue
+
+# SQLite authorizer action code for column reads
+_SQLITE_READ = 20
+
+_KEYED_BREAKERS = re.compile(
+    r"(?i)\b(distinct|group|union|intersect|except|limit|having|window)\b"
+)
+_AGGREGATES = {"count", "sum", "avg", "min", "max", "total", "group_concat"}
+_FROM_STOP = {
+    "where", "group", "order", "limit", "having", "window",
+    "union", "intersect", "except",
+}
+_JOIN_WORDS = {"join", "left", "right", "full", "inner", "outer", "cross", "natural"}
+
+
+class MatcherError(Exception):
+    pass
+
+
+def _tokenize(sql: str) -> List[Tuple[str, str, int]]:
+    """(kind, text, pos) tokens; kind in {id, num, str, punct, param}.
+    Comments are skipped; positions index into the original string."""
+    out: List[Tuple[str, str, int]] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+        elif sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+        elif sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+        elif c in "'\"`[":
+            close = {"[": "]"}.get(c, c)
+            j = i + 1
+            while j < n:
+                if sql[j] == close:
+                    if close in "'\"`" and j + 1 < n and sql[j + 1] == close:
+                        j += 2  # doubled quote escape
+                        continue
+                    break
+                j += 1
+            out.append(("str" if c == "'" else "id", sql[i : j + 1], i))
+            i = j + 1
+        elif c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] in "._+-"):
+                if sql[j] in "+-" and sql[j - 1] not in "eE":
+                    break
+                j += 1
+            out.append(("num", sql[i:j], i))
+            i = j
+        elif c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            out.append(("id", sql[i:j], i))
+            i = j
+        elif c in "?:@$":
+            j = i + 1
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            out.append(("param", sql[i:j], i))
+            i = j
+        else:
+            out.append(("punct", c, i))
+            i += 1
+    return out
+
+
+def _unquote(ident: str) -> str:
+    if ident and ident[0] in "\"`[":
+        return ident[1:-1].replace(ident[0] * 2, ident[0])
+    return ident
+
+
+def _parse_from_aliases(sql: str) -> Optional[Dict[str, str]]:
+    """Map real table name -> alias used in the top-level FROM clause.
+    Returns None when the shape defeats the keyed rewrite (subquery in FROM,
+    a table referenced twice, unparseable join)."""
+    toks = _tokenize(sql)
+    depth = 0
+    from_ix = None
+    for ix, (kind, text, _) in enumerate(toks):
+        if kind == "punct" and text == "(":
+            depth += 1
+        elif kind == "punct" and text == ")":
+            depth -= 1
+        elif depth == 0 and kind == "id" and text.lower() == "from":
+            from_ix = ix
+            break
+    if from_ix is None:
+        return None
+    aliases: Dict[str, str] = {}
+    ix = from_ix + 1
+    expect_table = True
+    while ix < len(toks):
+        kind, text, _ = toks[ix]
+        low = text.lower() if kind == "id" else ""
+        if kind == "punct" and text == "(":
+            return None  # FROM subquery → full mode
+        if depth == 0 and low in _FROM_STOP:
+            break
+        if expect_table:
+            if kind != "id":
+                return None
+            name = _unquote(text)
+            ix += 1
+            # optional schema qualifier main.t
+            if ix < len(toks) and toks[ix][1] == ".":
+                ix += 1
+                if ix >= len(toks) or toks[ix][0] != "id":
+                    return None
+                name = _unquote(toks[ix][1])
+                ix += 1
+            alias = name
+            if ix < len(toks) and toks[ix][0] == "id":
+                nxt = toks[ix][1].lower()
+                if nxt == "as":
+                    ix += 1
+                    if ix >= len(toks) or toks[ix][0] != "id":
+                        return None
+                    alias = _unquote(toks[ix][1])
+                    ix += 1
+                elif nxt not in _JOIN_WORDS and nxt not in _FROM_STOP and nxt not in (
+                    "on", "using",
+                ):
+                    alias = _unquote(toks[ix][1])
+                    ix += 1
+            if name in aliases:
+                return None  # self-join → full mode
+            aliases[name] = alias
+            expect_table = False
+        else:
+            if kind == "punct" and text == ",":
+                expect_table = True
+                ix += 1
+            elif low in _JOIN_WORDS:
+                if low == "join":
+                    expect_table = True
+                ix += 1
+            elif low in ("on", "using"):
+                # skip the join constraint expression until the next
+                # top-level join/comma/stop keyword
+                ix += 1
+                d = 0
+                while ix < len(toks):
+                    k2, t2, _ = toks[ix]
+                    l2 = t2.lower() if k2 == "id" else ""
+                    if k2 == "punct" and t2 == "(":
+                        d += 1
+                    elif k2 == "punct" and t2 == ")":
+                        d -= 1
+                    elif d == 0 and (
+                        l2 in _JOIN_WORDS or l2 in _FROM_STOP or (k2 == "punct" and t2 == ",")
+                    ):
+                        break
+                    ix += 1
+            else:
+                ix += 1
+    return aliases
+
+
+def _find_top_level_from(sql: str) -> Optional[int]:
+    depth = 0
+    for kind, text, pos in _tokenize(sql):
+        if kind == "punct" and text == "(":
+            depth += 1
+        elif kind == "punct" and text == ")":
+            depth -= 1
+        elif depth == 0 and kind == "id" and text.lower() == "from":
+            return pos
+    return None
+
+
+def _has_aggregate(sql: str) -> bool:
+    toks = _tokenize(sql)
+    for ix, (kind, text, _) in enumerate(toks):
+        if (
+            kind == "id"
+            and text.lower() in _AGGREGATES
+            and ix + 1 < len(toks)
+            and toks[ix + 1][1] == "("
+        ):
+            return True
+    return False
+
+
+def _enc_cell(v: SqliteValue):
+    if isinstance(v, bytes):
+        import base64
+
+        return {"$b": base64.b64encode(v).decode("ascii")}
+    return v
+
+
+def _enc_cells(row: Sequence[SqliteValue]) -> str:
+    return json.dumps([_enc_cell(v) for v in row], separators=(",", ":"))
+
+
+class Matcher:
+    """One subscription's incremental view.
+
+    ``main_conn`` is a connection to the node's replicated DB (read side);
+    ``state_path`` is this subscription's private state DB
+    (pubsub.rs:893-926), ``:memory:`` for ephemeral subs."""
+
+    def __init__(
+        self,
+        sub_id: str,
+        sql: str,
+        params: Sequence[SqliteValue],
+        main_conn: sqlite3.Connection,
+        crr_tables: Dict[str, Sequence[str]],  # table -> pk column names
+        state_path: str = ":memory:",
+    ):
+        self.id = sub_id
+        self.sql = sql.strip().rstrip(";")
+        self.params = tuple(params)
+        self.main = main_conn
+        head = self.sql.split(None, 1)[0].lower() if self.sql else ""
+        if head not in ("select", "with"):
+            raise MatcherError("only SELECT statements can be subscribed to")
+
+        referenced = self._referenced_tables()
+        self.tables: Dict[str, Tuple[str, ...]] = {
+            t: tuple(crr_tables[t]) for t in referenced if t in crr_tables
+        }
+        if not self.tables:
+            raise MatcherError("query references no replicated tables")
+
+        self.keyed = self._plan_keyed()
+        self.state = sqlite3.connect(state_path, check_same_thread=False)
+        self.state.execute("PRAGMA journal_mode = WAL")
+        self._init_state()
+        self.columns: List[str] = self._load_columns()
+        self.listeners: List[Callable[[dict], None]] = []
+
+    # -- planning ---------------------------------------------------------
+
+    def _referenced_tables(self) -> Set[str]:
+        """Ask SQLite's compiler which tables the query reads (the parser
+        ground truth the reference gets from sqlite3-parser)."""
+        seen: Set[str] = set()
+
+        def auth(action, a1, a2, dbname, trigger):
+            if action == _SQLITE_READ and a1:
+                seen.add(a1)
+            return sqlite3.SQLITE_OK
+
+        self.main.set_authorizer(auth)
+        try:
+            self.main.execute("EXPLAIN " + self.sql, self.params).fetchone()
+        except sqlite3.Error as e:
+            raise MatcherError(f"invalid query: {e}") from e
+        finally:
+            self.main.set_authorizer(None)
+        return seen
+
+    def _plan_keyed(self) -> bool:
+        """Decide keyed (pk-alias incremental) vs full (ordinal re-run) and
+        build the rewritten query if keyed."""
+        if self.sql.split(None, 1)[0].lower() == "with":
+            return False
+        if _KEYED_BREAKERS.search(self.sql) or _has_aggregate(self.sql):
+            return False
+        aliases = _parse_from_aliases(self.sql)
+        if aliases is None:
+            return False
+        for t in self.tables:
+            if t not in aliases:
+                return False  # read outside the FROM clause (subquery)
+        # pk alias columns, grouped per table (pubsub.rs:604-648)
+        self.pk_cols: Dict[str, List[str]] = {}
+        select_extra = []
+        for t, pks in self.tables.items():
+            a = aliases[t]
+            cols = []
+            for pk in pks:
+                alias_col = f"__corro_pk_{t}_{pk}"
+                select_extra.append(f'"{a}"."{pk}" AS "{alias_col}"')
+                cols.append(alias_col)
+            self.pk_cols[t] = cols
+        from_pos = _find_top_level_from(self.sql)
+        if from_pos is None:
+            return False
+        self.rewritten = (
+            self.sql[:from_pos].rstrip()
+            + ", "
+            + ", ".join(select_extra)
+            + " "
+            + self.sql[from_pos:]
+        )
+        self.n_alias = len(select_extra)
+        return True
+
+    # -- state db ---------------------------------------------------------
+
+    def _init_state(self):
+        alias_defs = ""
+        if self.keyed:
+            all_alias = [c for cols in self.pk_cols.values() for c in cols]
+            alias_defs = "".join(f', "{c}"' for c in all_alias)
+        self.state.executescript(
+            f"""
+            CREATE TABLE IF NOT EXISTS q (
+                rid INTEGER PRIMARY KEY AUTOINCREMENT,
+                k TEXT NOT NULL UNIQUE, cells TEXT NOT NULL{alias_defs});
+            CREATE TABLE IF NOT EXISTS changes (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                type TEXT NOT NULL, rid INTEGER NOT NULL, cells TEXT NOT NULL);
+            CREATE TABLE IF NOT EXISTS meta (key TEXT PRIMARY KEY, value);
+            CREATE TABLE IF NOT EXISTS cols (pos INTEGER PRIMARY KEY, name TEXT);
+            """
+        )
+        if self.keyed:
+            for t, cols in self.pk_cols.items():
+                cl = ", ".join(f'"{c}"' for c in cols)
+                self.state.execute(
+                    f'CREATE INDEX IF NOT EXISTS "ix_{t}" ON q ({cl})'
+                )
+        self.state.execute(
+            "INSERT OR REPLACE INTO meta (key, value) VALUES ('sql', ?)",
+            (json.dumps([self.sql, list(self.params)]),),
+        )
+        self.state.commit()
+
+    def _load_columns(self) -> List[str]:
+        return [r[0] for r in self.state.execute("SELECT name FROM cols ORDER BY pos")]
+
+    @property
+    def last_change_id(self) -> int:
+        row = self.state.execute("SELECT MAX(id) FROM changes").fetchone()
+        return row[0] or 0
+
+    def subscribe(self, cb: Callable[[dict], None]):
+        self.listeners.append(cb)
+
+    def unsubscribe(self, cb: Callable[[dict], None]):
+        if cb in self.listeners:
+            self.listeners.remove(cb)
+
+    def _emit(self, event: dict):
+        for cb in list(self.listeners):
+            cb(event)
+
+    # -- initial population ----------------------------------------------
+
+    def run_initial(self) -> List[dict]:
+        """Populate the snapshot (first run) or resync after restore; returns
+        the columns/row/eoq event list for a fresh subscriber
+        (pubsub.rs:1214+ Matcher::run)."""
+        import time
+
+        t0 = time.monotonic()
+        rows = self._query_all()
+        elapsed = time.monotonic() - t0
+        if not self.columns:
+            self.state.executemany(
+                "INSERT INTO cols (pos, name) VALUES (?, ?)",
+                list(enumerate(self._result_columns)),
+            )
+            self.columns = list(self._result_columns)
+        has_snapshot = (
+            self.state.execute("SELECT 1 FROM q LIMIT 1").fetchone() is not None
+        )
+        if has_snapshot:
+            # restored sub: diff what changed while we were away
+            self._diff_against_snapshot(rows)
+        else:
+            for key, cells, alias_vals in rows:
+                self._insert_row(key, cells, alias_vals, log=False)
+        self.state.commit()
+        events = [{"columns": self.columns}]
+        for rid, cells in self.state.execute("SELECT rid, cells FROM q ORDER BY rid"):
+            events.append({"row": [rid, json.loads(cells)]})
+        events.append(
+            {"eoq": {"time": elapsed, "change_id": self.last_change_id}}
+        )
+        return events
+
+    def _query_all(self):
+        """Full run of the (rewritten) query → [(key, cells_json, alias_vals)]."""
+        sql = self.rewritten if self.keyed else self.sql
+        cur = self.main.execute(sql, self.params)
+        desc = [d[0] for d in cur.description]
+        if self.keyed:
+            self._result_columns = desc[: -self.n_alias]
+        else:
+            self._result_columns = desc
+        out = []
+        for i, row in enumerate(cur.fetchall()):
+            if self.keyed:
+                cells = row[: -self.n_alias]
+                alias_vals = tuple(row[-self.n_alias :])
+                key = _enc_cells(alias_vals)
+            else:
+                cells = row
+                alias_vals = ()
+                key = str(i)
+            out.append((key, _enc_cells(cells), alias_vals))
+        return out
+
+    # -- change handling --------------------------------------------------
+
+    def filter_tables(self, changes: Sequence[Change]) -> Dict[str, Set[bytes]]:
+        """filter_matchable_change (pubsub.rs:294-332): which referenced
+        tables did this batch touch, and at which pks."""
+        cands: Dict[str, Set[bytes]] = {}
+        for ch in changes:
+            if ch.table in self.tables:
+                cands.setdefault(ch.table, set()).add(ch.pk)
+        return cands
+
+    def handle_changes(self, changes: Sequence[Change]) -> List[dict]:
+        """Incremental update for one committed batch; returns emitted change
+        events (also sent to listeners)."""
+        cands = self.filter_tables(changes)
+        if not cands:
+            return []
+        events: List[dict] = []
+        if not self.keyed:
+            events = self._diff_against_snapshot(self._query_all())
+        else:
+            for table, pks in cands.items():
+                events.extend(self._handle_candidates(table, pks))
+        self.state.commit()
+        return events
+
+    def _handle_candidates(self, table: str, pks: Set[bytes]) -> List[dict]:
+        """handle_candidates/handle_change (pubsub.rs:1434-1750): re-run the
+        rewritten query restricted to changed pks, diff against snapshot."""
+        alias_cols = self.pk_cols[table]
+        events: List[dict] = []
+        pk_tuples = [decode_pk(pk) for pk in pks]
+        for i in range(0, len(pk_tuples), 100):
+            chunk = pk_tuples[i : i + 100]
+            where, args = self._in_clause(alias_cols, chunk)
+            # fresh matching rows from the main DB
+            new: Dict[str, Tuple[str, tuple]] = {}
+            cur = self.main.execute(
+                f"SELECT * FROM ({self.rewritten}) WHERE {where}",
+                (*self.params, *args),
+            )
+            for row in cur.fetchall():
+                cells = row[: -self.n_alias]
+                alias_vals = tuple(row[-self.n_alias :])
+                new[_enc_cells(alias_vals)] = (_enc_cells(cells), alias_vals)
+            # current snapshot rows for those pks
+            old: Dict[str, Tuple[int, str]] = {}
+            for row in self.state.execute(
+                f"SELECT k, rid, cells FROM q WHERE {where}", args
+            ):
+                old[row[0]] = (row[1], row[2])
+            for key, (cells, alias_vals) in new.items():
+                if key in old:
+                    rid, old_cells = old[key]
+                    if old_cells != cells:
+                        self.state.execute(
+                            "UPDATE q SET cells = ? WHERE rid = ?", (cells, rid)
+                        )
+                        events.append(self._log("update", rid, cells))
+                else:
+                    events.append(self._insert_row(key, cells, alias_vals, log=True))
+            for key, (rid, old_cells) in old.items():
+                if key not in new:
+                    self.state.execute("DELETE FROM q WHERE rid = ?", (rid,))
+                    events.append(self._log("delete", rid, old_cells))
+        return events
+
+    def _diff_against_snapshot(self, rows) -> List[dict]:
+        """Full diff (fallback mode + restore resync): new full result vs
+        stored snapshot, keyed by pk aliases (keyed) or ordinal (full)."""
+        events: List[dict] = []
+        new = {key: (cells, alias_vals) for key, cells, alias_vals in rows}
+        old = {
+            k: (rid, cells)
+            for k, rid, cells in self.state.execute("SELECT k, rid, cells FROM q")
+        }
+        for key, (cells, alias_vals) in new.items():
+            if key in old:
+                rid, old_cells = old[key]
+                if old_cells != cells:
+                    self.state.execute(
+                        "UPDATE q SET cells = ? WHERE rid = ?", (cells, rid)
+                    )
+                    events.append(self._log("update", rid, cells))
+            else:
+                events.append(self._insert_row(key, cells, alias_vals, log=True))
+        for key, (rid, old_cells) in old.items():
+            if key not in new:
+                self.state.execute("DELETE FROM q WHERE rid = ?", (rid,))
+                events.append(self._log("delete", rid, old_cells))
+        return events
+
+    def _insert_row(self, key: str, cells: str, alias_vals: tuple, log: bool):
+        if self.keyed:
+            all_alias = [c for cols in self.pk_cols.values() for c in cols]
+            col_sql = "".join(f', "{c}"' for c in all_alias)
+            ph = ", ?" * len(all_alias)
+            cur = self.state.execute(
+                f"INSERT INTO q (k, cells{col_sql}) VALUES (?, ?{ph})",
+                (key, cells, *alias_vals),
+            )
+        else:
+            cur = self.state.execute(
+                "INSERT INTO q (k, cells) VALUES (?, ?)", (key, cells)
+            )
+        if log:
+            return self._log("insert", cur.lastrowid, cells)
+        return None
+
+    def _log(self, typ: str, rid: int, cells: str) -> dict:
+        cur = self.state.execute(
+            "INSERT INTO changes (type, rid, cells) VALUES (?, ?, ?)",
+            (typ, rid, cells),
+        )
+        event = {"change": [typ, rid, json.loads(cells), cur.lastrowid]}
+        self._emit(event)
+        return event
+
+    def _in_clause(self, cols: List[str], tuples: List[tuple]):
+        if len(cols) == 1:
+            ph = ", ".join("?" for _ in tuples)
+            return f'"{cols[0]}" IN ({ph})', [t[0] for t in tuples]
+        colref = "(" + ", ".join(f'"{c}"' for c in cols) + ")"
+        row_ph = "(" + ", ".join("?" for _ in cols) + ")"
+        ph = ", ".join(row_ph for _ in tuples)
+        args = [v for t in tuples for v in t]
+        return f"{colref} IN (VALUES {ph})", args
+
+    # -- catch-up ---------------------------------------------------------
+
+    def changes_since(self, change_id: int) -> List[dict]:
+        """Replay the change log for ?from= catch-up (pubsub.rs:100)."""
+        return [
+            {"change": [typ, rid, json.loads(cells), cid]}
+            for cid, typ, rid, cells in self.state.execute(
+                "SELECT id, type, rid, cells FROM changes WHERE id > ? ORDER BY id",
+                (change_id,),
+            )
+        ]
+
+    def snapshot_events(self) -> List[dict]:
+        """columns + current rows + eoq, without re-running the query."""
+        events = [{"columns": self.columns}]
+        for rid, cells in self.state.execute("SELECT rid, cells FROM q ORDER BY rid"):
+            events.append({"row": [rid, json.loads(cells)]})
+        events.append({"eoq": {"time": 0.0, "change_id": self.last_change_id}})
+        return events
+
+    def close(self):
+        self.state.close()

@@ -1,0 +1,107 @@
+"""LockRegistry: labeled lock/critical-section tracking + watchdog.
+
+Rebuild of the reference's registry (`corro-types/src/agent.rs:830-1055`):
+every Booked/Bookie lock acquisition registers label, kind and state with a
+start time; a watchdog warns on holds >10 s and flags >60 s as an invariant
+violation (`setup.rs:188-246`); `corrosion locks --top N` dumps it live
+(`main.rs:472-476`).  This is the rebuild's race-detection tier (SURVEY §5):
+there's no TSAN — discipline comes from the single writer lane plus this
+registry making long holds visible.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+WARN_AFTER_S = 10.0  # setup.rs:191
+FAIL_AFTER_S = 60.0  # setup.rs:231 (Antithesis assertion threshold)
+
+
+@dataclass
+class LockMeta:
+    id: int
+    label: str
+    kind: str  # "read" | "write"
+    state: str  # "acquiring" | "locked"
+    started_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def duration_s(self) -> float:
+        return time.monotonic() - self.started_at
+
+
+class LockRegistry:
+    def __init__(self):
+        self._ids = itertools.count(1)
+        self._held: Dict[int, LockMeta] = {}
+        self._mu = threading.Lock()
+        self.long_holds = 0  # watchdog counter (>WARN)
+        self.failed_holds = 0  # invariant violations (>FAIL)
+
+    def acquire(self, label: str, kind: str = "write") -> int:
+        meta = LockMeta(next(self._ids), label, kind, "acquiring")
+        with self._mu:
+            self._held[meta.id] = meta
+        return meta.id
+
+    def locked(self, lock_id: int):
+        with self._mu:
+            meta = self._held.get(lock_id)
+            if meta:
+                meta.state = "locked"
+                meta.started_at = time.monotonic()
+
+    def release(self, lock_id: int):
+        with self._mu:
+            self._held.pop(lock_id, None)
+
+    def track(self, label: str, kind: str = "write"):
+        """Context manager for a labeled critical section."""
+        registry = self
+
+        class _Track:
+            def __enter__(self):
+                self.id = registry.acquire(label, kind)
+                registry.locked(self.id)
+                return self
+
+            def __exit__(self, *exc):
+                registry.release(self.id)
+                return False
+
+        return _Track()
+
+    def top(self, n: int = 10) -> List[dict]:
+        """Longest-held entries (the `corrosion locks` dump)."""
+        with self._mu:
+            metas = sorted(self._held.values(), key=lambda m: -m.duration_s)
+        return [
+            {
+                "id": m.id, "label": m.label, "kind": m.kind,
+                "state": m.state, "duration_s": round(m.duration_s, 3),
+            }
+            for m in metas[:n]
+        ]
+
+    def check(self) -> Optional[dict]:
+        """One watchdog sweep; returns the worst offender past WARN, if any."""
+        worst = None
+        with self._mu:
+            for m in self._held.values():
+                d = m.duration_s
+                if d > WARN_AFTER_S and (worst is None or d > worst.duration_s):
+                    worst = m
+        if worst is None:
+            return None
+        self.long_holds += 1
+        if worst.duration_s > FAIL_AFTER_S:
+            self.failed_holds += 1
+        return {
+            "label": worst.label, "kind": worst.kind,
+            "duration_s": round(worst.duration_s, 3),
+            "failed": worst.duration_s > FAIL_AFTER_S,
+        }

@@ -1,0 +1,124 @@
+"""Admin Unix-socket RPC tests (corro-admin analog): framed JSON commands
+against a live agent."""
+
+import asyncio
+
+from corrosion_tpu.admin import AdminClient, AdminServer
+from corrosion_tpu.testing import Cluster
+
+
+async def _with_admin(n, fn):
+    import tempfile
+
+    cluster = Cluster(n)
+    await cluster.start()
+    servers, clients = [], []
+    tmp = tempfile.TemporaryDirectory()
+    try:
+        for i, agent in enumerate(cluster.agents):
+            path = f"{tmp.name}/admin{i}.sock"
+            srv = AdminServer(agent, path)
+            await srv.start()
+            servers.append(srv)
+            clients.append(AdminClient(path))
+        await fn(cluster, clients)
+    finally:
+        for srv in servers:
+            await srv.stop()
+        await cluster.stop()
+        tmp.cleanup()
+
+
+def test_ping_and_sync_generate():
+    async def body(cluster, clients):
+        assert (await clients[0].send({"cmd": "ping"}))["ok"] == "pong"
+        cluster.agents[0].exec_transaction(
+            [("INSERT INTO tests (id, text) VALUES (1, 'a')", ())]
+        )
+        dump = (await clients[0].send({"cmd": "sync", "sub": "generate"}))["ok"]
+        me = cluster.agents[0].actor_id.hex()
+        assert dump["actor_id"] == me
+        assert dump["heads"][me] == 1
+
+    asyncio.run(_with_admin(1, body))
+
+
+def test_cluster_members_and_membership_states():
+    async def body(cluster, clients):
+        # let SWIM converge membership
+        for _ in range(100):
+            resp = (await clients[0].send({"cmd": "cluster", "sub": "members"}))["ok"]
+            if len(resp) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(resp) >= 2
+        states = (
+            await clients[0].send({"cmd": "cluster", "sub": "membership_states"})
+        )["ok"]
+        assert all(s["state"] in ("alive", "suspect", "down") for s in states)
+
+    asyncio.run(_with_admin(3, body))
+
+
+def test_actor_version_classification():
+    async def body(cluster, clients):
+        a = cluster.agents[0]
+        a.exec_transaction([("INSERT INTO tests (id, text) VALUES (5, 'v')", ())])
+        resp = (
+            await clients[0].send(
+                {"cmd": "actor", "sub": "version",
+                 "actor_id": a.actor_id.hex(), "version": 1}
+            )
+        )["ok"]
+        assert resp["kind"] == "current"
+        resp = (
+            await clients[0].send(
+                {"cmd": "actor", "sub": "version",
+                 "actor_id": a.actor_id.hex(), "version": 99}
+            )
+        )["ok"]
+        assert resp["kind"] == "unknown"
+
+    asyncio.run(_with_admin(1, body))
+
+
+def test_subs_list_and_info_and_locks():
+    async def body(cluster, clients):
+        a = cluster.agents[0]
+        handle, _ = a.subs.get_or_insert("SELECT id, text FROM tests")
+        subs = (await clients[0].send({"cmd": "subs", "sub": "list"}))["ok"]
+        assert len(subs) == 1 and subs[0]["id"] == handle.id
+        info = (
+            await clients[0].send({"cmd": "subs", "sub": "info", "id": handle.id})
+        )["ok"]
+        assert info["mode"] == "keyed"
+        assert info["tables"] == ["tests"]
+        locks = (await clients[0].send({"cmd": "locks", "top": 5}))["ok"]
+        assert isinstance(locks, list)
+
+    asyncio.run(_with_admin(1, body))
+
+
+def test_cluster_set_id_and_log_level():
+    async def body(cluster, clients):
+        resp = await clients[0].send({"cmd": "cluster", "sub": "set_id", "id": 7})
+        assert resp["ok"] == 7
+        assert cluster.agents[0].config.cluster_id == 7
+        assert (await clients[0].send({"cmd": "log", "sub": "set", "filter": "debug"}))[
+            "ok"
+        ] == "debug"
+        assert (await clients[0].send({"cmd": "log", "sub": "reset"}))["ok"] == "reset"
+        resp = await clients[0].send({"cmd": "nope"})
+        assert "error" in resp
+
+    asyncio.run(_with_admin(1, body))
+
+
+def test_cluster_rejoin():
+    async def body(cluster, clients):
+        inc0 = cluster.agents[0].swim.incarnation
+        resp = await clients[0].send({"cmd": "cluster", "sub": "rejoin"})
+        assert resp["ok"] == "rejoined"
+        assert cluster.agents[0].swim.incarnation == inc0 + 1
+
+    asyncio.run(_with_admin(2, body))

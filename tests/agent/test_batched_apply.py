@@ -77,6 +77,35 @@ def test_batched_equals_sequential():
     b.close()
 
 
+def test_batched_mixed_causal_lengths_in_one_batch():
+    """Regression: a batch mixing changes at different causal lengths for the
+    same (locally unknown) pk must not fold them by col_version alone — the
+    resurrected lifecycle (higher cl) wins even at lower col_version."""
+    site_a, site_b = ActorId(b"\x01" * 16), ActorId(b"\x02" * 16)
+    stale = Change(table="t", pk=b"\x01\x01" + b"\x00" * 7 + b"\x07", cid="a",
+                   val="old", col_version=5, db_version=1, seq=0,
+                   site_id=site_a, cl=1)
+    fresh = Change(table="t", pk=stale.pk, cid="a",
+                   val="new", col_version=1, db_version=3, seq=0,
+                   site_id=site_b, cl=3)
+    # pad the batch over the native-path threshold with unrelated rows
+    pad = [
+        Change(table="t", pk=b"\x01\x01" + b"\x00" * 7 + bytes([100 + i]),
+               cid="a", val=f"p{i}", col_version=1, db_version=2, seq=i,
+               site_id=site_a, cl=1)
+        for i in range(20)
+    ]
+    for batch in ([stale, fresh] + pad, [fresh, stale] + pad):
+        s = CrrStore(":memory:", ActorId.random())
+        s.execute_schema(SCHEMA)
+        s.apply_changes(batch)
+        row = s.query('SELECT val, col_version FROM "t__crdt_clock" '
+                      "WHERE pk = ? AND cid = 'a'", (stale.pk,))[0]
+        cl = s.query('SELECT cl FROM "t__crdt_rows" WHERE pk = ?', (stale.pk,))[0][0]
+        assert (row[0], row[1], cl) == ("new", 1, 3), (tuple(row), cl)
+        s.close()
+
+
 def test_batched_idempotent_redelivery():
     changes = make_workload(seed=2)
     s = CrrStore(":memory:", ActorId.random())

@@ -64,3 +64,15 @@ def test_int_float_cross_comparison():
     assert native.value_cmp_native(1, 1.5) < 0
     assert native.value_cmp_native(2.0, 2) == 0
     assert native.value_cmp_native(2**62, 1e10) > 0
+    # exactness above 2^53: double conversion would collapse these
+    from corrosion_tpu.core.crdt import value_cmp
+
+    for a, b in [
+        (2**53 + 1, float(2**53)),
+        (2**53, float(2**53)),
+        (-(2**53) - 1, -float(2**53)),
+        (2**63 - 1, 9.3e18),
+        (-(2**63), -9.3e18),
+    ]:
+        assert native.value_cmp_native(a, b) == value_cmp(a, b), (a, b)
+        assert native.value_cmp_native(b, a) == value_cmp(b, a), (b, a)

@@ -1,0 +1,184 @@
+"""Matcher unit tests: keyed incremental diffs, fallback mode, change log
+catch-up — the reference's pubsub.rs inline test coverage equivalents."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent.store import CrrStore
+from corrosion_tpu.core.types import ActorId
+from corrosion_tpu.pubsub import Matcher, MatcherError, SubsManager, UpdatesManager
+
+SCHEMA = """
+CREATE TABLE sandwiches (
+    name TEXT PRIMARY KEY NOT NULL,
+    filling TEXT NOT NULL DEFAULT '',
+    price REAL NOT NULL DEFAULT 0
+);
+CREATE TABLE shops (
+    id INTEGER PRIMARY KEY NOT NULL,
+    city TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+def make_store():
+    store = CrrStore(":memory:", ActorId.random())
+    store.execute_schema(SCHEMA)
+    return store
+
+
+def crr_tables(store):
+    return {name: info.pk_cols for name, info in store._tables.items()}
+
+
+def apply_local(store, sql, params=()):
+    """Commit a local write and return the captured changes."""
+    _, info = store.transact([(sql, params)])
+    assert info is not None
+    return store.changes_for_version(store.site_id, info.db_version)
+
+
+def test_keyed_single_table_lifecycle():
+    store = make_store()
+    apply_local(store, "INSERT INTO sandwiches (name, filling) VALUES ('blt', 'bacon')")
+    m = Matcher("s1", "SELECT name, filling FROM sandwiches", (), store.conn,
+                crr_tables(store))
+    events = m.run_initial()
+    assert events[0] == {"columns": ["name", "filling"]}
+    assert events[1]["row"][1] == ["blt", "bacon"]
+    assert "eoq" in events[-1]
+    assert m.keyed
+
+    # insert
+    ev = m.handle_changes(
+        apply_local(store, "INSERT INTO sandwiches (name, filling) VALUES ('ham', 'ham')")
+    )
+    assert ev == [{"change": ["insert", ev[0]["change"][1], ["ham", "ham"], 1]}]
+    # update
+    ev = m.handle_changes(
+        apply_local(store, "UPDATE sandwiches SET filling = 'maple ham' WHERE name = 'ham'")
+    )
+    assert ev[0]["change"][0] == "update"
+    assert ev[0]["change"][2] == ["ham", "maple ham"]
+    # delete
+    ev = m.handle_changes(apply_local(store, "DELETE FROM sandwiches WHERE name = 'blt'"))
+    assert ev[0]["change"][0] == "delete"
+    assert ev[0]["change"][2] == ["blt", "bacon"]
+    assert m.last_change_id == 3
+
+
+def test_keyed_where_clause_filters_rows():
+    store = make_store()
+    m = Matcher("s2", "SELECT name FROM sandwiches WHERE price > 5", (), store.conn,
+                crr_tables(store))
+    m.run_initial()
+    ev = m.handle_changes(
+        apply_local(store, "INSERT INTO sandwiches (name, price) VALUES ('cheap', 1)")
+    )
+    assert ev == []  # filtered out
+    ev = m.handle_changes(
+        apply_local(store, "INSERT INTO sandwiches (name, price) VALUES ('lux', 12)")
+    )
+    assert ev[0]["change"][:1] == ["insert"]
+    # price drop moves it out of the result set → delete event
+    ev = m.handle_changes(
+        apply_local(store, "UPDATE sandwiches SET price = 2 WHERE name = 'lux'")
+    )
+    assert ev[0]["change"][0] == "delete"
+
+
+def test_keyed_join_two_tables():
+    store = make_store()
+    apply_local(store, "INSERT INTO shops (id, city) VALUES (1, 'lisbon')")
+    m = Matcher(
+        "s3",
+        "SELECT s.name, h.city FROM sandwiches s JOIN shops h ON h.id = 1",
+        (), store.conn, crr_tables(store),
+    )
+    m.run_initial()
+    assert m.keyed
+    ev = m.handle_changes(
+        apply_local(store, "INSERT INTO sandwiches (name) VALUES ('paris')")
+    )
+    assert ev[0]["change"][2] == ["paris", "lisbon"]
+    # change on the joined table side also lands
+    ev = m.handle_changes(
+        apply_local(store, "UPDATE shops SET city = 'porto' WHERE id = 1")
+    )
+    assert ev[0]["change"][0] == "update"
+    assert ev[0]["change"][2] == ["paris", "porto"]
+
+
+def test_aggregate_falls_back_to_full_mode():
+    store = make_store()
+    m = Matcher("s4", "SELECT COUNT(*) FROM sandwiches", (), store.conn,
+                crr_tables(store))
+    assert not m.keyed
+    events = m.run_initial()
+    assert events[1]["row"][1] == [0]
+    ev = m.handle_changes(
+        apply_local(store, "INSERT INTO sandwiches (name) VALUES ('one')")
+    )
+    assert ev[0]["change"][0] == "update"
+    assert ev[0]["change"][2] == [1]
+
+
+def test_params_and_catchup():
+    store = make_store()
+    m = Matcher("s5", "SELECT name FROM sandwiches WHERE filling = ?", ("x",),
+                store.conn, crr_tables(store))
+    m.run_initial()
+    m.handle_changes(
+        apply_local(store, "INSERT INTO sandwiches (name, filling) VALUES ('a', 'x')")
+    )
+    m.handle_changes(
+        apply_local(store, "INSERT INTO sandwiches (name, filling) VALUES ('b', 'x')")
+    )
+    assert [e["change"][3] for e in m.changes_since(0)] == [1, 2]
+    assert [e["change"][3] for e in m.changes_since(1)] == [2]
+
+
+def test_non_select_rejected():
+    store = make_store()
+    with pytest.raises(MatcherError):
+        Matcher("bad", "DELETE FROM sandwiches", (), store.conn, crr_tables(store))
+    with pytest.raises(MatcherError):
+        Matcher("bad2", "SELECT 1", (), store.conn, crr_tables(store))
+
+
+def test_subs_manager_share_and_remove():
+    async def body():
+        store = make_store()
+        subs = SubsManager(store)
+        h1, created1 = subs.get_or_insert("SELECT name FROM sandwiches")
+        h2, created2 = subs.get_or_insert("select   name from sandwiches")
+        assert created1 and not created2
+        assert h1.id == h2.id
+        q = h1.attach()
+        subs.match_changes(
+            apply_local(store, "INSERT INTO sandwiches (name) VALUES ('z')")
+        )
+        ev = q.get_nowait()
+        assert ev["change"][0] == "insert"
+        subs.remove(h1.id)
+        assert subs.get(h1.id) is None
+        row = store.conn.execute("SELECT COUNT(*) FROM __corro_subs").fetchone()
+        assert row[0] == 0
+
+    asyncio.run(body())
+
+
+def test_updates_manager_notify_events():
+    async def body():
+        store = make_store()
+        um = UpdatesManager()
+        q = um.attach("sandwiches")
+        um.match_changes(
+            apply_local(store, "INSERT INTO sandwiches (name) VALUES ('n1')")
+        )
+        assert q.get_nowait() == {"notify": ["update", ["n1"]]}
+        um.match_changes(apply_local(store, "DELETE FROM sandwiches WHERE name = 'n1'"))
+        assert q.get_nowait() == {"notify": ["delete", ["n1"]]}
+
+    asyncio.run(body())
