@@ -98,10 +98,19 @@ class Agent:
     # -- lifecycle --------------------------------------------------------
 
     async def start(self):
-        for path in self.config.schema_paths:
+        if self.config.schema_paths:
             from ..utils.files import read_sql_files
 
-            for sql in read_sql_files(path):
+            # all files form ONE schema (the reference joins every parsed
+            # file into a single Schema before apply, run_root.rs:101-106) —
+            # applying files separately would read each as a full schema
+            # and reject the tables the other files own as drops
+            sql = "\n".join(
+                s
+                for path in self.config.schema_paths
+                for s in read_sql_files(path)
+            )
+            if sql.strip():
                 self.store.execute_schema(sql)
         self.subs.restore()
         if self.config.use_swim:

@@ -1,0 +1,157 @@
+"""Cluster-aware backup and online restore.
+
+Rebuild of the reference's `corrosion backup` / `corrosion restore` commands
+(`crates/corrosion/src/main.rs:160-331`) and the `sqlite3-restore` crate
+(`crates/sqlite3-restore/src/lib.rs:57-152`):
+
+- **backup**: `VACUUM INTO` a snapshot, then strip everything node-specific
+  (the local site id, member list, persisted subscriptions, sync bookkeeping)
+  so the snapshot can be restored on *any* node — the analog of the reference
+  deleting the ordinal-0 `crsql_site_id` row and `__corro_*` per-node state.
+- **restore**: swap the snapshot over a live DB file while holding POSIX
+  locks on the main/-wal/-shm file handles (blocking every other SQLite
+  client, exactly `lock_all`, sqlite3-restore lib.rs:152), truncate-copy the
+  backup over the live file, drop the stale WAL, and stamp a fresh (or
+  caller-chosen) site id so the restored node is a brand-new actor.
+
+Replicated CRDT data (base tables, clock tables, row causal lengths,
+per-origin db_versions) is preserved verbatim: it is cluster state, not node
+state, and anti-entropy reconciles it from wherever the snapshot lands.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import sqlite3
+from contextlib import contextmanager
+from typing import Iterator, List, Optional
+
+from ..core.types import ActorId
+
+# Tables whose contents are per-node, not cluster data (main.rs:183-212).
+_NODE_STATE_TABLES = (
+    "__corro_members",
+    "__corro_subs",
+    "__corro_bookkeeping_gaps",
+    "__corro_seq_bookkeeping",
+    "__corro_buffered_changes",
+)
+
+
+def backup_db(src_path: str, dest_path: str) -> None:
+    """Snapshot `src_path` into `dest_path`, stripped of node identity.
+
+    Uses `VACUUM INTO` (same primitive as main.rs:172) so the snapshot is a
+    compact, consistent single file even while the source is being written.
+    """
+    if os.path.exists(dest_path):
+        raise FileExistsError(f"backup target already exists: {dest_path}")
+    src = sqlite3.connect(src_path)
+    try:
+        src.execute("VACUUM INTO ?", (dest_path,))
+    finally:
+        src.close()
+
+    dest = sqlite3.connect(dest_path)
+    try:
+        dest.execute("BEGIN")
+        dest.execute("DELETE FROM __corro_state WHERE key = 'site_id'")
+        for table in _NODE_STATE_TABLES:
+            try:
+                dest.execute(f'DELETE FROM "{table}"')
+            except sqlite3.OperationalError:
+                pass  # snapshot predates the table: nothing to strip
+        dest.execute("COMMIT")
+        dest.execute("VACUUM")
+    finally:
+        dest.close()
+
+
+@contextmanager
+def _locked_db_files(live_path: str) -> Iterator[List[int]]:
+    """POSIX-write-lock the main/-wal/-shm files of a live SQLite DB.
+
+    The reference locks every file handle before overwriting so concurrent
+    SQLite clients block rather than read torn state
+    (sqlite3-restore lib.rs:57-152). O_CREAT matches its behavior of locking
+    side files even if they don't exist yet.
+    """
+    fds: List[int] = []
+    try:
+        for suffix in ("", "-wal", "-shm"):
+            fd = os.open(live_path + suffix, os.O_RDWR | os.O_CREAT, 0o644)
+            fcntl.lockf(fd, fcntl.LOCK_EX)
+            fds.append(fd)
+        yield fds
+    finally:
+        for fd in fds:
+            try:
+                fcntl.lockf(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
+
+
+def restore_db(
+    backup_path: str,
+    live_path: str,
+    site_id: Optional[ActorId] = None,
+) -> ActorId:
+    """Swap `backup_path` over `live_path` under POSIX locks and stamp a
+    node identity.  Returns the ActorId the restored DB now runs as.
+
+    The restored node is a *new actor* (fresh site id unless the caller
+    pins one): its future writes must not collide with versions the
+    snapshot's origin already gossiped (main.rs:227-331).
+    """
+    if not os.path.exists(backup_path):
+        raise FileNotFoundError(backup_path)
+    # Validate the snapshot is actually node-stripped corrosion state before
+    # touching the live file.
+    check = sqlite3.connect(f"file:{backup_path}?mode=ro", uri=True)
+    try:
+        tables = {
+            r[0]
+            for r in check.execute(
+                "SELECT name FROM sqlite_master WHERE type = 'table'"
+            )
+        }
+        if "__corro_state" not in tables:
+            raise ValueError(f"not a corrosion backup: {backup_path}")
+    finally:
+        check.close()
+
+    actor = site_id or ActorId.random()
+    with _locked_db_files(live_path) as (main_fd, wal_fd, shm_fd):
+        # Truncate-copy the backup over the live main file through the
+        # locked fd (lib.rs:107-133), then drop the now-stale WAL/SHM.
+        os.lseek(main_fd, 0, os.SEEK_SET)
+        os.ftruncate(main_fd, 0)
+        with open(backup_path, "rb") as src:
+            while chunk := src.read(1 << 20):
+                os.write(main_fd, chunk)
+        os.ftruncate(wal_fd, 0)
+        os.ftruncate(shm_fd, 0)
+        os.fsync(main_fd)
+
+    conn = sqlite3.connect(live_path)
+    try:
+        conn.execute("BEGIN")
+        conn.execute("DELETE FROM __corro_state WHERE key = 'site_id'")
+        conn.execute(
+            "INSERT INTO __corro_state (key, value) VALUES ('site_id', ?)",
+            (actor.bytes_,),
+        )
+        conn.execute("COMMIT")
+    finally:
+        conn.close()
+    return actor
+
+
+@contextmanager
+def db_lock(live_path: str) -> Iterator[None]:
+    """Hold exclusive POSIX locks on a live DB's files (`corrosion db lock`
+    command, main.rs:478-497): blocks writers while an operator inspects or
+    copies the files out-of-band."""
+    with _locked_db_files(live_path):
+        yield

@@ -25,7 +25,6 @@ seq = ordinal of the column change inside the transaction.
 from __future__ import annotations
 
 import json
-import re
 import sqlite3
 import threading
 from dataclasses import dataclass
@@ -34,6 +33,14 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 from ..core.crdt import MergeOutcome, merge_cell, row_alive
 from ..core.hlc import HLC
 from ..core.pkcodec import decode_pk, encode_pk
+from ..core.schema import (
+    SchemaError,
+    SchemaTable,
+    normalize_sql as _normalize_sql,
+    parse_schema,
+    table_columns as _table_columns,
+    table_shape as _table_shape,
+)
 from ..core.types import Change, DELETE_SENTINEL, PKONLY_SENTINEL, ActorId, SqliteValue
 
 
@@ -57,9 +64,6 @@ class CommitInfo:
     db_version: int
     last_seq: int
     ts: int
-
-
-_CREATE_TABLE_RE = re.compile(r"(?is)^\s*create\s+table\s+(?:if\s+not\s+exists\s+)?[\"'`]?(\w+)")
 
 
 class CrrStore:
@@ -162,23 +166,164 @@ class CrrStore:
     # -- schema -----------------------------------------------------------
 
     def execute_schema(self, schema_sql: str) -> List[str]:
-        """Create tables from SQL and mark each as a CRR (the reference's
-        file-based schema + `crsql_as_crr`, corro-utils + schema.rs).
+        """Apply a schema file with live-migration diffing (the reference's
+        `apply_schema`, corro-types/src/schema.rs:274-608, plus the
+        `constrain` pass, schema.rs:113-168).
 
-        Returns the list of table names now replicated."""
-        created = []
+        Returns the list of newly replicated table names."""
+        return self.apply_schema(schema_sql)["new_tables"]
+
+    def apply_schema(self, schema_sql: str) -> Dict[str, object]:
+        """Diff the desired schema against the live DB and migrate:
+
+        - new tables: created + CRR'd + their indexes (schema.rs:310-385);
+          a pre-existing identical table is adopted (schema.rs:322-360)
+        - dropped tables: rejected (DropTableWithoutDestructiveFlag,
+          schema.rs:279-290)
+        - dropped/changed columns: rejected (schema.rs:414-455)
+        - new columns: must be non-PK and nullable-or-defaulted; applied via
+          ALTER TABLE ADD COLUMN (schema.rs:458-510)
+        - indexes on kept tables: created/dropped to match (schema.rs:585+)
+        """
+        desired = parse_schema(schema_sql)
+        out: Dict[str, object] = {"new_tables": [], "new_columns": {}}
         with self._lock:
-            for stmt in _split_statements(schema_sql):
-                m = _CREATE_TABLE_RE.match(stmt)
-                if not m:
-                    self.conn.execute(stmt)
+            current_names = set(self._tables)
+            dropped = current_names - set(desired.tables)
+            if dropped:
+                raise SchemaError(
+                    f"cannot drop table {sorted(dropped)[0]!r} without a "
+                    "destructive migration"
+                )
+            # DDL (tables/triggers/indexes) is transactional in SQLite, but
+            # the in-memory registry is not — snapshot it so a failed
+            # migration leaves no ghost entries pointing at rolled-back
+            # clock tables.
+            tables_snapshot = dict(self._tables)
+            self.conn.execute("BEGIN")
+            try:
+                for name, tbl in desired.tables.items():
+                    if name in self._tables:
+                        self._migrate_table(tbl, out)
+                    else:
+                        self._create_schema_table(tbl, out)
+                self.conn.execute("COMMIT")
+            except Exception:
+                self.conn.execute("ROLLBACK")
+                self._tables = tables_snapshot
+                raise
+        return out
+
+    def merge_schema(self, statements: Sequence[str]) -> Dict[str, object]:
+        """Merge partial schema statements into the live schema — the
+        `/v1/migrations` semantics (api/public/mod.rs:540-562): tables in
+        `statements` overwrite their previous definition ("users are
+        expected to return a full table def"); unmentioned tables are kept.
+        """
+        partial_sql = ";\n".join(statements)
+        partial = parse_schema(partial_sql)
+        with self._lock:
+            keep: List[str] = []
+            for name in self._tables:
+                if name in partial.tables:
                     continue
-                name = m.group(1)
-                if name in self._tables:
-                    continue  # live migration diffing lands with M6
-                self.conn.execute(stmt)
-                created.append(self.create_crr(name))
-        return [t.name for t in created]
+                # our clock/rows side tables and the _dbv index live under
+                # their own tbl_name, so tbl_name = base-table already
+                # excludes them
+                for (sql,) in self.conn.execute(
+                    "SELECT sql FROM sqlite_master WHERE tbl_name = ? AND "
+                    "type IN ('table', 'index') AND sql IS NOT NULL",
+                    (name,),
+                ):
+                    keep.append(sql)
+            return self.apply_schema(";\n".join(keep + [partial_sql]))
+
+    def _create_schema_table(self, tbl: "SchemaTable", out: Dict[str, object]):
+        exists = self.conn.execute(
+            "SELECT sql FROM sqlite_master WHERE type = 'table' AND name = ?",
+            (tbl.name,),
+        ).fetchone()
+        if exists is None:
+            self.conn.execute(tbl.sql)
+        else:
+            # reconcile an untracked pre-existing table (schema.rs:322-360):
+            # adopt it only if pk + columns match exactly
+            live = _table_shape(self.conn, tbl.name)
+            if live != tbl.shape():
+                raise SchemaError(
+                    f"existing table {tbl.name!r} does not match schema: "
+                    f"have {live}, want {tbl.shape()}"
+                )
+        for idx in tbl.indexes:
+            self.conn.execute(f'DROP INDEX IF EXISTS "{idx.name}"')
+            self.conn.execute(idx.sql)
+        out["new_tables"].append(tbl.name)  # type: ignore[union-attr]
+        self.create_crr(tbl.name)
+
+    def _migrate_table(self, tbl: "SchemaTable", out: Dict[str, object]):
+        info = self._tables[tbl.name]
+        live_cols = {c.name: c for c in _table_columns(self.conn, tbl.name)}
+        want_cols = {c.name: c for c in tbl.columns}
+
+        dropped = set(live_cols) - set(want_cols)
+        if dropped:
+            raise SchemaError(
+                f"cannot remove column {sorted(dropped)[0]!r} from "
+                f"{tbl.name!r} without a destructive migration"
+            )
+        changed = [
+            n for n in live_cols if n in want_cols and live_cols[n] != want_cols[n]
+        ]
+        if changed:
+            raise SchemaError(
+                f"cannot change column(s) {','.join(sorted(changed))} of "
+                f"{tbl.name!r} without a destructive migration"
+            )
+
+        added = [want_cols[n] for n in want_cols if n not in live_cols]
+        for col in added:
+            if col.pk:
+                raise SchemaError(
+                    f"cannot add primary-key column {col.name!r} to {tbl.name!r}"
+                )
+            if col.notnull and col.default is None:
+                raise SchemaError(
+                    f"new column {tbl.name}.{col.name} is NOT NULL and has "
+                    "no default"
+                )
+            self.conn.execute(
+                f'ALTER TABLE "{tbl.name}" ADD COLUMN {col.ddl()}'
+            )
+        if added:
+            non_pk = info.non_pk_cols + tuple(c.name for c in added)
+            info = TableInfo(tbl.name, info.pk_cols, non_pk)
+            self.conn.execute(
+                "UPDATE __crdt_tables SET cols = ? WHERE name = ?",
+                (json.dumps(non_pk), tbl.name),
+            )
+            self._tables[tbl.name] = info
+            self._create_triggers(info)
+            out["new_columns"][tbl.name] = [c.name for c in added]  # type: ignore[index]
+
+        # index diff: schema-managed indexes only (never our __crdt/_dbv ones)
+        live_idx = {
+            r[0]: r[1]
+            for r in self.conn.execute(
+                "SELECT name, sql FROM sqlite_master WHERE type = 'index' "
+                "AND tbl_name = ? AND sql IS NOT NULL",
+                (tbl.name,),
+            )
+            if not r[0].endswith("_dbv")
+        }
+        want_idx = {i.name: i for i in tbl.indexes}
+        for name in set(live_idx) - set(want_idx):
+            self.conn.execute(f'DROP INDEX IF EXISTS "{name}"')
+        for name, idx in want_idx.items():
+            if name not in live_idx:
+                self.conn.execute(idx.sql)
+            elif _normalize_sql(live_idx[name]) != _normalize_sql(idx.sql):
+                self.conn.execute(f'DROP INDEX "{name}"')
+                self.conn.execute(idx.sql)
 
     def create_crr(self, name: str) -> TableInfo:
         """`crsql_as_crr` equivalent: attach clock/rows tables + triggers."""
@@ -676,28 +821,3 @@ class CrrStore:
         if self.read_conn is not self.conn:
             self.read_conn.close()
         self.conn.close()
-
-
-def _split_statements(sql: str) -> List[str]:
-    """Split a schema file into statements (semicolons outside quotes)."""
-    out, buf, in_str = [], [], None
-    for chsym in sql:
-        if in_str:
-            buf.append(chsym)
-            if chsym == in_str:
-                in_str = None
-            continue
-        if chsym in ("'", '"'):
-            in_str = chsym
-            buf.append(chsym)
-        elif chsym == ";":
-            stmt = "".join(buf).strip()
-            if stmt:
-                out.append(stmt)
-            buf = []
-        else:
-            buf.append(chsym)
-    stmt = "".join(buf).strip()
-    if stmt:
-        out.append(stmt)
-    return out

@@ -280,10 +280,21 @@ class ApiServer:
             self.agent.updates.detach(table, queue)
 
     def _migrations(self, stmts) -> dict:
-        for s in stmts:
-            sql, _ = _parse_statement(s)
-            self.agent.store.execute_schema(sql)
-        return {"results": "ok"}
+        """api_v1_db_schema (api/public/mod.rs:595-641): merge full table
+        defs into the live schema with live-migration diffing."""
+        if not stmts:
+            raise HttpError(400, "at least 1 statement is required")
+        from ..core.schema import SchemaError
+
+        try:
+            out = self.agent.store.merge_schema(
+                [_parse_statement(s)[0] for s in stmts]
+            )
+        except SchemaError as e:
+            # deterministic client mistake (destructive/unsupported schema),
+            # not a server fault — don't invite 5xx retries
+            raise HttpError(400, str(e))
+        return {"results": out}
 
     def _table_stats(self) -> dict:
         out = {}

@@ -1,0 +1,223 @@
+"""Schema parsing + constraint checking for CRR tables.
+
+Rebuild of the reference's schema model (`corro-types/src/schema.rs`):
+`parse_sql` builds a Table/Column/Index model from schema files
+(schema.rs:609-748) and `constrain` rejects shapes that break CRDT
+replication (schema.rs:113-168): primary-key expressions, non-nullable
+non-PK columns without defaults, foreign keys, and unique indexes.
+
+Instead of hand-writing an SQL parser, the desired schema is executed into
+a scratch in-memory SQLite and read back through PRAGMA introspection —
+SQLite itself is the parser, so accepted syntax matches the storage engine
+exactly.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class SchemaError(Exception):
+    """A schema file is invalid or the migration it implies is destructive."""
+
+
+@dataclass(frozen=True)
+class SchemaColumn:
+    name: str
+    type: str  # uppercased declared type
+    notnull: bool
+    default: Optional[str]  # DEFAULT expression as SQL text, None if absent
+    pk: int  # 0 = not part of the PK, else 1-based ordinal within the PK
+    generated: bool = False
+
+    def ddl(self) -> str:
+        parts = [f'"{self.name}"']
+        if self.type:
+            parts.append(self.type)
+        if self.notnull:
+            parts.append("NOT NULL")
+        if self.default is not None:
+            parts.append(f"DEFAULT {self.default}")
+        return " ".join(parts)
+
+
+@dataclass(frozen=True)
+class SchemaIndex:
+    name: str
+    table: str
+    sql: str
+
+
+@dataclass
+class SchemaTable:
+    name: str
+    sql: str
+    columns: List[SchemaColumn]
+    indexes: List[SchemaIndex] = field(default_factory=list)
+
+    @property
+    def pk_cols(self) -> Tuple[str, ...]:
+        """PK columns in declared PK order (the ordinal, not column order) —
+        pk order defines the cross-node pk blob encoding."""
+        return tuple(
+            c.name for c in sorted((c for c in self.columns if c.pk), key=lambda c: c.pk)
+        )
+
+    def shape(self) -> Tuple:
+        """Comparable identity used for adopt-or-reject reconciliation
+        (schema.rs:343-357: pk mismatch — including PK column order — and
+        column mismatch both reject)."""
+        return tuple((c.name, c.type, c.notnull, c.default, c.pk) for c in self.columns)
+
+
+@dataclass
+class ParsedSchema:
+    tables: Dict[str, SchemaTable]
+
+
+def table_columns(conn: sqlite3.Connection, name: str) -> List[SchemaColumn]:
+    """Introspect a live table into the comparable column model."""
+    cols = []
+    for row in conn.execute(f'PRAGMA table_xinfo("{name}")'):
+        # hidden: 0 normal, 1 hidden, 2/3 generated (virtual/stored)
+        hidden = row[6] if len(row) > 6 else 0
+        if hidden == 1:
+            continue
+        cols.append(
+            SchemaColumn(
+                name=row[1],
+                type=(row[2] or "").upper(),
+                notnull=bool(row[3]),
+                default=row[4],
+                pk=row[5],
+                generated=hidden in (2, 3),
+            )
+        )
+    return cols
+
+
+def table_shape(conn: sqlite3.Connection, name: str) -> Tuple:
+    return tuple(
+        (c.name, c.type, c.notnull, c.default, c.pk)
+        for c in table_columns(conn, name)
+    )
+
+
+_WS = re.compile(r"\s+")
+
+
+def normalize_sql(sql: str) -> str:
+    return _WS.sub(" ", sql.strip().rstrip(";")).lower()
+
+
+_ALLOWED_STMT = re.compile(r"(?is)^\s*create\s+(table|(unique\s+)?index)\b")
+_FORBIDDEN_STMT = re.compile(r"(?is)^\s*create\s+(temp|temporary)\b")
+_AS_SELECT = re.compile(r"(?is)\bas\s+select\b")
+
+
+def split_statements(sql: str) -> List[str]:
+    """Split SQL into statements (semicolons outside string literals)."""
+    out, buf, in_str = [], [], None
+    for ch in sql:
+        if in_str:
+            buf.append(ch)
+            if ch == in_str:
+                in_str = None
+            continue
+        if ch in ("'", '"'):
+            in_str = ch
+            buf.append(ch)
+        elif ch == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+        else:
+            buf.append(ch)
+    stmt = "".join(buf).strip()
+    if stmt:
+        out.append(stmt)
+    return out
+
+
+def parse_schema(schema_sql: str) -> ParsedSchema:
+    """Execute the schema into a scratch DB, introspect, and constrain.
+
+    Only CREATE TABLE / CREATE INDEX statements are allowed in schema files
+    — anything else (views, triggers, seed data, temp tables, CREATE TABLE
+    AS SELECT) is rejected like the reference's `UnsupportedCmd` /
+    `TemporaryTable` errors (schema.rs:667-721)."""
+    for stmt in split_statements(schema_sql):
+        if _FORBIDDEN_STMT.match(stmt) or not _ALLOWED_STMT.match(stmt):
+            raise SchemaError(
+                f"unsupported statement in schema (only CREATE TABLE / "
+                f"CREATE INDEX are allowed): {stmt[:80]!r}"
+            )
+        if _AS_SELECT.search(stmt):
+            raise SchemaError(
+                f"CREATE TABLE ... AS SELECT is not allowed in schemas: "
+                f"{stmt[:80]!r}"
+            )
+    scratch = sqlite3.connect(":memory:")
+    try:
+        try:
+            scratch.executescript(schema_sql)
+        except sqlite3.Error as e:
+            raise SchemaError(f"invalid schema SQL: {e}") from e
+
+        tables: Dict[str, SchemaTable] = {}
+        for name, sql in scratch.execute(
+            "SELECT name, sql FROM sqlite_master WHERE type = 'table' "
+            "AND name NOT LIKE 'sqlite_%'"
+        ).fetchall():
+            tables[name] = SchemaTable(
+                name=name, sql=sql, columns=table_columns(scratch, name)
+            )
+        for idx_name, tbl_name, sql, uniq in scratch.execute(
+            "SELECT il.name, il.tbl_name, il.sql, ix.\"unique\" FROM sqlite_master il "
+            "JOIN pragma_index_list(il.tbl_name) ix ON ix.name = il.name "
+            "WHERE il.type = 'index' AND il.sql IS NOT NULL"
+        ).fetchall():
+            if uniq:
+                raise SchemaError(
+                    f"unique indexes are not supported for CRRs: {idx_name!r} "
+                    "(schema.rs:164)"
+                )
+            tables[tbl_name].indexes.append(
+                SchemaIndex(name=idx_name, table=tbl_name, sql=sql)
+            )
+
+        for tbl in tables.values():
+            _constrain(scratch, tbl)
+        return ParsedSchema(tables=tables)
+    finally:
+        scratch.close()
+
+
+def _constrain(scratch: sqlite3.Connection, tbl: SchemaTable) -> None:
+    """The reference's `constrain` pass (schema.rs:113-168)."""
+    if not tbl.pk_cols:
+        raise SchemaError(f"CRR table {tbl.name!r} must have a primary key")
+    if scratch.execute(f'PRAGMA foreign_key_list("{tbl.name}")').fetchall():
+        raise SchemaError(
+            f"foreign keys are not supported for CRRs: table {tbl.name!r} "
+            "(schema.rs:155)"
+        )
+    for col in tbl.columns:
+        if col.pk:
+            continue
+        if col.notnull and col.default is None and not col.generated:
+            raise SchemaError(
+                f"non-nullable column {tbl.name}.{col.name} needs a DEFAULT "
+                "(schema.rs:143)"
+            )
+    # UNIQUE table constraints surface as unique indexes without sql; catch them
+    for row in scratch.execute(f'PRAGMA index_list("{tbl.name}")'):
+        if row[2] and row[3] == "u":  # unique, origin 'u' = UNIQUE constraint
+            raise SchemaError(
+                f"UNIQUE constraints are not supported for CRRs: table "
+                f"{tbl.name!r} (schema.rs:164)"
+            )

@@ -1,0 +1,233 @@
+"""Live schema migration: apply_schema diffing semantics.
+
+Spec: corro-types/src/schema.rs:274-608 (apply_schema) and :113-168
+(constrain).  New tables/columns/indexes are applied live; anything
+destructive is rejected.
+"""
+
+import pytest
+
+from corrosion_tpu.agent.store import CrrStore
+from corrosion_tpu.core.schema import SchemaError, parse_schema
+from corrosion_tpu.core.types import ActorId
+
+V1 = """
+CREATE TABLE tests (
+    id INTEGER PRIMARY KEY NOT NULL,
+    text TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+def _store(tmp_path, schema=V1) -> CrrStore:
+    store = CrrStore(str(tmp_path / "db.sqlite"), ActorId.random())
+    store.execute_schema(schema)
+    return store
+
+
+def test_new_table_added_live(tmp_path):
+    store = _store(tmp_path)
+    out = store.apply_schema(V1 + "CREATE TABLE t2 (id INTEGER PRIMARY KEY NOT NULL, n INTEGER);")
+    assert out["new_tables"] == ["t2"]
+    assert "t2" in store._tables
+    _, info = store.transact([("INSERT INTO t2 (id, n) VALUES (1, 5)", ())])
+    assert info is not None  # triggers capture writes to the new table
+    store.close()
+
+
+def test_reapply_is_idempotent(tmp_path):
+    store = _store(tmp_path)
+    out = store.apply_schema(V1)
+    assert out["new_tables"] == [] and out["new_columns"] == {}
+    store.close()
+
+
+def test_add_column_with_default(tmp_path):
+    store = _store(tmp_path)
+    store.transact([("INSERT INTO tests (id, text) VALUES (1, 'a')", ())])
+    v2 = V1.replace(
+        "text TEXT NOT NULL DEFAULT ''",
+        "text TEXT NOT NULL DEFAULT '',\n    score INTEGER NOT NULL DEFAULT 0",
+    )
+    out = store.apply_schema(v2)
+    assert out["new_columns"] == {"tests": ["score"]}
+    # existing row got the default; new writes to the column are captured
+    assert store.query("SELECT score FROM tests WHERE id = 1")[0][0] == 0
+    _, info = store.transact([("UPDATE tests SET score = 9 WHERE id = 1", ())])
+    assert info is not None
+    row = store.conn.execute(
+        "SELECT val FROM tests__crdt_clock WHERE cid = 'score'"
+    ).fetchone()
+    assert row[0] == 9
+    store.close()
+
+
+def test_new_column_replicates(tmp_path):
+    (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir()
+    a = _store(tmp_path / "a")
+    b = _store(tmp_path / "b")
+    v2 = V1.replace(
+        "text TEXT NOT NULL DEFAULT ''",
+        "text TEXT NOT NULL DEFAULT '',\n    score INTEGER",
+    )
+    a.apply_schema(v2)
+    b.apply_schema(v2)
+    _, info = a.transact(
+        [("INSERT INTO tests (id, text, score) VALUES (1, 'x', 7)", ())]
+    )
+    changes = a.changes_for_version(a.site_id, info.db_version)
+    b.apply_changes(changes)
+    assert b.query("SELECT score FROM tests WHERE id = 1")[0][0] == 7
+    a.close(); b.close()
+
+
+def test_drop_table_rejected(tmp_path):
+    store = _store(tmp_path)
+    with pytest.raises(SchemaError, match="drop table"):
+        store.apply_schema("CREATE TABLE other (id INTEGER PRIMARY KEY);")
+    store.close()
+
+
+def test_drop_column_rejected(tmp_path):
+    store = _store(tmp_path)
+    with pytest.raises(SchemaError, match="remove column"):
+        store.apply_schema("CREATE TABLE tests (id INTEGER PRIMARY KEY NOT NULL);")
+    store.close()
+
+
+def test_change_column_rejected(tmp_path):
+    store = _store(tmp_path)
+    with pytest.raises(SchemaError, match="change column"):
+        store.apply_schema(V1.replace("TEXT NOT NULL DEFAULT ''", "BLOB"))
+    store.close()
+
+
+def test_add_notnull_without_default_rejected(tmp_path):
+    store = _store(tmp_path)
+    v2 = V1.replace(
+        "text TEXT NOT NULL DEFAULT ''",
+        "text TEXT NOT NULL DEFAULT '',\n    score INTEGER NOT NULL",
+    )
+    with pytest.raises(SchemaError, match="needs a DEFAULT|NOT NULL"):
+        store.apply_schema(v2)
+    store.close()
+
+
+def test_constrain_rejects_bad_shapes():
+    with pytest.raises(SchemaError, match="primary key"):
+        parse_schema("CREATE TABLE t (a INTEGER);")
+    with pytest.raises(SchemaError, match="unique"):
+        parse_schema(
+            "CREATE TABLE t (id INTEGER PRIMARY KEY, a INTEGER);"
+            "CREATE UNIQUE INDEX t_a ON t (a);"
+        )
+    with pytest.raises(SchemaError, match="foreign key"):
+        parse_schema(
+            "CREATE TABLE p (id INTEGER PRIMARY KEY);"
+            "CREATE TABLE t (id INTEGER PRIMARY KEY, p_id INTEGER REFERENCES p(id));"
+        )
+    with pytest.raises(SchemaError, match="needs a DEFAULT"):
+        parse_schema("CREATE TABLE t (id INTEGER PRIMARY KEY, a INTEGER NOT NULL);")
+
+
+def test_index_diffing(tmp_path):
+    store = _store(tmp_path, V1 + "CREATE INDEX tests_text ON tests (text);")
+    names = lambda: {
+        r[0]
+        for r in store.conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='index' "
+            "AND tbl_name='tests' AND sql IS NOT NULL"
+        )
+    }
+    assert "tests_text" in names()
+    # index removed from schema → dropped; new index → created
+    store.apply_schema(V1 + "CREATE INDEX tests_text2 ON tests (text, id);")
+    assert "tests_text" not in names()
+    assert "tests_text2" in names()
+    store.close()
+
+
+def test_failed_migration_leaves_no_ghost_tables(tmp_path):
+    # one valid new table + one destructive change in the same apply: the
+    # whole migration must roll back, including the in-memory registry
+    store = _store(tmp_path)
+    bad = (
+        "CREATE TABLE fresh (id INTEGER PRIMARY KEY NOT NULL);\n"
+        "CREATE TABLE tests (id INTEGER PRIMARY KEY NOT NULL, text BLOB);"
+    )
+    with pytest.raises(SchemaError):
+        store.apply_schema(bad)
+    assert "fresh" not in store._tables
+    # the store still works: sync reads iterate _tables and must not hit
+    # rolled-back clock tables
+    _, info = store.transact([("INSERT INTO tests (id, text) VALUES (1, 'a')", ())])
+    assert store.changes_for_version(store.site_id, info.db_version)
+    store.close()
+
+
+def test_unsupported_statements_rejected():
+    for stmt in (
+        "CREATE VIEW v AS SELECT 1",
+        "INSERT INTO t VALUES (1)",
+        "CREATE TEMP TABLE t (id INTEGER PRIMARY KEY)",
+        "CREATE TABLE t AS SELECT 1 AS id",
+        "CREATE TRIGGER trg AFTER INSERT ON t BEGIN SELECT 1; END",
+    ):
+        with pytest.raises(SchemaError, match="unsupported|not allowed"):
+            parse_schema(
+                "CREATE TABLE t0 (id INTEGER PRIMARY KEY NOT NULL);" + stmt
+            )
+
+
+def test_composite_pk_order_is_identity(tmp_path):
+    # PK column *order* defines the pk blob encoding; a reordered PK is a
+    # different table and must not be adopted
+    store = CrrStore(str(tmp_path / "db.sqlite"), ActorId.random())
+    store.conn.execute("CREATE TABLE t (a INTEGER, b INTEGER, PRIMARY KEY (b, a))")
+    with pytest.raises(SchemaError, match="does not match"):
+        store.apply_schema("CREATE TABLE t (a INTEGER, b INTEGER, PRIMARY KEY (a, b))")
+    store.close()
+
+
+def test_multi_file_schema_startup(tmp_path):
+    # schema dirs with several files form ONE schema (run_root.rs:101-106)
+    import asyncio
+
+    from corrosion_tpu.agent.agent import Agent
+    from corrosion_tpu.agent.config import Config
+    from corrosion_tpu.agent.transport import MemoryNetwork
+
+    d = tmp_path / "schemas"
+    d.mkdir()
+    (d / "a.sql").write_text("CREATE TABLE aa (id INTEGER PRIMARY KEY NOT NULL);")
+    (d / "b.sql").write_text("CREATE TABLE bb (id INTEGER PRIMARY KEY NOT NULL);")
+
+    async def body():
+        net = MemoryNetwork()
+        ag = Agent(
+            Config(
+                db_path=str(tmp_path / "n.db"), gossip_addr="n0",
+                schema_paths=[str(d)], use_swim=False,
+            ),
+            net.transport("n0"),
+        )
+        await ag.start()
+        assert {"aa", "bb"} <= set(ag.store._tables)
+        await ag.stop()
+
+    asyncio.run(body())
+
+
+def test_adopt_existing_identical_table(tmp_path):
+    store = CrrStore(str(tmp_path / "db.sqlite"), ActorId.random())
+    store.conn.execute(
+        "CREATE TABLE tests (id INTEGER PRIMARY KEY NOT NULL, "
+        "text TEXT NOT NULL DEFAULT '')"
+    )
+    out = store.apply_schema(V1)
+    assert out["new_tables"] == ["tests"]
+    with pytest.raises(SchemaError, match="does not match"):
+        store2 = CrrStore(str(tmp_path / "db2.sqlite"), ActorId.random())
+        store2.conn.execute("CREATE TABLE tests (id INTEGER PRIMARY KEY, other BLOB)")
+        store2.apply_schema(V1)
+    store.close()
