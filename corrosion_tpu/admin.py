@@ -132,6 +132,8 @@ class AdminServer:
                     "tables": sorted(m.tables),
                 }
             }
+        if cmd == "reload":
+            return {"ok": self._reload(req)}
         if cmd == "log" and sub == "set":
             level = getattr(logging, req["filter"].upper(), None)
             if level is None:
@@ -142,6 +144,23 @@ class AdminServer:
             logging.getLogger("corrosion_tpu").setLevel(logging.NOTSET)
             return {"ok": "reset"}
         return {"error": f"unknown command: {req}"}
+
+    def _reload(self, req: dict) -> dict:
+        """`corrosion reload` (main.rs:455-457): hot-swap the reloadable
+        parts of the config — schema files are re-read and live-migrated
+        (the reference's ArcSwap<Config> + execute_schema path)."""
+        agent = self.agent
+        schema_paths = req.get("schema_paths", agent.config.schema_paths)
+        from .utils.files import read_sql_files
+
+        sql = ";\n".join(
+            s for path in schema_paths for s in read_sql_files(path)
+        )
+        out = agent.store.apply_schema(sql) if sql.strip() else {
+            "new_tables": [], "new_columns": {}
+        }
+        agent.config.schema_paths = list(schema_paths)
+        return out
 
     def _sync_dump(self) -> dict:
         s = self.agent.sync_state()

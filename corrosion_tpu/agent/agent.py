@@ -105,7 +105,7 @@ class Agent:
             # file into a single Schema before apply, run_root.rs:101-106) —
             # applying files separately would read each as a full schema
             # and reject the tables the other files own as drops
-            sql = "\n".join(
+            sql = ";\n".join(
                 s
                 for path in self.config.schema_paths
                 for s in read_sql_files(path)

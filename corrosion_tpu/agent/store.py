@@ -291,11 +291,17 @@ class CrrStore:
                     f"new column {tbl.name}.{col.name} is NOT NULL and has "
                     "no default"
                 )
+            # raw source DDL keeps GENERATED/COLLATE/CHECK clauses that
+            # PRAGMA introspection can't reconstruct
             self.conn.execute(
-                f'ALTER TABLE "{tbl.name}" ADD COLUMN {col.ddl()}'
+                f'ALTER TABLE "{tbl.name}" ADD COLUMN '
+                f"{tbl.column_ddl(col.name) or col.ddl()}"
             )
-        if added:
-            non_pk = info.non_pk_cols + tuple(c.name for c in added)
+        # generated columns are derived, never clocked/replicated (matching
+        # create_crr, whose table_info introspection omits them)
+        replicated_added = [c for c in added if not c.generated]
+        if replicated_added:
+            non_pk = info.non_pk_cols + tuple(c.name for c in replicated_added)
             info = TableInfo(tbl.name, info.pk_cols, non_pk)
             self.conn.execute(
                 "UPDATE __crdt_tables SET cols = ? WHERE name = ?",
@@ -303,6 +309,7 @@ class CrrStore:
             )
             self._tables[tbl.name] = info
             self._create_triggers(info)
+        if added:
             out["new_columns"][tbl.name] = [c.name for c in added]  # type: ignore[index]
 
         # index diff: schema-managed indexes only (never our __crdt/_dbv ones)

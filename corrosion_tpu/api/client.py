@@ -12,6 +12,8 @@ import asyncio
 import json
 from typing import AsyncIterator, List, Optional, Sequence
 
+from .wire import decode_value, encode_tree
+
 
 class ApiClient:
     def __init__(self, addr: str, authz_token: Optional[str] = None):
@@ -61,7 +63,7 @@ class ApiClient:
 
     async def execute(self, statements: Sequence) -> dict:
         status, headers, reader, writer = await self._request(
-            "POST", "/v1/transactions", json.dumps(list(statements)).encode()
+            "POST", "/v1/transactions", json.dumps(encode_tree(list(statements))).encode()
         )
         try:
             body = await self._read_body(headers, reader)
@@ -77,7 +79,7 @@ class ApiClient:
         rows = []
         async for event in self.query_stream(statement):
             if "row" in event:
-                rows.append(event["row"][1])
+                rows.append([decode_value(v) for v in event["row"][1]])
             elif "error" in event:
                 raise RuntimeError(event["error"])
         return rows
@@ -86,7 +88,7 @@ class ApiClient:
         """Incremental NDJSON consumption: events yield as chunks arrive,
         never buffering the whole result set."""
         status, headers, reader, writer = await self._request(
-            "POST", "/v1/queries", json.dumps(statement).encode()
+            "POST", "/v1/queries", json.dumps(encode_tree(statement)).encode()
         )
         try:
             if status != 200:

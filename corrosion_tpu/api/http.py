@@ -18,6 +18,7 @@ import json
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 from ..agent.agent import Agent
+from .wire import decode_value, encode_value
 
 MAX_BODY = 64 * 1024 * 1024
 
@@ -306,28 +307,25 @@ class ApiServer:
         return out
 
 
+def _decode_param(v):
+    return decode_value(v)
+
+
 def _parse_statement(s) -> Tuple[str, tuple]:
     if isinstance(s, str):
         return s, ()
     if isinstance(s, list):
         if len(s) == 1:
             return s[0], ()
-        return s[0], tuple(s[1]) if isinstance(s[1], list) else tuple(s[1:])
+        params = s[1] if isinstance(s[1], list) else list(s[1:])
+        return s[0], tuple(_decode_param(p) for p in params)
     if isinstance(s, dict):
-        return s["query"], tuple(s.get("params", ()))
+        return s["query"], tuple(_decode_param(p) for p in s.get("params", ()))
     raise HttpError(400, f"bad statement: {s!r}")
 
 
 def _json_row(row):
-    out = []
-    for v in row:
-        if isinstance(v, bytes):
-            import base64
-
-            out.append({"$b": base64.b64encode(v).decode("ascii")})
-        else:
-            out.append(v)
-    return out
+    return [encode_value(v) for v in row]
 
 
 async def _respond_json(writer, status: int, payload) -> None:

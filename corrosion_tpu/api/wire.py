@@ -1,0 +1,37 @@
+"""JSON wire representation of SQLite values.
+
+The one place that knows how blobs ride over the HTTP API: bytes are
+encoded as {"$b": base64} (the analog of the reference SqliteValue::Blob
+serde representation, corro-api-types/src/lib.rs:422).  Used by both the
+server (params in, rows out) and the client (params out, rows in).
+"""
+
+from __future__ import annotations
+
+import base64
+
+
+def encode_value(v):
+    """SqliteValue → JSON-safe value (bytes → {"$b": base64})."""
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {"$b": base64.b64encode(bytes(v)).decode("ascii")}
+    return v
+
+
+def decode_value(v):
+    """JSON value → SqliteValue ({"$b": base64} → bytes)."""
+    if isinstance(v, dict) and set(v) == {"$b"}:
+        return base64.b64decode(v["$b"])
+    return v
+
+
+def encode_tree(v):
+    """encode_value applied through nested lists/tuples/dicts (statement
+    payloads)."""
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return encode_value(v)
+    if isinstance(v, (list, tuple)):
+        return [encode_tree(x) for x in v]
+    if isinstance(v, dict):
+        return {k: encode_tree(x) for k, x in v.items()}
+    return v

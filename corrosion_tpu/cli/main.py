@@ -1,0 +1,485 @@
+"""The `corrosion-tpu` command-line interface.
+
+Rebuild of the reference's `corrosion` binary command surface
+(`crates/corrosion/src/main.rs:152-560,649`): agent, backup, restore,
+cluster {rejoin,members,membership-states,set-id}, query, exec, reload,
+sync {generate,reconcile-gaps}, locks, tls {ca,server,client} generate,
+actor version, db lock, subs {info,list}, log {set,reset} — plus the
+rebuild-specific `sim` command that runs the TPU epidemic-simulator
+benchmark configs (template and consul land with their subsystems).
+
+Run as `python -m corrosion_tpu.cli.main <command> ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import List, Optional
+
+from ..agent.config import Config
+
+
+def _load_config(args) -> Config:
+    import os
+
+    if args.config and os.path.exists(args.config):
+        cfg = Config.load(args.config)
+    else:
+        cfg = Config()
+    if getattr(args, "api_addr", None):
+        cfg.api_addr = args.api_addr
+    if getattr(args, "db_path", None):
+        cfg.db_path = args.db_path
+    if getattr(args, "admin_path", None):
+        cfg.admin_path = args.admin_path
+    return cfg
+
+
+def _admin(cfg: Config, req: dict) -> dict:
+    from ..admin import AdminClient
+
+    if not cfg.admin_path:
+        raise SystemExit("no admin socket configured (set [admin] path)")
+    resp = AdminClient(cfg.admin_path).send_sync(req)
+    if "error" in resp:
+        raise SystemExit(f"admin error: {resp['error']}")
+    return resp["ok"]
+
+
+def _api(cfg: Config):
+    from ..api.client import ApiClient
+
+    if not cfg.api_addr:
+        raise SystemExit("no API address configured (set [api] addr)")
+    return ApiClient(cfg.api_addr)
+
+
+def _print_json(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+# -- commands ------------------------------------------------------------
+
+
+def cmd_agent(args) -> int:
+    """Run the full agent: UDP/TCP gossip transport, HTTP API, admin socket
+    (command/agent.rs:19)."""
+    cfg = _load_config(args)
+    if not cfg.gossip_addr:
+        raise SystemExit("gossip addr required to run an agent")
+
+    async def run():
+        import signal
+
+        from ..agent.agent import Agent
+        from ..agent.transport import UdpTcpTransport
+        from ..api.http import ApiServer
+
+        ghost, _, gport = cfg.gossip_addr.rpartition(":")
+        transport = UdpTcpTransport(ghost or "127.0.0.1", int(gport or 0))
+        bound = await transport.start()
+        cfg.gossip_addr = bound  # port-0 binds resolve here
+        agent = Agent(cfg, transport)
+        await agent.start()
+        api = None
+        if cfg.api_addr:
+            host, _, port = cfg.api_addr.rpartition(":")
+            api = ApiServer(agent, host or "127.0.0.1", int(port))
+            cfg.api_addr = await api.start()  # port-0 binds resolve here
+        admin = None
+        if cfg.admin_path:
+            from ..admin import AdminServer
+
+            admin = AdminServer(agent, cfg.admin_path)
+            await admin.start()
+        print(
+            f"agent running: actor {agent.actor_id.hex()} "
+            f"gossip {cfg.gossip_addr} api {cfg.api_addr or '-'}",
+            flush=True,
+        )
+        # tripwire analog: first SIGINT/SIGTERM begins graceful shutdown
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        if admin:
+            await admin.stop()
+        if api:
+            await api.stop()
+        await agent.stop()
+        await transport.close()
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_backup(args) -> int:
+    from ..agent.backup import backup_db
+
+    cfg = _load_config(args)
+    backup_db(cfg.db_path, args.path)
+    print(f"backed up {cfg.db_path} -> {args.path}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    from ..agent.backup import restore_db
+    from ..core.types import ActorId
+
+    cfg = _load_config(args)
+    site = ActorId.from_hex(args.site_id) if args.site_id else None
+    actor = restore_db(args.path, cfg.db_path, site_id=site)
+    print(f"restored {args.path} -> {cfg.db_path} as actor {actor.hex()}")
+    return 0
+
+
+def cmd_db_lock(args) -> int:
+    """Hold exclusive locks on the DB files until interrupted
+    (main.rs:478-497)."""
+    from ..agent.backup import db_lock
+
+    cfg = _load_config(args)
+    with db_lock(cfg.db_path):
+        print(f"locked {cfg.db_path} (Ctrl-C to release)", flush=True)
+        if args.once:  # test hook: acquire, report, release
+            return 0
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def cmd_query(args) -> int:
+    """`corrosion query` (main.rs:459-470): rows tab-separated, optional
+    column header and timing."""
+    cfg = _load_config(args)
+
+    async def run():
+        client = _api(cfg)
+        stmt = [args.sql, args.param or []]
+        t0 = time.monotonic()
+        events = client.query_stream(stmt)
+        async for ev in events:
+            if "columns" in ev and args.columns:
+                print("\t".join(ev["columns"]))
+            elif "row" in ev:
+                _, vals = ev["row"]
+                print("\t".join("" if v is None else str(v) for v in vals))
+            elif "error" in ev:
+                raise SystemExit(f"query error: {ev['error']}")
+        if args.timer:
+            print(f"time: {time.monotonic() - t0:.6f}s", file=sys.stderr)
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_exec(args) -> int:
+    cfg = _load_config(args)
+
+    async def run():
+        client = _api(cfg)
+        resp = await client.execute([[args.sql, args.param or []]])
+        if args.timer:
+            print(f"time: {resp.get('time', 0):.6f}s", file=sys.stderr)
+        _print_json(resp)
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_reload(args) -> int:
+    cfg = _load_config(args)
+    out = _admin(cfg, {"cmd": "reload", "schema_paths": cfg.schema_paths})
+    _print_json(out)
+    return 0
+
+
+def cmd_sync(args) -> int:
+    cfg = _load_config(args)
+    sub = "generate" if args.sync_cmd == "generate" else "reconcile_gaps"
+    _print_json(_admin(cfg, {"cmd": "sync", "sub": sub}))
+    return 0
+
+
+def cmd_locks(args) -> int:
+    cfg = _load_config(args)
+    _print_json(_admin(cfg, {"cmd": "locks", "top": args.top}))
+    return 0
+
+
+def cmd_cluster(args) -> int:
+    cfg = _load_config(args)
+    sub = args.cluster_cmd.replace("-", "_")
+    req = {"cmd": "cluster", "sub": sub}
+    if sub == "set_id":
+        req["id"] = args.id
+    _print_json(_admin(cfg, req))
+    return 0
+
+
+def cmd_actor(args) -> int:
+    cfg = _load_config(args)
+    _print_json(
+        _admin(
+            cfg,
+            {
+                "cmd": "actor", "sub": "version",
+                "actor_id": args.actor_id, "version": args.version,
+            },
+        )
+    )
+    return 0
+
+
+def cmd_subs(args) -> int:
+    cfg = _load_config(args)
+    req = {"cmd": "subs", "sub": args.subs_cmd}
+    if args.subs_cmd == "info":
+        req["id"] = args.id
+    _print_json(_admin(cfg, req))
+    return 0
+
+
+def cmd_log(args) -> int:
+    cfg = _load_config(args)
+    req = {"cmd": "log", "sub": args.log_cmd}
+    if args.log_cmd == "set":
+        req["filter"] = args.filter
+    _print_json(_admin(cfg, req))
+    return 0
+
+
+def cmd_tls(args) -> int:
+    from ..utils import tls
+
+    if args.tls_kind == "ca":
+        cert, key = tls.generate_ca(args.output)
+    elif args.tls_kind == "server":
+        cert, key = tls.generate_server_cert(
+            args.ca_cert, args.ca_key, args.ip, args.output
+        )
+    else:
+        cert, key = tls.generate_client_cert(args.ca_cert, args.ca_key, args.output)
+    print(f"wrote {cert}\nwrote {key}")
+    return 0
+
+
+def cmd_template(args) -> int:
+    """`corrosion template` (command/tpl.rs): render templates against the
+    agent's API, optionally re-rendering as the data changes."""
+    cfg = _load_config(args)
+    from ..tpl.engine import render_to_file, watch_and_render
+
+    if not args.once:
+        asyncio.run(
+            watch_and_render(
+                _api(cfg), args.template, args.output or _strip_tpl(args.template)
+            )
+        )
+        return 0
+    asyncio.run(
+        render_to_file(
+            _api(cfg), args.template, args.output or _strip_tpl(args.template)
+        )
+    )
+    return 0
+
+
+def _strip_tpl(path: str) -> str:
+    return path[: -len(".tpl")] if path.endswith(".tpl") else path + ".out"
+
+
+def cmd_consul(args) -> int:
+    """`corrosion consul sync` (command/consul/sync.rs)."""
+    cfg = _load_config(args)
+    from ..consul.sync import run_sync
+
+    asyncio.run(
+        run_sync(
+            _api(cfg),
+            consul_addr=args.consul_addr,
+            node=args.node,
+            once=args.once,
+        )
+    )
+    return 0
+
+
+def cmd_sim(args) -> int:
+    """Run a TPU-simulator benchmark config (rebuild-specific; these are
+    the BASELINE.md scenario tiers)."""
+    from ..sim import runner
+
+    fns = {
+        "ground-truth-3node": runner.config_ground_truth_3node,
+        "swim-churn-64": runner.config_swim_churn_64,
+        "broadcast-1k": runner.config_broadcast_1k,
+        "partition-heal-10k": runner.config_partition_heal_10k,
+        "write-storm-100k": runner.config_write_storm_100k,
+    }
+    fn = fns[args.scenario]
+    kwargs = {"seed": args.seed}
+    if args.scenario == "write-storm-100k" and args.nodes:
+        kwargs["n_nodes"] = args.nodes
+    print(json.dumps(fn(**kwargs), default=float))
+    return 0
+
+
+# -- parser ---------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="corrosion-tpu",
+        description="TPU-native gossip-replicated state (corrosion rebuild)",
+    )
+    p.add_argument("-c", "--config", default="corrosion.toml", help="config file")
+    p.add_argument("--api-addr", help="override [api] addr")
+    p.add_argument("--db-path", help="override [db] path")
+    p.add_argument("--admin-path", help="override [admin] path")
+    sp = p.add_subparsers(dest="command", required=True)
+
+    sp.add_parser("agent", help="run the agent").set_defaults(fn=cmd_agent)
+
+    b = sp.add_parser("backup", help="snapshot the DB, stripped of node state")
+    b.add_argument("path")
+    b.set_defaults(fn=cmd_backup)
+
+    r = sp.add_parser("restore", help="restore a backup over the live DB")
+    r.add_argument("path")
+    r.add_argument("--site-id", help="pin the restored actor id (hex)")
+    r.set_defaults(fn=cmd_restore)
+
+    db = sp.add_parser("db", help="database utilities")
+    dbs = db.add_subparsers(dest="db_cmd", required=True)
+    lk = dbs.add_parser("lock", help="hold exclusive locks on the DB files")
+    lk.add_argument("--once", action="store_true", help=argparse.SUPPRESS)
+    lk.set_defaults(fn=cmd_db_lock)
+
+    q = sp.add_parser("query", help="run a SQL query via the HTTP API")
+    q.add_argument("sql")
+    q.add_argument("--columns", action="store_true", help="print column header")
+    q.add_argument("--timer", action="store_true", help="print elapsed time")
+    q.add_argument("--param", action="append", help="bind a parameter")
+    q.set_defaults(fn=cmd_query)
+
+    e = sp.add_parser("exec", help="execute a write statement via the HTTP API")
+    e.add_argument("sql")
+    e.add_argument("--param", action="append")
+    e.add_argument("--timer", action="store_true")
+    e.set_defaults(fn=cmd_exec)
+
+    sp.add_parser(
+        "reload", help="hot-reload schema files on a running agent"
+    ).set_defaults(fn=cmd_reload)
+
+    sy = sp.add_parser("sync", help="sync bookkeeping introspection")
+    sys_ = sy.add_subparsers(dest="sync_cmd", required=True)
+    sys_.add_parser("generate", help="dump this node's sync state").set_defaults(
+        fn=cmd_sync
+    )
+    sys_.add_parser(
+        "reconcile-gaps", help="clear gaps whose data is actually present"
+    ).set_defaults(fn=cmd_sync)
+
+    lo = sp.add_parser("locks", help="dump the lock registry")
+    lo.add_argument("--top", type=int, default=10)
+    lo.set_defaults(fn=cmd_locks)
+
+    cl = sp.add_parser("cluster", help="cluster membership commands")
+    cls_ = cl.add_subparsers(dest="cluster_cmd", required=True)
+    for name, help_ in (
+        ("rejoin", "rejoin the cluster with a renewed identity"),
+        ("members", "list known members"),
+        ("membership-states", "dump SWIM state for every member"),
+    ):
+        cls_.add_parser(name, help=help_).set_defaults(fn=cmd_cluster)
+    si = cls_.add_parser("set-id", help="set the cluster id")
+    si.add_argument("id", type=int)
+    si.set_defaults(fn=cmd_cluster)
+
+    ac = sp.add_parser("actor", help="actor introspection")
+    acs = ac.add_subparsers(dest="actor_cmd", required=True)
+    av = acs.add_parser("version", help="classify a (actor, version)")
+    av.add_argument("actor_id")
+    av.add_argument("version", type=int)
+    av.set_defaults(fn=cmd_actor)
+
+    su = sp.add_parser("subs", help="subscription introspection")
+    sus = su.add_subparsers(dest="subs_cmd", required=True)
+    sus.add_parser("list", help="list subscriptions").set_defaults(fn=cmd_subs)
+    sin = sus.add_parser("info", help="detail one subscription")
+    sin.add_argument("--id", required=True)
+    sin.set_defaults(fn=cmd_subs)
+
+    lg = sp.add_parser("log", help="dynamic log filtering")
+    lgs = lg.add_subparsers(dest="log_cmd", required=True)
+    ls_ = lgs.add_parser("set", help="set the log level")
+    ls_.add_argument("filter")
+    ls_.set_defaults(fn=cmd_log)
+    lgs.add_parser("reset", help="reset the log level").set_defaults(fn=cmd_log)
+
+    tl = sp.add_parser("tls", help="generate TLS certificates")
+    tls_ = tl.add_subparsers(dest="tls_kind", required=True)
+    ca = tls_.add_parser("ca", help="generate a self-signed CA")
+    ca.add_argument("generate", choices=["generate"])
+    ca.add_argument("-o", "--output", default=".")
+    ca.set_defaults(fn=cmd_tls)
+    srv = tls_.add_parser("server", help="generate a server certificate")
+    srv.add_argument("generate", choices=["generate"])
+    srv.add_argument("ip")
+    srv.add_argument("--ca-cert", required=True)
+    srv.add_argument("--ca-key", required=True)
+    srv.add_argument("-o", "--output", default=".")
+    srv.set_defaults(fn=cmd_tls)
+    cli = tls_.add_parser("client", help="generate a client certificate")
+    cli.add_argument("generate", choices=["generate"])
+    cli.add_argument("--ca-cert", required=True)
+    cli.add_argument("--ca-key", required=True)
+    cli.add_argument("-o", "--output", default=".")
+    cli.set_defaults(fn=cmd_tls)
+
+    tp = sp.add_parser("template", help="render a template against the API")
+    tp.add_argument("template")
+    tp.add_argument("-o", "--output")
+    tp.add_argument("--once", action="store_true", help="render once and exit")
+    tp.set_defaults(fn=cmd_template)
+
+    co = sp.add_parser("consul", help="consul integration")
+    cos = co.add_subparsers(dest="consul_cmd", required=True)
+    cs = cos.add_parser("sync", help="replicate consul services/checks")
+    cs.add_argument("--consul-addr", default="127.0.0.1:8500")
+    cs.add_argument("--node", default=None, help="node name override")
+    cs.add_argument("--once", action="store_true", help="one sync pass then exit")
+    cs.set_defaults(fn=cmd_consul)
+
+    sm = sp.add_parser("sim", help="run a TPU-simulator benchmark config")
+    sm.add_argument(
+        "scenario",
+        choices=[
+            "ground-truth-3node", "swim-churn-64", "broadcast-1k",
+            "partition-heal-10k", "write-storm-100k",
+        ],
+    )
+    sm.add_argument("--seed", type=int, default=0)
+    sm.add_argument("--nodes", type=int, default=None)
+    sm.set_defaults(fn=cmd_sim)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Consul state replication (consul-client + corrosion consul sync rebuild)."""
+
+from .client import AgentCheck, AgentService, ConsulClient
+from .sync import hash_check, hash_service, run_sync, sync_pass
+
+__all__ = [
+    "AgentCheck",
+    "AgentService",
+    "ConsulClient",
+    "hash_check",
+    "hash_service",
+    "run_sync",
+    "sync_pass",
+]

@@ -1,0 +1,111 @@
+"""Minimal Consul agent HTTP client.
+
+Rebuild of the reference's `consul-client` crate (`crates/consul-client/src/
+lib.rs:23,99-103`): just the two agent endpoints the sync service consumes —
+`GET /v1/agent/services` and `GET /v1/agent/checks` — over plain asyncio
+sockets (the reference uses hyper; TLS optional and out of scope here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class AgentService:
+    """consul-client's AgentService (lib.rs:120+)."""
+
+    id: str
+    name: str = ""
+    tags: tuple = ()
+    meta: tuple = ()  # sorted (k, v) pairs for hashability
+    port: int = 0
+    address: str = ""
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "AgentService":
+        return cls(
+            id=obj.get("ID", ""),
+            name=obj.get("Service", obj.get("Name", "")),
+            tags=tuple(obj.get("Tags") or ()),
+            meta=tuple(sorted((obj.get("Meta") or {}).items())),
+            port=obj.get("Port", 0) or 0,
+            address=obj.get("Address", "") or "",
+        )
+
+    def tags_json(self) -> str:
+        return json.dumps(list(self.tags))
+
+    def meta_json(self) -> str:
+        return json.dumps(dict(self.meta))
+
+
+@dataclass(frozen=True)
+class AgentCheck:
+    """consul-client's AgentCheck."""
+
+    id: str
+    name: str = ""
+    status: str = ""
+    output: str = ""
+    service_id: str = ""
+    service_name: str = ""
+    notes: Optional[str] = None
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "AgentCheck":
+        return cls(
+            id=obj.get("CheckID", obj.get("ID", "")),
+            name=obj.get("Name", ""),
+            status=obj.get("Status", ""),
+            output=obj.get("Output", "") or "",
+            service_id=obj.get("ServiceID", "") or "",
+            service_name=obj.get("ServiceName", "") or "",
+            notes=obj.get("Notes") or None,
+        )
+
+
+class ConsulClient:
+    def __init__(self, addr: str = "127.0.0.1:8500"):
+        self.addr = addr
+
+    async def _get_json(self, path: str):
+        host, _, port = self.addr.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nHost: {self.addr}\r\n"
+                "Connection: close\r\n\r\n".encode()
+            )
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            length = None
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                if k.strip().lower() == "content-length":
+                    length = int(v.strip())
+            body = (
+                await reader.readexactly(length)
+                if length is not None
+                else await reader.read()
+            )
+            if status != 200:
+                raise RuntimeError(f"consul {path} -> {status}")
+            return json.loads(body)
+        finally:
+            writer.close()
+
+    async def agent_services(self) -> Dict[str, AgentService]:
+        raw = await self._get_json("/v1/agent/services")
+        return {k: AgentService.from_json(v) for k, v in raw.items()}
+
+    async def agent_checks(self) -> Dict[str, AgentCheck]:
+        raw = await self._get_json("/v1/agent/checks")
+        return {k: AgentCheck.from_json(v) for k, v in raw.items()}

@@ -1,0 +1,267 @@
+"""Consul → corrosion sync loop.
+
+Rebuild of `corrosion consul sync` (`crates/corrosion/src/command/consul/
+sync.rs:22-700`): every second, pull the local Consul agent's services and
+checks, hash each, and write only the diffs through `/v1/transactions` so
+they replicate cluster-wide.  Hash state lives in the (non-replicated)
+`__corro_consul_services`/`__corro_consul_checks` tables, written in the
+same API transaction as the replicated rows (sync.rs:288-299) so a crash
+can't desync them; the replicated `consul_services`/`consul_checks` CRR
+tables must come from the user's schema files and are verified at startup
+(sync.rs:149-215).
+
+Check hashes include (service_id, service_name) and, by default, status —
+a check's Notes field may carry `{"hash_include": ["status", "output"]}`
+to opt into output-sensitive hashing (sync.rs:360-386).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import time
+from typing import Dict, Iterable, List, Tuple
+
+from .client import AgentCheck, AgentService, ConsulClient
+
+log = logging.getLogger(__name__)
+
+PULL_INTERVAL_S = 1.0  # sync.rs:21 CONSUL_PULL_INTERVAL
+
+_SETUP_SQL = """
+CREATE TABLE IF NOT EXISTS __corro_consul_services (
+    id TEXT NOT NULL PRIMARY KEY, hash BLOB NOT NULL);
+CREATE TABLE IF NOT EXISTS __corro_consul_checks (
+    id TEXT NOT NULL PRIMARY KEY, hash BLOB NOT NULL);
+"""
+
+_EXPECTED_SERVICE_COLS = {
+    "node", "id", "name", "tags", "meta", "port", "address", "updated_at",
+}
+_EXPECTED_CHECK_COLS = {
+    "node", "id", "service_id", "service_name", "name", "status", "output",
+    "updated_at",
+}
+
+
+def _hash64(*parts: bytes) -> bytes:
+    """Stable 8-byte hash (the reference uses seahash; any stable 64-bit
+    digest works — it only ever compares equal/not-equal)."""
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p)
+        h.update(b"\x1f")
+    return h.digest()[:8]
+
+
+def hash_service(svc: AgentService) -> bytes:
+    return _hash64(
+        svc.id.encode(), svc.name.encode(), json.dumps(svc.tags).encode(),
+        json.dumps(svc.meta).encode(), str(svc.port).encode(),
+        svc.address.encode(),
+    )
+
+
+def hash_check(check: AgentCheck) -> bytes:
+    """sync.rs:360-386: service identity always hashed; Notes may select
+    which volatile fields participate."""
+    parts = [check.service_name.encode(), check.service_id.encode()]
+    include = ["status"]
+    if check.notes:
+        try:
+            directives = json.loads(check.notes)
+            include = directives.get("hash_include", include)
+        except (ValueError, AttributeError):
+            pass
+    if "status" in include:
+        parts.append(check.status.encode())
+    if "output" in include:
+        parts.append(check.output.encode())
+    return _hash64(*parts)
+
+
+async def setup(client, node: str) -> None:
+    """Create hash tables + verify the replicated schema exists
+    (sync.rs:128-215)."""
+    await client.execute(
+        [[s, []] for s in _SETUP_SQL.strip().split(";\n") if s.strip()]
+    )
+    for table, expected in (
+        ("consul_services", _EXPECTED_SERVICE_COLS),
+        ("consul_checks", _EXPECTED_CHECK_COLS),
+    ):
+        rows = await client.query(
+            f"SELECT name FROM pragma_table_info('{table}')"
+        )
+        have = {r[0] for r in rows}
+        missing = expected - have
+        if missing:
+            raise RuntimeError(
+                f"schema for {table} is missing columns {sorted(missing)}; "
+                "add the consul tables to your schema files"
+            )
+
+
+async def _load_hashes(client, table: str) -> Dict[str, bytes]:
+    rows = await client.query(f"SELECT id, hash FROM {table}")
+    return {r[0]: bytes(r[1]) if not isinstance(r[1], bytes) else r[1] for r in rows}
+
+
+def _service_statements(
+    node: str, svc: AgentService, h: bytes, updated_at: int
+) -> List:
+    """sync.rs:388-433."""
+    return [
+        [
+            "INSERT INTO __corro_consul_services (id, hash) VALUES (?, ?) "
+            "ON CONFLICT (id) DO UPDATE SET hash = excluded.hash",
+            [svc.id, h],
+        ],
+        [
+            "INSERT INTO consul_services "
+            "(node, id, name, tags, meta, port, address, updated_at) "
+            "VALUES (?,?,?,?,?,?,?,?) "
+            "ON CONFLICT(node, id) DO UPDATE SET "
+            "name = excluded.name, tags = excluded.tags, "
+            "meta = excluded.meta, port = excluded.port, "
+            "address = excluded.address, updated_at = excluded.updated_at "
+            "WHERE source IS NULL",
+            [node, svc.id, svc.name, svc.tags_json(), svc.meta_json(),
+             svc.port, svc.address, updated_at],
+        ],
+    ]
+
+
+def _check_statements(
+    node: str, check: AgentCheck, h: bytes, updated_at: int
+) -> List:
+    """sync.rs:435-483."""
+    return [
+        [
+            "INSERT INTO __corro_consul_checks (id, hash) VALUES (?, ?) "
+            "ON CONFLICT (id) DO UPDATE SET hash = excluded.hash",
+            [check.id, h],
+        ],
+        [
+            "INSERT INTO consul_checks "
+            "(node, id, service_id, service_name, name, status, output, updated_at) "
+            "VALUES (?,?,?,?,?,?,?,?) "
+            "ON CONFLICT(node, id) DO UPDATE SET "
+            "service_id = excluded.service_id, "
+            "service_name = excluded.service_name, name = excluded.name, "
+            "status = excluded.status, output = excluded.output, "
+            "updated_at = excluded.updated_at "
+            "WHERE source IS NULL",
+            [node, check.id, check.service_id, check.service_name,
+             check.name, check.status, check.output, updated_at],
+        ],
+    ]
+
+
+def _delete_statements(node: str, kind: str, gone: Iterable[str]) -> List:
+    """sync.rs:645-695: per-id deletes + a catch-all prune of rows whose
+    hash entry vanished."""
+    stmts = []
+    for id_ in gone:
+        stmts.append([f"DELETE FROM __corro_consul_{kind} WHERE id = ?", [id_]])
+        stmts.append(
+            [
+                f"DELETE FROM consul_{kind} WHERE node = ? AND id = ? "
+                "AND source IS NULL",
+                [node, id_],
+            ]
+        )
+    stmts.append(
+        [
+            f"DELETE FROM consul_{kind} WHERE node = ? AND source IS NULL "
+            f"AND id NOT IN (SELECT id FROM __corro_consul_{kind})",
+            [node],
+        ]
+    )
+    return stmts
+
+
+async def sync_pass(
+    client,
+    consul: ConsulClient,
+    node: str,
+    service_hashes: Dict[str, bytes],
+    check_hashes: Dict[str, bytes],
+) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """One pull + diff + transaction (sync.rs:562-700).  Mutates the hash
+    caches on success.  Returns per-kind {upserted, deleted} stats."""
+    services = await consul.agent_services()
+    checks = await consul.agent_checks()
+    now = int(time.time())
+
+    statements: List = []
+    svc_stats = {"upserted": 0, "deleted": 0}
+    chk_stats = {"upserted": 0, "deleted": 0}
+
+    new_svc_hashes = dict(service_hashes)
+    for id_, svc in services.items():
+        h = hash_service(svc)
+        if service_hashes.get(id_) != h:
+            statements.extend(_service_statements(node, svc, h, now))
+            svc_stats["upserted"] += 1
+        new_svc_hashes[id_] = h
+    gone_svcs = [i for i in service_hashes if i not in services]
+    if gone_svcs or svc_stats["upserted"]:
+        statements.extend(_delete_statements(node, "services", gone_svcs))
+    svc_stats["deleted"] = len(gone_svcs)
+    for i in gone_svcs:
+        del new_svc_hashes[i]
+
+    new_chk_hashes = dict(check_hashes)
+    for id_, check in checks.items():
+        h = hash_check(check)
+        if check_hashes.get(id_) != h:
+            statements.extend(_check_statements(node, check, h, now))
+            chk_stats["upserted"] += 1
+        new_chk_hashes[id_] = h
+    gone_chks = [i for i in check_hashes if i not in checks]
+    if gone_chks or chk_stats["upserted"]:
+        statements.extend(_delete_statements(node, "checks", gone_chks))
+    chk_stats["deleted"] = len(gone_chks)
+    for i in gone_chks:
+        del new_chk_hashes[i]
+
+    if statements:
+        await client.execute(statements)
+    service_hashes.clear()
+    service_hashes.update(new_svc_hashes)
+    check_hashes.clear()
+    check_hashes.update(new_chk_hashes)
+    return svc_stats, chk_stats
+
+
+async def run_sync(
+    client,
+    consul_addr: str = "127.0.0.1:8500",
+    node: str = None,
+    once: bool = False,
+    interval_s: float = PULL_INTERVAL_S,
+) -> None:
+    """The sync service entry point (sync.rs:24-126)."""
+    import socket
+
+    node = node or socket.gethostname()
+    consul = ConsulClient(consul_addr)
+    await setup(client, node)
+    service_hashes = await _load_hashes(client, "__corro_consul_services")
+    check_hashes = await _load_hashes(client, "__corro_consul_checks")
+
+    while True:
+        try:
+            svc_stats, chk_stats = await sync_pass(
+                client, consul, node, service_hashes, check_hashes
+            )
+            if any(svc_stats.values()) or any(chk_stats.values()):
+                log.info("consul sync: services=%s checks=%s", svc_stats, chk_stats)
+        except (OSError, RuntimeError) as e:
+            log.error("consul sync pass failed (continuing): %s", e)
+        if once:
+            return
+        await asyncio.sleep(interval_s)

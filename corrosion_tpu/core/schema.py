@@ -72,6 +72,19 @@ class SchemaTable:
         column mismatch both reject)."""
         return tuple((c.name, c.type, c.notnull, c.default, c.pk) for c in self.columns)
 
+    def column_ddl(self, name: str) -> Optional[str]:
+        """The raw column definition text from the CREATE TABLE source —
+        used for ALTER TABLE ADD COLUMN so clauses introspection can't
+        reconstruct (GENERATED ALWAYS AS, COLLATE, CHECK) survive."""
+        paren = _find_body_start(self.sql)
+        if paren is None:
+            return None
+        for item in _split_top_level(self.sql[paren + 1 : _match_paren(self.sql, paren)]):
+            first = _first_identifier(item)
+            if first is not None and first.lower() == name.lower():
+                return item.strip()
+        return None
+
 
 @dataclass
 class ParsedSchema:
@@ -106,6 +119,77 @@ def table_shape(conn: sqlite3.Connection, name: str) -> Tuple:
     )
 
 
+def _find_body_start(sql: str) -> Optional[int]:
+    """Index of the '(' opening the CREATE TABLE column list."""
+    in_str = None
+    for i, ch in enumerate(sql):
+        if in_str:
+            if ch == in_str:
+                in_str = None
+        elif ch in ("'", '"', "`"):
+            in_str = ch
+        elif ch == "(":
+            return i
+    return None
+
+
+def _match_paren(sql: str, start: int) -> int:
+    """Index of the ')' matching sql[start] == '(' (string-aware)."""
+    depth, in_str = 0, None
+    for i in range(start, len(sql)):
+        ch = sql[i]
+        if in_str:
+            if ch == in_str:
+                in_str = None
+        elif ch in ("'", '"', "`"):
+            in_str = ch
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(sql)
+
+
+def _split_top_level(body: str) -> List[str]:
+    """Split a column-list body on depth-0 commas (string-aware)."""
+    out, buf, depth, in_str = [], [], 0, None
+    for ch in body:
+        if in_str:
+            buf.append(ch)
+            if ch == in_str:
+                in_str = None
+            continue
+        if ch in ("'", '"', "`"):
+            in_str = ch
+            buf.append(ch)
+        elif ch == "(":
+            depth += 1
+            buf.append(ch)
+        elif ch == ")":
+            depth -= 1
+            buf.append(ch)
+        elif ch == "," and depth == 0:
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return out
+
+
+_IDENT = re.compile(r'\s*(?:"([^"]+)"|`([^`]+)`|\[([^\]]+)\]|([A-Za-z_][\w$]*))')
+
+
+def _first_identifier(item: str) -> Optional[str]:
+    m = _IDENT.match(item)
+    if not m:
+        return None
+    return next(g for g in m.groups() if g is not None)
+
+
 _WS = re.compile(r"\s+")
 
 
@@ -118,10 +202,38 @@ _FORBIDDEN_STMT = re.compile(r"(?is)^\s*create\s+(temp|temporary)\b")
 _AS_SELECT = re.compile(r"(?is)\bas\s+select\b")
 
 
+def strip_comments(sql: str) -> str:
+    """Remove -- line and /* */ block comments (outside string literals)."""
+    out, i, n, in_str = [], 0, len(sql), None
+    while i < n:
+        ch = sql[i]
+        if in_str:
+            out.append(ch)
+            if ch == in_str:
+                in_str = None
+            i += 1
+        elif ch in ("'", '"'):
+            in_str = ch
+            out.append(ch)
+            i += 1
+        elif ch == "-" and sql[i : i + 2] == "--":
+            j = sql.find("\n", i)
+            i = n if j == -1 else j  # keep the newline
+        elif ch == "/" and sql[i : i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            i = n if j == -1 else j + 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
 def split_statements(sql: str) -> List[str]:
-    """Split SQL into statements (semicolons outside string literals)."""
+    """Split SQL into statements (semicolons outside string literals);
+    comments are stripped first so they neither hide semicolons nor trip
+    the statement-kind allowlist."""
     out, buf, in_str = [], [], None
-    for ch in sql:
+    for ch in strip_comments(sql):
         if in_str:
             buf.append(ch)
             if ch == in_str:

@@ -1,0 +1,5 @@
+"""Template rendering against the agent API (corro-tpl rebuild)."""
+
+from .engine import TemplateEngine, render_to_file, watch_and_render
+
+__all__ = ["TemplateEngine", "render_to_file", "watch_and_render"]

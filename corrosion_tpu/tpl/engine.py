@@ -1,0 +1,177 @@
+"""Template engine: SQL-driven config-file rendering with live re-render.
+
+Rebuild of corro-tpl (`crates/corro-tpl/src/lib.rs:444+`): templates call
+`sql("SELECT ...")` to pull rows out of the cluster state, `sql_json(...)`
+for raw JSON, and `hostname()`; the watcher subscribes to every query a
+render used and re-renders the file whenever any of them changes (the
+reference's QueryHandle change hooks, lib.rs:338).
+
+The reference embeds Rhai; the rebuild embeds Jinja2 (the Python-native
+equivalent already in the image) with the same function surface:
+
+    {% for row in sql("SELECT name, port FROM services") %}
+    backend {{ row.name }} 127.0.0.1:{{ row.port }}
+    {% endfor %}
+    {{ sql_json("SELECT * FROM services") }}
+    host: {{ hostname() }}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+class Row:
+    """One result row: index, key, and attribute access (Rhai rows expose
+    column names as properties)."""
+
+    def __init__(self, columns: Sequence[str], values: Sequence):
+        self._columns = list(columns)
+        self._values = list(values)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._values[key]
+        return self._values[self._columns.index(key)]
+
+    def __getattr__(self, name):
+        try:
+            return self._values[self._columns.index(name)]
+        except ValueError:
+            raise AttributeError(name) from None
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def to_dict(self) -> Dict[str, object]:
+        return dict(zip(self._columns, self._values))
+
+    def __repr__(self):
+        return f"Row({self.to_dict()})"
+
+
+class TemplateEngine:
+    """Renders one template source against an ApiClient, recording every
+    SQL query the render executed (the watch set)."""
+
+    def __init__(self, client):
+        import jinja2
+
+        self.client = client
+        self.env = jinja2.Environment(
+            undefined=jinja2.StrictUndefined, enable_async=True
+        )
+        self.queries_used: List[str] = []
+
+    async def _sql(self, query: str) -> List[Row]:
+        self.queries_used.append(query)
+        columns: List[str] = []
+        rows: List[Row] = []
+        async for ev in self.client.query_stream(query):
+            if "columns" in ev:
+                columns = ev["columns"]
+            elif "row" in ev:
+                rows.append(Row(columns, ev["row"][1]))
+            elif "error" in ev:
+                raise RuntimeError(f"sql() failed: {ev['error']}")
+        return rows
+
+    async def _sql_json(self, query: str) -> str:
+        rows = await self._sql(query)
+        return json.dumps([r.to_dict() for r in rows])
+
+    async def render(self, source: str) -> str:
+        self.queries_used = []
+        template = self.env.from_string(source)
+        return await template.render_async(
+            sql=self._sql,
+            sql_json=self._sql_json,
+            hostname=socket.gethostname,
+            env=os.environ.get,
+        )
+
+
+def _write_atomic(path: str, content: str) -> None:
+    """tmp-file + rename so consumers never read a half-written config
+    (the reference writes through tempfile + persist)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tpl-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(content)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+async def render_to_file(client, template_path: str, output_path: str) -> List[str]:
+    """One-shot render. Returns the queries the template used."""
+    with open(template_path) as f:
+        source = f.read()
+    engine = TemplateEngine(client)
+    out = await engine.render(source)
+    _write_atomic(output_path, out)
+    return engine.queries_used
+
+
+async def watch_and_render(
+    client,
+    template_path: str,
+    output_path: str,
+    on_render: Optional[Callable[[int], None]] = None,
+    max_renders: Optional[int] = None,
+) -> int:
+    """Render, subscribe to every query used, and re-render on any change
+    (corro-tpl's watch loop).  `on_render(n)` fires after each write;
+    `max_renders` bounds the loop for tests.  Returns renders performed."""
+    renders = 0
+    with open(template_path) as f:
+        source = f.read()
+    engine = TemplateEngine(client)
+
+    while True:
+        out = await engine.render(source)
+        _write_atomic(output_path, out)
+        renders += 1
+        if on_render:
+            on_render(renders)
+        if max_renders is not None and renders >= max_renders:
+            return renders
+        if not engine.queries_used:
+            return renders  # nothing to watch: static template
+
+        # wait until ANY watched query changes, then loop to re-render
+        changed = asyncio.Event()
+
+        async def _watch_one(query: str):
+            stream = await client.subscribe(query)
+            try:
+                saw_eoq = False
+                async for ev in stream:
+                    # skip the initial snapshot (rows up to eoq);
+                    # anything after is a live change
+                    if "eoq" in ev:
+                        saw_eoq = True
+                    elif saw_eoq and "change" in ev:
+                        changed.set()
+                        return
+            finally:
+                stream.close()
+
+        watchers = [
+            asyncio.create_task(_watch_one(q))
+            for q in dict.fromkeys(engine.queries_used)
+        ]
+        try:
+            await changed.wait()
+        finally:
+            for w in watchers:
+                w.cancel()
+            await asyncio.gather(*watchers, return_exceptions=True)

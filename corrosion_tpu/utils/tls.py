@@ -1,0 +1,147 @@
+"""TLS certificate generation for gossip mTLS.
+
+Rebuild of the reference's cert tooling (`corro-types/src/tls.rs:17-101`,
+CLI `corrosion tls {ca,server,client} generate`, main.rs:333-453): a
+self-signed CA, server certs bound to the gossip IP, and client certs for
+mutual TLS — all ECDSA P-256, PEM-encoded.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from typing import Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+_VALIDITY = datetime.timedelta(days=365 * 5)
+
+
+def _write_pem(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+    os.chmod(path, 0o600)
+
+
+def _key_pem(key: ec.EllipticCurvePrivateKey) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def generate_ca(out_dir: str) -> Tuple[str, str]:
+    """Self-signed CA (tls.rs:17-39). Returns (cert_path, key_path)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "corrosion-tpu CA")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _ONE_DAY)
+        .not_valid_after(now + _VALIDITY)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False,
+            ),
+            critical=True,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    cert_path = os.path.join(out_dir, "ca_cert.pem")
+    key_path = os.path.join(out_dir, "ca_key.pem")
+    _write_pem(cert_path, cert.public_bytes(serialization.Encoding.PEM))
+    _write_pem(key_path, _key_pem(key))
+    return cert_path, key_path
+
+
+def _load_ca(ca_cert_path: str, ca_key_path: str):
+    with open(ca_cert_path, "rb") as f:
+        ca_cert = x509.load_pem_x509_certificate(f.read())
+    with open(ca_key_path, "rb") as f:
+        ca_key = serialization.load_pem_private_key(f.read(), password=None)
+    return ca_cert, ca_key
+
+
+def _issue(
+    ca_cert_path: str,
+    ca_key_path: str,
+    common_name: str,
+    out_dir: str,
+    prefix: str,
+    ip: Optional[str] = None,
+    server: bool = True,
+) -> Tuple[str, str]:
+    ca_cert, ca_key = _load_ca(ca_cert_path, ca_key_path)
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _ONE_DAY)
+        .not_valid_after(now + _VALIDITY)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+        .add_extension(
+            x509.ExtendedKeyUsage(
+                [
+                    x509.oid.ExtendedKeyUsageOID.SERVER_AUTH
+                    if server
+                    else x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH
+                ]
+            ),
+            critical=False,
+        )
+    )
+    if ip is not None:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address(ip))]
+            ),
+            critical=False,
+        )
+    cert = builder.sign(ca_key, hashes.SHA256())
+    os.makedirs(out_dir, exist_ok=True)
+    cert_path = os.path.join(out_dir, f"{prefix}_cert.pem")
+    key_path = os.path.join(out_dir, f"{prefix}_key.pem")
+    _write_pem(cert_path, cert.public_bytes(serialization.Encoding.PEM))
+    _write_pem(key_path, _key_pem(key))
+    return cert_path, key_path
+
+
+def generate_server_cert(
+    ca_cert_path: str, ca_key_path: str, ip: str, out_dir: str
+) -> Tuple[str, str]:
+    """Server cert with the gossip IP as SAN (tls.rs:41-76)."""
+    return _issue(
+        ca_cert_path, ca_key_path, "corrosion-tpu server", out_dir, "server",
+        ip=ip, server=True,
+    )
+
+
+def generate_client_cert(
+    ca_cert_path: str, ca_key_path: str, out_dir: str
+) -> Tuple[str, str]:
+    """Client cert for gossip mTLS (tls.rs:78-101)."""
+    return _issue(
+        ca_cert_path, ca_key_path, "corrosion-tpu client", out_dir, "client",
+        server=False,
+    )

@@ -218,6 +218,56 @@ def test_multi_file_schema_startup(tmp_path):
     asyncio.run(body())
 
 
+def test_comments_and_missing_trailing_semicolons(tmp_path):
+    schema = (
+        "-- the main table; with a sneaky semicolon\n"
+        "CREATE TABLE t1 (id INTEGER PRIMARY KEY NOT NULL); \n"
+        "/* block\n comment */\n"
+        "CREATE TABLE t2 (id INTEGER PRIMARY KEY NOT NULL)"  # no trailing ;
+    )
+    parsed = parse_schema(schema)
+    assert set(parsed.tables) == {"t1", "t2"}
+
+    # multi-file join where the first file lacks a trailing semicolon
+    import asyncio
+
+    from corrosion_tpu.agent.agent import Agent
+    from corrosion_tpu.agent.config import Config
+    from corrosion_tpu.agent.transport import MemoryNetwork
+
+    d = tmp_path / "schemas"
+    d.mkdir()
+    (d / "a.sql").write_text("CREATE TABLE aa (id INTEGER PRIMARY KEY NOT NULL)")
+    (d / "b.sql").write_text("-- comment\nCREATE TABLE bb (id INTEGER PRIMARY KEY NOT NULL)")
+
+    async def body():
+        net = MemoryNetwork()
+        ag = Agent(
+            Config(db_path=str(tmp_path / "n.db"), gossip_addr="n0",
+                   schema_paths=[str(d)], use_swim=False),
+            net.transport("n0"),
+        )
+        await ag.start()
+        assert {"aa", "bb"} <= set(ag.store._tables)
+        await ag.stop()
+
+    asyncio.run(body())
+
+
+def test_add_generated_column_keeps_expression(tmp_path):
+    store = _store(tmp_path)
+    store.transact([("INSERT INTO tests (id, text) VALUES (1, 'hi')", ())])
+    v2 = V1.replace(
+        "text TEXT NOT NULL DEFAULT ''",
+        "text TEXT NOT NULL DEFAULT '',\n"
+        "    text_len INTEGER GENERATED ALWAYS AS (LENGTH(text)) VIRTUAL",
+    )
+    out = store.apply_schema(v2)
+    assert out["new_columns"] == {"tests": ["text_len"]}
+    assert store.query("SELECT text_len FROM tests WHERE id = 1")[0][0] == 2
+    store.close()
+
+
 def test_adopt_existing_identical_table(tmp_path):
     store = CrrStore(str(tmp_path / "db.sqlite"), ActorId.random())
     store.conn.execute(

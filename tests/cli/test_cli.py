@@ -1,0 +1,196 @@
+"""CLI black-box tests (integration-tests/tests/cli_test.rs analog):
+`--help`, a full `agent` boot + `query`/`exec` round-trip over a real
+config file, plus admin-socket commands against the live agent.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+CLI = [sys.executable, "-m", "corrosion_tpu.cli.main"]
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def run_cli(*args, cwd=None, check=True, timeout=60):
+    out = subprocess.run(
+        [*CLI, *args], capture_output=True, text=True, cwd=cwd,
+        timeout=timeout, env=ENV,
+    )
+    if check and out.returncode != 0:
+        raise AssertionError(
+            f"cli {args} failed ({out.returncode}):\n{out.stdout}\n{out.stderr}"
+        )
+    return out
+
+
+def test_help():
+    out = run_cli("--help")
+    for cmd in (
+        "agent", "backup", "restore", "query", "exec", "reload", "sync",
+        "locks", "cluster", "actor", "subs", "log", "tls", "template",
+        "consul", "sim", "db",
+    ):
+        assert cmd in out.stdout, f"missing command {cmd}"
+
+
+@pytest.fixture
+def live_agent(tmp_path):
+    """A real `corrosion-tpu agent` subprocess on loopback with a TOML
+    config, API + admin enabled."""
+    schema_dir = tmp_path / "schemas"
+    schema_dir.mkdir()
+    (schema_dir / "base.sql").write_text(
+        "CREATE TABLE tests (id INTEGER PRIMARY KEY NOT NULL, "
+        "text TEXT NOT NULL DEFAULT '');"
+    )
+    admin = tmp_path / "admin.sock"
+    config = tmp_path / "corrosion.toml"
+    config.write_text(
+        f"""
+[db]
+path = "{tmp_path}/agent.db"
+schema_paths = ["{schema_dir}"]
+
+[api]
+addr = "127.0.0.1:0"
+
+[gossip]
+addr = "127.0.0.1:0"
+
+[admin]
+path = "{admin}"
+"""
+    )
+    proc = subprocess.Popen(
+        [*CLI, "-c", str(config), "agent"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    line = ""
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "agent running" in line:
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(f"agent died: {proc.stderr.read()}")
+    else:
+        proc.kill()
+        raise RuntimeError("agent did not start in 30s")
+    api_addr = line.split("api ")[1].strip()
+    try:
+        yield {"config": str(config), "api": api_addr, "tmp": tmp_path}
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _cfg_args(env):
+    # port-0 API addr resolves at runtime; pass the live one explicitly
+    return ["-c", env["config"], "--api-addr", env["api"]]
+
+
+def test_agent_exec_query_roundtrip(live_agent):
+    run_cli(
+        *_cfg_args(live_agent), "exec",
+        "INSERT INTO tests (id, text) VALUES (1, 'from-cli')",
+    )
+    out = run_cli(
+        *_cfg_args(live_agent), "query", "--columns",
+        "SELECT id, text FROM tests",
+    )
+    assert out.stdout.splitlines() == ["id\ttext", "1\tfrom-cli"]
+
+
+def test_admin_commands_against_live_agent(live_agent):
+    args = _cfg_args(live_agent)
+
+    sync = json.loads(run_cli(*args, "sync", "generate").stdout)
+    assert "actor_id" in sync and "heads" in sync
+
+    locks = json.loads(run_cli(*args, "locks", "--top", "5").stdout)
+    assert isinstance(locks, list)
+
+    members = json.loads(run_cli(*args, "cluster", "members").stdout)
+    assert isinstance(members, list)
+
+    states = json.loads(run_cli(*args, "cluster", "membership-states").stdout)
+    assert isinstance(states, list)
+
+    subs = json.loads(run_cli(*args, "subs", "list").stdout)
+    assert subs == []
+
+    out = json.loads(run_cli(*args, "log", "set", "debug").stdout)
+    assert out == "debug"
+    json.loads(run_cli(*args, "log", "reset").stdout)
+
+    recon = json.loads(run_cli(*args, "sync", "reconcile-gaps").stdout)
+    assert recon["count"] == 0
+
+
+def test_reload_applies_new_schema_file(live_agent):
+    schema_dir = live_agent["tmp"] / "schemas"
+    (schema_dir / "extra.sql").write_text(
+        "CREATE TABLE extras (id INTEGER PRIMARY KEY NOT NULL, n INTEGER);"
+    )
+    out = json.loads(run_cli(*_cfg_args(live_agent), "reload").stdout)
+    assert out["new_tables"] == ["extras"]
+    run_cli(
+        *_cfg_args(live_agent), "exec", "INSERT INTO extras (id, n) VALUES (1, 2)"
+    )
+    q = run_cli(*_cfg_args(live_agent), "query", "SELECT n FROM extras")
+    assert q.stdout.strip() == "2"
+
+
+def test_actor_version_classification(live_agent):
+    run_cli(
+        *_cfg_args(live_agent), "exec",
+        "INSERT INTO tests (id, text) VALUES (9, 'v')",
+    )
+    sync = json.loads(run_cli(*_cfg_args(live_agent), "sync", "generate").stdout)
+    actor = sync["actor_id"]
+    out = json.loads(
+        run_cli(*_cfg_args(live_agent), "actor", "version", actor, "1").stdout
+    )
+    assert out["kind"] == "current"
+    out = json.loads(
+        run_cli(*_cfg_args(live_agent), "actor", "version", actor, "99").stdout
+    )
+    assert out["kind"] == "unknown"
+
+
+def test_backup_restore_via_cli(tmp_path):
+    from corrosion_tpu.agent.store import CrrStore
+    from corrosion_tpu.core.types import ActorId
+
+    db = str(tmp_path / "n.db")
+    s = CrrStore(db, ActorId.random())
+    s.execute_schema(
+        "CREATE TABLE tests (id INTEGER PRIMARY KEY NOT NULL, "
+        "text TEXT NOT NULL DEFAULT '')"
+    )
+    s.transact([("INSERT INTO tests (id, text) VALUES (1, 'keep')", ())])
+    s.close()
+
+    snap = str(tmp_path / "snap.db")
+    run_cli("--db-path", db, "backup", snap)
+    restored = str(tmp_path / "restored.db")
+    out = run_cli("--db-path", restored, "restore", snap)
+    assert "as actor" in out.stdout
+
+    s2 = CrrStore(restored, ActorId.random())
+    assert s2.query("SELECT text FROM tests")[0][0] == "keep"
+    s2.close()
+
+
+def test_sim_smoke():
+    out = run_cli("sim", "ground-truth-3node", timeout=300)
+    m = json.loads(out.stdout)
+    assert m.get("converged", 0) >= 1 or m.get("rounds", 0) > 0, m

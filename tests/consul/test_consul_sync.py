@@ -1,0 +1,224 @@
+"""Consul sync: hash-diff replication of agent services/checks.
+
+Spec: crates/corrosion/src/command/consul/sync.rs (pull → hash → diff →
+/v1/transactions) with its inline tests (sync.rs:745-980) as the model:
+first pass inserts, unchanged pass writes nothing, changed service updates,
+removed service deletes, and check-status flaps respect hash_include notes.
+"""
+
+import asyncio
+import json
+
+from corrosion_tpu.api.client import ApiClient
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.consul.client import ConsulClient
+from corrosion_tpu.consul.sync import run_sync, setup, sync_pass, _load_hashes
+from corrosion_tpu.testing import Cluster
+
+CONSUL_SCHEMA = """
+CREATE TABLE consul_services (
+    node TEXT NOT NULL,
+    id TEXT NOT NULL,
+    name TEXT NOT NULL DEFAULT '',
+    tags TEXT NOT NULL DEFAULT '[]',
+    meta TEXT NOT NULL DEFAULT '{}',
+    port INTEGER NOT NULL DEFAULT 0,
+    address TEXT NOT NULL DEFAULT '',
+    updated_at INTEGER NOT NULL DEFAULT 0,
+    source TEXT,
+    PRIMARY KEY (node, id)
+);
+CREATE TABLE consul_checks (
+    node TEXT NOT NULL,
+    id TEXT NOT NULL,
+    service_id TEXT NOT NULL DEFAULT '',
+    service_name TEXT NOT NULL DEFAULT '',
+    name TEXT NOT NULL DEFAULT '',
+    status TEXT NOT NULL DEFAULT '',
+    output TEXT NOT NULL DEFAULT '',
+    updated_at INTEGER NOT NULL DEFAULT 0,
+    source TEXT,
+    PRIMARY KEY (node, id)
+);
+"""
+
+
+class StubConsul:
+    """Canned /v1/agent/{services,checks} responses."""
+
+    def __init__(self):
+        self.services = {}
+        self.checks = {}
+        self.addr = ""
+        self._server = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._on_conn, "127.0.0.1", 0)
+        port = self._server.sockets[0].getsockname()[1]
+        self.addr = f"127.0.0.1:{port}"
+
+    async def stop(self):
+        self._server.close()
+        await self._server.wait_closed()
+
+    async def _on_conn(self, reader, writer):
+        line = await reader.readline()
+        path = line.split()[1].decode()
+        while (await reader.readline()) not in (b"\r\n", b""):
+            pass
+        body = json.dumps(
+            self.services if path.endswith("services") else self.checks
+        ).encode()
+        writer.write(
+            b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+            + f"content-length: {len(body)}\r\n\r\n".encode()
+            + body
+        )
+        await writer.drain()
+        writer.close()
+
+
+async def _env(fn):
+    cluster = Cluster(2, schema=CONSUL_SCHEMA)
+    await cluster.start()
+    srv = ApiServer(cluster.agents[0])
+    await srv.start()
+    stub = StubConsul()
+    await stub.start()
+    client = ApiClient(srv.addr)
+    try:
+        await fn(cluster, client, stub)
+    finally:
+        await stub.stop()
+        await srv.stop()
+        await cluster.stop()
+
+
+SVC1 = {
+    "ID": "web-1", "Service": "web", "Tags": ["http"],
+    "Meta": {"env": "prod"}, "Port": 8080, "Address": "10.0.0.1",
+}
+CHK1 = {
+    "CheckID": "web-1-alive", "Name": "alive", "Status": "passing",
+    "Output": "ok", "ServiceID": "web-1", "ServiceName": "web",
+}
+
+
+def test_first_pass_inserts_then_noop_then_update_then_delete():
+    async def body(cluster, client, stub):
+        stub.services = {"web-1": SVC1}
+        stub.checks = {"web-1-alive": CHK1}
+        consul = ConsulClient(stub.addr)
+        await setup(client, "nodeA")
+        svc_h, chk_h = {}, {}
+
+        s, c = await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+        assert (s["upserted"], c["upserted"]) == (1, 1)
+        rows = await client.query(
+            "SELECT node, id, name, tags, port FROM consul_services"
+        )
+        assert rows == [["nodeA", "web-1", "web", '["http"]', 8080]]
+        rows = await client.query("SELECT id, status FROM consul_checks")
+        assert rows == [["web-1-alive", "passing"]]
+
+        # unchanged: nothing written
+        s, c = await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+        assert (s["upserted"], s["deleted"], c["upserted"]) == (0, 0, 0)
+
+        # service changed: one upsert
+        stub.services = {"web-1": {**SVC1, "Port": 9090}}
+        s, c = await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+        assert s["upserted"] == 1
+        rows = await client.query("SELECT port FROM consul_services")
+        assert rows == [[9090]]
+
+        # service + check removed: rows deleted
+        stub.services, stub.checks = {}, {}
+        s, c = await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+        assert (s["deleted"], c["deleted"]) == (1, 1)
+        assert await client.query("SELECT * FROM consul_services") == []
+        assert await client.query("SELECT * FROM consul_checks") == []
+        assert svc_h == {} and chk_h == {}
+
+    asyncio.run(_env(body))
+
+
+def test_hash_state_survives_restart_of_sync():
+    async def body(cluster, client, stub):
+        stub.services = {"web-1": SVC1}
+        consul = ConsulClient(stub.addr)
+        await setup(client, "nodeA")
+        svc_h, chk_h = {}, {}
+        await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+
+        # a fresh sync process reloads hashes from the DB: no rewrites
+        svc_h2 = await _load_hashes(client, "__corro_consul_services")
+        assert svc_h2 == svc_h
+        s, _ = await sync_pass(client, consul, "nodeA", svc_h2, {})
+        assert s["upserted"] == 0
+
+    asyncio.run(_env(body))
+
+
+def test_check_output_flap_ignored_without_notes_directive():
+    async def body(cluster, client, stub):
+        stub.checks = {"web-1-alive": CHK1}
+        consul = ConsulClient(stub.addr)
+        await setup(client, "nodeA")
+        svc_h, chk_h = {}, {}
+        await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+
+        # output changes but status doesn't: default hash ignores output
+        stub.checks = {"web-1-alive": {**CHK1, "Output": "ok again"}}
+        _, c = await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+        assert c["upserted"] == 0
+
+        # with the notes directive, output participates (sync.rs:360-386)
+        noted = {
+            **CHK1,
+            "Notes": json.dumps({"hash_include": ["status", "output"]}),
+        }
+        stub.checks = {"web-1-alive": noted}
+        await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+        stub.checks = {"web-1-alive": {**noted, "Output": "different"}}
+        _, c = await sync_pass(client, consul, "nodeA", svc_h, chk_h)
+        assert c["upserted"] == 1
+
+    asyncio.run(_env(body))
+
+
+def test_consul_rows_replicate_across_cluster():
+    async def body(cluster, client, stub):
+        stub.services = {"web-1": SVC1}
+        await run_sync(client, consul_addr=stub.addr, node="nodeA", once=True)
+        # the second agent receives the service row via gossip
+        for _ in range(100):
+            rows = cluster.agents[1].store.query(
+                "SELECT node, id, port FROM consul_services"
+            )
+            if rows:
+                break
+            await asyncio.sleep(0.05)
+        assert [tuple(r) for r in rows] == [("nodeA", "web-1", 8080)]
+
+    asyncio.run(_env(body))
+
+
+def test_setup_rejects_missing_schema():
+    async def body():
+        cluster = Cluster(1)  # TEST_SCHEMA: no consul tables
+        await cluster.start()
+        srv = ApiServer(cluster.agents[0])
+        await srv.start()
+        try:
+            client = ApiClient(srv.addr)
+            try:
+                await setup(client, "n")
+                raise AssertionError("setup should have failed")
+            except RuntimeError as e:
+                assert "consul_services" in str(e)
+        finally:
+            await srv.stop()
+            await cluster.stop()
+
+    asyncio.run(body())

@@ -1,0 +1,122 @@
+"""Template engine: sql()/sql_json()/hostname() rendering + watch re-render.
+
+Spec: corro-tpl (crates/corro-tpl/src/lib.rs:444+) — templates query cluster
+state and re-render when any watched query's results change.
+"""
+
+import asyncio
+import socket
+
+from corrosion_tpu.api.client import ApiClient
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.testing import Cluster
+from corrosion_tpu.tpl import TemplateEngine, render_to_file, watch_and_render
+
+
+async def _with_api(fn):
+    cluster = Cluster(1)
+    await cluster.start()
+    srv = ApiServer(cluster.agents[0])
+    await srv.start()
+    client = ApiClient(srv.addr)
+    try:
+        await fn(cluster, client)
+    finally:
+        await srv.stop()
+        await cluster.stop()
+
+
+def test_render_sql_rows_and_json():
+    async def body(cluster, client):
+        await client.execute(
+            [
+                ["INSERT INTO tests (id, text) VALUES (?, ?)", [1, "alpha"]],
+                ["INSERT INTO tests (id, text) VALUES (?, ?)", [2, "beta"]],
+            ]
+        )
+        engine = TemplateEngine(client)
+        out = await engine.render(
+            "{% for row in sql(\"SELECT id, text FROM tests ORDER BY id\") %}"
+            "{{ row.id }}={{ row.text }};{% endfor %}"
+        )
+        assert out == "1=alpha;2=beta;"
+        assert engine.queries_used == ["SELECT id, text FROM tests ORDER BY id"]
+
+        out = await engine.render(
+            '{{ sql_json("SELECT id FROM tests WHERE id = 1") }}'
+        )
+        assert out == '[{"id": 1}]'
+
+        out = await engine.render("{{ hostname() }}")
+        assert out == socket.gethostname()
+
+    asyncio.run(_with_api(body))
+
+
+def test_render_to_file_and_row_access_styles(tmp_path):
+    async def body(cluster, client):
+        await client.execute(
+            [["INSERT INTO tests (id, text) VALUES (?, ?)", [5, "x"]]]
+        )
+        tpl = tmp_path / "cfg.tpl"
+        tpl.write_text(
+            "{% for r in sql(\"SELECT id, text FROM tests\") %}"
+            "{{ r[0] }} {{ r['text'] }} {{ r.text }}{% endfor %}"
+        )
+        out = tmp_path / "cfg"
+        queries = await render_to_file(client, str(tpl), str(out))
+        assert out.read_text() == "5 x x"
+        assert queries == ["SELECT id, text FROM tests"]
+
+    asyncio.run(_with_api(body))
+
+
+def test_watch_rerenders_on_change(tmp_path):
+    async def body(cluster, client):
+        await client.execute(
+            [["INSERT INTO tests (id, text) VALUES (?, ?)", [1, "v1"]]]
+        )
+        tpl = tmp_path / "cfg.tpl"
+        tpl.write_text(
+            "{% for r in sql(\"SELECT text FROM tests ORDER BY id\") %}"
+            "{{ r.text }};{% endfor %}"
+        )
+        out = tmp_path / "cfg"
+
+        renders = []
+
+        async def mutate_after_first_render():
+            while not renders:
+                await asyncio.sleep(0.01)
+            await client.execute(
+                [["INSERT INTO tests (id, text) VALUES (?, ?)", [2, "v2"]]]
+            )
+
+        mut = asyncio.create_task(mutate_after_first_render())
+        n = await asyncio.wait_for(
+            watch_and_render(
+                client, str(tpl), str(out),
+                on_render=lambda i: renders.append(i),
+                max_renders=2,
+            ),
+            timeout=10,
+        )
+        await mut
+        assert n == 2
+        assert out.read_text() == "v1;v2;"
+
+    asyncio.run(_with_api(body))
+
+
+def test_static_template_watch_returns(tmp_path):
+    async def body(cluster, client):
+        tpl = tmp_path / "static.tpl"
+        tpl.write_text("nothing dynamic")
+        out = tmp_path / "static"
+        n = await asyncio.wait_for(
+            watch_and_render(client, str(tpl), str(out)), timeout=5
+        )
+        assert n == 1
+        assert out.read_text() == "nothing dynamic"
+
+    asyncio.run(_with_api(body))
