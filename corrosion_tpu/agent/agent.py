@@ -76,6 +76,10 @@ class Agent:
         self._sync_inbound = 0
         self._tasks: List[asyncio.Task] = []
         self._stopped = asyncio.Event()
+        # the ONE writer lane at the event-loop level (agent.rs:97
+        # write_sema): held across PG explicit transactions, acquired by
+        # the ingest loop so remote applies can't interleave with one
+        self.write_sema = asyncio.Lock()
         self._rng = random.Random(self.actor_id.bytes_)
         self.swim = None  # attached by SwimRuntime.attach()
         # labeled critical-section registry + watchdog (agent.rs:830-1055)
@@ -178,6 +182,11 @@ class Agent:
         self.stats["changes_committed"] += info.last_seq + 1
         self._queue_local_broadcast(info)
         return cursors, info
+
+    def interactive_tx(self) -> "InteractiveTx":
+        """Explicit client transaction spanning wire messages (the PG
+        front-end's BEGIN..COMMIT).  Caller must hold ``write_sema``."""
+        return InteractiveTx(self)
 
     def _queue_local_broadcast(self, info: CommitInfo):
         """Chunk the committed version and queue frames (broadcast_changes,
@@ -319,7 +328,8 @@ class Agent:
                 batch.append(nxt)
                 cost += nxt.processing_cost()
             try:
-                self._process_changesets(batch)
+                async with self.write_sema:
+                    self._process_changesets(batch)
             except Exception:  # keep the loop alive; reference logs + drops
                 import traceback
 
@@ -684,3 +694,62 @@ class Agent:
             (actor_id.bytes_, version),
         ).fetchone()
         return row[0] if row else max(ch.seq for ch in changes)
+
+
+class InteractiveTx:
+    """One explicit write transaction held open across client messages.
+
+    Mirrors exec_transaction_cursors but split into begin/execute/commit
+    phases so the PG front-end can interleave wire round-trips (the
+    reference checks out the pooled write connection for the whole
+    explicit tx, corro-pg/src/lib.rs:1950-2117).  On commit the captured
+    changeset flows through the same bookkeeping + broadcast path as the
+    HTTP API."""
+
+    def __init__(self, agent: Agent):
+        self.agent = agent
+        self._booked = agent.bookie.for_actor(agent.actor_id)
+        self._snap = None
+        self._open = False
+
+    def begin(self):
+        self._snap = self._booked.snapshot()
+        self._lock_id = self.agent.locks.acquire("pg_interactive_tx")
+        try:
+            self.agent.store.begin_interactive()
+        except Exception:
+            self.agent.locks.release(self._lock_id)
+            raise
+        self._open = True
+
+    def execute(self, sql: str, params=()):
+        return self.agent.store.exec_interactive(sql, params)
+
+    def commit(self) -> Optional[CommitInfo]:
+        agent = self.agent
+        snap = self._snap
+
+        def pre_commit(conn, info: CommitInfo):
+            agent.bookie.record_versions(
+                agent.actor_id, snap, RangeSet([(info.db_version, info.db_version)])
+            )
+
+        try:
+            info = agent.store.commit_interactive(pre_commit)
+        except Exception:
+            agent.store.rollback_interactive()
+            raise
+        finally:
+            self._open = False
+            agent.locks.release(self._lock_id)
+        if info is not None:
+            self._booked.commit_snapshot(snap)
+            agent.stats["changes_committed"] += info.last_seq + 1
+            agent._queue_local_broadcast(info)
+        return info
+
+    def rollback(self):
+        if self._open:
+            self.agent.store.rollback_interactive()
+            self._open = False
+            self.agent.locks.release(self._lock_id)

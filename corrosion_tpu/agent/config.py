@@ -51,6 +51,7 @@ class Config:
     db_path: str = ":memory:"
     gossip_addr: str = ""
     api_addr: str = ""  # "host:port" or "" to disable HTTP
+    pg_addr: str = ""  # "host:port" for the PG wire front-end; "" disables
     bootstrap: List[str] = field(default_factory=list)
     schema_paths: List[str] = field(default_factory=list)
     cluster_id: int = 0
@@ -79,6 +80,9 @@ class Config:
             db_path=db.get("path", ":memory:"),
             schema_paths=db.get("schema_paths", []),
             api_addr=api.get("addr", ""),
+            pg_addr=api.get("pg", {}).get("addr", "")
+            if isinstance(api.get("pg"), dict)
+            else api.get("pg_addr", ""),
             gossip_addr=gossip.get("addr", ""),
             bootstrap=gossip.get("bootstrap", []),
             cluster_id=gossip.get("cluster_id", 0),
@@ -104,3 +108,5 @@ class Config:
                 self.gossip_addr = val
             elif len(parts) == 2 and parts[0] == "api" and parts[1] == "addr":
                 self.api_addr = val
+            elif len(parts) == 2 and parts[0] == "api" and parts[1] == "pg_addr":
+                self.pg_addr = val

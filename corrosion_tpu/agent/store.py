@@ -451,34 +451,59 @@ class CrrStore:
         the agent uses it to persist bookkeeping atomically with the data
         (insert_local_changes, change.rs:189-260)."""
         with self._lock:
-            self._pending_dbv = self.peek_next_db_version()
-            self._seq = 0
-            self._pending_ts = self.clock.now()
-            self._applying = False
-            self.conn.execute("BEGIN IMMEDIATE")
+            self.begin_interactive()
             try:
                 results = []
                 for sql, params in statements:
-                    results.append(self.conn.execute(sql, tuple(params)))
-                info = None
-                if self._seq > 0:  # at least one captured change
-                    info = CommitInfo(
-                        db_version=self._pending_dbv,
-                        last_seq=self._seq - 1,
-                        ts=self._pending_ts,
-                    )
-                    self.conn.execute(
-                        "INSERT INTO __crdt_db_versions (site_id, db_version) VALUES (?, ?) "
-                        "ON CONFLICT (site_id) DO UPDATE SET db_version = excluded.db_version",
-                        (self.site_id.bytes_, info.db_version),
-                    )
-                    if pre_commit:
-                        pre_commit(self.conn, info)
-                self.conn.execute("COMMIT")
-                return results, info
+                    results.append(self.exec_interactive(sql, params))
+                return results, self.commit_interactive(pre_commit)
             except Exception:
-                self.conn.execute("ROLLBACK")
+                self.rollback_interactive()
                 raise
+
+    # -- interactive write transaction ------------------------------------
+    # The PG front-end holds one of these open across wire messages
+    # (corro-pg keeps the pooled write conn checked out for the explicit
+    # tx, lib.rs:1950-2117).  Caller must serialize via the agent's
+    # write semaphore; while open, reads on this conn see uncommitted
+    # rows (the reference reads from separate RO conns instead).
+
+    def begin_interactive(self) -> None:
+        self._pending_dbv = self.peek_next_db_version()
+        self._seq = 0
+        self._pending_ts = self.clock.now()
+        self._applying = False
+        self.conn.execute("BEGIN IMMEDIATE")
+
+    def exec_interactive(self, sql: str, params: Sequence[SqliteValue] = ()):
+        return self.conn.execute(sql, tuple(params))
+
+    def commit_interactive(
+        self,
+        pre_commit: Optional[Callable[[sqlite3.Connection, CommitInfo], None]] = None,
+    ) -> Optional[CommitInfo]:
+        info = None
+        if self._seq > 0:  # at least one captured change
+            info = CommitInfo(
+                db_version=self._pending_dbv,
+                last_seq=self._seq - 1,
+                ts=self._pending_ts,
+            )
+            self.conn.execute(
+                "INSERT INTO __crdt_db_versions (site_id, db_version) VALUES (?, ?) "
+                "ON CONFLICT (site_id) DO UPDATE SET db_version = excluded.db_version",
+                (self.site_id.bytes_, info.db_version),
+            )
+            if pre_commit:
+                pre_commit(self.conn, info)
+        self.conn.execute("COMMIT")
+        return info
+
+    def rollback_interactive(self) -> None:
+        try:
+            self.conn.execute("ROLLBACK")
+        except sqlite3.OperationalError:
+            pass  # no tx active (e.g. BEGIN itself failed)
 
     # -- reads ------------------------------------------------------------
 

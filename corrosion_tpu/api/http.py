@@ -151,12 +151,15 @@ class ApiServer:
                 return False
             async with self._sem:
                 if method == "POST" and path == "/v1/transactions":
-                    resp = self._transactions(json.loads(body))
+                    # single-writer lane: wait out any open PG explicit tx
+                    async with self.agent.write_sema:
+                        resp = self._transactions(json.loads(body))
                 elif method == "POST" and path == "/v1/queries":
                     await self._queries(json.loads(body), writer)
                     return True
                 elif method == "POST" and path == "/v1/migrations":
-                    resp = self._migrations(json.loads(body))
+                    async with self.agent.write_sema:
+                        resp = self._migrations(json.loads(body))
                 elif method == "GET" and path == "/v1/table_stats":
                     resp = self._table_stats()
                 else:

@@ -96,9 +96,17 @@ def cmd_agent(args) -> int:
 
             admin = AdminServer(agent, cfg.admin_path)
             await admin.start()
+        pg = None
+        if cfg.pg_addr:
+            from ..pg import PgServer
+
+            host, _, port = cfg.pg_addr.rpartition(":")
+            pg = PgServer(agent, host or "127.0.0.1", int(port))
+            cfg.pg_addr = await pg.start()
         print(
             f"agent running: actor {agent.actor_id.hex()} "
-            f"gossip {cfg.gossip_addr} api {cfg.api_addr or '-'}",
+            f"gossip {cfg.gossip_addr} api {cfg.api_addr or '-'} "
+            f"pg {cfg.pg_addr or '-'}",
             flush=True,
         )
         # tripwire analog: first SIGINT/SIGTERM begins graceful shutdown
@@ -109,6 +117,8 @@ def cmd_agent(args) -> int:
         await stop.wait()
         if admin:
             await admin.stop()
+        if pg:
+            await pg.stop()
         if api:
             await api.stop()
         await agent.stop()

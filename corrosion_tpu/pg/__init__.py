@@ -1,0 +1,12 @@
+"""PostgreSQL wire-protocol front-end (reference: crates/corro-pg).
+
+Speaks the PG v3 protocol (startup, simple query, extended
+parse/bind/describe/execute portals) over asyncio, translates PG SQL to
+the store's SQLite dialect, emulates the ``pg_catalog`` tables clients
+introspect, and routes every write through the same
+broadcastable-changes path as the HTTP API (corro-pg/src/lib.rs:19-21).
+"""
+
+from .server import PgServer
+
+__all__ = ["PgServer"]

@@ -1,0 +1,151 @@
+"""pg_catalog emulation.
+
+The reference implements pg_type/pg_class/pg_namespace/pg_database/
+pg_range as SQLite virtual tables (corro-pg/src/vtab/).  Here the same
+tables are ordinary rows in an in-memory database ATTACHed to the store
+connection under the schema name ``pg_catalog`` — so both
+``pg_catalog.pg_type`` and bare ``pg_type`` resolve with zero query
+rewriting.  ``pg_class`` is refreshed from ``sqlite_schema`` before any
+statement that mentions it, which is how the vtab's live scan behaves.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+from .protocol import (
+    OID_BOOL,
+    OID_BYTEA,
+    OID_FLOAT4,
+    OID_FLOAT8,
+    OID_INT2,
+    OID_INT4,
+    OID_INT8,
+    OID_OID,
+    OID_TEXT,
+    OID_VARCHAR,
+)
+
+PG_CATALOG_NS_OID = 11
+PUBLIC_NS_OID = 2200
+DATABASE_OID = 16384
+
+_TYPES = [
+    # (oid, typname, typlen, typtype, typcategory)
+    (OID_BOOL, "bool", 1, "b", "B"),
+    (OID_BYTEA, "bytea", -1, "b", "U"),
+    (OID_INT8, "int8", 8, "b", "N"),
+    (OID_INT2, "int2", 2, "b", "N"),
+    (OID_INT4, "int4", 4, "b", "N"),
+    (OID_TEXT, "text", -1, "b", "S"),
+    (OID_OID, "oid", 4, "b", "N"),
+    (OID_FLOAT4, "float4", 4, "b", "N"),
+    (OID_FLOAT8, "float8", 8, "b", "N"),
+    (OID_VARCHAR, "varchar", -1, "b", "S"),
+    (1114, "timestamp", 8, "b", "D"),
+    (1184, "timestamptz", 8, "b", "D"),
+    (2950, "uuid", 16, "b", "U"),
+    (114, "json", -1, "b", "U"),
+    (3802, "jsonb", -1, "b", "U"),
+    (19, "name", 64, "b", "S"),
+    (1700, "numeric", -1, "b", "N"),
+]
+
+
+def attach(conn: sqlite3.Connection, dbname: str) -> None:
+    """Attach and populate the catalog schema (idempotent)."""
+    rows = conn.execute(
+        "SELECT name FROM pragma_database_list WHERE name = 'pg_catalog'"
+    ).fetchall()
+    if not rows:
+        conn.execute("ATTACH DATABASE ':memory:' AS pg_catalog")
+    conn.executescript(
+        """
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_type (
+            oid INTEGER PRIMARY KEY, typname TEXT, typlen INTEGER,
+            typtype TEXT, typcategory TEXT, typnamespace INTEGER,
+            typrelid INTEGER DEFAULT 0, typelem INTEGER DEFAULT 0,
+            typbasetype INTEGER DEFAULT 0, typtypmod INTEGER DEFAULT -1
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_namespace (
+            oid INTEGER PRIMARY KEY, nspname TEXT, nspowner INTEGER DEFAULT 10
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_database (
+            oid INTEGER PRIMARY KEY, datname TEXT, encoding INTEGER DEFAULT 6,
+            datallowconn INTEGER DEFAULT 1
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_class (
+            oid INTEGER PRIMARY KEY, relname TEXT, relnamespace INTEGER,
+            relkind TEXT, reltuples REAL DEFAULT -1, relowner INTEGER DEFAULT 10
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_range (
+            rngtypid INTEGER PRIMARY KEY, rngsubtype INTEGER
+        );
+        """
+    )
+    cur = conn.execute("SELECT count(*) FROM pg_catalog.pg_type")
+    if cur.fetchone()[0] == 0:
+        conn.executemany(
+            "INSERT INTO pg_catalog.pg_type "
+            "(oid, typname, typlen, typtype, typcategory, typnamespace) "
+            "VALUES (?, ?, ?, ?, ?, ?)",
+            [(o, n, l, t, c, PG_CATALOG_NS_OID) for o, n, l, t, c in _TYPES],
+        )
+        conn.executemany(
+            "INSERT INTO pg_catalog.pg_namespace (oid, nspname) VALUES (?, ?)",
+            [(PG_CATALOG_NS_OID, "pg_catalog"), (PUBLIC_NS_OID, "public")],
+        )
+        conn.execute(
+            "INSERT INTO pg_catalog.pg_database (oid, datname) VALUES (?, ?)",
+            (DATABASE_OID, dbname),
+        )
+    refresh_pg_class(conn)
+
+
+def refresh_pg_class(conn: sqlite3.Connection) -> None:
+    """Mirror sqlite_schema into pg_class (vtab live-scan analog)."""
+    conn.execute("DELETE FROM pg_catalog.pg_class")
+    rows = conn.execute(
+        "SELECT rowid, name, type FROM sqlite_schema "
+        "WHERE name NOT LIKE 'sqlite_%' AND name NOT LIKE '\\_\\_%' ESCAPE '\\'"
+    ).fetchall()
+    conn.executemany(
+        "INSERT OR IGNORE INTO pg_catalog.pg_class "
+        "(oid, relname, relnamespace, relkind) VALUES (?, ?, ?, ?)",
+        [
+            (100000 + rid, name, PUBLIC_NS_OID, "r" if typ == "table" else "i")
+            for rid, name, typ in rows
+        ],
+    )
+
+
+def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
+    """Session functions PG clients call during introspection."""
+    conn.create_function("version", 0, lambda: "PostgreSQL 14.0 (corrosion-tpu)")
+    conn.create_function("current_schema", 0, lambda: "public")
+    conn.create_function("current_database", 0, lambda: dbname)
+    conn.create_function("pg_backend_pid", 0, lambda: 1)
+    conn.create_function("current_setting", 1, lambda _n: "")
+    conn.create_function(
+        "pg_get_userbyid", 1, lambda _o: "postgres", deterministic=True
+    )
+    conn.create_function(
+        "format_type", 2, _format_type, deterministic=True
+    )
+    conn.create_function("pg_table_is_visible", 1, lambda _o: 1, deterministic=True)
+    conn.create_function("obj_description", 2, lambda _o, _c: None)
+
+
+_OID_NAMES = {o: n for o, n, *_ in _TYPES}
+
+
+def _format_type(oid, _typmod):
+    try:
+        return _OID_NAMES.get(int(oid), "???")
+    except (TypeError, ValueError):
+        return "???"
+
+
+def mentions_catalog(sql: str) -> bool:
+    low = sql.lower()
+    return "pg_class" in low or "pg_catalog" in low or "pg_namespace" in low

@@ -1,0 +1,175 @@
+"""Minimal asyncio PostgreSQL client (text format).
+
+Test-grade counterpart of the server — the reference exercises corro-pg
+with tokio-postgres (corro-pg/src/lib.rs:3440+); this plays that role
+for the in-repo test suite and the CLI's pg probe.  Speaks startup,
+simple query, and the extended Parse/Bind/Describe/Execute/Sync flow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from . import protocol as p
+
+
+@dataclass
+class Result:
+    tag: str
+    columns: List[str] = field(default_factory=list)
+    rows: List[Tuple] = field(default_factory=list)
+
+
+class PgClientError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class PgClient:
+    def __init__(self, host: str, port: int, user: str = "postgres",
+                 database: str = "corrosion"):
+        self.host, self.port = host, port
+        self.user, self.database = user, database
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self):
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        params = (
+            f"user\x00{self.user}\x00database\x00{self.database}\x00\x00"
+        ).encode()
+        body = struct.pack("!i", p.PROTOCOL_V3) + params
+        self.writer.write(struct.pack("!i", len(body) + 4) + body)
+        await self.writer.drain()
+        await self._until_ready()
+
+    async def close(self):
+        if self.writer:
+            self.writer.write(b"X" + struct.pack("!i", 4))
+            await self.writer.drain()
+            self.writer.close()
+            await self.writer.wait_closed()
+
+    async def _read_backend(self) -> Tuple[bytes, bytes]:
+        tag = await self.reader.readexactly(1)
+        (length,) = struct.unpack("!i", await self.reader.readexactly(4))
+        body = await self.reader.readexactly(length - 4)
+        return tag, body
+
+    async def _until_ready(self) -> List[Result]:
+        """Collect results until ReadyForQuery; raise on ErrorResponse."""
+        results: List[Result] = []
+        current: Optional[Result] = None
+        error: Optional[PgClientError] = None
+        while True:
+            tag, body = await self._read_backend()
+            if tag == b"Z":
+                if error:
+                    raise error
+                return results
+            if tag == b"E":
+                fields = _error_fields(body)
+                error = error or PgClientError(
+                    fields.get("C", "?????"), fields.get("M", "")
+                )
+            elif tag == b"T":
+                current = Result(tag="", columns=_columns(body))
+                results.append(current)
+            elif tag == b"D":
+                row = _row(body)
+                if current is None:
+                    current = Result(tag="")
+                    results.append(current)
+                current.rows.append(row)
+            elif tag == b"C":
+                tagstr = body.rstrip(b"\x00").decode()
+                if current is None:
+                    results.append(Result(tag=tagstr))
+                else:
+                    current.tag = tagstr
+                    current = None
+            elif tag == b"I":
+                results.append(Result(tag=""))
+            # R/S/K/1/2/3/n/t/s/N: handshake + extended-flow acks, skipped
+
+    async def query(self, sql: str) -> List[Result]:
+        """Simple-query protocol: possibly multiple statements."""
+        body = sql.encode() + b"\x00"
+        self.writer.write(b"Q" + struct.pack("!i", len(body) + 4) + body)
+        await self.writer.drain()
+        return await self._until_ready()
+
+    async def execute(self, sql: str, params: Sequence = ()) -> Result:
+        """Extended protocol round: parse/bind/describe/execute/sync."""
+        w = self.writer
+        sql_b = sql.encode()
+        w.write(_frame(b"P", b"\x00" + sql_b + b"\x00" + struct.pack("!h", 0)))
+        # bind: text params
+        bind = b"\x00\x00" + struct.pack("!h", 0)
+        bind += struct.pack("!h", len(params))
+        for v in params:
+            if v is None:
+                bind += struct.pack("!i", -1)
+            else:
+                data = _to_text(v)
+                bind += struct.pack("!i", len(data)) + data
+        bind += struct.pack("!h", 0)
+        w.write(_frame(b"B", bind))
+        w.write(_frame(b"D", b"P\x00"))
+        w.write(_frame(b"E", b"\x00" + struct.pack("!i", 0)))
+        w.write(_frame(b"S", b""))
+        await w.drain()
+        results = await self._until_ready()
+        return results[0] if results else Result(tag="")
+
+
+def _frame(tag: bytes, body: bytes) -> bytes:
+    return tag + struct.pack("!i", len(body) + 4) + body
+
+
+def _to_text(v) -> bytes:
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, (bytes, memoryview)):
+        return b"\\x" + bytes(v).hex().encode()
+    return str(v).encode()
+
+
+def _error_fields(body: bytes) -> dict:
+    fields = {}
+    for part in body.split(b"\x00"):
+        if part:
+            fields[chr(part[0])] = part[1:].decode("utf-8", "replace")
+    return fields
+
+
+def _columns(body: bytes) -> List[str]:
+    (n,) = struct.unpack("!h", body[:2])
+    cols, rest = [], body[2:]
+    for _ in range(n):
+        i = rest.index(b"\x00")
+        cols.append(rest[:i].decode())
+        rest = rest[i + 1 + 18 :]
+    return cols
+
+
+def _row(body: bytes) -> Tuple:
+    (n,) = struct.unpack("!h", body[:2])
+    rest = body[2:]
+    vals = []
+    for _ in range(n):
+        (ln,) = struct.unpack("!i", rest[:4])
+        rest = rest[4:]
+        if ln == -1:
+            vals.append(None)
+        else:
+            vals.append(rest[:ln].decode("utf-8", "replace"))
+            rest = rest[ln:]
+    return tuple(vals)

@@ -1,0 +1,381 @@
+"""PostgreSQL v3 wire-protocol codec.
+
+Frame readers/writers for the frontend and backend message sets the
+server handles (the reference delegates this to the pgwire crate,
+corro-pg/src/lib.rs:40-47; here it is ~200 lines of struct packing).
+Text format is the primary data representation; binary send/recv is
+implemented for the fixed-width scalar types clients commonly request.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+PROTOCOL_V3 = 196608  # 3.0
+SSL_REQUEST = 80877103
+GSSENC_REQUEST = 80877104
+CANCEL_REQUEST = 80877102
+
+# type OIDs (pg_type.dat)
+OID_BOOL = 16
+OID_BYTEA = 17
+OID_INT8 = 20
+OID_INT2 = 21
+OID_INT4 = 23
+OID_TEXT = 25
+OID_OID = 26
+OID_FLOAT4 = 700
+OID_FLOAT8 = 701
+OID_UNKNOWN = 705
+OID_VARCHAR = 1043
+
+_INT_OIDS = (OID_INT2, OID_INT4, OID_INT8, OID_OID)
+_FLOAT_OIDS = (OID_FLOAT4, OID_FLOAT8)
+
+
+def oid_for_value(v) -> int:
+    if isinstance(v, bool):
+        return OID_BOOL
+    if isinstance(v, int):
+        return OID_INT8
+    if isinstance(v, float):
+        return OID_FLOAT8
+    if isinstance(v, (bytes, memoryview)):
+        return OID_BYTEA
+    return OID_TEXT
+
+
+def encode_text(v) -> Optional[bytes]:
+    """SqliteValue → PG text-format field (None → SQL NULL)."""
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, float):
+        # repr round-trips; PG sends shortest-exact too
+        return repr(v).encode()
+    if isinstance(v, (bytes, memoryview)):
+        return b"\\x" + bytes(v).hex().encode()
+    return str(v).encode()
+
+
+def encode_binary(v, oid: int) -> Optional[bytes]:
+    if v is None:
+        return None
+    if oid == OID_BOOL:
+        return b"\x01" if v else b"\x00"
+    if oid == OID_INT2:
+        return struct.pack("!h", int(v))
+    if oid == OID_INT4:
+        return struct.pack("!i", int(v))
+    if oid in (OID_INT8, OID_OID):
+        return struct.pack("!q", int(v))
+    if oid == OID_FLOAT4:
+        return struct.pack("!f", float(v))
+    if oid == OID_FLOAT8:
+        return struct.pack("!d", float(v))
+    if oid == OID_BYTEA:
+        return bytes(v)
+    return str(v).encode()  # text/varchar/unknown: raw utf8
+
+
+def decode_param(data: Optional[bytes], oid: int, fmt: int):
+    """Bind parameter → SqliteValue."""
+    if data is None:
+        return None
+    if fmt == 1:  # binary
+        if oid == OID_BOOL:
+            return 1 if data != b"\x00" else 0
+        if oid == OID_INT2:
+            return struct.unpack("!h", data)[0]
+        if oid == OID_INT4:
+            return struct.unpack("!i", data)[0]
+        if oid in (OID_INT8, OID_OID):
+            return struct.unpack("!q", data)[0]
+        if oid == OID_FLOAT4:
+            return struct.unpack("!f", data)[0]
+        if oid == OID_FLOAT8:
+            return struct.unpack("!d", data)[0]
+        if oid == OID_BYTEA:
+            return data
+        return data.decode("utf-8", "replace")
+    # text format: coerce by declared OID so SQLite sees native types
+    text = data.decode("utf-8")
+    if oid in _INT_OIDS:
+        return int(text)
+    if oid in _FLOAT_OIDS:
+        return float(text)
+    if oid == OID_BOOL:
+        return 1 if text in ("t", "true", "1", "on", "yes") else 0
+    if oid == OID_BYTEA:
+        if text.startswith("\\x"):
+            return bytes.fromhex(text[2:])
+        return text.encode()
+    return text
+
+
+# -- frontend messages -------------------------------------------------------
+
+
+@dataclass
+class Startup:
+    protocol: int
+    params: dict
+
+
+@dataclass
+class Query:
+    sql: str
+
+
+@dataclass
+class Parse:
+    name: str
+    sql: str
+    param_oids: Tuple[int, ...]
+
+
+@dataclass
+class Bind:
+    portal: str
+    statement: str
+    param_formats: Tuple[int, ...]
+    params: Tuple[Optional[bytes], ...]
+    result_formats: Tuple[int, ...]
+
+
+@dataclass
+class Describe:
+    kind: str  # 'S' or 'P'
+    name: str
+
+
+@dataclass
+class Execute:
+    portal: str
+    max_rows: int
+
+
+@dataclass
+class Close:
+    kind: str
+    name: str
+
+
+@dataclass
+class Sync:
+    pass
+
+
+@dataclass
+class Flush:
+    pass
+
+
+@dataclass
+class Terminate:
+    pass
+
+
+@dataclass
+class PasswordMessage:
+    data: bytes
+
+
+class ProtocolError(Exception):
+    pass
+
+
+async def read_startup(reader):
+    """First frame has no type byte: length + payload."""
+    head = await reader.readexactly(4)
+    (length,) = struct.unpack("!i", head)
+    if length < 8 or length > 10_000:
+        raise ProtocolError(f"bad startup length {length}")
+    body = await reader.readexactly(length - 4)
+    (code,) = struct.unpack("!i", body[:4])
+    if code in (SSL_REQUEST, GSSENC_REQUEST, CANCEL_REQUEST):
+        return Startup(protocol=code, params={})
+    params = {}
+    parts = body[4:].split(b"\x00")
+    for k, v in zip(parts[::2], parts[1::2]):
+        if k:
+            params[k.decode()] = v.decode()
+    return Startup(protocol=code, params=params)
+
+
+async def read_message(reader):
+    """One typed frontend frame → message object (None for unknown)."""
+    tag = await reader.readexactly(1)
+    (length,) = struct.unpack("!i", await reader.readexactly(4))
+    if length < 4 or length > 1 << 30:
+        raise ProtocolError(f"bad frame length {length}")
+    body = await reader.readexactly(length - 4)
+    if tag == b"Q":
+        return Query(sql=body.rstrip(b"\x00").decode("utf-8"))
+    if tag == b"P":
+        name, rest = _cstr(body)
+        sql, rest = _cstr(rest)
+        (n,) = struct.unpack("!h", rest[:2])
+        oids = struct.unpack(f"!{n}i", rest[2 : 2 + 4 * n]) if n else ()
+        return Parse(name=name, sql=sql, param_oids=oids)
+    if tag == b"B":
+        return _read_bind(body)
+    if tag == b"D":
+        return Describe(kind=chr(body[0]), name=body[1:].rstrip(b"\x00").decode())
+    if tag == b"E":
+        name, rest = _cstr(body)
+        (max_rows,) = struct.unpack("!i", rest[:4])
+        return Execute(portal=name, max_rows=max_rows)
+    if tag == b"C":
+        return Close(kind=chr(body[0]), name=body[1:].rstrip(b"\x00").decode())
+    if tag == b"S":
+        return Sync()
+    if tag == b"H":
+        return Flush()
+    if tag == b"X":
+        return Terminate()
+    if tag == b"p":
+        return PasswordMessage(data=body)
+    return None  # CopyData/CopyFail/etc: caller decides
+
+
+def _cstr(buf: bytes) -> Tuple[str, bytes]:
+    i = buf.index(b"\x00")
+    return buf[:i].decode("utf-8"), buf[i + 1 :]
+
+
+def _read_bind(body: bytes) -> Bind:
+    portal, rest = _cstr(body)
+    statement, rest = _cstr(rest)
+    (nfmt,) = struct.unpack("!h", rest[:2])
+    fmts = struct.unpack(f"!{nfmt}h", rest[2 : 2 + 2 * nfmt]) if nfmt else ()
+    rest = rest[2 + 2 * nfmt :]
+    (nparams,) = struct.unpack("!h", rest[:2])
+    rest = rest[2:]
+    params: List[Optional[bytes]] = []
+    for _ in range(nparams):
+        (plen,) = struct.unpack("!i", rest[:4])
+        rest = rest[4:]
+        if plen == -1:
+            params.append(None)
+        else:
+            params.append(rest[:plen])
+            rest = rest[plen:]
+    (nres,) = struct.unpack("!h", rest[:2])
+    res = struct.unpack(f"!{nres}h", rest[2 : 2 + 2 * nres]) if nres else ()
+    return Bind(
+        portal=portal,
+        statement=statement,
+        param_formats=fmts,
+        params=tuple(params),
+        result_formats=res,
+    )
+
+
+# -- backend messages --------------------------------------------------------
+
+
+def _frame(tag: bytes, body: bytes = b"") -> bytes:
+    return tag + struct.pack("!i", len(body) + 4) + body
+
+
+def auth_ok() -> bytes:
+    return _frame(b"R", struct.pack("!i", 0))
+
+
+def parameter_status(key: str, value: str) -> bytes:
+    return _frame(b"S", key.encode() + b"\x00" + value.encode() + b"\x00")
+
+
+def backend_key_data(pid: int, secret: int) -> bytes:
+    return _frame(b"K", struct.pack("!ii", pid, secret))
+
+
+def ready_for_query(status: str) -> bytes:
+    return _frame(b"Z", status.encode())
+
+
+@dataclass
+class FieldDesc:
+    name: str
+    oid: int = OID_TEXT
+    fmt: int = 0
+    table_oid: int = 0
+    col_attr: int = 0
+    typlen: int = -1
+    typmod: int = -1
+
+
+def row_description(fields: Sequence[FieldDesc]) -> bytes:
+    body = struct.pack("!h", len(fields))
+    for f in fields:
+        body += (
+            f.name.encode() + b"\x00"
+            + struct.pack(
+                "!ihihih", f.table_oid, f.col_attr, f.oid, f.typlen, f.typmod, f.fmt
+            )
+        )
+    return _frame(b"T", body)
+
+
+def data_row(values: Sequence[Optional[bytes]]) -> bytes:
+    body = struct.pack("!h", len(values))
+    for v in values:
+        if v is None:
+            body += struct.pack("!i", -1)
+        else:
+            body += struct.pack("!i", len(v)) + v
+    return _frame(b"D", body)
+
+
+def command_complete(tag: str) -> bytes:
+    return _frame(b"C", tag.encode() + b"\x00")
+
+
+def empty_query_response() -> bytes:
+    return _frame(b"I")
+
+
+def parse_complete() -> bytes:
+    return _frame(b"1")
+
+
+def bind_complete() -> bytes:
+    return _frame(b"2")
+
+
+def close_complete() -> bytes:
+    return _frame(b"3")
+
+
+def no_data() -> bytes:
+    return _frame(b"n")
+
+
+def portal_suspended() -> bytes:
+    return _frame(b"s")
+
+
+def parameter_description(oids: Sequence[int]) -> bytes:
+    return _frame(b"t", struct.pack(f"!h{len(oids)}i", len(oids), *oids))
+
+
+def error_response(sqlstate: str, message: str, severity: str = "ERROR") -> bytes:
+    body = (
+        b"S" + severity.encode() + b"\x00"
+        + b"V" + severity.encode() + b"\x00"
+        + b"C" + sqlstate.encode() + b"\x00"
+        + b"M" + message.encode("utf-8", "replace") + b"\x00"
+        + b"\x00"
+    )
+    return _frame(b"E", body)
+
+
+def notice_response(message: str) -> bytes:
+    body = (
+        b"SNOTICE\x00VNOTICE\x00C00000\x00M" + message.encode() + b"\x00\x00"
+    )
+    return _frame(b"N", body)

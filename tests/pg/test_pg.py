@@ -1,0 +1,228 @@
+"""PG wire-protocol front-end tests — a real client speaking the v3
+protocol over TCP against the server, with writes verified to gossip to
+a second node (the reference drives corro-pg with tokio-postgres,
+corro-pg/src/lib.rs:3440+)."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.pg import PgServer
+from corrosion_tpu.pg.client import PgClient, PgClientError
+from corrosion_tpu.testing import Cluster
+
+
+async def _with_pg(n, fn):
+    cluster = Cluster(n, use_swim=False)
+    await cluster.start()
+    servers, clients = [], []
+    try:
+        for agent in cluster.agents:
+            srv = PgServer(agent)
+            await srv.start()
+            servers.append(srv)
+            c = PgClient("127.0.0.1", srv._port)
+            await c.connect()
+            clients.append(c)
+        await fn(cluster, clients)
+    finally:
+        for c in clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for srv in servers:
+            await srv.stop()
+        await cluster.stop()
+
+
+def test_simple_query_roundtrip():
+    async def body(cluster, clients):
+        res = await clients[0].query(
+            "INSERT INTO tests (id, text) VALUES (1, 'pg')"
+        )
+        assert res[0].tag == "INSERT 0 1"
+        res = await clients[0].query("SELECT id, text FROM tests")
+        assert res[0].columns == ["id", "text"]
+        assert res[0].rows == [("1", "pg")]
+        assert res[0].tag == "SELECT 1"
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_extended_protocol_params():
+    async def body(cluster, clients):
+        res = await clients[0].execute(
+            "INSERT INTO tests (id, text) VALUES ($1, $2)", [5, "param"]
+        )
+        assert res.tag == "INSERT 0 1"
+        res = await clients[0].execute(
+            "SELECT text FROM tests WHERE id = $1", [5]
+        )
+        assert res.rows == [("param",)]
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_explicit_transaction_commit_and_gossip():
+    async def body(cluster, clients):
+        res = await clients[0].query(
+            "BEGIN; "
+            "INSERT INTO tests (id, text) VALUES (10, 'a'); "
+            "INSERT INTO tests (id, text) VALUES (11, 'b'); "
+            "COMMIT"
+        )
+        assert [r.tag for r in res] == ["BEGIN", "INSERT 0 1", "INSERT 0 1", "COMMIT"]
+        # one version for the whole tx, replicated to node 1
+        for _ in range(200):
+            rows = cluster.agents[1].store.query(
+                "SELECT id FROM tests WHERE id IN (10, 11) ORDER BY id"
+            )
+            if len(rows) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert [r[0] for r in rows] == [10, 11]
+
+    asyncio.run(_with_pg(2, body))
+
+
+def test_rollback_discards():
+    async def body(cluster, clients):
+        await clients[0].query(
+            "BEGIN; INSERT INTO tests (id, text) VALUES (20, 'x'); ROLLBACK"
+        )
+        rows = cluster.agents[0].store.query(
+            "SELECT id FROM tests WHERE id = 20"
+        )
+        assert rows == []
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_failed_transaction_is_sticky():
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query("BEGIN")
+        with pytest.raises(PgClientError) as ei:
+            await c.query("SELECT * FROM nonexistent_table")
+        assert ei.value.code == "42P01"
+        # further statements refused with 25P02 until rollback
+        with pytest.raises(PgClientError) as ei:
+            await c.query("SELECT 1")
+        assert ei.value.code == "25P02"
+        await c.query("ROLLBACK")
+        res = await c.query("SELECT 1")
+        assert res[0].rows == [("1",)]
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_error_sqlstate_mapping():
+    async def body(cluster, clients):
+        with pytest.raises(PgClientError) as ei:
+            await clients[0].query("SELECT * FROM missing_tbl")
+        assert ei.value.code == "42P01"
+        with pytest.raises(PgClientError) as ei:
+            await clients[0].query("SELEKT 1")
+        assert ei.value.code == "42601"
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_set_show_and_introspection():
+    async def body(cluster, clients):
+        c = clients[0]
+        res = await c.query("SET application_name = 'myapp'")
+        assert res[0].tag == "SET"
+        res = await c.query("SHOW application_name")
+        assert res[0].rows == [("myapp",)]
+        res = await c.query("SELECT version()")
+        assert "corrosion-tpu" in res[0].rows[0][0]
+        # pg_catalog emulation: typname lookup + user tables in pg_class
+        res = await c.query(
+            "SELECT typname FROM pg_catalog.pg_type WHERE oid = 25"
+        )
+        assert res[0].rows == [("text",)]
+        res = await c.query(
+            "SELECT relname FROM pg_class WHERE relkind = 'r' ORDER BY relname"
+        )
+        assert ("tests",) in res[0].rows
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_pg_write_visible_over_store_and_broadcast_path():
+    async def body(cluster, clients):
+        # writes via PG ride the same changeset machinery: version bump +
+        # crdt clock rows exist
+        await clients[0].execute(
+            "INSERT INTO tests (id, text) VALUES ($1, $2)", [30, "w"]
+        )
+        agent = cluster.agents[0]
+        assert agent.store.db_version() >= 1
+        changes = agent.store.changes_for_version(
+            agent.actor_id, agent.store.db_version()
+        )
+        assert any(ch.table == "tests" for ch in changes)
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_create_table_over_pg_is_crr():
+    async def body(cluster, clients):
+        res = await clients[0].query(
+            "CREATE TABLE pgmade (id bigint primary key, note text)"
+        )
+        assert res[0].tag == "CREATE TABLE"
+        res = await clients[0].execute(
+            "INSERT INTO pgmade (id, note) VALUES ($1, $2)", [1, "hi"]
+        )
+        assert res.tag == "INSERT 0 1"
+        # it's a CRR: changes captured for broadcast
+        agent = cluster.agents[0]
+        changes = agent.store.changes_for_version(
+            agent.actor_id, agent.store.db_version()
+        )
+        assert any(ch.table == "pgmade" for ch in changes)
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_portal_suspension_max_rows():
+    async def body(cluster, clients):
+        c = clients[0]
+        for i in range(8):
+            await c.execute(
+                "INSERT INTO tests (id, text) VALUES ($1, $2)", [100 + i, "r"]
+            )
+        # manual extended flow with max_rows=3: expect 2 suspensions
+        import struct
+
+        from corrosion_tpu.pg.client import _frame
+
+        w = c.writer
+        sql = b"SELECT id FROM tests ORDER BY id\x00"
+        w.write(_frame(b"P", b"\x00" + sql + struct.pack("!h", 0)))
+        w.write(
+            _frame(
+                b"B",
+                b"\x00\x00" + struct.pack("!hhh", 0, 0, 0),
+            )
+        )
+        for _ in range(3):
+            w.write(_frame(b"E", b"\x00" + struct.pack("!i", 3)))
+        w.write(_frame(b"S", b""))
+        await w.drain()
+        suspended = rows = 0
+        while True:
+            tag, body = await c._read_backend()
+            if tag == b"s":
+                suspended += 1
+            elif tag == b"D":
+                rows += 1
+            elif tag == b"Z":
+                break
+        assert suspended == 2
+        assert rows == 8
+
+    asyncio.run(_with_pg(1, body))
