@@ -40,6 +40,7 @@ from ..core.types import (
     SyncNeed,
 )
 from ..core.hlc import HLC, ClockDriftError
+from ..metrics import REGISTRY
 from ..utils.backoff import Backoff
 from ..utils.locks import LockRegistry
 from . import codec
@@ -48,6 +49,12 @@ from .config import Config
 from .members import Members
 from .store import CommitInfo, CrrStore
 from .transport import BiStream, Transport
+
+
+# hot-path histograms (corro_sqlite_pool_execution_seconds /
+# corro_sync_* families in doc/telemetry/prometheus.md)
+_apply_hist = REGISTRY.histogram("corro_agent_apply_seconds")
+_sync_hist = REGISTRY.histogram("corro_sync_round_seconds")
 
 
 @dataclass
@@ -329,7 +336,8 @@ class Agent:
                 cost += nxt.processing_cost()
             try:
                 async with self.write_sema:
-                    self._process_changesets(batch)
+                    with _apply_hist.time():
+                        self._process_changesets(batch)
             except Exception:  # keep the loop alive; reference logs + drops
                 import traceback
 
@@ -514,9 +522,10 @@ class Agent:
         if not peers:
             return 0
         self.stats["sync_rounds"] += 1
-        results = await asyncio.gather(
-            *(self._sync_with(st.addr) for st in peers), return_exceptions=True
-        )
+        with _sync_hist.time():
+            results = await asyncio.gather(
+                *(self._sync_with(st.addr) for st in peers), return_exceptions=True
+            )
         return sum(r for r in results if isinstance(r, int))
 
     async def _sync_with(self, addr: str, timeout: float = 30.0) -> int:

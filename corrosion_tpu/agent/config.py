@@ -59,6 +59,7 @@ class Config:
     use_swim: bool = True
     perf: PerfConfig = field(default_factory=PerfConfig)
     admin_path: str = ""  # unix socket path; "" disables
+    prometheus_addr: str = ""  # "host:port" scrape endpoint; "" disables
 
     @classmethod
     def load(cls, path: str) -> "Config":
@@ -75,6 +76,8 @@ class Config:
         api = raw.get("api", {})
         gossip = raw.get("gossip", {})
         admin = raw.get("admin", {})
+        tel = raw.get("telemetry", {})
+        tel_prom = tel.get("prometheus")
         perf_raw = {**raw.get("perf", {})}
         cfg = cls(
             db_path=db.get("path", ":memory:"),
@@ -87,6 +90,11 @@ class Config:
             bootstrap=gossip.get("bootstrap", []),
             cluster_id=gossip.get("cluster_id", 0),
             admin_path=admin.get("path", ""),
+            prometheus_addr=(
+                tel_prom.get("addr", "")
+                if isinstance(tel_prom, dict)
+                else tel.get("prometheus_addr", "")
+            ),
         )
         for k, v in perf_raw.items():
             if hasattr(cfg.perf, k):

@@ -155,6 +155,11 @@ class CrrStore:
         )
         return site_id
 
+    @property
+    def tables(self) -> Tuple[str, ...]:
+        """Names of the replicated (CRR) tables."""
+        return tuple(self._tables)
+
     def _load_tables(self):
         for name, pks, cols in self.conn.execute(
             "SELECT name, pks, cols FROM __crdt_tables"

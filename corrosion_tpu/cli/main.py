@@ -103,10 +103,17 @@ def cmd_agent(args) -> int:
             host, _, port = cfg.pg_addr.rpartition(":")
             pg = PgServer(agent, host or "127.0.0.1", int(port))
             cfg.pg_addr = await pg.start()
+        prom = None
+        if cfg.prometheus_addr:
+            from ..metrics import MetricsServer
+
+            host, _, port = cfg.prometheus_addr.rpartition(":")
+            prom = MetricsServer(agent, host or "127.0.0.1", int(port))
+            cfg.prometheus_addr = await prom.start()
         print(
             f"agent running: actor {agent.actor_id.hex()} "
             f"gossip {cfg.gossip_addr} api {cfg.api_addr or '-'} "
-            f"pg {cfg.pg_addr or '-'}",
+            f"pg {cfg.pg_addr or '-'} prometheus {cfg.prometheus_addr or '-'}",
             flush=True,
         )
         # tripwire analog: first SIGINT/SIGTERM begins graceful shutdown
@@ -117,6 +124,8 @@ def cmd_agent(args) -> int:
         await stop.wait()
         if admin:
             await admin.stop()
+        if prom:
+            await prom.stop()
         if pg:
             await pg.stop()
         if api:

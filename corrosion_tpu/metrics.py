@@ -1,0 +1,359 @@
+"""Metrics facade + Prometheus text exporter.
+
+Rebuild of the reference's `metrics` crate facade + exporter setup
+(command/agent.rs:105-130) and the periodic DB collector
+(agent/metrics.rs:8-110).  A process-wide `Registry` holds
+counter/gauge/histogram families; `MetricsServer` serves the Prometheus
+text exposition format over HTTP and, on each scrape, additionally
+samples live agent state (table row counts, buffered changes per actor,
+gap sums, membership, queue depths) — pull-sampling replaces the
+reference's 10 s collector loop with zero steady-state cost.
+
+Histogram buckets default to the reference's latency ladder
+(1 ms … 60 s, command/agent.rs:109-127).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.010, 0.025, 0.050, 0.100, 0.250, 0.500,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labelkey(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+class Counter:
+    kind = "counter"
+
+    def __init__(self):
+        self._values: Dict[_LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = _labelkey(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, **labels) -> float:
+        return self._values.get(_labelkey(labels), 0.0)
+
+    def samples(self, name: str) -> List[str]:
+        return [
+            f"{name}{_fmt_labels(k)} {_fmt_value(v)}"
+            for k, v in sorted(self._values.items())
+        ] or [f"{name} 0"]
+
+
+class Gauge:
+    kind = "gauge"
+
+    def __init__(self):
+        self._values: Dict[_LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._values[_labelkey(labels)] = value
+
+    def add(self, amount: float, **labels):
+        key = _labelkey(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, **labels) -> float:
+        return self._values.get(_labelkey(labels), 0.0)
+
+    def samples(self, name: str) -> List[str]:
+        return [
+            f"{name}{_fmt_labels(k)} {_fmt_value(v)}"
+            for k, v in sorted(self._values.items())
+        ] or [f"{name} 0"]
+
+
+class Histogram:
+    kind = "histogram"
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[_LabelKey, List[int]] = {}
+        self._sums: Dict[_LabelKey, float] = {}
+        self._totals: Dict[_LabelKey, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels):
+        key = _labelkey(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels):
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.monotonic() - self.t0, **labels)
+
+        return _Timer()
+
+    def samples(self, name: str) -> List[str]:
+        out = []
+        for key in sorted(self._counts):
+            counts = self._counts[key]
+            for i, ub in enumerate(self.buckets):
+                lk = key + (("le", _fmt_value(float(ub))),)
+                out.append(f"{name}_bucket{_fmt_labels(lk)} {counts[i]}")
+            lk = key + (("le", "+Inf"),)
+            out.append(f"{name}_bucket{_fmt_labels(lk)} {self._totals[key]}")
+            out.append(f"{name}_sum{_fmt_labels(key)} {_fmt_value(self._sums[key])}")
+            out.append(f"{name}_count{_fmt_labels(key)} {self._totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        return self._get(name, Histogram, lambda: Histogram(buckets))
+
+    def _get(self, name: str, cls, factory=None):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory() if factory else cls()
+                self._metrics[name] = m
+            if not isinstance(m, cls):
+                raise TypeError(f"metric {name} is {type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            lines.append(f"# TYPE {name} {m.kind}")
+            lines.extend(m.samples(name))
+        return "\n".join(lines) + "\n"
+
+
+#: process-wide default registry (the `metrics` crate's global recorder)
+REGISTRY = Registry()
+
+
+class MetricsServer:
+    """Prometheus scrape endpoint: GET /metrics.
+
+    Serves the global registry plus live samples of one agent's state —
+    the reference's periodic collector families (agent/metrics.rs:8-110)
+    computed at scrape time.
+    """
+
+    def __init__(self, agent=None, host: str = "127.0.0.1", port: int = 0,
+                 registry: Registry = REGISTRY):
+        self.agent = agent
+        self.registry = registry
+        self._host, self._port = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._scrape_lock = asyncio.Lock()
+        # file-backed stores have a WAL read_conn usable off-thread; the
+        # in-memory fallback shares the writer conn and must stay on-loop
+        db_path = getattr(getattr(agent, "store", None), "path", None)
+        self._use_thread = bool(db_path) and db_path != ":memory:"
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(
+            self._on_conn, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self.addr
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader, writer):
+        try:
+            async def _read_request():
+                line = await reader.readline()
+                while True:  # drain headers
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                return line
+
+            line = await asyncio.wait_for(_read_request(), timeout=10.0)
+            if not line.startswith(b"GET"):
+                body = b"method not allowed"
+                writer.write(
+                    b"HTTP/1.1 405 Method Not Allowed\r\nContent-Length: "
+                    + str(len(body)).encode() + b"\r\n\r\n" + body
+                )
+            else:
+                if self._use_thread:
+                    # file-backed store: sample on a dedicated RO conn off
+                    # the loop so big count(*) scans can't stall gossip
+                    async with self._scrape_lock:
+                        body = (await asyncio.to_thread(self.render)).encode()
+                else:
+                    body = self.render().encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+                )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def render(self) -> str:
+        out = self.registry.render()
+        if self.agent is not None:
+            out += self._agent_samples()
+        return out
+
+    def _agent_samples(self) -> str:
+        agent = self.agent
+        lines: List[str] = []
+
+        def fam(name, kind, samples):
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(samples)
+
+        # build info (command/agent.rs:40-56)
+        from . import __version__ as _v
+
+        fam("corro_build_info", "gauge", [f'corro_build_info{{version="{_v}"}} 1'])
+
+        # stats dict → counters (facade counters in the reference)
+        statmap = {
+            "changes_committed": "corro_changes_committed",
+            "changes_applied": "corro_changes_applied",
+            "changes_deduped": "corro_changes_deduped",
+            "broadcasts_sent": "corro_broadcast_sent_count",
+            "broadcasts_recv": "corro_broadcast_recv_count",
+            "sync_rounds": "corro_sync_attempts_count",
+            "ingest_dropped": "corro_agent_changes_dropped",
+            "empties_recv": "corro_agent_empties_recv",
+        }
+        for key, name in statmap.items():
+            fam(name, "counter", [f"{name} {agent.stats.get(key, 0)}"])
+
+        # queue depths (channel metrics, corro-types/src/channel.rs)
+        fam(
+            "corro_agent_ingest_queue_len",
+            "gauge",
+            [f"corro_agent_ingest_queue_len {agent._ingest_q.qsize()}"],
+        )
+        fam(
+            "corro_broadcast_pending_count",
+            "gauge",
+            [f"corro_broadcast_pending_count {len(agent._bcast_q)}"],
+        )
+
+        # membership (corro_gossip_members)
+        up = sum(1 for st in agent.members.states.values() if st.is_up)
+        down = len(agent.members.states) - up
+        fam(
+            "corro_gossip_members",
+            "gauge",
+            [f"corro_gossip_members {len(agent.members.states)}"],
+        )
+        fam(
+            "corro_gossip_member_states",
+            "gauge",
+            [
+                f'corro_gossip_member_states{{state="up"}} {up}',
+                f'corro_gossip_member_states{{state="down"}} {down}',
+            ],
+        )
+
+        # db collector (agent/metrics.rs:8-110): table rows, buffered, gaps
+        # — on the RO connection (reference reads via the RO pool)
+        try:
+            conn = agent.store.read_conn
+            rows = []
+            for t in agent.store.tables:
+                (n,) = conn.execute(
+                    f'SELECT count(*) FROM "{t}"'
+                ).fetchone()
+                rows.append(f'corro_db_table_rows_total{{table="{_escape(t)}"}} {n}')
+            fam("corro_db_table_rows_total", "gauge", rows or ["corro_db_table_rows_total 0"])
+            buffered = [
+                f'corro_db_buffered_changes_rows_total{{actor="{r[0].hex()[:12]}"}} {r[1]}'
+                for r in conn.execute(
+                    "SELECT site_id, count(*) FROM __corro_buffered_changes GROUP BY site_id"
+                )
+            ]
+            fam(
+                "corro_db_buffered_changes_rows_total",
+                "gauge",
+                buffered or ["corro_db_buffered_changes_rows_total 0"],
+            )
+            (gapsum,) = conn.execute(
+                "SELECT coalesce(sum(end - start + 1), 0) FROM __corro_bookkeeping_gaps"
+            ).fetchone()
+            fam("corro_db_gaps_versions_total", "gauge", [f"corro_db_gaps_versions_total {gapsum}"])
+        except Exception:
+            pass  # scrape must never fail on a racing schema change
+
+        # lock registry (corro_lock_registry)
+        held = agent.locks.top(100)
+        fam(
+            "corro_lock_registry_held",
+            "gauge",
+            [f"corro_lock_registry_held {len(held)}"],
+        )
+        return "\n".join(lines) + "\n"

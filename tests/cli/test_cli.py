@@ -81,7 +81,7 @@ path = "{admin}"
     else:
         proc.kill()
         raise RuntimeError("agent did not start in 30s")
-    api_addr = line.split("api ")[1].strip()
+    api_addr = line.split("api ")[1].split()[0].strip()
     try:
         yield {"config": str(config), "api": api_addr, "tmp": tmp_path}
     finally:
