@@ -136,10 +136,14 @@ class Agent:
                     self.members.add_member(
                         Actor(id=ActorId(bytes([0] * 15 + [i + 1])), addr=addr, ts=0)
                     )
-        self._tasks.append(asyncio.create_task(self._broadcast_loop()))
-        self._tasks.append(asyncio.create_task(self._ingest_loop()))
-        self._tasks.append(asyncio.create_task(self._sync_loop()))
-        self._tasks.append(asyncio.create_task(self._lock_watchdog()))
+        # counted so wait_for_all_pending_handles can drain them at
+        # shutdown (spawn_counted, spawn/src/lib.rs:17)
+        from ..utils.tripwire import spawn_counted
+
+        self._tasks.append(spawn_counted(self._broadcast_loop(), "broadcast"))
+        self._tasks.append(spawn_counted(self._ingest_loop(), "ingest"))
+        self._tasks.append(spawn_counted(self._sync_loop(), "sync"))
+        self._tasks.append(spawn_counted(self._lock_watchdog(), "lock-watchdog"))
 
     async def _lock_watchdog(self):
         """Warn on long-held critical sections (setup.rs:188-246)."""

@@ -110,18 +110,20 @@ def cmd_agent(args) -> int:
             host, _, port = cfg.prometheus_addr.rpartition(":")
             prom = MetricsServer(agent, host or "127.0.0.1", int(port))
             cfg.prometheus_addr = await prom.start()
+        # first SIGINT/SIGTERM begins graceful shutdown; a second
+        # force-exits (tripwire.rs signal stream).  Armed BEFORE the
+        # "agent running" line so a supervisor reacting to that line
+        # can't beat the handler installation.
+        from ..utils.tripwire import Tripwire, wait_for_all_pending_handles
+
+        tripwire = Tripwire.from_signals(signal.SIGINT, signal.SIGTERM)
         print(
             f"agent running: actor {agent.actor_id.hex()} "
             f"gossip {cfg.gossip_addr} api {cfg.api_addr or '-'} "
             f"pg {cfg.pg_addr or '-'} prometheus {cfg.prometheus_addr or '-'}",
             flush=True,
         )
-        # tripwire analog: first SIGINT/SIGTERM begins graceful shutdown
-        stop = asyncio.Event()
-        loop = asyncio.get_running_loop()
-        for sig in (signal.SIGINT, signal.SIGTERM):
-            loop.add_signal_handler(sig, stop.set)
-        await stop.wait()
+        await tripwire.wait()
         if admin:
             await admin.stop()
         if prom:
@@ -132,6 +134,9 @@ def cmd_agent(args) -> int:
             await api.stop()
         await agent.stop()
         await transport.close()
+        # drain counted background work before exiting
+        # (wait_for_all_pending_handles, spawn/src/lib.rs:117)
+        await wait_for_all_pending_handles(timeout=60.0)
 
     asyncio.run(run())
     return 0

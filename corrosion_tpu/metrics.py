@@ -67,9 +67,10 @@ class Counter:
         return self._values.get(_labelkey(labels), 0.0)
 
     def samples(self, name: str) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
         return [
-            f"{name}{_fmt_labels(k)} {_fmt_value(v)}"
-            for k, v in sorted(self._values.items())
+            f"{name}{_fmt_labels(k)} {_fmt_value(v)}" for k, v in items
         ] or [f"{name} 0"]
 
 
@@ -93,9 +94,10 @@ class Gauge:
         return self._values.get(_labelkey(labels), 0.0)
 
     def samples(self, name: str) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
         return [
-            f"{name}{_fmt_labels(k)} {_fmt_value(v)}"
-            for k, v in sorted(self._values.items())
+            f"{name}{_fmt_labels(k)} {_fmt_value(v)}" for k, v in items
         ] or [f"{name} 0"]
 
 
@@ -133,16 +135,21 @@ class Histogram:
         return _Timer()
 
     def samples(self, name: str) -> List[str]:
+        with self._lock:
+            snap = {
+                k: (list(c), self._sums[k], self._totals[k])
+                for k, c in self._counts.items()
+            }
         out = []
-        for key in sorted(self._counts):
-            counts = self._counts[key]
+        for key in sorted(snap):
+            counts, total_sum, total = snap[key]
             for i, ub in enumerate(self.buckets):
                 lk = key + (("le", _fmt_value(float(ub))),)
                 out.append(f"{name}_bucket{_fmt_labels(lk)} {counts[i]}")
             lk = key + (("le", "+Inf"),)
-            out.append(f"{name}_bucket{_fmt_labels(lk)} {self._totals[key]}")
-            out.append(f"{name}_sum{_fmt_labels(key)} {_fmt_value(self._sums[key])}")
-            out.append(f"{name}_count{_fmt_labels(key)} {self._totals[key]}")
+            out.append(f"{name}_bucket{_fmt_labels(lk)} {total}")
+            out.append(f"{name}_sum{_fmt_labels(key)} {_fmt_value(total_sum)}")
+            out.append(f"{name}_count{_fmt_labels(key)} {total}")
         return out
 
 
@@ -237,15 +244,28 @@ class MetricsServer:
                     + str(len(body)).encode() + b"\r\n\r\n" + body
                 )
             else:
-                if self._use_thread:
-                    # file-backed store: sample on a dedicated RO conn off
-                    # the loop so big count(*) scans can't stall gossip
-                    async with self._scrape_lock:
-                        body = (await asyncio.to_thread(self.render)).encode()
-                else:
-                    body = self.render().encode()
+                try:
+                    # registry + cheap live state: sampled on the loop so
+                    # loop-mutated dicts are never iterated concurrently
+                    out = self.registry.render()
+                    if self.agent is not None:
+                        out += self._agent_live_samples()
+                        if self._use_thread:
+                            # big count(*) scans run on the RO conn off
+                            # the loop so they can't stall gossip
+                            async with self._scrape_lock:
+                                out += await asyncio.to_thread(
+                                    self._agent_db_samples
+                                )
+                        else:
+                            out += self._agent_db_samples()
+                    body = out.encode()
+                    status = b"HTTP/1.1 200 OK\r\n"
+                except Exception:
+                    body = b"scrape failed"
+                    status = b"HTTP/1.1 500 Internal Server Error\r\n"
                 writer.write(
-                    b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                    status + b"Content-Type: text/plain; version=0.0.4\r\n"
                     b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
                 )
             await writer.drain()
@@ -259,12 +279,14 @@ class MetricsServer:
                 pass
 
     def render(self) -> str:
+        """Full inline render (loop-context callers and tests)."""
         out = self.registry.render()
         if self.agent is not None:
-            out += self._agent_samples()
+            out += self._agent_live_samples()
+            out += self._agent_db_samples()
         return out
 
-    def _agent_samples(self) -> str:
+    def _agent_live_samples(self) -> str:
         agent = self.agent
         lines: List[str] = []
 
@@ -320,8 +342,25 @@ class MetricsServer:
             ],
         )
 
-        # db collector (agent/metrics.rs:8-110): table rows, buffered, gaps
-        # — on the RO connection (reference reads via the RO pool)
+        # lock registry (corro_lock_registry)
+        held = agent.locks.top(100)
+        fam(
+            "corro_lock_registry_held",
+            "gauge",
+            [f"corro_lock_registry_held {len(held)}"],
+        )
+        return "\n".join(lines) + "\n"
+
+    def _agent_db_samples(self) -> str:
+        """DB collector families (agent/metrics.rs:8-110): table rows,
+        buffered changes, gap sums — safe to run off-loop on the RO conn."""
+        agent = self.agent
+        lines: List[str] = []
+
+        def fam(name, kind, samples):
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(samples)
+
         try:
             conn = agent.store.read_conn
             rows = []
@@ -348,12 +387,4 @@ class MetricsServer:
             fam("corro_db_gaps_versions_total", "gauge", [f"corro_db_gaps_versions_total {gapsum}"])
         except Exception:
             pass  # scrape must never fail on a racing schema change
-
-        # lock registry (corro_lock_registry)
-        held = agent.locks.top(100)
-        fam(
-            "corro_lock_registry_held",
-            "gauge",
-            [f"corro_lock_registry_held {len(held)}"],
-        )
         return "\n".join(lines) + "\n"

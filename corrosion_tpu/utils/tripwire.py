@@ -1,0 +1,137 @@
+"""Graceful-shutdown plumbing: tripwire signal + counted task drain.
+
+Rebuild of the reference's `tripwire` and `spawn` crates
+(tripwire/src/tripwire.rs:21-100, preempt.rs:12-97, spawn/src/lib.rs:13-134)
+on asyncio primitives:
+
+- ``Tripwire`` — a broadcast shutdown signal any number of tasks can await;
+  ``from_signals()`` arms it on SIGINT/SIGTERM (first signal trips, a
+  second force-exits, matching the reference's double-ctrl-C behavior).
+- ``preemptible(aw, tripwire)`` — race an awaitable against the tripwire;
+  returns ``Outcome.COMPLETED(value)`` or ``Outcome.PREEMPTED`` with the
+  awaitable cancelled (PreemptibleFutureExt).
+- ``spawn_counted`` / ``wait_for_all_pending_handles`` — global counter of
+  in-flight tasks and the shutdown drain loop (600 x 100 ms in the
+  reference; here a deadline with the same default budget).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal as _signal
+from dataclasses import dataclass
+from typing import Any, Awaitable, Optional, Set
+
+
+class Tripwire:
+    """Awaitable, idempotent shutdown signal."""
+
+    def __init__(self):
+        self._event = asyncio.Event()
+
+    def trip(self) -> None:
+        self._event.set()
+
+    @property
+    def is_tripped(self) -> bool:
+        return self._event.is_set()
+
+    async def wait(self) -> None:
+        await self._event.wait()
+
+    def __await__(self):
+        return self._event.wait().__await__()
+
+    @classmethod
+    def from_signals(cls, *signals: int) -> "Tripwire":
+        """Trip on the first OS signal; force-exit on the second
+        (tripwire.rs signal stream + the conventional double-ctrl-C)."""
+        tw = cls()
+        loop = asyncio.get_running_loop()
+        sigs = signals or (_signal.SIGINT, _signal.SIGTERM)
+
+        def _on_signal():
+            if tw.is_tripped:
+                raise SystemExit(1)  # second signal: give up waiting
+            tw.trip()
+
+        for s in sigs:
+            loop.add_signal_handler(s, _on_signal)
+        return tw
+
+
+@dataclass
+class Outcome:
+    """Result of a preemptible await (tripwire's Outcome enum)."""
+
+    preempted: bool
+    value: Any = None
+
+    @classmethod
+    def completed(cls, value) -> "Outcome":
+        return cls(preempted=False, value=value)
+
+    def __bool__(self):  # truthy iff completed
+        return not self.preempted
+
+
+Outcome.PREEMPTED = Outcome(preempted=True)
+
+
+async def preemptible(aw: Awaitable, tripwire: Tripwire) -> Outcome:
+    """Run ``aw`` unless/until the tripwire trips; on preemption the
+    awaitable is cancelled (preempt.rs:83)."""
+    if tripwire.is_tripped:
+        if asyncio.iscoroutine(aw):
+            aw.close()  # never started; avoid the un-awaited warning
+        return Outcome.PREEMPTED
+    task = asyncio.ensure_future(aw)
+    trip_task = asyncio.ensure_future(tripwire.wait())
+    try:
+        done, _ = await asyncio.wait(
+            {task, trip_task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if task in done:
+            return Outcome.completed(task.result())
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+        return Outcome.PREEMPTED
+    finally:
+        trip_task.cancel()
+
+
+# -- counted spawns (spawn/src/lib.rs) ---------------------------------------
+
+_pending: Set[asyncio.Task] = set()
+
+
+def spawn_counted(aw: Awaitable, name: Optional[str] = None) -> asyncio.Task:
+    """Like asyncio.create_task but tracked for the shutdown drain
+    (spawn_counted, spawn/src/lib.rs:17)."""
+    task = asyncio.create_task(aw, name=name)
+    _pending.add(task)
+    task.add_done_callback(_pending.discard)
+    return task
+
+
+def pending_count() -> int:
+    return len(_pending)
+
+
+async def wait_for_all_pending_handles(timeout: float = 60.0) -> bool:
+    """Drain counted tasks at shutdown; True if all finished within the
+    budget (wait_for_all_pending_handles, spawn/src/lib.rs:117: 600 x
+    100 ms)."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while _pending:
+        remaining = deadline - asyncio.get_running_loop().time()
+        if remaining <= 0:
+            return False
+        done, _ = await asyncio.wait(
+            set(_pending), timeout=min(remaining, 0.1)
+        )
+        # loop: newly spawned counted tasks join the drain set too
+    return True

@@ -1,0 +1,104 @@
+"""Tripwire/spawn shutdown plumbing tests (tripwire/src/tripwire.rs,
+spawn/src/lib.rs)."""
+
+import asyncio
+
+from corrosion_tpu.utils.tripwire import (
+    Outcome,
+    Tripwire,
+    pending_count,
+    preemptible,
+    spawn_counted,
+    wait_for_all_pending_handles,
+)
+
+
+def test_preemptible_completes():
+    async def body():
+        tw = Tripwire()
+
+        async def work():
+            return 42
+
+        out = await preemptible(work(), tw)
+        assert out and out.value == 42
+
+    asyncio.run(body())
+
+
+def test_preemptible_preempted_cancels():
+    async def body():
+        tw = Tripwire()
+        cancelled = asyncio.Event()
+
+        async def work():
+            try:
+                await asyncio.sleep(30)
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+
+        async def tripper():
+            await asyncio.sleep(0.01)
+            tw.trip()
+
+        asyncio.create_task(tripper())
+        out = await preemptible(work(), tw)
+        assert out.preempted and not out
+        assert cancelled.is_set()
+
+    asyncio.run(body())
+
+
+def test_already_tripped_short_circuits():
+    async def body():
+        tw = Tripwire()
+        tw.trip()
+        ran = False
+
+        async def work():
+            nonlocal ran
+            ran = True
+
+        out = await preemptible(work(), tw)
+        assert out.preempted
+        # the coroutine was never started but must not leak a warning
+        assert not ran
+
+    asyncio.run(body())
+
+
+def test_counted_drain():
+    async def body():
+        done = []
+
+        async def work(i):
+            await asyncio.sleep(0.02 * i)
+            done.append(i)
+
+        for i in range(3):
+            spawn_counted(work(i))
+        assert pending_count() >= 1
+        ok = await wait_for_all_pending_handles(timeout=5.0)
+        assert ok
+        assert sorted(done) == [0, 1, 2]
+        assert pending_count() == 0
+
+    asyncio.run(body())
+
+
+def test_drain_times_out_on_stuck_task():
+    async def body():
+        async def stuck():
+            await asyncio.sleep(60)
+
+        t = spawn_counted(stuck())
+        ok = await wait_for_all_pending_handles(timeout=0.3)
+        assert not ok
+        t.cancel()
+        try:
+            await t
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(body())
