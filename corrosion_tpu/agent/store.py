@@ -93,6 +93,11 @@ class CrrStore:
                 f"file:{path}?mode=ro", uri=True, check_same_thread=False
             )
             self.read_conn.row_factory = sqlite3.Row
+            # client-facing SQL helpers must exist on the read lane too —
+            # API queries and templates execute there
+            self.read_conn.create_function(
+                "corro_json_contains", 2, _corro_json_contains, deterministic=True
+            )
         else:
             self.read_conn = self.conn  # in-memory: single-conn fallback
 
@@ -107,6 +112,11 @@ class CrrStore:
         c.create_function("crdt_seq", 0, self._next_seq)
         c.create_function(
             "crdt_pk", -1, lambda *vals: encode_pk(vals), deterministic=True
+        )
+        # custom SQL helpers (sqlite-functions/src/lib.rs:5-51): JSON
+        # object-subset match, used by consul-state templates
+        c.create_function(
+            "corro_json_contains", 2, _corro_json_contains, deterministic=True
         )
 
     def _next_seq(self) -> int:
@@ -858,3 +868,17 @@ class CrrStore:
         if self.read_conn is not self.conn:
             self.read_conn.close()
         self.conn.close()
+
+
+def _corro_json_contains(selector: str, obj: str) -> int:
+    """True iff the first JSON value is fully contained in the second:
+    objects match when every selector key exists with a contained value;
+    everything else matches by equality (sqlite-functions/src/lib.rs:34-51).
+    Raises on malformed JSON, like the reference's UserFunctionError."""
+
+    def contains(s, o) -> bool:
+        if isinstance(s, dict) and isinstance(o, dict):
+            return all(k in o and contains(v, o[k]) for k, v in s.items())
+        return s == o
+
+    return 1 if contains(json.loads(selector), json.loads(obj)) else 0

@@ -95,10 +95,32 @@ class SwimRuntime:
 
     async def start(self):
         self._load_members()
+        await self._announce()
+        self._tasks.append(asyncio.create_task(self._probe_loop()))
+        self._tasks.append(asyncio.create_task(self._announcer_loop()))
+
+    async def _announce(self):
+        """Send a join to every bootstrap peer (one place for the
+        payload + self-address filter)."""
         for addr in self.agent.config.bootstrap:
             if addr != self.transport.addr:
                 await self._send(addr, {"k": "join", "me": self._self_member()})
-        self._tasks.append(asyncio.create_task(self._probe_loop()))
+
+    async def _announcer_loop(self):
+        """Re-announce to the bootstrap set with backoff while the node
+        knows no live peers (spawn_swim_announcer, handlers.rs:193-246) —
+        a lone join datagram is lost if the peer isn't up yet."""
+        from ..utils.backoff import Backoff
+
+        backoff = Backoff(min_s=1.0, max_s=15.0)
+        while not self._stopped:
+            await asyncio.sleep(next(backoff))
+            if any(
+                m.status == ALIVE and m.actor_id != self.agent.actor_id
+                for m in self.members.values()
+            ):
+                return  # joined; the probe loop takes over
+            await self._announce()
 
     async def stop(self):
         self._stopped = True
@@ -123,13 +145,7 @@ class SwimRuntime:
         self.incarnation += 1
         me = _decode_member(self._self_member())
         self._disseminate(me)
-        for addr in self.agent.config.bootstrap:
-            if addr != self.transport.addr:
-                self._tasks.append(
-                    asyncio.create_task(
-                        self._send(addr, {"k": "join", "me": self._self_member()})
-                    )
-                )
+        self._tasks.append(asyncio.create_task(self._announce()))
 
     # -- persistence (reference __corro_members) --------------------------
 

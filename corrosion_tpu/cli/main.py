@@ -497,7 +497,38 @@ def build_parser() -> argparse.ArgumentParser:
     sm.add_argument("--nodes", type=int, default=None)
     sm.set_defaults(fn=cmd_sim)
 
+    dc = sp.add_parser(
+        "devcluster", help="spawn a real multi-process cluster from a topology file"
+    )
+    dc.add_argument("topology", help="file of 'A -> B' bootstrap edges")
+    dc.add_argument("--state-dir", required=True, help="per-node state root")
+    dc.add_argument("--schema-dir", required=True, help="schema .sql dir")
+    dc.add_argument("--base-port", type=int, default=0, help="0 = OS-assigned")
+    dc.set_defaults(fn=cmd_devcluster)
+
     return p
+
+
+def cmd_devcluster(args) -> int:
+    """Topology-file-driven multi-process cluster
+    (corro-devcluster/src/main.rs:102-240)."""
+    from ..devcluster import DevCluster, Topology
+
+    topo = Topology.load(args.topology)
+    cluster = DevCluster(
+        topo, args.state_dir, args.schema_dir, base_port=args.base_port
+    )
+    cluster.write_configs()
+    for name, node in cluster.nodes.items():
+        print(f"node {name}: gossip 127.0.0.1:{node.gossip_port} api {node.api_addr}")
+    try:
+        cluster.start()
+        cluster.wait_ready()
+    except BaseException:
+        cluster.stop()  # don't orphan already-spawned agents
+        raise
+    print(f"devcluster up: {len(cluster.nodes)} nodes", flush=True)
+    return cluster.run_forever()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,232 @@
+"""Dev-cluster harness: topology file → real multi-process cluster.
+
+Rebuild of corro-devcluster (corro-devcluster/src/main.rs:102-240): parse
+an ``A -> B`` topology DSL (A bootstraps to B; a bare ``A`` line declares
+a node with no links), generate a per-node state dir + TOML config with
+the bootstrap edges, spawn one real agent process per node (pure
+responders first), tee each node's output to ``<state>/<name>/node.log``,
+and supervise until the first node dies or the caller interrupts.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Topology:
+    """node → outgoing bootstrap links (Simple in the reference)."""
+
+    links: Dict[str, List[str]] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, text: str) -> "Topology":
+        topo = cls()
+        for lineno, raw in enumerate(text.splitlines(), 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "->" in line:
+                # chains are allowed: "A -> B -> C" is the edges A->B, B->C
+                parts = [s.strip() for s in line.split("->")]
+                if not all(parts):
+                    raise ValueError(f"line {lineno}: malformed link {raw!r}")
+                for left, right in zip(parts, parts[1:]):
+                    topo.links.setdefault(left, []).append(right)
+                    topo.links.setdefault(right, [])
+            else:
+                topo.links.setdefault(line, [])
+        if not topo.links:
+            raise ValueError("empty topology")
+        return topo
+
+    @classmethod
+    def load(cls, path: str) -> "Topology":
+        with open(path) as f:
+            return cls.parse(f.read())
+
+    @property
+    def nodes(self) -> List[str]:
+        return sorted(self.links)
+
+
+def generate_config(
+    state_dir: str,
+    schema_dir: str,
+    gossip_port: int,
+    api_port: int,
+    bootstrap: List[str],
+) -> str:
+    """Per-node TOML (generate_config, corro-devcluster/src/main.rs:176-208)."""
+    boots = ", ".join(f'"{b}"' for b in bootstrap)
+    return f"""[db]
+path = "{state_dir}/corrosion.db"
+schema_paths = ["{schema_dir}"]
+
+[gossip]
+addr = "127.0.0.1:{gossip_port}"
+bootstrap = [{boots}]
+
+[api]
+addr = "127.0.0.1:{api_port}"
+
+[admin]
+path = "{state_dir}/admin.sock"
+"""
+
+
+@dataclass
+class Node:
+    name: str
+    state_dir: str
+    gossip_port: int
+    api_port: int
+    proc: Optional[subprocess.Popen] = None
+
+    @property
+    def api_addr(self) -> str:
+        return f"127.0.0.1:{self.api_port}"
+
+
+class DevCluster:
+    def __init__(self, topo: Topology, state_dir: str, schema_dir: str,
+                 base_port: int = 0):
+        self.topo = topo
+        self.state_dir = state_dir
+        self.schema_dir = schema_dir
+        self._base_port = base_port
+        self.nodes: Dict[str, Node] = {}
+
+    def _alloc_ports(self) -> None:
+        import socket
+
+        # hold every probe socket open until ALL ports are assigned —
+        # releasing one early lets the OS hand it to the next bind
+        held: List["socket.socket"] = []
+        try:
+            for i, name in enumerate(self.topo.nodes):
+                if self._base_port:
+                    gp = self._base_port + 2 * i
+                    ap = self._base_port + 2 * i + 1
+                else:
+                    pair = [socket.socket() for _ in range(2)]
+                    for s in pair:
+                        s.bind(("127.0.0.1", 0))
+                    held.extend(pair)
+                    gp, ap = (s.getsockname()[1] for s in pair)
+                self.nodes[name] = Node(
+                    name=name,
+                    state_dir=os.path.join(self.state_dir, name),
+                    gossip_port=gp,
+                    api_port=ap,
+                )
+        finally:
+            for s in held:
+                s.close()
+
+    def write_configs(self) -> None:
+        self._alloc_ports()
+        for name, node in self.nodes.items():
+            os.makedirs(node.state_dir, exist_ok=True)
+            boots = [
+                f"127.0.0.1:{self.nodes[peer].gossip_port}"
+                for peer in self.topo.links[name]
+            ]
+            cfg = generate_config(
+                node.state_dir, self.schema_dir, node.gossip_port,
+                node.api_port, boots,
+            )
+            with open(os.path.join(node.state_dir, "config.toml"), "w") as f:
+                f.write(cfg)
+
+    def start(self, stagger_s: float = 0.25) -> None:
+        """Spawn agents: pure responders (no outgoing links) first
+        (run_simple_topology, main.rs:158-168)."""
+        order = [n for n in self.topo.nodes if not self.topo.links[n]] + [
+            n for n in self.topo.nodes if self.topo.links[n]
+        ]
+        for name in order:
+            node = self.nodes[name]
+            log = open(os.path.join(node.state_dir, "node.log"), "w")
+            node.proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "corrosion_tpu.cli.main",
+                    "-c", os.path.join(node.state_dir, "config.toml"),
+                    "agent",
+                ],
+                stdout=log,
+                stderr=subprocess.STDOUT,
+            )
+            time.sleep(stagger_s)
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        """Block until every node's log announces readiness."""
+        deadline = time.monotonic() + timeout
+        for node in self.nodes.values():
+            logpath = os.path.join(node.state_dir, "node.log")
+            while True:
+                if node.proc and node.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"node {node.name} exited rc={node.proc.returncode}; "
+                        f"see {logpath}"
+                    )
+                try:
+                    with open(logpath) as f:
+                        if "agent running" in f.read():
+                            break
+                except FileNotFoundError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"node {node.name} never became ready")
+                time.sleep(0.05)
+
+    def poll_dead(self) -> Optional[Node]:
+        for node in self.nodes.values():
+            if node.proc and node.proc.poll() is not None:
+                return node
+        return None
+
+    def stop(self, timeout: float = 15.0) -> None:
+        for node in self.nodes.values():
+            if node.proc and node.proc.poll() is None:
+                node.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout
+        for node in self.nodes.values():
+            if node.proc:
+                try:
+                    node.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    node.proc.kill()
+                    node.proc.wait()
+
+    def run_forever(self) -> int:
+        """Supervise until SIGINT/SIGTERM or the first node death."""
+        stop_requested = False
+
+        def _on_term(_sig, _frame):
+            nonlocal stop_requested
+            stop_requested = True
+
+        prev = signal.signal(signal.SIGTERM, _on_term)
+        try:
+            while not stop_requested:
+                dead = self.poll_dead()
+                if dead is not None:
+                    print(
+                        f"node {dead.name} exited rc={dead.proc.returncode}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                time.sleep(0.5)
+            return 0
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            self.stop()

@@ -52,7 +52,12 @@ class Tripwire:
 
         def _on_signal():
             if tw.is_tripped:
-                raise SystemExit(1)  # second signal: give up waiting
+                # second signal: give up waiting NOW.  SystemExit would
+                # still await asyncio.run's task-cancellation cleanup,
+                # which hangs on exactly the stuck task being escaped.
+                import os
+
+                os._exit(1)
             tw.trip()
 
         for s in sigs:

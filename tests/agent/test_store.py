@@ -148,3 +148,29 @@ def test_site_id_persisted(tmp_path):
     s2 = CrrStore(str(tmp_path / "p.db"), ActorId.random())
     assert s2.site_id == sid  # identity survives reboot (doc/crdts.md:42)
     s2.close()
+
+
+def test_corro_json_contains_matrix():
+    """Reference semantics (sqlite-functions/src/lib.rs:70-127): JSON
+    object subset match, on both the writer and read-only connections."""
+    import os
+    import tempfile
+
+    from corrosion_tpu.agent.store import CrrStore
+    from corrosion_tpu.core.types import ActorId
+
+    with tempfile.TemporaryDirectory() as d:
+        store = CrrStore(os.path.join(d, "t.db"), ActorId.random())
+        for conn in (store.conn, store.read_conn):
+            q = lambda a, b: conn.execute(
+                "SELECT corro_json_contains(?, ?)", (a, b)
+            ).fetchone()[0]
+            assert q("{}", "{}") == 1
+            assert q("{}", '{"key": "value"}') == 1
+            assert q('{"key": "value"}', "{}") == 0
+            assert q('{"key": "value"}', '{"key": "value"}') == 1
+            assert q('{"key": "value"}', '{"key": "value", "key2": "value2"}') == 1
+            assert q('{"key": "value"}', '{"key": "wrong value"}') == 0
+            assert q('{"m": {"key": "value"}}', '{"m": {"key": "value"}}') == 1
+            assert q('{"m": {"key": "value"}}', '{"m": {"key": "wrong"}}') == 0
+        store.close()
