@@ -18,6 +18,7 @@ from typing import Dict, Iterable, Optional
 from ..core.bookkeeping import BookedVersions, PartialVersion, VersionsSnapshot
 from ..core.intervals import RangeSet
 from ..core.types import ActorId
+from ..invariants import always
 from .store import CrrStore
 
 
@@ -33,8 +34,13 @@ class SqliteGapsSink:
             "DELETE FROM __corro_bookkeeping_gaps WHERE actor_id = ? AND start = ? AND end = ?",
             (actor_id.bytes_, lo, hi),
         )
-        if cur.rowcount != 1:
-            raise RuntimeError(f"ineffective deletion of gap {lo}..={hi}")
+        # catalog invariant, not a crash: the reference logs in prod and
+        # fails only under the simulator (agent.rs:1129-1133)
+        always(
+            cur.rowcount == 1,
+            "gaps-deleted-effectively",
+            {"lo": lo, "hi": hi, "rowcount": cur.rowcount},
+        )
 
     def insert_gap(self, actor_id: ActorId, lo: int, hi: int) -> None:
         self.conn.execute(

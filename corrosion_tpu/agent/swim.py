@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, TYPE_CHECKING
 
 from ..core.types import Actor, ActorId
+from ..utils.backoff import Backoff
 
 if TYPE_CHECKING:
     from .agent import Agent
@@ -107,11 +108,10 @@ class SwimRuntime:
                 await self._send(addr, {"k": "join", "me": self._self_member()})
 
     async def _announcer_loop(self):
-        """Re-announce to the bootstrap set with backoff while the node
+        """Re-announce to the bootstrap set with backoff whenever the node
         knows no live peers (spawn_swim_announcer, handlers.rs:193-246) —
-        a lone join datagram is lost if the peer isn't up yet."""
-        from ..utils.backoff import Backoff
-
+        a lone join datagram is lost if the peer isn't up yet, and a node
+        whose peers all died must keep trying to rejoin."""
         backoff = Backoff(min_s=1.0, max_s=15.0)
         while not self._stopped:
             await asyncio.sleep(next(backoff))
@@ -119,7 +119,8 @@ class SwimRuntime:
                 m.status == ALIVE and m.actor_id != self.agent.actor_id
                 for m in self.members.values()
             ):
-                return  # joined; the probe loop takes over
+                backoff.reset()  # joined; stay cheap until peers vanish
+                continue
             await self._announce()
 
     async def stop(self):

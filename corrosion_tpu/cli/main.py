@@ -506,6 +506,17 @@ def build_parser() -> argparse.ArgumentParser:
     dc.add_argument("--base-port", type=int, default=0, help="0 = OS-assigned")
     dc.set_defaults(fn=cmd_devcluster)
 
+    lgn = sp.add_parser(
+        "loadgen", help="flood writes + validate subscription consistency"
+    )
+    lgn.add_argument("--write-addr", required=True, help="API addr written to")
+    lgn.add_argument("--read-addr", default=None, help="API addr watched (default: write addr)")
+    lgn.add_argument("--table", default="tests")
+    lgn.add_argument("--writes", type=int, default=100)
+    lgn.add_argument("--rate", type=float, default=200.0)
+    lgn.add_argument("--settle-timeout", type=float, default=30.0)
+    lgn.set_defaults(fn=cmd_loadgen)
+
     return p
 
 
@@ -529,6 +540,23 @@ def cmd_devcluster(args) -> int:
         raise
     print(f"devcluster up: {len(cluster.nodes)} nodes", flush=True)
     return cluster.run_forever()
+
+
+def cmd_loadgen(args) -> int:
+    """Workload driver (.antithesis/client/src/main.rs:65-308): exit 0
+    iff every committed write surfaced on the watched subscription."""
+    from ..loadgen import LoadGenerator
+
+    gen = LoadGenerator(args.write_addr, args.read_addr, table=args.table)
+    report = asyncio.run(
+        gen.run(
+            n_writes=args.writes,
+            rate_hz=args.rate,
+            settle_timeout_s=args.settle_timeout,
+        )
+    )
+    _print_json(report.to_dict())
+    return 0 if report.consistent else 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:

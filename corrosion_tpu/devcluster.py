@@ -153,16 +153,17 @@ class DevCluster:
         ]
         for name in order:
             node = self.nodes[name]
-            log = open(os.path.join(node.state_dir, "node.log"), "w")
-            node.proc = subprocess.Popen(
-                [
-                    sys.executable, "-m", "corrosion_tpu.cli.main",
-                    "-c", os.path.join(node.state_dir, "config.toml"),
-                    "agent",
-                ],
-                stdout=log,
-                stderr=subprocess.STDOUT,
-            )
+            # the child inherits the descriptor; close the parent's copy
+            with open(os.path.join(node.state_dir, "node.log"), "w") as log:
+                node.proc = subprocess.Popen(
+                    [
+                        sys.executable, "-m", "corrosion_tpu.cli.main",
+                        "-c", os.path.join(node.state_dir, "config.toml"),
+                        "agent",
+                    ],
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                )
             time.sleep(stagger_s)
 
     def wait_ready(self, timeout: float = 30.0) -> None:

@@ -1,0 +1,175 @@
+"""Runtime invariant catalog: always / sometimes / unreachable assertions.
+
+Rebuild of the reference's Antithesis assertion catalog (antithesis_sdk
+calls threaded through production code — gap deletion effective
+corro-types/agent.rs:1129-1133, contiguous seq ranges util.rs:1152-1157,
+processing <60 s util.rs:1012-1016, tx-commit unreachable util.rs:846).
+Without the deterministic hypervisor, the catalog itself is the value:
+every assertion self-registers, violations are recorded (and optionally
+raised in strict mode, which the test suite turns on), and the harnesses
+can interrogate coverage — "did every `sometimes` marker fire?" is the
+reference's coverage property, checked by the stress test.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class AssertionState:
+    kind: str  # 'always' | 'sometimes' | 'unreachable'
+    passes: int = 0
+    violations: int = 0
+    last_details: Optional[dict] = None
+
+
+class Catalog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._asserts: Dict[str, AssertionState] = {}
+        self._expected_sometimes: List[str] = []
+        self.strict = False  # raise on violation (tests turn this on)
+        self._listeners: List[Callable[[str, str, Optional[dict]], None]] = []
+
+    def reset(self):
+        with self._lock:
+            self._asserts.clear()
+            for name in self._expected_sometimes:
+                self._state(name, "sometimes")
+
+    def on_violation(self, fn: Callable[[str, str, Optional[dict]], None]):
+        self._listeners.append(fn)
+
+    def _state(self, name: str, kind: str) -> AssertionState:
+        st = self._asserts.get(name)
+        if st is None:
+            st = self._asserts[name] = AssertionState(kind=kind)
+        return st
+
+    def always(self, cond: bool, name: str, details: Optional[dict] = None):
+        """Must hold every time execution reaches it (assert_always)."""
+        with self._lock:
+            st = self._state(name, "always")
+            if cond:
+                st.passes += 1
+                return
+            st.violations += 1
+            st.last_details = details
+        self._violated(name, "always", details)
+
+    def sometimes(self, cond: bool, name: str, details: Optional[dict] = None):
+        """Coverage marker: must hold at least once over a run
+        (assert_sometimes)."""
+        with self._lock:
+            st = self._state(name, "sometimes")
+            if cond:
+                st.passes += 1
+            else:
+                st.last_details = details
+
+    def unreachable(self, name: str, details: Optional[dict] = None):
+        """Execution must never reach this point (assert_unreachable)."""
+        with self._lock:
+            st = self._state(name, "unreachable")
+            st.violations += 1
+            st.last_details = details
+        self._violated(name, "unreachable", details)
+
+    def reachable(self, name: str):
+        """Pre-register an unreachable marker so reports list it."""
+        with self._lock:
+            self._state(name, "unreachable")
+
+    def expect_sometimes(self, *names: str):
+        """Statically pre-register coverage markers so a never-executed
+        site still shows up in unfired_sometimes() — the Antithesis SDK
+        registers assertions at build time for exactly this reason."""
+        with self._lock:
+            for name in names:
+                if name not in self._expected_sometimes:
+                    self._expected_sometimes.append(name)
+                self._state(name, "sometimes")
+
+    def _violated(self, name: str, kind: str, details: Optional[dict]):
+        if not self._listeners:
+            # never silent: the reference logs violations in production
+            import logging
+
+            logging.getLogger("corrosion_tpu.invariants").warning(
+                "invariant %s %r violated: %r", kind, name, details
+            )
+        for fn in self._listeners:
+            fn(name, kind, details)
+        if self.strict:
+            raise InvariantViolation(name, kind, details)
+
+    # -- reporting --------------------------------------------------------
+
+    def violations(self) -> Dict[str, AssertionState]:
+        with self._lock:
+            return {
+                n: st for n, st in self._asserts.items() if st.violations > 0
+            }
+
+    def unfired_sometimes(self) -> List[str]:
+        """Coverage gaps: `sometimes` markers that never held
+        (check the stress test exercised every interesting path)."""
+        with self._lock:
+            return sorted(
+                n
+                for n, st in self._asserts.items()
+                if st.kind == "sometimes" and st.passes == 0
+            )
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                n: {
+                    "kind": st.kind,
+                    "passes": st.passes,
+                    "violations": st.violations,
+                }
+                for n, st in sorted(self._asserts.items())
+            }
+
+
+class InvariantViolation(AssertionError):
+    def __init__(self, name: str, kind: str, details: Optional[dict]):
+        super().__init__(f"invariant {kind} {name!r} violated: {details!r}")
+        self.name = name
+        self.kind = kind
+        self.details = details
+
+
+#: process-wide catalog (the reference's antithesis_sdk global)
+CATALOG = Catalog()
+
+always = CATALOG.always
+sometimes = CATALOG.sometimes
+unreachable = CATALOG.unreachable
+
+
+class Timed:
+    """Bound a critical section's duration (the reference pairs
+    processing-time asserts with a 60 s budget, util.rs:1012-1016)."""
+
+    def __init__(self, name: str, budget_s: float, catalog: Catalog = CATALOG):
+        self.name = name
+        self.budget_s = budget_s
+        self.catalog = catalog
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        elapsed = time.monotonic() - self._t0
+        self.catalog.always(
+            elapsed < self.budget_s,
+            self.name,
+            {"elapsed_s": round(elapsed, 3), "budget_s": self.budget_s},
+        )

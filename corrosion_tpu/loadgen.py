@@ -1,0 +1,167 @@
+"""Workload generator + stream-consistency checker.
+
+Rebuild of the Antithesis rust-load-generator
+(.antithesis/client/src/main.rs:65-308): flood ``/v1/transactions`` with
+inserts, follow the same table through a SQL subscription and the
+``/v1/updates`` feed, and validate that every write eventually appears on
+every watched stream — the "no lost writes" property the reference's
+``eventually_check_db.sh`` / ``check_bookkeeping.py`` checkers assert.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .api.client import ApiClient
+
+
+@dataclass
+class LoadReport:
+    writes_attempted: int = 0
+    writes_ok: int = 0
+    write_errors: int = 0
+    sub_rows_seen: int = 0
+    update_events_seen: int = 0
+    missing_on_sub: List[int] = field(default_factory=list)
+    stream_errors: List[str] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+    @property
+    def consistent(self) -> bool:
+        """No lost writes AND the checker itself stayed attached — a dead
+        watch stream must read as "checker broken", not "writes lost"."""
+        return (
+            self.writes_ok > 0
+            and not self.missing_on_sub
+            and not self.stream_errors
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "writes_attempted": self.writes_attempted,
+            "writes_ok": self.writes_ok,
+            "write_errors": self.write_errors,
+            "sub_rows_seen": self.sub_rows_seen,
+            "update_events_seen": self.update_events_seen,
+            "missing_on_sub": len(self.missing_on_sub),
+            "stream_errors": list(self.stream_errors),
+            "consistent": self.consistent,
+            "elapsed_s": round(self.elapsed_s, 3),
+        }
+
+
+class LoadGenerator:
+    """Drives one table (default the test schema's ``tests``) on a write
+    address while watching a read address (same node or a different one —
+    cross-node watching also validates convergence)."""
+
+    def __init__(
+        self,
+        write_addr: str,
+        read_addr: Optional[str] = None,
+        table: str = "tests",
+        seed: int = 0,
+    ):
+        self.write_client = ApiClient(write_addr)
+        self.read_client = ApiClient(read_addr or write_addr)
+        self.table = table
+        self._rng = random.Random(seed)
+        self._written: Set[int] = set()
+        self._sub_seen: Set[int] = set()
+        self.report = LoadReport()
+
+    async def _writer(self, n_writes: int, rate_hz: float, base_id: int):
+        interval = 1.0 / rate_hz if rate_hz > 0 else 0.0
+        for i in range(n_writes):
+            rowid = base_id + i
+            self.report.writes_attempted += 1
+            try:
+                await self.write_client.execute(
+                    [
+                        [
+                            f"INSERT OR REPLACE INTO {self.table} (id, text) "
+                            "VALUES (?, ?)",
+                            [rowid, f"load-{rowid}"],
+                        ]
+                    ]
+                )
+                self.report.writes_ok += 1
+                self._written.add(rowid)
+            except Exception:
+                self.report.write_errors += 1
+            if interval:
+                await asyncio.sleep(interval * self._rng.uniform(0.5, 1.5))
+
+    async def _subscriber(self, stop: asyncio.Event):
+        try:
+            sub = await self.read_client.subscribe(
+                [f"SELECT id, text FROM {self.table}", []]
+            )
+        except Exception as e:
+            self.report.stream_errors.append(f"subscribe: {e!r}")
+            return
+        try:
+            async for event in sub:
+                if stop.is_set():
+                    break
+                if "row" in event:
+                    self._sub_seen.add(event["row"][1][0])
+                    self.report.sub_rows_seen += 1
+                elif "change" in event:
+                    self._sub_seen.add(event["change"][2][0])
+                    self.report.sub_rows_seen += 1
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            self.report.stream_errors.append(f"subscription: {e!r}")
+        finally:
+            sub.close()
+
+    async def _updates_watcher(self, stop: asyncio.Event):
+        try:
+            stream = await self.read_client.updates(self.table)
+        except Exception as e:
+            self.report.stream_errors.append(f"updates attach: {e!r}")
+            return
+        try:
+            async for _event in stream:
+                if stop.is_set():
+                    break
+                self.report.update_events_seen += 1
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            self.report.stream_errors.append(f"updates: {e!r}")
+        finally:
+            stream.close()
+
+    async def run(
+        self,
+        n_writes: int = 100,
+        rate_hz: float = 200.0,
+        settle_timeout_s: float = 30.0,
+        base_id: int = 1_000_000,
+    ) -> LoadReport:
+        t0 = time.monotonic()
+        stop = asyncio.Event()
+        sub_task = asyncio.create_task(self._subscriber(stop))
+        upd_task = asyncio.create_task(self._updates_watcher(stop))
+        await asyncio.sleep(0.2)  # streams attached before the flood
+        await self._writer(n_writes, rate_hz, base_id)
+        # eventually: every committed write visible on the subscription
+        deadline = time.monotonic() + settle_timeout_s
+        while time.monotonic() < deadline:
+            if self._written <= self._sub_seen:
+                break
+            await asyncio.sleep(0.2)
+        self.report.missing_on_sub = sorted(self._written - self._sub_seen)
+        stop.set()
+        for t in (sub_task, upd_task):
+            t.cancel()
+        await asyncio.gather(sub_task, upd_task, return_exceptions=True)
+        self.report.elapsed_s = time.monotonic() - t0
+        return self.report

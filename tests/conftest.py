@@ -23,3 +23,9 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# invariant violations in the suite are bugs, not warnings: strict mode
+# raises (the reference fails these under its deterministic simulator)
+from corrosion_tpu.invariants import CATALOG  # noqa: E402
+
+CATALOG.strict = True
