@@ -154,6 +154,18 @@ class Agent:
         self._tasks.append(spawn_counted(self._ingest_loop(), "ingest"))
         self._tasks.append(spawn_counted(self._sync_loop(), "sync"))
         self._tasks.append(spawn_counted(self._lock_watchdog(), "lock-watchdog"))
+        from .maintenance import db_maintenance_loop
+
+        # (no-op for in-memory stores — the loop gates itself)
+        self._tasks.append(
+            spawn_counted(
+                db_maintenance_loop(
+                    self,
+                    interval_s=self.config.perf.db_maintenance_interval_s,
+                ),
+                "db-maintenance",
+            )
+        )
 
     async def _lock_watchdog(self):
         """Warn on long-held critical sections (setup.rs:188-246)."""

@@ -44,6 +44,12 @@ class PerfConfig:
     swim_max_transmissions: int = 10
     swim_max_packet_size: int = 1178
     swim_down_gc_s: float = 48 * 3600.0
+    # db maintenance (handlers.rs:470-540, config.rs PerfConfig wal)
+    wal_threshold_bytes: int = 10 * 1024 * 1024
+    db_maintenance_interval_s: float = 300.0
+    # statement interruption (sqlite-pool/src/lib.rs:116)
+    statement_timeout_s: float = 30.0
+    slow_query_warn_s: float = 1.0
 
 
 @dataclass

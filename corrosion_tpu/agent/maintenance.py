@@ -1,0 +1,129 @@
+"""DB maintenance: WAL checkpoint/truncate + incremental vacuum.
+
+Rebuild of spawn_handle_db_maintenance (corro-agent/src/agent/
+handlers.rs:372-540): an initial WAL truncate at boot, then a periodic
+loop that (a) runs ``PRAGMA incremental_vacuum`` whenever the freelist
+exceeds a page budget and (b) truncates the WAL whenever the ``-wal``
+file outgrows a byte threshold — with a raised busy timeout when it has
+grown far past it (the reference escalates to the write conn at 5x).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import TYPE_CHECKING, Optional
+
+from ..invariants import sometimes
+from ..metrics import REGISTRY
+
+if TYPE_CHECKING:
+    from .agent import Agent
+
+log = logging.getLogger("corrosion_tpu.maintenance")
+
+_wal_hist = REGISTRY.histogram("corro_db_wal_truncate_seconds")
+_wal_busy = REGISTRY.counter("corro_db_wal_truncate_busy")
+
+MAX_DB_FREE_PAGES = 10_000
+VACUUM_CHUNK_PAGES = 1_000
+
+
+def wal_checkpoint_truncate(conn, busy_timeout_ms: int = 1_000) -> bool:
+    """PRAGMA wal_checkpoint(TRUNCATE) with a temporary busy timeout
+    (wal_checkpoint, handlers.rs:372-392).  True if the WAL truncated."""
+    t0 = time.monotonic()
+    (orig,) = conn.execute("PRAGMA busy_timeout").fetchone()
+    conn.execute(f"PRAGMA busy_timeout = {busy_timeout_ms}")
+    try:
+        busy, _log_pages, _ckpt_pages = conn.execute(
+            "PRAGMA wal_checkpoint(TRUNCATE)"
+        ).fetchone()
+    finally:
+        conn.execute(f"PRAGMA busy_timeout = {orig}")
+    sometimes(not busy, "wal-truncated")
+    if busy:
+        log.warning(
+            "could not truncate sqlite WAL, database busy "
+            "(timeout %d ms)", busy_timeout_ms,
+        )
+        _wal_busy.inc()
+        return False
+    _wal_hist.observe(time.monotonic() - t0)
+    return True
+
+
+def vacuum_db(store, max_free_pages: int = MAX_DB_FREE_PAGES) -> int:
+    """Incremental-vacuum until the freelist drops below the budget
+    (vacuum_db, handlers.rs:396-468).  Returns pages reclaimed.
+    No-op (silent — callers warn once) unless auto_vacuum=INCREMENTAL."""
+    conn = store.conn
+    (mode,) = conn.execute("PRAGMA auto_vacuum").fetchone()
+    if mode != 2:
+        return 0
+    (freelist,) = conn.execute("PRAGMA freelist_count").fetchone()
+    reclaimed = 0
+    while freelist > max_free_pages:
+        # chunked so the write lane is never held long (the reference
+        # vacuums N pages per txn for the same reason)
+        with store._lock:
+            conn.execute(f"PRAGMA incremental_vacuum({VACUUM_CHUNK_PAGES})")
+        (now_free,) = conn.execute("PRAGMA freelist_count").fetchone()
+        if now_free >= freelist:
+            break  # no progress; don't spin
+        reclaimed += freelist - now_free
+        freelist = now_free
+    return reclaimed
+
+
+async def db_maintenance_loop(
+    agent: "Agent",
+    interval_s: float = 300.0,
+    initial_delay_s: float = 60.0,
+) -> None:
+    """spawn_handle_db_maintenance (handlers.rs:470-540): initial WAL
+    truncate, then periodic vacuum + threshold-triggered truncation."""
+    store = agent.store
+    if store.path in (":memory:", ""):
+        return
+    wal_path = store.path + "-wal"
+    threshold = agent.config.perf.wal_threshold_bytes
+
+    # checkpoints run in a worker thread (never on the loop — a 5 s busy
+    # wait would stall gossip); write_sema keeps async writers out, and
+    # SQLite's serialized mode handles any concurrent loop-side read.
+    try:
+        async with agent.write_sema:
+            await asyncio.to_thread(wal_checkpoint_truncate, store.conn)
+    except Exception as e:
+        log.error("could not initially truncate WAL: %s", e)
+
+    (mode,) = store.conn.execute("PRAGMA auto_vacuum").fetchone()
+    if mode != 2:
+        log.warning("auto_vacuum isn't set to INCREMENTAL; vacuums disabled")
+
+    # the reference sleeps 60 s first to give the node time to sync
+    await asyncio.sleep(initial_delay_s)
+    while not agent._stopped.is_set():
+        try:
+            await asyncio.to_thread(vacuum_db, store)
+        except Exception as e:
+            log.error("could not check freelist and vacuum: %s", e)
+        try:
+            wal_size = os.path.getsize(wal_path) if os.path.exists(wal_path) else 0
+            if wal_size > threshold:
+                # far past threshold: wait longer for stragglers (the
+                # reference escalates to the write conn at 5x)
+                busy_ms = 5_000 if wal_size > 5 * threshold else 1_000
+                async with agent.write_sema:
+                    await asyncio.to_thread(
+                        wal_checkpoint_truncate, store.conn, busy_ms
+                    )
+        except Exception as e:
+            log.error("could not wal_checkpoint truncate: %s", e)
+        try:
+            await asyncio.wait_for(agent._stopped.wait(), timeout=interval_s)
+        except asyncio.TimeoutError:
+            pass

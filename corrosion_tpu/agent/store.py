@@ -25,8 +25,11 @@ seq = ordinal of the column change inside the transaction.
 from __future__ import annotations
 
 import json
+import logging
 import sqlite3
 import threading
+import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -74,6 +77,9 @@ class CrrStore:
         self.clock = clock or HLC()
         self.conn = sqlite3.connect(path, check_same_thread=False, isolation_level=None)
         self.conn.row_factory = sqlite3.Row
+        # before any table exists (setup.rs:84-93); a pre-existing DB in
+        # another mode stays there until a manual VACUUM
+        self.conn.execute("PRAGMA auto_vacuum = INCREMENTAL")
         self.conn.execute("PRAGMA journal_mode = WAL")
         self.conn.execute("PRAGMA synchronous = NORMAL")
         self._lock = threading.RLock()  # the ONE writer lane (agent.rs:97 write_sema)
@@ -521,6 +527,38 @@ class CrrStore:
             pass  # no tx active (e.g. BEGIN itself failed)
 
     # -- reads ------------------------------------------------------------
+
+    @contextmanager
+    def interruptible_read(
+        self,
+        timeout_s: Optional[float] = None,
+        slow_warn_s: Optional[float] = 1.0,
+        label: str = "",
+    ):
+        """Bound a read on ``read_conn``: a timer fires
+        ``sqlite3_interrupt`` at the deadline (InterruptibleStatement,
+        sqlite-pool/src/lib.rs:116,259) and statements at/over the slow
+        threshold warn (the trace_v2 PROFILE hook, sqlite.rs:51-61).
+
+        Interruption aborts every in-flight statement on ``read_conn`` —
+        the reference avoids that with a 20-conn RO pool; here slow
+        victims see the same 'interrupted' error and simply retry."""
+        timer: Optional[threading.Timer] = None
+        if timeout_s is not None and self.read_conn is not self.conn:
+            timer = threading.Timer(timeout_s, self.read_conn.interrupt)
+            timer.daemon = True
+            timer.start()
+        t0 = time.monotonic()
+        try:
+            yield self.read_conn
+        finally:
+            if timer is not None:
+                timer.cancel()
+            elapsed = time.monotonic() - t0
+            if slow_warn_s is not None and elapsed >= slow_warn_s:
+                logging.getLogger("corrosion_tpu.store").warning(
+                    "slow query (%.2fs): %s", elapsed, label[:200]
+                )
 
     def query(self, sql: str, params: Sequence[SqliteValue] = ()) -> List[sqlite3.Row]:
         return self.conn.execute(sql, tuple(params)).fetchall()

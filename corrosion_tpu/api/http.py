@@ -201,20 +201,38 @@ class ApiServer:
         sql, params = _parse_statement(stmt)
         import time
 
+        perf = self.agent.config.perf
         t0 = time.monotonic()
-        # errors before the stream starts surface as a normal HTTP error
-        cur = self.agent.store.read_conn.execute(sql, tuple(params))
-        cols = [d[0] for d in cur.description] if cur.description else []
+        # the timeout bounds SQLite work only — rows are fetched inside the
+        # window and streamed after it, so a slow CLIENT can't trip the
+        # statement interrupt (the reference's per-statement timeout wraps
+        # execution on a pooled RO conn, not the network write)
+        with self.agent.store.interruptible_read(
+            timeout_s=perf.statement_timeout_s,
+            slow_warn_s=perf.slow_query_warn_s,
+            label=sql,
+        ) as conn:
+            # errors before the stream starts surface as a normal HTTP error
+            cur = conn.execute(sql, tuple(params))
+            cols = [d[0] for d in cur.description] if cur.description else []
+            try:
+                rows = cur.fetchall()
+                fetch_err = None
+            except Exception as e:  # incl. 'interrupted' at the deadline
+                rows, fetch_err = [], e
         await _start_ndjson(writer)
         try:
             await _send_ndjson(writer, {"columns": cols})
-            for i, row in enumerate(cur):
+            for i, row in enumerate(rows):
                 await _send_ndjson(writer, {"row": [i + 1, _json_row(row)]})
-            await _send_ndjson(writer, {"eoq": {"time": time.monotonic() - t0}})
+            if fetch_err is not None:
+                await _send_ndjson(writer, {"error": str(fetch_err)})
+            else:
+                await _send_ndjson(
+                    writer, {"eoq": {"time": time.monotonic() - t0}}
+                )
         except ConnectionError:
             raise
-        except Exception as e:  # mid-iteration SQLite errors
-            await _send_ndjson(writer, {"error": str(e)})
         finally:
             await _end_ndjson(writer)
 
