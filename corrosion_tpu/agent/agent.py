@@ -567,12 +567,23 @@ class Agent:
         return sum(r for r in results if isinstance(r, int))
 
     async def _sync_with(self, addr: str, timeout: float = 30.0) -> int:
+        from ..tracing import span
+
+        with span("parallel_sync", peer=addr) as sp:
+            return await self._sync_with_traced(addr, timeout, sp)
+
+    async def _sync_with_traced(self, addr: str, timeout: float, sp) -> int:
         ours = self.sync_state()
         bi = await self.transport.open_bi(addr)
         try:
+            # trace context rides the handshake so the trace spans both
+            # ends (SyncTraceContextV1, peer/mod.rs:1019-1022)
             await bi.send(
                 codec.encode_message(
-                    "sync_start", codec.encode_sync_state(ours), ts=self.clock.now()
+                    "sync_start",
+                    codec.encode_sync_state(ours),
+                    ts=self.clock.now(),
+                    trace={"traceparent": sp.context.traceparent()},
                 )
             )
             frame = await bi.recv(timeout)
@@ -606,6 +617,7 @@ class Agent:
                     cs = codec.decode_changeset(body)
                     await self._enqueue_changeset(cs, ChangeSource.SYNC)
                     count += 1
+            sp.set_attribute("changesets", count)
             return count
         finally:
             bi.close()
@@ -621,37 +633,51 @@ class Agent:
             frame = await bi.recv(30.0)
             if not frame:
                 return
-            kind, body, ts = codec.decode_message(frame)
+            kind, body, ts, tr = codec.decode_message_tr(frame)
             if kind != "sync_start":
                 return
-            if ts is not None:
-                try:
-                    self.clock.update(ts)
-                except ClockDriftError:
-                    return
-            await bi.send(
-                codec.encode_message(
-                    "sync_state",
-                    codec.encode_sync_state(self.sync_state()),
-                    ts=self.clock.now(),
-                )
+            # continue the client's trace (serve_sync extraction,
+            # peer/mod.rs:1415-1417)
+            from ..tracing import extract, span
+
+            # a malformed carrier from a peer must never break sync
+            remote = (
+                extract(tr.get("traceparent"), tr.get("tracestate", ""))
+                if isinstance(tr, dict)
+                else None
             )
-            frame = await bi.recv(30.0)
-            if not frame:
-                return
-            kind, body, _ = codec.decode_message(frame)
-            if kind != "sync_request" or not body:
-                return
-            needs = codec.decode_needs(body)
-            for actor_id, need_list in needs.items():
-                for need in need_list:
-                    await self._serve_need(bi, actor_id, need)
-            await bi.send(codec.encode_message("sync_done", None))
+            with span("serve_sync", parent=remote, peer=src):
+                await self._serve_sync_traced(bi, ts)
         except ConnectionError:
             pass
         finally:
             self._sync_inbound -= 1
             bi.close()
+
+    async def _serve_sync_traced(self, bi: BiStream, ts: Optional[int]):
+        if ts is not None:
+            try:
+                self.clock.update(ts)
+            except ClockDriftError:
+                return
+        await bi.send(
+            codec.encode_message(
+                "sync_state",
+                codec.encode_sync_state(self.sync_state()),
+                ts=self.clock.now(),
+            )
+        )
+        frame = await bi.recv(30.0)
+        if not frame:
+            return
+        kind, body, _ = codec.decode_message(frame)
+        if kind != "sync_request" or not body:
+            return
+        needs = codec.decode_needs(body)
+        for actor_id, need_list in needs.items():
+            for need in need_list:
+                await self._serve_need(bi, actor_id, need)
+        await bi.send(codec.encode_message("sync_done", None))
 
     async def _serve_need(self, bi: BiStream, actor_id: ActorId, need: SyncNeed):
         """handle_need (peer/mod.rs:371-790): stream chunked changesets,

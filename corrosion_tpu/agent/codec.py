@@ -137,13 +137,30 @@ def decode_needs(d: dict) -> Dict[ActorId, List[SyncNeed]]:
     return out
 
 
-def encode_message(kind: str, body: Any, ts: Optional[int] = None) -> bytes:
-    """One framed gossip message: {"t": kind, "ts": clock, "b": body}."""
-    return json.dumps(
-        {"t": kind, "ts": ts, "b": body}, separators=(",", ":")
-    ).encode("utf-8")
+def encode_message(
+    kind: str,
+    body: Any,
+    ts: Optional[int] = None,
+    trace: Optional[dict] = None,
+) -> bytes:
+    """One framed gossip message: {"t": kind, "ts": clock, "b": body}.
+    ``trace`` adds an optional "tr" carrier — the SyncTraceContextV1
+    {traceparent, tracestate} riding the sync handshake
+    (corro-types/src/sync.rs:33-67)."""
+    env = {"t": kind, "ts": ts, "b": body}
+    if trace:
+        env["tr"] = trace
+    return json.dumps(env, separators=(",", ":")).encode("utf-8")
 
 
 def decode_message(data: bytes) -> Tuple[str, Any, Optional[int]]:
+    return decode_message_tr(data)[:3]
+
+
+def decode_message_tr(
+    data: bytes,
+) -> Tuple[str, Any, Optional[int], Optional[dict]]:
+    """decode_message plus the optional trace carrier (serve_sync's
+    extraction side, peer/mod.rs:1415-1417)."""
     d = json.loads(data)
-    return d["t"], d.get("b"), d.get("ts")
+    return d["t"], d.get("b"), d.get("ts"), d.get("tr")

@@ -31,18 +31,26 @@ MAX_DB_FREE_PAGES = 10_000
 VACUUM_CHUNK_PAGES = 1_000
 
 
-def wal_checkpoint_truncate(conn, busy_timeout_ms: int = 1_000) -> bool:
+def wal_checkpoint_truncate(store, busy_timeout_ms: int = 1_000) -> bool:
     """PRAGMA wal_checkpoint(TRUNCATE) with a temporary busy timeout
-    (wal_checkpoint, handlers.rs:372-392).  True if the WAL truncated."""
-    t0 = time.monotonic()
-    (orig,) = conn.execute("PRAGMA busy_timeout").fetchone()
-    conn.execute(f"PRAGMA busy_timeout = {busy_timeout_ms}")
-    try:
-        busy, _log_pages, _ckpt_pages = conn.execute(
-            "PRAGMA wal_checkpoint(TRUNCATE)"
-        ).fetchone()
-    finally:
-        conn.execute(f"PRAGMA busy_timeout = {orig}")
+    (wal_checkpoint, handlers.rs:372-392).  True if the WAL truncated.
+
+    Runs under the store's writer lock: this executes on a worker thread,
+    and without the lock a concurrent ``store.close()`` would close the
+    connection out from under the C call (segfault)."""
+    with store._lock:
+        if store._closed:
+            return False
+        conn = store.conn
+        t0 = time.monotonic()
+        (orig,) = conn.execute("PRAGMA busy_timeout").fetchone()
+        conn.execute(f"PRAGMA busy_timeout = {busy_timeout_ms}")
+        try:
+            busy, _log_pages, _ckpt_pages = conn.execute(
+                "PRAGMA wal_checkpoint(TRUNCATE)"
+            ).fetchone()
+        finally:
+            conn.execute(f"PRAGMA busy_timeout = {orig}")
     sometimes(not busy, "wal-truncated")
     if busy:
         log.warning(
@@ -55,22 +63,59 @@ def wal_checkpoint_truncate(conn, busy_timeout_ms: int = 1_000) -> bool:
     return True
 
 
+def _vacuum_enabled(conn) -> bool:
+    (mode,) = conn.execute("PRAGMA auto_vacuum").fetchone()
+    return mode == 2
+
+
+def _freelist(conn) -> int:
+    (n,) = conn.execute("PRAGMA freelist_count").fetchone()
+    return n
+
+
+def _vacuum_chunk(store, pages: int) -> None:
+    # chunked so the write lane is never held long (the reference
+    # vacuums N pages per txn for the same reason)
+    with store._lock:
+        if store._closed:
+            return
+        store.conn.execute(f"PRAGMA incremental_vacuum({pages})")
+
+
 def vacuum_db(store, max_free_pages: int = MAX_DB_FREE_PAGES) -> int:
     """Incremental-vacuum until the freelist drops below the budget
     (vacuum_db, handlers.rs:396-468).  Returns pages reclaimed.
-    No-op (silent — callers warn once) unless auto_vacuum=INCREMENTAL."""
-    conn = store.conn
-    (mode,) = conn.execute("PRAGMA auto_vacuum").fetchone()
-    if mode != 2:
+    No-op (silent — callers warn once) unless auto_vacuum=INCREMENTAL.
+    Synchronous variant for tools/tests; the agent loop drives the same
+    primitives via vacuum_db_async."""
+    if not _vacuum_enabled(store.conn):
         return 0
-    (freelist,) = conn.execute("PRAGMA freelist_count").fetchone()
     reclaimed = 0
+    freelist = _freelist(store.conn)
     while freelist > max_free_pages:
-        # chunked so the write lane is never held long (the reference
-        # vacuums N pages per txn for the same reason)
-        with store._lock:
-            conn.execute(f"PRAGMA incremental_vacuum({VACUUM_CHUNK_PAGES})")
-        (now_free,) = conn.execute("PRAGMA freelist_count").fetchone()
+        _vacuum_chunk(store, VACUUM_CHUNK_PAGES)
+        now_free = _freelist(store.conn)
+        if now_free >= freelist:
+            break  # no progress; don't spin
+        reclaimed += freelist - now_free
+        freelist = now_free
+    return reclaimed
+
+
+async def vacuum_db_async(agent: "Agent", max_free_pages: int = MAX_DB_FREE_PAGES) -> int:
+    """vacuum_db's loop with each chunk run off-loop under the agent
+    write semaphore — the vacuum must never execute inside someone
+    else's open write transaction on the shared connection (the
+    reference vacuums on the pooled low-priority write conn)."""
+    store = agent.store
+    if not _vacuum_enabled(store.conn):
+        return 0
+    reclaimed = 0
+    freelist = _freelist(store.conn)
+    while freelist > max_free_pages and not agent._stopped.is_set():
+        async with agent.write_sema:
+            await asyncio.to_thread(_vacuum_chunk, store, VACUUM_CHUNK_PAGES)
+        now_free = _freelist(store.conn)
         if now_free >= freelist:
             break  # no progress; don't spin
         reclaimed += freelist - now_free
@@ -96,7 +141,7 @@ async def db_maintenance_loop(
     # SQLite's serialized mode handles any concurrent loop-side read.
     try:
         async with agent.write_sema:
-            await asyncio.to_thread(wal_checkpoint_truncate, store.conn)
+            await asyncio.to_thread(wal_checkpoint_truncate, store)
     except Exception as e:
         log.error("could not initially truncate WAL: %s", e)
 
@@ -108,7 +153,7 @@ async def db_maintenance_loop(
     await asyncio.sleep(initial_delay_s)
     while not agent._stopped.is_set():
         try:
-            await asyncio.to_thread(vacuum_db, store)
+            await vacuum_db_async(agent)
         except Exception as e:
             log.error("could not check freelist and vacuum: %s", e)
         try:
@@ -119,7 +164,7 @@ async def db_maintenance_loop(
                 busy_ms = 5_000 if wal_size > 5 * threshold else 1_000
                 async with agent.write_sema:
                     await asyncio.to_thread(
-                        wal_checkpoint_truncate, store.conn, busy_ms
+                        wal_checkpoint_truncate, store, busy_ms
                     )
         except Exception as e:
             log.error("could not wal_checkpoint truncate: %s", e)

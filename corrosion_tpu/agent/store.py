@@ -83,6 +83,7 @@ class CrrStore:
         self.conn.execute("PRAGMA journal_mode = WAL")
         self.conn.execute("PRAGMA synchronous = NORMAL")
         self._lock = threading.RLock()  # the ONE writer lane (agent.rs:97 write_sema)
+        self._closed = False  # guards maintenance threads vs close()
         self._tables: Dict[str, TableInfo] = {}
         self._applying = False
         self._pending_dbv = 0
@@ -535,7 +536,7 @@ class CrrStore:
         slow_warn_s: Optional[float] = 1.0,
         label: str = "",
     ):
-        """Bound a read on ``read_conn``: a timer fires
+        """Bound a read on ``read_conn``: a shared watchdog fires
         ``sqlite3_interrupt`` at the deadline (InterruptibleStatement,
         sqlite-pool/src/lib.rs:116,259) and statements at/over the slow
         threshold warn (the trace_v2 PROFILE hook, sqlite.rs:51-61).
@@ -543,17 +544,15 @@ class CrrStore:
         Interruption aborts every in-flight statement on ``read_conn`` —
         the reference avoids that with a 20-conn RO pool; here slow
         victims see the same 'interrupted' error and simply retry."""
-        timer: Optional[threading.Timer] = None
+        handle = None
         if timeout_s is not None and self.read_conn is not self.conn:
-            timer = threading.Timer(timeout_s, self.read_conn.interrupt)
-            timer.daemon = True
-            timer.start()
+            handle = _watchdog().schedule(self.read_conn, timeout_s)
         t0 = time.monotonic()
         try:
             yield self.read_conn
         finally:
-            if timer is not None:
-                timer.cancel()
+            if handle is not None:
+                handle.cancel()
             elapsed = time.monotonic() - t0
             if slow_warn_s is not None and elapsed >= slow_warn_s:
                 logging.getLogger("corrosion_tpu.store").warning(
@@ -903,9 +902,14 @@ class CrrStore:
         )
 
     def close(self):
-        if self.read_conn is not self.conn:
-            self.read_conn.close()
-        self.conn.close()
+        # taken under the writer lock: a maintenance thread mid-checkpoint
+        # holds _lock, so close waits instead of yanking the conn from
+        # under a C call (observed segfault); late threads see _closed
+        with self._lock:
+            self._closed = True
+            if self.read_conn is not self.conn:
+                self.read_conn.close()
+            self.conn.close()
 
 
 def _corro_json_contains(selector: str, obj: str) -> int:
@@ -920,3 +924,83 @@ def _corro_json_contains(selector: str, obj: str) -> int:
         return s == o
 
     return 1 if contains(json.loads(selector), json.loads(obj)) else 0
+
+
+class _Handle:
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
+
+
+class _InterruptWatchdog:
+    """One daemon thread serving every statement deadline in the process
+    (replaces a per-query threading.Timer — the hot read path must not
+    create an OS thread per request)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._entries: list = []  # heap of (deadline, seq, conn, handle)
+        self._seq = 0  # tiebreaker: conns aren't comparable
+        self._thread: Optional[threading.Thread] = None
+
+    def schedule(self, conn, timeout_s: float) -> _Handle:
+        import heapq
+
+        handle = _Handle()
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(self._entries, (deadline, self._seq, conn, handle))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="sqlite-interrupt-watchdog"
+                )
+                self._thread.start()
+            self._cond.notify()
+        return handle
+
+    def _run(self):
+        import heapq
+
+        with self._cond:
+            while True:
+                while self._entries:
+                    deadline, _tie, conn, handle = self._entries[0]
+                    now = time.monotonic()
+                    if handle.cancelled:
+                        heapq.heappop(self._entries)
+                        continue
+                    if deadline <= now:
+                        heapq.heappop(self._entries)
+                        try:
+                            conn.interrupt()
+                        except Exception:
+                            pass  # conn may be closed already
+                        continue
+                    self._cond.wait(timeout=deadline - now)
+                    break
+                else:
+                    # idle: park until new work (bounded so a dead store
+                    # doesn't pin the thread forever).  _thread is cleared
+                    # under the lock BEFORE returning so a concurrent
+                    # schedule() either sees it None (starts a fresh
+                    # thread) or got its entry in while we still loop.
+                    if not self._cond.wait(timeout=60.0) and not self._entries:
+                        self._thread = None
+                        return
+
+
+_WATCHDOG: Optional[_InterruptWatchdog] = None
+_WATCHDOG_LOCK = threading.Lock()
+
+
+def _watchdog() -> _InterruptWatchdog:
+    global _WATCHDOG
+    with _WATCHDOG_LOCK:
+        if _WATCHDOG is None:
+            _WATCHDOG = _InterruptWatchdog()
+        return _WATCHDOG

@@ -203,36 +203,46 @@ class ApiServer:
 
         perf = self.agent.config.perf
         t0 = time.monotonic()
-        # the timeout bounds SQLite work only — rows are fetched inside the
-        # window and streamed after it, so a slow CLIENT can't trip the
-        # statement interrupt (the reference's per-statement timeout wraps
-        # execution on a pooled RO conn, not the network write)
-        with self.agent.store.interruptible_read(
+        store = self.agent.store
+        # rows stream lazily in batches; each BATCH of SQLite work gets its
+        # own interrupt window, so the timeout bounds database time while
+        # network writes to a slow client never count against it (the
+        # reference's per-statement timeout wraps execution on a pooled RO
+        # conn, not the network write) — and memory stays O(batch)
+        import asyncio as _asyncio
+
+        with store.interruptible_read(
             timeout_s=perf.statement_timeout_s,
             slow_warn_s=perf.slow_query_warn_s,
             label=sql,
         ) as conn:
-            # errors before the stream starts surface as a normal HTTP error
-            cur = conn.execute(sql, tuple(params))
+            # errors before the stream starts surface as a normal HTTP
+            # error; execution runs off-loop so an expensive first step
+            # can't stall gossip for up to the statement timeout
+            cur = await _asyncio.to_thread(conn.execute, sql, tuple(params))
             cols = [d[0] for d in cur.description] if cur.description else []
-            try:
-                rows = cur.fetchall()
-                fetch_err = None
-            except Exception as e:  # incl. 'interrupted' at the deadline
-                rows, fetch_err = [], e
         await _start_ndjson(writer)
+        i = 0
         try:
             await _send_ndjson(writer, {"columns": cols})
-            for i, row in enumerate(rows):
-                await _send_ndjson(writer, {"row": [i + 1, _json_row(row)]})
-            if fetch_err is not None:
-                await _send_ndjson(writer, {"error": str(fetch_err)})
-            else:
-                await _send_ndjson(
-                    writer, {"eoq": {"time": time.monotonic() - t0}}
-                )
+            while True:
+                with store.interruptible_read(
+                    timeout_s=perf.statement_timeout_s, slow_warn_s=None
+                ):
+                    batch = await _asyncio.to_thread(cur.fetchmany, 256)
+                if not batch:
+                    await _send_ndjson(
+                        writer, {"eoq": {"time": time.monotonic() - t0}}
+                    )
+                    break
+                for row in batch:
+                    i += 1
+                    await _send_ndjson(writer, {"row": [i, _json_row(row)]})
         except ConnectionError:
             raise
+        except Exception as e:  # mid-iteration SQLite errors (incl.
+            # 'interrupted' when a batch window expired)
+            await _send_ndjson(writer, {"error": str(e)})
         finally:
             await _end_ndjson(writer)
 

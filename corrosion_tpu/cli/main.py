@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 from typing import List, Optional
@@ -340,6 +341,13 @@ def cmd_consul(args) -> int:
 def cmd_sim(args) -> int:
     """Run a TPU-simulator benchmark config (rebuild-specific; these are
     the BASELINE.md scenario tiers)."""
+    # honor JAX_PLATFORMS even when an accelerator plugin would win over
+    # the env var (jax.config takes precedence) — tests set cpu to keep
+    # subprocess sims off the contended real chip
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     from ..sim import runner
 
     fns = {

@@ -1,0 +1,207 @@
+"""Structured span tracing with cross-node context propagation.
+
+Rebuild of the reference's tracing + OpenTelemetry layer
+(corrosion/src/main.rs:57-150): spans with ids/attributes/durations, a
+process-local collector with a pluggable exporter (the OTLP pipeline
+seam — no exporter dependency is baked in), and W3C ``traceparent`` /
+``tracestate`` carriers so a trace spans both ends of a sync exchange
+(SyncTraceContextV1, corro-types/src/sync.rs:33-67; injected at
+parallel_sync peer/mod.rs:1019-1022, extracted in serve_sync
+peer/mod.rs:1415-1417).
+
+Usage::
+
+    with span("parallel_sync", peer=addr) as sp:
+        ctx = current_traceparent()     # inject into the wire message
+    ...
+    with span("serve_sync", parent=extract(tp)):  # remote parent
+        ...
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+log = logging.getLogger("corrosion_tpu.tracing")
+
+_rng = random.Random()
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """W3C trace-context identifiers."""
+
+    trace_id: int  # 128-bit
+    span_id: int  # 64-bit
+    sampled: bool = True
+    tracestate: str = ""
+
+    def traceparent(self) -> str:
+        return (
+            f"00-{self.trace_id:032x}-{self.span_id:016x}"
+            f"-{'01' if self.sampled else '00'}"
+        )
+
+
+def extract(traceparent: Optional[str], tracestate: str = "") -> Optional[SpanContext]:
+    """Parse an incoming ``traceparent`` header/field (None if absent or
+    malformed — a bad peer must never break sync)."""
+    if not traceparent or not isinstance(traceparent, str):
+        return None
+    if not isinstance(tracestate, str):
+        tracestate = ""
+    parts = traceparent.split("-")
+    if len(parts) != 4 or parts[0] != "00":
+        return None
+    try:
+        trace_id = int(parts[1], 16)
+        span_id = int(parts[2], 16)
+        flags = int(parts[3], 16)
+    except ValueError:
+        return None
+    if trace_id == 0 or span_id == 0:
+        return None
+    return SpanContext(
+        trace_id=trace_id,
+        span_id=span_id,
+        sampled=bool(flags & 1),
+        tracestate=tracestate,
+    )
+
+
+@dataclass
+class Span:
+    name: str
+    context: SpanContext
+    parent_span_id: Optional[int]
+    attributes: Dict[str, object] = field(default_factory=dict)
+    start_s: float = 0.0
+    end_s: Optional[float] = None
+    status: str = "ok"
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        if self.end_s is None:
+            return None
+        return self.end_s - self.start_s
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": f"{self.context.trace_id:032x}",
+            "span_id": f"{self.context.span_id:016x}",
+            "parent_span_id": (
+                f"{self.parent_span_id:016x}" if self.parent_span_id else None
+            ),
+            "start_s": self.start_s,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "attributes": dict(self.attributes),
+        }
+
+
+class Tracer:
+    """Collects finished spans in a bounded ring; an exporter callable
+    (the OTLP batch-export seam) drains them when registered."""
+
+    def __init__(self, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self.finished: Deque[Span] = deque(maxlen=capacity)
+        self._exporter: Optional[Callable[[Span], None]] = None
+
+    def set_exporter(self, exporter: Optional[Callable[[Span], None]]):
+        self._exporter = exporter
+
+    def record(self, s: Span):
+        with self._lock:
+            self.finished.append(s)
+        if self._exporter is not None:
+            try:
+                self._exporter(s)
+            except Exception:
+                log.exception("span exporter failed")
+
+    def find(self, name: Optional[str] = None, trace_id: Optional[int] = None):
+        with self._lock:
+            return [
+                s
+                for s in self.finished
+                if (name is None or s.name == name)
+                and (trace_id is None or s.context.trace_id == trace_id)
+            ]
+
+
+TRACER = Tracer()
+
+_current: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
+    "corrosion_tpu_current_span", default=None
+)
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def current_traceparent() -> Optional[str]:
+    sp = _current.get()
+    return sp.context.traceparent() if sp else None
+
+
+class span:
+    """Context manager opening a child of the active span (or of an
+    explicit remote ``parent`` SpanContext)."""
+
+    def __init__(
+        self,
+        name: str,
+        parent: Optional[SpanContext] = None,
+        tracer: Tracer = TRACER,
+        **attributes,
+    ):
+        self.name = name
+        self.tracer = tracer
+        self.attributes = attributes
+        self._explicit_parent = parent
+        self._token = None
+        self.span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        active = _current.get()
+        if self._explicit_parent is not None:
+            trace_id = self._explicit_parent.trace_id
+            parent_id = self._explicit_parent.span_id
+        elif active is not None:
+            trace_id = active.context.trace_id
+            parent_id = active.context.span_id
+        else:
+            trace_id = _rng.getrandbits(128)
+            parent_id = None
+        ctx = SpanContext(trace_id=trace_id, span_id=_rng.getrandbits(64) or 1)
+        self.span = Span(
+            name=self.name,
+            context=ctx,
+            parent_span_id=parent_id,
+            attributes=dict(self.attributes),
+            start_s=time.time(),
+        )
+        self._token = _current.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, _tb):
+        sp = self.span
+        sp.end_s = time.time()
+        if exc_type is not None:
+            sp.status = f"error: {exc_type.__name__}"
+        _current.reset(self._token)
+        self.tracer.record(sp)
+        return False

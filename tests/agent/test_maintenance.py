@@ -34,7 +34,7 @@ def test_wal_checkpoint_truncates_file(file_store):
         )
     wal = file_store.path + "-wal"
     assert os.path.getsize(wal) > 0
-    assert wal_checkpoint_truncate(file_store.conn)
+    assert wal_checkpoint_truncate(file_store)
     assert os.path.getsize(wal) == 0
 
 

@@ -1,0 +1,100 @@
+"""Tracing tests: span mechanics, W3C carrier round-trip, and the
+cross-node property — one trace spans both ends of a sync exchange
+(SyncTraceContextV1, corro-types/src/sync.rs:33-67)."""
+
+import asyncio
+
+from corrosion_tpu.tracing import (
+    TRACER,
+    SpanContext,
+    Tracer,
+    current_traceparent,
+    extract,
+    span,
+)
+
+
+def test_span_nesting_and_ids():
+    tracer = Tracer()
+    with span("outer", tracer=tracer) as outer:
+        assert current_traceparent() == outer.context.traceparent()
+        with span("inner", tracer=tracer) as inner:
+            assert inner.context.trace_id == outer.context.trace_id
+            assert inner.parent_span_id == outer.context.span_id
+    assert current_traceparent() is None
+    names = [s.name for s in tracer.finished]
+    assert names == ["inner", "outer"]  # children finish first
+    assert all(s.duration_s is not None for s in tracer.finished)
+
+
+def test_traceparent_roundtrip():
+    ctx = SpanContext(trace_id=0xABC123, span_id=0x42)
+    tp = ctx.traceparent()
+    assert tp == f"00-{0xABC123:032x}-{0x42:016x}-01"
+    back = extract(tp)
+    assert back.trace_id == 0xABC123
+    assert back.span_id == 0x42
+    assert back.sampled
+
+
+def test_extract_rejects_garbage():
+    assert extract(None) is None
+    assert extract("") is None
+    assert extract("zz-123") is None
+    assert extract("00-0-0-01") is None
+    assert extract("00-xyz-abc-01") is None
+
+
+def test_error_status_recorded():
+    tracer = Tracer()
+    try:
+        with span("boom", tracer=tracer):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    assert tracer.finished[-1].status == "error: ValueError"
+
+
+def test_exporter_receives_spans():
+    tracer = Tracer()
+    got = []
+    tracer.set_exporter(got.append)
+    with span("exported", tracer=tracer):
+        pass
+    assert [s.name for s in got] == ["exported"]
+
+
+def test_sync_trace_spans_both_nodes():
+    """Force a sync round and assert the server's serve_sync span joined
+    the client's parallel_sync trace."""
+    from corrosion_tpu.testing import Cluster, LinkModel
+
+    async def body():
+        # 100% broadcast loss: only sync can converge, guaranteeing a
+        # sync exchange happens
+        cluster = Cluster(2, use_swim=False, link=LinkModel(loss=1.0))
+        await cluster.start()
+        try:
+            before = len(TRACER.finished)
+            cluster.agents[0].exec_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "t"))]
+            )
+            ok = await cluster.wait_converged(timeout=30.0)
+            assert ok
+            spans = list(TRACER.finished)[before:]
+            clients = [s for s in spans if s.name == "parallel_sync"]
+            servers = [s for s in spans if s.name == "serve_sync"]
+            assert clients and servers
+            client_traces = {s.context.trace_id for s in clients}
+            # at least one server span continues a client trace with the
+            # client span as its parent
+            joined = [
+                s
+                for s in servers
+                if s.context.trace_id in client_traces and s.parent_span_id
+            ]
+            assert joined, [s.to_dict() for s in servers]
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
