@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .state import ALIVE, PayloadMeta, SimConfig, SimState
+from .state import ALIVE, PayloadMeta, SimConfig, SimState, budget_prefix_mask
 from .topology import Topology, edge_alive, edge_delay, edge_drop
 
 
@@ -38,13 +38,11 @@ def broadcast_step(
     # what each node would send: held, budget left, payload active
     eligible = (state.have > 0) & (state.relay_left > 0) & active  # [N, P]
 
-    # rate limit: FIFO prefix (payload-index == injection order) within the
-    # per-round byte budget — the reference drains its broadcast queue
-    # oldest-first under the governor (broadcast/mod.rs:453-463)
-    cost = jnp.where(eligible, meta.nbytes[None, :], 0)  # [N, P]
-    cum = jnp.cumsum(cost, axis=1)
-    within_budget = cum <= cfg.rate_limit_bytes_round
-    sending = eligible & within_budget  # [N, P]
+    # rate limit: FIFO prefix (payload-index == injection order, the
+    # version-major layout guarantee) within the per-round byte budget —
+    # the reference drains its broadcast queue oldest-first under the
+    # governor (broadcast/mod.rs:453-463)
+    sending = budget_prefix_mask(eligible, cfg.rate_limit_bytes_round, cfg)
 
     # sample fanout targets per node (uniform over the id space; down or
     # partitioned targets are masked at the edge level, matching SWIM's

@@ -130,6 +130,22 @@ def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
     )
 
 
+def budget_prefix_mask(mask: jnp.ndarray, budget_bytes: int, cfg: SimConfig) -> jnp.ndarray:
+    """Oldest-first byte budget as a count rank: keep the first
+    ``budget_bytes // default_payload_bytes`` True entries along the last
+    (payload) axis.  Payload size is uniform (uniform_payloads enforces
+    it), payloads are version-major, so a prefix of the index order is
+    exactly the reference's oldest-first drain.  Shared by the broadcast
+    governor and the sync budget."""
+    p = mask.shape[-1]
+    # clamp to p: rank never exceeds p, and an unclamped "unlimited"
+    # budget must not overflow the narrow rank dtype
+    max_count = max(1, min(budget_bytes // cfg.default_payload_bytes, p))
+    rank_dtype = jnp.int16 if p <= 32767 else jnp.int32
+    cum = jnp.cumsum(mask, axis=-1, dtype=rank_dtype)  # 1-indexed rank
+    return mask & (cum <= max_count)
+
+
 def uniform_payloads(
     cfg: SimConfig,
     n_writers: int = 1,
@@ -139,31 +155,55 @@ def uniform_payloads(
     payload_bytes: Optional[int] = None,
 ) -> PayloadMeta:
     """A write-storm scenario: ``n_writers`` origins each commit versions of
-    ``chunks_per_version`` chunks, injected ``inject_every`` rounds apart."""
+    ``chunks_per_version`` chunks, injected ``inject_every`` rounds apart.
+
+    The payload axis is **version-major** — index order IS (version,
+    actor, chunk) order, which is also injection order since the inject
+    round is monotone in version.  Both hot kernels rely on this: the
+    broadcast rate limiter drains oldest-first by index
+    (broadcast.py) and the sync budget grants oldest-version-first
+    WITHOUT any per-round permutation (sync.py)."""
     p = cfg.n_payloads
     if n_writers > p:
         raise ValueError(
             f"n_writers={n_writers} exceeds n_payloads={p}: every writer "
             "needs at least one payload"
         )
+    if payload_bytes is not None and payload_bytes != cfg.default_payload_bytes:
+        # the kernels' byte budgets are count-ranks derived from the
+        # static cfg.default_payload_bytes — set that instead
+        raise ValueError(
+            "payload_bytes must equal cfg.default_payload_bytes "
+            f"({cfg.default_payload_bytes}); set it on SimConfig"
+        )
+    wave = n_writers * chunks_per_version  # payloads per version wave
+    if wave > p:
+        # version-major layout fills whole waves; a partial first wave
+        # would silently leave the highest-index writers with nothing
+        raise ValueError(
+            f"n_writers*chunks_per_version={wave} exceeds n_payloads={p}: "
+            "every writer needs at least one full version"
+        )
     per_writer = p // n_writers
     vpw = versions_per_writer or max(1, per_writer // chunks_per_version)
     idx = jnp.arange(p, dtype=jnp.int32)
-    within = idx % per_writer
-    actor = jnp.minimum(idx // per_writer, n_writers - 1)
-    version = 1 + within // chunks_per_version
-    chunk = within % chunks_per_version
+    raw_version = 1 + idx // wave
+    actor = (idx % wave) // chunks_per_version
+    chunk = idx % chunks_per_version
     # writers spread across the node id space
     actor_node = (actor * max(1, cfg.n_nodes // n_writers)) % cfg.n_nodes
     return PayloadMeta(
         actor=actor_node.astype(jnp.int32),
-        version=jnp.minimum(version, vpw).astype(jnp.int32),
+        version=jnp.minimum(raw_version, vpw).astype(jnp.int32),
         chunk=chunk.astype(jnp.int32),
         nchunks=jnp.full((p,), chunks_per_version, jnp.int32),
         nbytes=jnp.full(
             (p,), payload_bytes or cfg.default_payload_bytes, jnp.int32
         ),
-        round=((version - 1) * inject_every).astype(jnp.int32),
+        # schedule from the UNCLAMPED version so payloads past the vpw
+        # cap keep injecting inject_every rounds apart instead of
+        # collapsing into one burst
+        round=((raw_version - 1) * inject_every).astype(jnp.int32),
     )
 
 

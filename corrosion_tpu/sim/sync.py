@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .state import PayloadMeta, SimConfig, SimState
+from .state import PayloadMeta, SimConfig, SimState, budget_prefix_mask
 from .topology import Topology, edge_alive, edge_drop
 
 
@@ -51,14 +51,12 @@ def sync_step(
     need = (state.have[dst] > 0) & (state.have[src] == 0) & active  # [E, P]
     need &= ok[:, None]
 
-    # oldest-first budget: payloads are laid out in version order per writer;
-    # prioritise by global version then actor (matches request ordering)
-    order = jnp.argsort(meta.version * (n + 1) + meta.actor)
-    cost_ord = jnp.where(need[:, order], meta.nbytes[order][None, :], 0)
-    cum = jnp.cumsum(cost_ord, axis=1)
-    within = cum <= cfg.sync_budget_bytes
-    granted_ord = need[:, order] & within
-    granted = jnp.zeros_like(need).at[:, order].set(granted_ord)
+    # oldest-first budget: the payload axis is version-major BY
+    # CONSTRUCTION (uniform_payloads), so index order is already global
+    # (version, actor) request order — no per-round permutation needed
+    # (the argsort + two [E, P] permuted gathers this replaces dominated
+    # the whole round's cost)
+    granted = budget_prefix_mask(need, cfg.sync_budget_bytes, cfg)
 
     # deliver next round via the delay ring (bi-stream round trip)
     d_slots = state.inflight.shape[0]

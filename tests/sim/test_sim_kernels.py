@@ -161,11 +161,13 @@ def test_sharded_run_matches_single_device():
 
 def test_rate_limit_slows_dissemination():
     """Choking the byte budget must strictly slow convergence."""
-    meta_kw = dict(n_writers=1, payload_bytes=64 * 1024)
+    meta_kw = dict(n_writers=1)
     fast_cfg = SimConfig(n_nodes=48, n_payloads=32,
+                         default_payload_bytes=64 * 1024,
                          rate_limit_bytes_round=10**9,
                          sync_interval_rounds=10_000)
     slow_cfg = SimConfig(n_nodes=48, n_payloads=32,
+                         default_payload_bytes=64 * 1024,
                          rate_limit_bytes_round=64 * 1024,  # 1 payload/round
                          sync_interval_rounds=10_000)
     fast_meta = uniform_payloads(fast_cfg, **meta_kw)
