@@ -1,61 +1,252 @@
 #!/usr/bin/env python
-"""Headline benchmark: 100k-node epidemic write-storm convergence.
+"""Headline benchmark: epidemic write-storm convergence (BASELINE config #5).
 
-BASELINE.json north star: simulate 100k-node p99 time-to-convergence in
-<60 s wall-clock, matching 3-node ground truth.  This runs config #5
-(16 writers, 4-chunk versions, broadcast + anti-entropy) to full
-convergence on the real chip and prints ONE JSON line:
+North star (BASELINE.json): simulate 100k-node p99 time-to-convergence in
+<60 s wall-clock, matching 3-node ground truth.  Prints ONE JSON line::
 
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 
-value = steady-state wall-clock seconds for the full convergence run
-(compile excluded: an identically-shaped warmup run primes the XLA cache,
-matching how the reference's long-lived agents amortise startup).
-vs_baseline = 60 / value (>1 ⇒ beating the 60 s target); 0 if unconverged.
+``value`` is steady-state wall-clock seconds for a full convergence run
+(compile excluded via an identically-shaped warmup compile).
+``vs_baseline`` = target/value where target pro-rates the 60 s @ 100k-node
+goal linearly in node count (target = 60 * n/100k), so a step-down
+measurement can never inflate the score; 0.0 if nothing converged.
 
-Env overrides: BENCH_NODES, BENCH_PAYLOADS, BENCH_PLATFORM=cpu (debug).
+Round-1 hardening (VERDICT.md "next round" item 1): the round-1 bench died
+with rc=1 because `jax.devices()` on the wedged axon/TPU backend hung
+forever and nothing defended against it.  This orchestrator therefore:
+
+- never imports JAX itself — every backend-touching step runs in a
+  bench_child.py subprocess with a hard timeout (kill -9 on expiry);
+- preflights the backend (devices + tiny matmul) with bounded retries and
+  falls back to CPU if the TPU platform is truly wedged;
+- climbs a node ladder SMALL→LARGE (4k → 25k → 100k) so some measured
+  point always lands, then reports the largest converged size;
+- prints the best-so-far result on SIGTERM/SIGINT, so a driver-imposed
+  deadline still yields a number;
+- records every attempt (incl. failures, distinguishing env-broken from
+  sim-broken) in BENCH_DIAG.json and the aux configs #2-#4 in
+  BENCH_CONFIGS.json.
+
+Env overrides: BENCH_NODES (cap ladder), BENCH_PAYLOADS, BENCH_PLATFORM
+(force platform, e.g. cpu for debug), BENCH_BUDGET_S (total wall budget,
+default 1500), BENCH_PREFLIGHT_TIMEOUT, BENCH_AUX=0 (skip configs #2-#4).
 """
+
+from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+CHILD = os.path.join(REPO, "bench_child.py")
+CACHE_DIR = os.path.join(REPO, ".cache", "jax")
+
+T0 = time.monotonic()
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+
+# best-so-far, printed exactly once (normal exit or signal)
+_best: dict | None = None
+_printed = False
+_diag: dict = {"attempts": [], "preflight": None, "started_unix": time.time()}
+
+
+def _remaining() -> float:
+    return BUDGET_S - (time.monotonic() - T0)
+
+
+def _emit_and_exit(code: int = 0) -> None:
+    """Print the single JSON result line (best so far, or a zero record)."""
+    global _printed
+    if _printed:
+        os._exit(code)
+    _printed = True
+    if _best is not None:
+        out = _best
+    else:
+        out = {
+            "metric": "sim_write_storm_p99_convergence_wallclock",
+            "value": 0.0,
+            "unit": "s",
+            "vs_baseline": 0.0,
+        }
+    print(json.dumps(out), flush=True)
+    _write_diag()
+    os._exit(code)
+
+
+def _write_diag() -> None:
+    _diag["elapsed_s"] = round(time.monotonic() - T0, 1)
+    try:
+        with open(os.path.join(REPO, "BENCH_DIAG.json"), "w") as f:
+            json.dump(_diag, f, indent=1, default=str)
+    except OSError:
+        pass
+
+
+def _on_signal(signum, frame):  # noqa: ANN001
+    _diag["killed_by_signal"] = signum
+    _emit_and_exit(0)
+
+
+def run_child(spec: dict, timeout: float) -> dict:
+    """Run one bench_child.py attempt with a hard timeout; always returns a
+    result dict (``ok=False`` + reason on timeout/crash)."""
+    fd, out_path = tempfile.mkstemp(prefix="bench_", suffix=".json")
+    os.close(fd)
+    os.unlink(out_path)
+    spec = dict(spec, out=out_path, cache_dir=CACHE_DIR)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, CHILD, json.dumps(spec)],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+            cwd=REPO,
+        )
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            return {
+                "ok": False,
+                "error": f"timeout after {timeout:.0f}s (backend hang or too slow)",
+                "timeout": True,
+                "wall_s": round(time.monotonic() - t0, 1),
+            }
+        if os.path.exists(out_path):
+            with open(out_path) as f:
+                res = json.load(f)
+            res["wall_s"] = round(time.monotonic() - t0, 1)
+            return res
+        return {
+            "ok": False,
+            "error": f"child exited rc={proc.returncode} with no result file",
+            "wall_s": round(time.monotonic() - t0, 1),
+        }
+    finally:
+        if os.path.exists(out_path):
+            os.unlink(out_path)
+
+
+def preflight() -> tuple[str, str] | None:
+    """Probe backends in a subprocess; returns (requested_platform,
+    actual_platform) or None.  ``actual_platform`` is what the child's
+    `jax.devices()[0].platform` reported — the ladder/metric naming must
+    key off reality, not the request (a default platform can silently
+    resolve to CPU when the TPU plugin is absent).
+
+    Retries the default (TPU) platform with growing timeouts — transient
+    tunnel wedges were the round-1 killer — then falls back to CPU so the
+    benchmark still lands a measured (if slower) point.
+    """
+    forced = os.environ.get("BENCH_PLATFORM")
+    base_t = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "150"))
+    candidates = [forced] if forced else [None, None, None, "cpu"]
+    for i, plat in enumerate(candidates):
+        timeout = min(base_t * (1 + i * 0.5), max(30.0, _remaining() * 0.4))
+        if _remaining() < 30:
+            break
+        res = run_child(
+            {"mode": "preflight", "platform": plat}, timeout=timeout
+        )
+        res["requested_platform"] = plat or "default(axon/tpu)"
+        _diag["preflight"] = res
+        _diag["attempts"].append({"phase": "preflight", **res})
+        if res.get("ok"):
+            return plat or "", str(res.get("platform", plat or ""))
+        time.sleep(min(10, 2**i))
+    return None
 
 
 def main() -> int:
-    if os.environ.get("BENCH_PLATFORM"):
-        import jax
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    global _best
 
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    pf = preflight()
+    if pf is None:
+        _diag["verdict"] = "env-broken: no JAX backend initialised in time"
+        _emit_and_exit(0)
+    plat, actual = pf
 
-    n_nodes = int(os.environ.get("BENCH_NODES", "100000"))
+    cap = int(os.environ.get("BENCH_NODES", "100000"))
     n_payloads = int(os.environ.get("BENCH_PAYLOADS", "512"))
+    on_cpu = actual == "cpu"
+    ladder = [n for n in (4_000, 25_000, 100_000) if n <= cap] or [cap]
+    if on_cpu:
+        # CPU fallback: dense 100k kernels take far too long; measure what
+        # fits so the point is real, flagged by the metric name
+        ladder = [n for n in ladder if n <= 8_000] or [4_000]
+    _diag["platform"] = actual or plat or "default(axon/tpu)"
+    _diag["ladder"] = ladder
 
-    from corrosion_tpu.sim.runner import config_write_storm_100k
+    for n in ladder:
+        rem = _remaining()
+        if rem < 60:
+            _diag["attempts"].append(
+                {"phase": "storm", "nodes": n, "skipped": "budget exhausted"}
+            )
+            break
+        # first ladder rung pays full compile; leave room for later rungs
+        timeout = min(rem - 30, max(240.0, rem * 0.5))
+        res = run_child(
+            {
+                "mode": "storm",
+                "platform": plat or None,
+                "nodes": n,
+                "payloads": n_payloads,
+            },
+            timeout=timeout,
+        )
+        _diag["attempts"].append({"phase": "storm", "nodes": n, **res})
+        _write_diag()
+        if res.get("ok") and res.get("metrics", {}).get("converged"):
+            m = res["metrics"]
+            value = round(float(m["wall_clock_s"]), 3)
+            target = 60.0 * (n / 100_000.0)
+            suffix = "_cpu_fallback" if on_cpu else ""
+            _best = {
+                "metric": f"sim_write_storm_{n // 1000}k_p99_convergence_wallclock{suffix}",
+                "value": value,
+                "unit": "s",
+                "vs_baseline": round(target / value, 3) if value > 0 else 0.0,
+            }
+            _diag["best"] = {"nodes": n, **m}
+        elif res.get("timeout") and _best is not None:
+            break  # bigger sizes will only be slower; keep what we have
 
-    # warmup: AOT lower+compile only (primes the cache without running a
-    # whole convergence loop)
-    config_write_storm_100k(
-        seed=0, n_nodes=n_nodes, n_payloads=n_payloads, compile_only=True
-    )
-    # measured steady-state run
-    m = config_write_storm_100k(seed=1, n_nodes=n_nodes, n_payloads=n_payloads)
+    # aux configs #2-#4 (VERDICT item 1: "record configs #2-#4 outputs")
+    if os.environ.get("BENCH_AUX", "1") != "0" and _remaining() > 90:
+        aux = {}
+        for fn in (
+            "config_swim_churn_64",
+            "config_broadcast_1k",
+            "config_partition_heal_10k",
+        ):
+            rem = _remaining()
+            if rem < 60:
+                aux[fn] = {"ok": False, "error": "budget exhausted"}
+                continue
+            res = run_child(
+                {"mode": "aux", "platform": plat or None, "fn": fn},
+                timeout=min(rem - 20, 420.0),
+            )
+            aux[fn] = res
+        try:
+            with open(os.path.join(REPO, "BENCH_CONFIGS.json"), "w") as f:
+                json.dump(aux, f, indent=1, default=str)
+        except OSError:
+            pass
+        _diag["aux_done"] = True
 
-    value = round(m["wall_clock_s"], 3)
-    converged = bool(m["converged"])
-    out = {
-        "metric": f"sim_write_storm_{n_nodes // 1000}k_p99_convergence_wallclock",
-        "value": value,
-        "unit": "s",
-        "vs_baseline": round(60.0 / value, 3) if converged and value > 0 else 0.0,
-    }
-    print(json.dumps(out))
-    # context for humans on stderr (driver reads stdout only)
-    print(
-        f"# rounds={m['rounds']} p99_payload_latency={m['p99_payload_latency_rounds']}r "
-        f"p99_node_conv_round={m['p99_node_convergence_round']} "
-        f"converged={converged} nodes={n_nodes} payloads={n_payloads}",
-        file=sys.stderr,
-    )
+    _emit_and_exit(0)
     return 0
 
 

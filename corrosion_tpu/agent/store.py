@@ -69,6 +69,94 @@ class CommitInfo:
     ts: int
 
 
+READ_POOL_SIZE = 5
+
+
+class _ReadPool:
+    """Small lazy pool of read-only connections (SplitPool's RO side,
+    agent.rs:419-498, sized down: 5 vs the reference's 20 — Python threads
+    saturate far fewer concurrent reads).
+
+    Connections are created on demand up to ``size``; ``acquire`` blocks
+    when all are checked out.  ``add_init`` replays a setup hook over
+    existing and future connections (catalog attach etc.)."""
+
+    def __init__(self, factory: Callable[[], sqlite3.Connection], size: int):
+        self._factory = factory
+        self._size = size
+        self._cond = threading.Condition()
+        self._free: List[sqlite3.Connection] = []
+        self._all: List[sqlite3.Connection] = []
+        self._inits: List[Callable[[sqlite3.Connection], None]] = []
+        self._reserved = 0  # slots claimed by in-flight connection creation
+        self._closed = False
+
+    def add_init(self, fn: Callable[[sqlite3.Connection], None]) -> None:
+        with self._cond:
+            self._inits.append(fn)
+            existing = list(self._all)
+        for conn in existing:
+            fn(conn)
+
+    def acquire(self, timeout: Optional[float] = 30.0) -> sqlite3.Connection:
+        grow = False
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while True:
+                if self._closed:
+                    raise sqlite3.ProgrammingError("read pool closed")
+                if self._free:
+                    return self._free.pop()
+                if self._reserved + len(self._all) < self._size:
+                    self._reserved += 1  # slot claimed; connect outside lock
+                    grow = True
+                    break
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("read pool exhausted")
+                if not self._cond.wait(timeout=remaining):
+                    raise TimeoutError("read pool exhausted")
+        # connection creation + init (catalog attach etc.) can be slow;
+        # never hold the pool lock across them
+        assert grow
+        try:
+            conn = self._factory()
+            with self._cond:
+                inits = list(self._inits)
+            for fn in inits:
+                fn(conn)
+        except BaseException:
+            with self._cond:
+                self._reserved -= 1
+                self._cond.notify()
+            raise
+        with self._cond:
+            self._reserved -= 1
+            self._all.append(conn)
+        return conn
+
+    def release(self, conn: sqlite3.Connection) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._free.append(conn)
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            for conn in self._all:
+                try:
+                    conn.close()
+                except sqlite3.ProgrammingError:
+                    pass
+            self._all.clear()
+            self._free.clear()
+            self._cond.notify_all()
+
+
 class CrrStore:
     """One node's storage: base tables + CRDT clocks + bookkeeping tables."""
 
@@ -89,24 +177,58 @@ class CrrStore:
         self._pending_dbv = 0
         self._seq = 0
         self._pending_ts = 0
+        self._last_dml_changes = 0
         self._register_functions()
         self._migrate()
         self.site_id = self._init_site_id(site_id)
         self._load_tables()
-        # read-only connection for client queries (the reference's RO pool,
-        # agent.rs:419-498): keeps arbitrary SQL off the trigger-armed writer
+        # read-only connection pool for client queries (the reference keeps
+        # a 20-conn RO pool, agent.rs:419-498): keeps arbitrary SQL off the
+        # trigger-armed writer, and an interrupted slow read only aborts the
+        # statements on ITS connection, not every in-flight read
         if path not in (":memory:", ""):
-            self.read_conn = sqlite3.connect(
-                f"file:{path}?mode=ro", uri=True, check_same_thread=False
+            self._read_pool: Optional[_ReadPool] = _ReadPool(
+                self._new_read_conn, size=READ_POOL_SIZE
             )
-            self.read_conn.row_factory = sqlite3.Row
-            # client-facing SQL helpers must exist on the read lane too —
-            # API queries and templates execute there
-            self.read_conn.create_function(
-                "corro_json_contains", 2, _corro_json_contains, deterministic=True
-            )
+            # dedicated direct handle OUTSIDE the pool (metrics thread and
+            # tests): pool checkouts can be watchdog-interrupted, and a
+            # shared member would cross-abort the direct user's statements
+            self.read_conn = self._new_read_conn()
         else:
+            self._read_pool = None
             self.read_conn = self.conn  # in-memory: single-conn fallback
+
+    def _new_read_conn(self) -> sqlite3.Connection:
+        # autocommit (isolation_level=None): DML on ATTACHed scratch DBs
+        # (pg_catalog refresh) must not open an implicit transaction that
+        # would freeze this conn's read snapshot of the main DB forever
+        conn = sqlite3.connect(
+            f"file:{self.path}?mode=ro",
+            uri=True,
+            check_same_thread=False,
+            isolation_level=None,
+        )
+        conn.row_factory = sqlite3.Row
+        # client-facing SQL helpers must exist on the read lane too —
+        # API queries and templates execute there
+        conn.create_function(
+            "corro_json_contains", 2, _corro_json_contains, deterministic=True
+        )
+        return conn
+
+    def add_read_conn_init(self, fn: Callable[[sqlite3.Connection], None]) -> None:
+        """Run ``fn`` on every read connection, existing and future (used by
+        the PG bridge to attach pg_catalog + session functions on the read
+        lane).  No-op target on in-memory stores where reads share the
+        writer conn — callers must apply their init to ``conn`` themselves."""
+        if self._read_pool is not None:
+            self._read_pool.add_init(fn)
+
+    @property
+    def has_read_pool(self) -> bool:
+        """False for in-memory stores, where reads share the writer conn
+        and must stay serialized on the caller's thread/loop."""
+        return self._read_pool is not None
 
     # -- setup ------------------------------------------------------------
 
@@ -498,7 +620,23 @@ class CrrStore:
         self.conn.execute("BEGIN IMMEDIATE")
 
     def exec_interactive(self, sql: str, params: Sequence[SqliteValue] = ()):
-        return self.conn.execute(sql, tuple(params))
+        cur = self.conn.execute(sql, tuple(params))
+        if cur.rowcount >= 0:
+            self._last_dml_changes = cur.rowcount
+        else:
+            # Python's sqlite3 only fills rowcount for statements it sniffs
+            # as DML; a WITH-prefixed INSERT/UPDATE/DELETE reports -1, so
+            # ask SQLite directly (command tags must be accurate — PG
+            # clients branch on them)
+            self._last_dml_changes = self.conn.execute(
+                "SELECT changes()"
+            ).fetchone()[0]
+        return cur
+
+    @property
+    def last_dml_changes(self) -> int:
+        """Rows changed by the most recent exec_interactive DML statement."""
+        return self._last_dml_changes
 
     def commit_interactive(
         self,
@@ -541,15 +679,50 @@ class CrrStore:
         sqlite-pool/src/lib.rs:116,259) and statements at/over the slow
         threshold warn (the trace_v2 PROFILE hook, sqlite.rs:51-61).
 
-        Interruption aborts every in-flight statement on ``read_conn`` —
-        the reference avoids that with a 20-conn RO pool; here slow
-        victims see the same 'interrupted' error and simply retry."""
+        The connection comes from the RO pool, so an interrupt only aborts
+        statements on THIS connection — concurrent reads on other pool
+        members are untouched (the reference's SplitPool isolation,
+        agent.rs:419-498)."""
+        with self.read_lease() as conn:
+            with self.interrupt_window(
+                conn, timeout_s, slow_warn_s=slow_warn_s, label=label
+            ):
+                yield conn
+
+    @contextmanager
+    def read_lease(self):
+        """Check out one RO connection for an extended read (e.g. a
+        streaming query whose cursor must live across many fetch batches).
+        Interrupt windows (``interrupt_window``) must target THIS conn —
+        acquiring a fresh ``interruptible_read`` per batch would schedule
+        the watchdog on a different pool member than the cursor's."""
+        if self._read_pool is None:
+            yield self.conn
+            return
+        conn = self._read_pool.acquire()
+        try:
+            yield conn
+        finally:
+            self._read_pool.release(conn)
+
+    @contextmanager
+    def interrupt_window(
+        self,
+        conn: sqlite3.Connection,
+        timeout_s: Optional[float] = None,
+        slow_warn_s: Optional[float] = 1.0,
+        label: str = "",
+    ):
+        """Bound one window of SQLite work on ``conn`` with the interrupt
+        watchdog + slow-statement warning.  No-op timeout on the shared
+        writer conn (in-memory fallback) — interrupting it would abort
+        writer transactions."""
         handle = None
-        if timeout_s is not None and self.read_conn is not self.conn:
-            handle = _watchdog().schedule(self.read_conn, timeout_s)
+        if timeout_s is not None and conn is not self.conn:
+            handle = _watchdog().schedule(conn, timeout_s)
         t0 = time.monotonic()
         try:
-            yield self.read_conn
+            yield conn
         finally:
             if handle is not None:
                 handle.cancel()
@@ -907,8 +1080,8 @@ class CrrStore:
         # under a C call (observed segfault); late threads see _closed
         with self._lock:
             self._closed = True
-            if self.read_conn is not self.conn:
-                self.read_conn.close()
+            if self._read_pool is not None:
+                self._read_pool.close()
             self.conn.close()
 
 

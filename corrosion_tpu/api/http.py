@@ -211,40 +211,46 @@ class ApiServer:
         # conn, not the network write) — and memory stays O(batch)
         import asyncio as _asyncio
 
-        with store.interruptible_read(
-            timeout_s=perf.statement_timeout_s,
-            slow_warn_s=perf.slow_query_warn_s,
-            label=sql,
-        ) as conn:
-            # errors before the stream starts surface as a normal HTTP
-            # error; execution runs off-loop so an expensive first step
-            # can't stall gossip for up to the statement timeout
-            cur = await _asyncio.to_thread(conn.execute, sql, tuple(params))
-            cols = [d[0] for d in cur.description] if cur.description else []
-        await _start_ndjson(writer)
-        i = 0
-        try:
-            await _send_ndjson(writer, {"columns": cols})
-            while True:
-                with store.interruptible_read(
-                    timeout_s=perf.statement_timeout_s, slow_warn_s=None
-                ):
-                    batch = await _asyncio.to_thread(cur.fetchmany, 256)
-                if not batch:
-                    await _send_ndjson(
-                        writer, {"eoq": {"time": time.monotonic() - t0}}
-                    )
-                    break
-                for row in batch:
-                    i += 1
-                    await _send_ndjson(writer, {"row": [i, _json_row(row)]})
-        except ConnectionError:
-            raise
-        except Exception as e:  # mid-iteration SQLite errors (incl.
-            # 'interrupted' when a batch window expired)
-            await _send_ndjson(writer, {"error": str(e)})
-        finally:
-            await _end_ndjson(writer)
+        # ONE pool lease for the whole stream: the cursor is bound to its
+        # connection, so every interrupt window must target that same conn
+        # (a per-batch interruptible_read would watchdog a different pool
+        # member than the one running fetchmany)
+        with store.read_lease() as conn:
+            with store.interrupt_window(
+                conn,
+                timeout_s=perf.statement_timeout_s,
+                slow_warn_s=perf.slow_query_warn_s,
+                label=sql,
+            ):
+                # errors before the stream starts surface as a normal HTTP
+                # error; execution runs off-loop so an expensive first step
+                # can't stall gossip for up to the statement timeout
+                cur = await _asyncio.to_thread(conn.execute, sql, tuple(params))
+                cols = [d[0] for d in cur.description] if cur.description else []
+            await _start_ndjson(writer)
+            i = 0
+            try:
+                await _send_ndjson(writer, {"columns": cols})
+                while True:
+                    with store.interrupt_window(
+                        conn, timeout_s=perf.statement_timeout_s, slow_warn_s=None
+                    ):
+                        batch = await _asyncio.to_thread(cur.fetchmany, 256)
+                    if not batch:
+                        await _send_ndjson(
+                            writer, {"eoq": {"time": time.monotonic() - t0}}
+                        )
+                        break
+                    for row in batch:
+                        i += 1
+                        await _send_ndjson(writer, {"row": [i, _json_row(row)]})
+            except ConnectionError:
+                raise
+            except Exception as e:  # mid-iteration SQLite errors (incl.
+                # 'interrupted' when a batch window expired)
+                await _send_ndjson(writer, {"error": str(e)})
+            finally:
+                await _end_ndjson(writer)
 
     # -- subscriptions (api/public/pubsub.rs:37,135) ----------------------
 

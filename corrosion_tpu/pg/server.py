@@ -56,9 +56,18 @@ class PgServer:
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
         dbname = "corrosion"
+        # catalog + session functions on the write conn (reads inside an
+        # explicit tx run there) AND on every RO-pool conn (all other reads
+        # — the reference serves those from its RO pool, agent.rs:419-498)
         conn = agent.store.conn
         catalog.attach(conn, dbname)
         catalog.register_functions(conn, dbname)
+
+        def _init_read(rc):
+            catalog.attach(rc, dbname)
+            catalog.register_functions(rc, dbname)
+
+        agent.store.add_read_conn_init(_init_read)
 
     @property
     def addr(self) -> str:
@@ -142,6 +151,10 @@ class _Session:
                     done = await self._dispatch(msg)
                 except PgError as e:
                     await self._send_error(e, msg)
+                except tr.UnsupportedStatement as e:
+                    await self._send_error(
+                        PgError(sql_state.FEATURE_NOT_SUPPORTED, str(e)), msg
+                    )
                 except Exception as e:  # sqlite3 or internal
                     await self._send_error(
                         PgError(sql_state.from_sqlite_error(e), str(e)), msg
@@ -181,10 +194,14 @@ class _Session:
         self.writer.write(p.error_response(e.code, e.message))
         if self.tx is not None:
             self.tx_failed = True
-        if not isinstance(msg, p.Query):
-            # extended protocol: skip until Sync (PG spec error recovery)
+        if isinstance(msg, p.Query):
+            # simple query: RFQ ends the (aborted) batch immediately
+            self.writer.write(p.ready_for_query(self._status))
+        else:
+            # extended protocol: discard messages until Sync; ReadyForQuery
+            # is owed only in response to that Sync (PG error-recovery
+            # contract — a premature RFQ desyncs Flush-pipelining drivers)
             self._discard_until_sync = True
-        self.writer.write(p.ready_for_query(self._status))
         await self.writer.drain()
 
     async def _abort_open_tx(self):
@@ -199,7 +216,8 @@ class _Session:
         if self._discard_until_sync:
             if isinstance(msg, p.Sync):
                 self._discard_until_sync = False
-                # ReadyForQuery was already sent by _send_error
+                self.writer.write(p.ready_for_query(self._status))
+                return True
             return False
         if isinstance(msg, p.Query):
             await self._simple_query(msg.sql)
@@ -213,7 +231,7 @@ class _Session:
             self.writer.write(p.bind_complete())
             return False
         if isinstance(msg, p.Describe):
-            self._describe(msg)
+            await self._describe(msg)
             return False
         if isinstance(msg, p.Execute):
             await self._execute_portal(msg)
@@ -249,6 +267,13 @@ class _Session:
             try:
                 t = tr.translate(stmt)
                 await self._run_statement(t, (), (), describe_rows=True)
+            except tr.UnsupportedStatement as e:
+                self.writer.write(
+                    p.error_response(sql_state.FEATURE_NOT_SUPPORTED, str(e))
+                )
+                if self.tx is not None:
+                    self.tx_failed = True
+                break
             except PgError as e:
                 self.writer.write(p.error_response(e.code, e.message))
                 if self.tx is not None:
@@ -310,11 +335,11 @@ class _Session:
             result_formats=msg.result_formats,
         )
 
-    def _describe(self, msg: p.Describe):
+    async def _describe(self, msg: p.Describe):
         if msg.kind == "S":
             prep = self._get_prepared(msg.name)
             self.writer.write(p.parameter_description(prep.param_oids))
-            fields = self._describe_fields(prep.translated, ())
+            fields = await self._describe_fields(prep.translated, ())
         else:
             portal = self.portals.get(msg.name)
             if portal is None:
@@ -322,7 +347,7 @@ class _Session:
                     sql_state.INVALID_CURSOR_NAME,
                     f'portal "{msg.name}" does not exist',
                 )
-            fields = self._describe_fields(
+            fields = await self._describe_fields(
                 portal.prepared.translated, portal.params, portal.result_formats
             )
         if fields is None:
@@ -330,7 +355,7 @@ class _Session:
         else:
             self.writer.write(p.row_description(fields))
 
-    def _describe_fields(
+    async def _describe_fields(
         self, t: tr.Translated, params, result_formats=()
     ) -> Optional[List[p.FieldDesc]]:
         """Column metadata without side effects: reads run LIMIT-0."""
@@ -339,13 +364,25 @@ class _Session:
                 return [p.FieldDesc(name="setting")]
             return None
         pad = tuple(params) + (None,) * 16  # unbound params describe as NULL
-        cur = self.agent.store.conn.execute(
-            f"SELECT * FROM ({t.sql}) LIMIT 0", pad[: max(t.n_params, len(params))]
-        )
+        bound = pad[: max(t.n_params, len(params))]
+        sql = f"SELECT * FROM ({t.sql}) LIMIT 0"
+        store = self.agent.store
+        if self.tx is not None or not store.has_read_pool:
+            cur = store.conn.execute(sql, bound)
+            desc = cur.description or []
+        else:
+            # LIMIT-0 is cheap once running, but pool acquire can block when
+            # all members are checked out — keep it off the event loop
+            def blocking_describe():
+                with store.interruptible_read(slow_warn_s=None) as conn:
+                    if catalog.mentions_catalog(t.sql):
+                        catalog.refresh_pg_class(conn)
+                    return conn.execute(sql, bound).description or []
+
+            desc = await asyncio.to_thread(blocking_describe)
         fmt = result_formats[0] if len(result_formats) == 1 else 0
         return [
-            p.FieldDesc(name=d[0], oid=p.OID_TEXT, fmt=fmt)
-            for d in (cur.description or [])
+            p.FieldDesc(name=d[0], oid=p.OID_TEXT, fmt=fmt) for d in desc
         ]
 
     async def _execute_portal(self, msg: p.Execute):
@@ -430,7 +467,9 @@ class _Session:
                 w.write(p.command_complete(tag))
             return
         if t.kind == "read":
-            self._run_read(t, params, result_formats, describe_rows, portal, max_rows)
+            await self._run_read(
+                t, params, result_formats, describe_rows, portal, max_rows
+            )
             return
         if t.kind == "ddl":
             await self._run_ddl(t)
@@ -467,15 +506,47 @@ class _Session:
             self.agent.write_sema.release()
         return tag
 
-    def _run_read(
+    async def _run_read(
         self, t, params, result_formats, describe_rows, portal, max_rows
     ):
-        conn = self.agent.store.conn
-        if catalog.mentions_catalog(t.sql):
-            catalog.refresh_pg_class(conn)
-        cur = conn.execute(t.sql, tuple(params))
-        desc = cur.description or []
-        rows = cur.fetchall()
+        if self.tx is not None:
+            # inside an explicit tx reads MUST see its uncommitted rows, so
+            # they stay on the write conn (held by this session anyway)
+            conn = self.agent.store.conn
+            if catalog.mentions_catalog(t.sql):
+                catalog.refresh_pg_class(conn)
+            cur = conn.execute(t.sql, tuple(params))
+            desc = cur.description or []
+            rows = cur.fetchall()
+        elif not self.agent.store.has_read_pool:
+            # in-memory fallback: reads share the WRITER conn, so they must
+            # stay on the event loop — a worker thread would interleave with
+            # another session's open write transaction (dirty reads)
+            conn = self.agent.store.conn
+            if catalog.mentions_catalog(t.sql):
+                catalog.refresh_pg_class(conn)
+            cur = conn.execute(t.sql, tuple(params))
+            desc = cur.description or []
+            rows = cur.fetchall()
+        else:
+            # RO pool + watchdog + worker thread: one slow PG query must not
+            # stall gossip/ingest/SWIM on the event loop (mirrors
+            # api/http.py's /v1/queries hardening)
+            perf = self.agent.config.perf
+            store = self.agent.store
+
+            def blocking_read():
+                with store.interruptible_read(
+                    timeout_s=perf.statement_timeout_s,
+                    slow_warn_s=perf.slow_query_warn_s,
+                    label=t.sql,
+                ) as conn:
+                    if catalog.mentions_catalog(t.sql):
+                        catalog.refresh_pg_class(conn)
+                    cur = conn.execute(t.sql, tuple(params))
+                    return cur.description or [], cur.fetchall()
+
+            desc, rows = await asyncio.to_thread(blocking_read)
         fmt = result_formats[0] if len(result_formats) == 1 else 0
         fields = [
             p.FieldDesc(
@@ -519,14 +590,11 @@ class _Session:
 
     async def _run_write(self, t: tr.Translated, params):
         if self.tx is not None:
-            cur = self.tx.execute(t.sql, tuple(params))
-            n = max(cur.rowcount, 0)
+            self.tx.execute(t.sql, tuple(params))
         else:
             async with self.agent.write_sema:
-                cursors, _info = self.agent.exec_transaction_cursors(
-                    [(t.sql, tuple(params))]
-                )
-            n = max(cursors[0].rowcount, 0) if cursors else 0
+                self.agent.exec_transaction_cursors([(t.sql, tuple(params))])
+        n = max(self.agent.store.last_dml_changes, 0)
         if t.tag == "INSERT":
             self.writer.write(p.command_complete(f"INSERT 0 {n}"))
         else:

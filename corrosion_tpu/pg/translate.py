@@ -23,8 +23,111 @@ _SESSION_RE = re.compile(
 _TX_RE = re.compile(
     r"^\s*(BEGIN|START\s+TRANSACTION|COMMIT|END|ROLLBACK|ABORT)\b", re.I
 )
-_READ_RE = re.compile(r"^\s*(SELECT|VALUES|EXPLAIN|WITH|TABLE|PRAGMA)\b", re.I)
+_READ_RE = re.compile(r"^\s*(SELECT|VALUES|EXPLAIN|TABLE)\b", re.I)
 _DDL_RE = re.compile(r"^\s*(CREATE|DROP|ALTER)\b", re.I)
+_WITH_RE = re.compile(r"^\s*WITH\b", re.I)
+_PRAGMA_RE = re.compile(r"^\s*PRAGMA\s+(?:[\w.]+\.)?(\w+)\s*(\(|=)?", re.I)
+
+# PRAGMAs with no connection/database side effects: safe on the read path.
+# Everything else (journal_mode, synchronous, writable pragmas, and any
+# `PRAGMA x = v` assignment) is rejected — a PG client must not mutate the
+# shared connection state (the reference's StmtTag parser never lets
+# PRAGMA through at all, corro-pg/src/lib.rs:149-170).
+_READONLY_PRAGMAS = frozenset(
+    {
+        "table_info",
+        "table_xinfo",
+        "table_list",
+        "index_list",
+        "index_info",
+        "index_xinfo",
+        "database_list",
+        "collation_list",
+        "foreign_key_list",
+        "function_list",
+        "compile_options",
+        "freelist_count",
+        "page_count",
+        "page_size",
+        "schema_version",
+        "user_version",
+        "data_version",
+        "integrity_check",
+        "quick_check",
+    }
+)
+
+_CTE_VERBS = frozenset({"SELECT", "VALUES", "INSERT", "UPDATE", "DELETE", "REPLACE"})
+
+
+class UnsupportedStatement(ValueError):
+    """Raised for statements that must not reach the store (e.g. non-
+    read-only PRAGMA, malformed CTE)."""
+
+
+def _cte_main_verb(s: str) -> str:
+    """First top-level (paren-depth-0) verb after a WITH prefix.
+
+    A writable CTE (``WITH x AS (...) INSERT ...``) is valid SQLite and
+    MUST be routed through the write path: classifying it as a read would
+    commit rows outside the write lock with a stale db_version — silent
+    replica divergence (advisor finding r1-high).  CTE bodies always sit
+    inside parens, so a depth-0 token scan finds the main verb.
+    """
+    depth = 0
+    i, n = 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "'":
+            i += 1
+            while i < n:
+                if s[i] == "'":
+                    if i + 1 < n and s[i + 1] == "'":
+                        i += 2
+                        continue
+                    break
+                i += 1
+            i += 1
+            continue
+        if c == '"':
+            j = s.find('"', i + 1)
+            i = n if j < 0 else j + 1
+            continue
+        if c == "`":  # SQLite backtick-quoted identifier (`delete` is valid)
+            j = s.find("`", i + 1)
+            i = n if j < 0 else j + 1
+            continue
+        if c == "[":  # SQLite bracket-quoted identifier
+            j = s.find("]", i + 1)
+            i = n if j < 0 else j + 1
+            continue
+        if s[i : i + 2] == "--":
+            j = s.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if s[i : i + 2] == "/*":
+            j = s.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            continue
+        if c == "(":
+            depth += 1
+            i += 1
+            continue
+        if c == ")":
+            depth -= 1
+            i += 1
+            continue
+        if depth == 0 and (c.isalpha() or c == "_"):
+            j = i
+            while j < n and (s[j].isalnum() or s[j] == "_"):
+                j += 1
+            word = s[i:j].upper()
+            if word in _CTE_VERBS:
+                return word
+            i = j
+            continue
+        i += 1
+    raise UnsupportedStatement("WITH statement has no top-level verb")
 
 _TYPE_MAP = {
     "int2": "INTEGER",
@@ -72,9 +175,22 @@ def classify(sql: str) -> Tuple[str, str]:
     m = _SESSION_RE.match(s)
     if m:
         return m.group(1).upper(), "session"
+    if s[:6].upper() == "PRAGMA":
+        m = _PRAGMA_RE.match(s)
+        if not m:
+            raise UnsupportedStatement("malformed PRAGMA")
+        name, trailer = m.group(1).lower(), m.group(2)
+        if trailer == "=" or name not in _READONLY_PRAGMAS:
+            raise UnsupportedStatement(f"PRAGMA {name} is not allowed over PG")
+        return "PRAGMA", "read"
+    if _WITH_RE.match(s):
+        verb = _cte_main_verb(s)
+        if verb in ("SELECT", "VALUES"):
+            return "SELECT", "read"
+        return verb, "write"  # writable CTE → write path
     if _READ_RE.match(s):
         first = s.split(None, 1)[0].upper()
-        return ("SELECT" if first in ("TABLE", "VALUES", "WITH") else first), "read"
+        return ("SELECT" if first in ("TABLE", "VALUES") else first), "read"
     if _DDL_RE.match(s):
         words = s.split()
         return " ".join(w.upper() for w in words[:2]), "ddl"

@@ -139,8 +139,12 @@ def budget_prefix_mask(mask: jnp.ndarray, budget_bytes: int, cfg: SimConfig) -> 
     governor and the sync budget."""
     p = mask.shape[-1]
     # clamp to p: rank never exceeds p, and an unclamped "unlimited"
-    # budget must not overflow the narrow rank dtype
-    max_count = max(1, min(budget_bytes // cfg.default_payload_bytes, p))
+    # budget must not overflow the narrow rank dtype.  A budget below one
+    # payload sends NOTHING — matching the reference's governor, which
+    # simply blocks until the limiter has room (broadcast/mod.rs:460-463)
+    max_count = min(budget_bytes // cfg.default_payload_bytes, p)
+    if max_count <= 0:
+        return jnp.zeros_like(mask)
     rank_dtype = jnp.int16 if p <= 32767 else jnp.int32
     cum = jnp.cumsum(mask, axis=-1, dtype=rank_dtype)  # 1-indexed rank
     return mask & (cum <= max_count)

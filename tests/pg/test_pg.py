@@ -226,3 +226,87 @@ def test_portal_suspension_max_rows():
         assert rows == 8
 
     asyncio.run(_with_pg(1, body))
+
+
+def test_writable_cte_routes_through_write_path():
+    """Advisor r1-high: WITH x AS (...) INSERT must be versioned +
+    broadcastable, not slip through the read path with a stale db_version."""
+
+    async def body(cluster, clients):
+        agent = cluster.agents[0]
+        v0 = agent.store.db_version()
+        res = await clients[0].query(
+            "WITH src AS (SELECT 40 AS id, 'cte' AS t) "
+            "INSERT INTO tests (id, text) SELECT id, t FROM src"
+        )
+        assert res[0].tag == "INSERT 0 1"
+        assert agent.store.db_version() == v0 + 1
+        changes = agent.store.changes_for_version(
+            agent.actor_id, agent.store.db_version()
+        )
+        assert any(ch.table == "tests" for ch in changes)
+        # read-only CTE still classified (and served) as a read
+        res = await clients[0].query(
+            "WITH c AS (SELECT count(*) AS n FROM tests) SELECT n FROM c"
+        )
+        assert res[0].tag == "SELECT 1"
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_pragma_policy_over_pg():
+    """Advisor r1-high: state-mutating PRAGMAs must be rejected; harmless
+    introspection PRAGMAs stay available on the read lane."""
+
+    async def body(cluster, clients):
+        c = clients[0]
+        for bad in (
+            "PRAGMA journal_mode = DELETE",
+            "PRAGMA synchronous = OFF",
+            "PRAGMA journal_mode",  # read form of a connection-state pragma
+        ):
+            with pytest.raises(PgClientError) as ei:
+                await c.query(bad)
+            assert ei.value.code == "0A000"
+        res = await c.query("PRAGMA table_info(tests)")
+        assert any("id" in r for r in res[0].rows)
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_extended_error_rfq_only_on_sync():
+    """Advisor r1-medium: after an extended-protocol error the server must
+    swallow messages until Sync and answer THAT with ReadyForQuery — a
+    premature RFQ desyncs Flush-pipelining drivers."""
+
+    async def body(cluster, clients):
+        import struct
+
+        from corrosion_tpu.pg.client import _frame
+
+        c = clients[0]
+        w = c.writer
+        # Parse a statement rejected at Parse time, then Flush (no Sync yet)
+        w.write(
+            _frame(
+                b"P",
+                b"\x00" + b"PRAGMA journal_mode = DELETE\x00" + struct.pack("!h", 0),
+            )
+        )
+        w.write(_frame(b"H", b""))
+        await w.drain()
+        tag, _ = await c._read_backend()
+        assert tag == b"E"  # ErrorResponse...
+        # ...and NOTHING else yet: a Bind sent now must be discarded silently
+        w.write(
+            _frame(b"B", b"\x00\x00" + struct.pack("!hhh", 0, 0, 0))
+        )
+        w.write(_frame(b"S", b""))
+        await w.drain()
+        tag, body_ = await c._read_backend()
+        assert tag == b"Z"  # RFQ arrives only in response to Sync
+        # session still usable afterwards
+        res = await c.query("SELECT 1")
+        assert res[0].rows == [("1",)]
+
+    asyncio.run(_with_pg(1, body))

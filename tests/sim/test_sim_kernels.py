@@ -185,3 +185,18 @@ def test_chunked_versions_cover():
     final, metrics = run(cfg, meta)
     assert bool((np.asarray(metrics.converged_at) >= 0).all())
     assert np.asarray(final.have).min() == 1
+
+
+def test_budget_below_one_payload_sends_nothing():
+    """Advisor r1-low: a byte budget smaller than one payload transmits
+    ZERO payloads (the reference's governor blocks; no at-least-one floor)."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.sim.state import budget_prefix_mask
+
+    cfg = SimConfig(n_nodes=4, n_payloads=8, default_payload_bytes=1024)
+    mask = jnp.ones((4, 8), bool)
+    out = budget_prefix_mask(mask, budget_bytes=512, cfg=cfg)
+    assert int(out.sum()) == 0
+    out = budget_prefix_mask(mask, budget_bytes=2048, cfg=cfg)
+    assert (out.sum(axis=-1) == 2).all()
