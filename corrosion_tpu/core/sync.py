@@ -7,8 +7,12 @@ ranges the peer can supply.  ``generate_sync`` (sync.rs:284-333) builds our
 advertisement from the bookie.  The reference's unit test
 (sync.rs:380-501) is ported in `tests/core/test_sync_needs.py`.
 
-The same algebra runs vectorised on device in `corrosion_tpu.sim.sync`
-(fixed-K gap tensors); this module is the scalar spec.
+The same algebra runs vectorised on device: `corrosion_tpu.sim.gaps`
+holds the fixed-K gap interval tensors (extract_gaps/gaps_to_mask) and
+`corrosion_tpu.sim.sync.edge_needs` evaluates the three need classes per
+sampled sync edge.  This module is the scalar spec;
+tests/sim/test_gap_kernels.py property-tests the two against each other
+on randomized two-node traces (identical effective transfers).
 """
 
 from __future__ import annotations

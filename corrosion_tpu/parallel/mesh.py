@@ -37,6 +37,7 @@ def state_shardings(mesh: Mesh, swim_full_view: bool) -> SimState:
     r = NamedSharding(mesh, P())  # replicated
     n0 = NamedSharding(mesh, P(NODE_AXIS))
     n0p = NamedSharding(mesh, P(NODE_AXIS, None))
+    n0ak = NamedSharding(mesh, P(NODE_AXIS, None, None))
     dn = NamedSharding(mesh, P(None, NODE_AXIS, None))
     swim = n0p if swim_full_view else r
     return SimState(
@@ -45,6 +46,8 @@ def state_shardings(mesh: Mesh, swim_full_view: bool) -> SimState:
         sync_countdown=n0, alive=n0, incarnation=n0, group=n0,
         view=swim, vinc=swim, suspect_since=swim,
         converged_at=n0,
+        heads=n0p, gap_lo=n0ak, gap_hi=n0ak,
+        pid=n0p, pkey=n0p, psince=n0p,
     )
 
 

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from .state import ALIVE, PayloadMeta, SimConfig, SimState, budget_prefix_mask
+from .swim import sample_member_targets
 from .topology import Topology, edge_alive, edge_delay, edge_drop
 
 
@@ -44,14 +45,17 @@ def broadcast_step(
     # governor (broadcast/mod.rs:453-463)
     sending = budget_prefix_mask(eligible, cfg.rate_limit_bytes_round, cfg)
 
-    # sample fanout targets per node (uniform over the id space; down or
-    # partitioned targets are masked at the edge level, matching SWIM's
-    # lagging membership view rather than an oracle)
-    targets = jax.random.randint(k_targets, (n, f), 0, n, jnp.int32)  # [N, F]
+    # fanout targets come from each node's believed member list (the
+    # reference's choose_count sample over Members.states,
+    # broadcast/mod.rs:653-680) — false suspicion starves a live node;
+    # ground-truth delivery masks still apply below
+    targets = sample_member_targets(state, cfg, k_targets, f)  # [N, F]
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
     dst = targets.reshape(-1)  # [E]
+    ok = dst >= 0
+    dst = jnp.maximum(dst, 0)
 
-    ok = edge_alive(state.group, state.alive, src, dst)
+    ok &= edge_alive(state.group, state.alive, src, dst)
     ok &= ~edge_drop(topo, k_drop, src.shape[0])
     ok &= dst != src
     delay = edge_delay(topo, region, src, dst)  # [E]

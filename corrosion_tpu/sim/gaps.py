@@ -1,0 +1,104 @@
+"""Fixed-K version-gap interval tensors — the device form of the
+reference's gap algebra.
+
+The reference tracks, per (node, origin-actor), the set of version ranges
+it has never seen any data for: `BookedVersions`'s `RangeInclusiveSet`
+persisted to `__corro_bookkeeping_gaps` (agent.rs:1092-1236, 1261-1437).
+`generate_sync` advertises them as `need`; `compute_available_needs`
+(sync.rs:127-249) intersects our needs with a peer's fully-held set.
+
+On device the rangemap becomes two fixed-K tensors per (node, actor):
+``gap_lo/gap_hi[N, A, K]`` (1-based inclusive version ranges, 0 = empty
+slot).  K overflow is handled conservatively: the K-th slot's hi is
+extended to the last missing version, merging every overflow run into one
+range.  That direction is SAFE — a node may *request* versions it already
+has (the chunk-level grant mask filters those out), and a server may
+*under-advertise* (versions inside the merged range look missing), which
+slows convergence but never corrupts it.  `gap_overflow` counts clamped
+(node, actor) pairs so runs can report the distortion.
+
+The scalar spec for all of this is `corrosion_tpu.core.sync` /
+`core.bookkeeping`; tests/sim/test_gap_kernels.py property-tests the two
+against each other on randomized traces.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .state import SimConfig
+
+
+class GapTensors(NamedTuple):
+    lo: jnp.ndarray  # i32[N, A, K] 1-based range starts, 0 = empty slot
+    hi: jnp.ndarray  # i32[N, A, K] inclusive ends
+    overflow: jnp.ndarray  # bool[N, A] had more than K runs (clamped)
+
+
+def extract_gaps(
+    touched: jnp.ndarray, heads: jnp.ndarray, cfg: SimConfig
+) -> GapTensors:
+    """Run-length-extract needed version ranges into fixed-K interval slots.
+
+    ``touched[N, A, V]`` — any chunk of the version arrived (the bookie
+    knows the version, complete or partial); ``heads[N, A]`` — max touched
+    version.  A *gap* is a maximal run of untouched versions below the
+    head — exactly the ranges `VersionsSnapshot::insert_db` would persist
+    (agent.rs:1092-1236).  Untouched versions above the head are not gaps;
+    they are the head-catchup range of `compute_available_needs`.
+
+    Pure gather/scatter + cumsum — one fused XLA pass per round.
+    """
+    n, a, v = touched.shape
+    k = cfg.gap_slots
+    v_idx = jnp.arange(1, v + 1, dtype=jnp.int32)  # 1-based versions
+
+    missing = (~touched) & (v_idx[None, None, :] <= heads[:, :, None])
+    prev = jnp.pad(missing[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
+    nxt = jnp.pad(missing[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
+    start = missing & ~prev
+    end = missing & ~nxt
+    # run index (1-based) at every position of its run
+    rank = jnp.cumsum(start, axis=2, dtype=jnp.int32)
+
+    # scatter run boundaries into K slots (runs beyond K contribute 0)
+    rows = jnp.arange(n * a, dtype=jnp.int32)[:, None]  # [N*A, 1]
+    slot = jnp.clip(rank - 1, 0, k - 1).reshape(n * a, v)
+    keep = (rank <= k).reshape(n * a, v)
+    lo_vals = jnp.where(start.reshape(n * a, v) & keep, v_idx[None, :], 0)
+    hi_vals = jnp.where(end.reshape(n * a, v) & keep, v_idx[None, :], 0)
+    lo = jnp.zeros((n * a, k), jnp.int32).at[rows, slot].max(lo_vals)
+    hi = jnp.zeros((n * a, k), jnp.int32).at[rows, slot].max(hi_vals)
+    lo = lo.reshape(n, a, k)
+    hi = hi.reshape(n, a, k)
+
+    # overflow clamp: merge runs K.. into slot K-1 by extending its hi to
+    # the last missing version (over-covers; see module docstring)
+    overflow = rank[:, :, -1] > k
+    last_missing = (missing * v_idx[None, None, :]).max(axis=2)  # [N, A]
+    hi = hi.at[:, :, k - 1].set(
+        jnp.where(overflow, last_missing, hi[:, :, k - 1])
+    )
+    return GapTensors(lo=lo, hi=hi, overflow=overflow)
+
+
+def gaps_to_mask(lo: jnp.ndarray, hi: jnp.ndarray, n_versions: int) -> jnp.ndarray:
+    """Expand interval tensors [..., K] back to a dense bool mask
+    [..., V] over 1-based versions, via the difference-array trick (no
+    [..., V, K] intermediate): +1 at each lo, -1 past each hi, cumsum.
+    """
+    *batch, k = lo.shape
+    rows_n = math.prod(batch) if batch else 1
+    flat_lo = lo.reshape(rows_n, k)
+    flat_hi = hi.reshape(rows_n, k)
+    valid = (flat_lo > 0).astype(jnp.int32)
+    rows = jnp.arange(rows_n, dtype=jnp.int32)[:, None]
+    # index v (1-based) lives at delta position v; empty slots hit 0
+    delta = jnp.zeros((rows_n, n_versions + 2), jnp.int32)
+    delta = delta.at[rows, jnp.clip(flat_lo, 0, n_versions + 1)].add(valid)
+    delta = delta.at[rows, jnp.clip(flat_hi + 1, 0, n_versions + 1)].add(-valid)
+    covered = jnp.cumsum(delta, axis=1)[:, 1 : n_versions + 1] > 0
+    return covered.reshape(*batch, n_versions)

@@ -19,7 +19,19 @@ import jax
 import jax.numpy as jnp
 
 from .broadcast import broadcast_step, deliver_step, inject_step
-from .state import ALIVE, PayloadMeta, SimConfig, SimState, init_state
+from .gaps import extract_gaps
+from .state import (
+    ALIVE,
+    PayloadMeta,
+    SimConfig,
+    SimState,
+    complete_versions,
+    init_state,
+    touched_versions,
+    version_active,
+    version_heads,
+    grid_to_payload,
+)
 from .swim import swim_step
 from .sync import sync_step
 from .topology import Topology, regions
@@ -28,8 +40,8 @@ from .topology import Topology, regions
 class RunMetrics(NamedTuple):
     """Per-run convergence record (device)."""
 
-    coverage_at: jnp.ndarray  # i32[P] round when payload reached every up node
-    converged_at: jnp.ndarray  # i32[N] round when node held all active payloads
+    coverage_at: jnp.ndarray  # i32[P] round when payload's VERSION was applied cluster-wide
+    converged_at: jnp.ndarray  # i32[N] round when node applied all active versions
 
 
 def new_metrics(cfg: SimConfig) -> RunMetrics:
@@ -68,17 +80,29 @@ def round_step(
     state = deliver_step(state, cfg)
     state = swim_step(state, cfg, topo, k_swim)
 
-    # convergence bookkeeping: only payloads that actually entered the
-    # system count (a dead origin's commits never existed cluster-wide)
-    up = (state.alive == ALIVE)[:, None]  # [N, 1]
-    active = (state.injected > 0)[None, :]  # [1, P]
-    held = state.have > 0
+    # refresh the advertised bookkeeping tensors from this round's chunk
+    # arrivals (generate_sync's snapshot; next round's sync reads them)
+    touched = touched_versions(state.have, cfg)  # [N, A, V]
+    heads = version_heads(touched)  # [N, A]
+    gaps = extract_gaps(touched, heads, cfg)
+    state = state._replace(heads=heads, gap_lo=gaps.lo, gap_hi=gaps.hi)
 
-    payload_done = jnp.all(held | ~up | ~active, axis=0) & active[0]  # [P]
+    # convergence bookkeeping: a node holds a version only when EVERY
+    # chunk arrived (the fully-buffered apply gate, util.rs:986-1005);
+    # only versions that actually entered the system count (a dead
+    # origin's commits never existed cluster-wide)
+    up = state.alive == ALIVE  # [N]
+    comp = complete_versions(state.have, cfg)  # [N, A, V]
+    act = version_active(state.injected, cfg)  # [A, V]
+
+    version_done = (
+        jnp.all(comp | ~up[:, None, None], axis=0) & act
+    )  # [A, V] applied at every up node
+    payload_done = grid_to_payload(version_done, cfg)  # [P]
     coverage_at = jnp.where(
         (metrics.coverage_at < 0) & payload_done, state.t, metrics.coverage_at
     )
-    node_done = jnp.all(held | ~active, axis=1) & up[:, 0]  # [N]
+    node_done = jnp.all(comp | ~act[None], axis=(1, 2)) & up  # [N]
     all_injected = jnp.all(meta.round <= state.t)
     converged_at = jnp.where(
         (metrics.converged_at < 0) & node_done & all_injected,

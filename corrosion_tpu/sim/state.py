@@ -5,12 +5,20 @@ the reference's per-node `BookedVersions`/broadcast queues/SWIM state
 (SURVEY.md §2.3) become node-major tensors, and one jitted `round_step`
 advances every node at once.
 
-State layout:
-- ``have[N, P] u8``     — node n holds payload p (a changeset chunk).  This is
-  the on-device form of corro-types' `Changeset` dissemination state: L6
-  broadcast marks bits via sampled fan-out edges, L7 sync fills them via
-  pairwise need pulls (need = ~have[i] & have[j], which is exactly
-  `compute_available_needs` restricted to the active window).
+State layout (the north-star "node×changeset-version matrix"):
+- ``have[N, P] u8``     — node n holds payload p (a changeset chunk).  The
+  payload axis is a flattened (version, actor, chunk) grid — ``have`` IS the
+  seq-occupancy bitmap of SURVEY §5's long-context analog.  A version counts
+  as **applied** only when every one of its chunks arrived (the reference's
+  fully-buffered gate, util.rs:986-1005, run_root.rs:180-194); convergence
+  counts applied versions, never loose chunks.
+- ``heads[N, A] i32``   — per (node, origin-actor) max version seen (any
+  chunk), ≡ `BookedVersions.last()` / the `heads` advertised in
+  `generate_sync` (sync.rs:284-333).
+- ``gap_lo/gap_hi[N, A, K] i32`` — fixed-K needed version ranges per
+  (node, actor), 1-based inclusive, 0 = empty slot: the device form of the
+  `__corro_bookkeeping_gaps` interval algebra (agent.rs:1092-1236).  L7 sync
+  computes needs from these tensors (see sim/gaps.py).
 - ``relay_left[N, P] u8`` — remaining epidemic retransmissions
   (`max_transmissions` decay, broadcast/mod.rs:653-778).
 - ``inflight[D, N, P] u8`` — latency ring buffer: deliveries scheduled d
@@ -31,6 +39,7 @@ round; a payload activates once the sim reaches it).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import NamedTuple, Optional
 
 import jax
@@ -52,6 +61,13 @@ class SimConfig:
 
     n_nodes: int
     n_payloads: int
+    # payload layout: P = n_versions * n_writers * chunks_per_version in
+    # version-major order (see uniform_payloads); the kernels reshape
+    # have[N, P] into the (node, actor, version, chunk) grid with these
+    n_writers: int = 1
+    chunks_per_version: int = 1
+    # fixed-K gap interval slots per (node, actor) — SURVEY §7 state layout
+    gap_slots: int = 8
     # broadcast (L6)
     fanout: int = 3  # num_indirect_probes floor of choose_count
     max_transmissions: int = 10
@@ -62,6 +78,28 @@ class SimConfig:
     sync_budget_bytes: int = 4 * 1024 * 1024
     # SWIM (L5)
     swim_full_view: bool = False
+    # partial-view SWIM (sim/pswim.py): O(N·M) direct-mapped member
+    # tables instead of O(N²) belief matrices — the scale tier that lets
+    # the 10k/100k configs run real membership (VERDICT r1 item 3)
+    swim_partial_view: bool = False
+    member_slots: int = 64  # M buckets per node
+    gossip_entries: int = 8  # table entries piggybacked per gossip push
+    # DOWN table entries resist eviction until this old (the
+    # remove_down_after=48h analog, broadcast/mod.rs:951-960) so a
+    # rejoining member can still be healed in place by precedence; kept
+    # MUCH longer than refute/rejoin latency, as in the reference
+    down_gc_rounds: int = 600
+    # couple dissemination to membership: broadcast/sync/probe targets are
+    # drawn from each node's believed member list (view != DOWN), so false
+    # suspicion slows convergence exactly as in the reference, where
+    # targets come from Members.states with down members removed
+    # (broadcast/mod.rs:653-680, handlers.rs:279-366)
+    couple_membership: bool = True
+    # re-announce cadence: every tick each up node pushes its self-belief
+    # to ONE uniformly random node, bypassing its own member list — the
+    # bootstrap/announcer seam (spawn_swim_announcer, util.rs:104-123)
+    # that recovers from mutual false-DOWN after partitions
+    announce_interval_rounds: int = 4
     probe_period_rounds: int = 2  # probe every ~1 s
     suspect_timeout_rounds: int = 6  # ~3 s suspicion
     indirect_probes: int = 3
@@ -70,8 +108,85 @@ class SimConfig:
     # payload byte size assumed when metadata gives none
     default_payload_bytes: int = 8 * 1024
 
+    def __post_init__(self) -> None:
+        wave = self.n_writers * self.chunks_per_version
+        if self.n_payloads % wave != 0:
+            raise ValueError(
+                f"n_payloads={self.n_payloads} must be a multiple of "
+                f"n_writers*chunks_per_version={wave} (version-major grid)"
+            )
+        if self.swim_full_view and self.swim_partial_view:
+            raise ValueError("pick ONE of swim_full_view / swim_partial_view")
+        if self.swim_partial_view and self.n_nodes > 131072:
+            # pswim packs (belief_key, id) into one i32 scatter word:
+            # id needs 17 bits (see pswim.py)
+            raise ValueError("partial-view SWIM supports at most 2^17 nodes")
+
+    @classmethod
+    def wan_tuned(cls, n_nodes: int, **kw) -> "SimConfig":
+        """Cluster-size-adaptive SWIM timing — the analog of the reference
+        re-tuning foca's WAN config as the cluster-size estimate moves
+        (broadcast/mod.rs:236-256, 951-960): suspicion windows grow with
+        log₂(N) so detection stays accurate as gossip paths lengthen."""
+        log = max(3, math.ceil(math.log2(n_nodes + 1)))
+        kw.setdefault("probe_period_rounds", 2)
+        kw.setdefault("suspect_timeout_rounds", log)
+        kw.setdefault("indirect_probes", 3)
+        kw.setdefault("announce_interval_rounds", max(4, log // 2))
+        return cls(n_nodes=n_nodes, **kw)
+
+    @property
+    def n_versions(self) -> int:
+        return self.n_payloads // (self.n_writers * self.chunks_per_version)
+
     def sync_peers_clamped(self) -> int:
         return max(3, min(10, self.n_nodes // 100 or 3))
+
+
+# -- (actor, version, chunk) grid views of the payload axis ------------------
+#
+# Payload index p = (v * A + a) * C + c (version-major, uniform_payloads).
+# These helpers are the only place that layout knowledge lives.
+
+
+def chunk_grid(have: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """bool[N, A, V, C] chunk-occupancy grid from have[N, P]."""
+    n = have.shape[0]
+    g = (have > 0).reshape(n, cfg.n_versions, cfg.n_writers, cfg.chunks_per_version)
+    return g.transpose(0, 2, 1, 3)
+
+
+def complete_versions(have: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """bool[N, A, V]: version fully received (every chunk) — the apply gate
+    (`process_fully_buffered_changes` fires only at gaps==0, util.rs:986)."""
+    return chunk_grid(have, cfg).all(axis=3)
+
+
+def touched_versions(have: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """bool[N, A, V]: any chunk of the version arrived (≡ the version is in
+    the bookie — complete or partial)."""
+    return chunk_grid(have, cfg).any(axis=3)
+
+
+def version_heads(touched: jnp.ndarray) -> jnp.ndarray:
+    """i32[N, A] max 1-based version touched (BookedVersions.last())."""
+    v = jnp.arange(1, touched.shape[2] + 1, dtype=jnp.int32)
+    return (touched * v[None, None, :]).max(axis=2)
+
+
+def grid_to_payload(x_av: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """Broadcast a per-(actor, version) array [..., A, V] back onto the
+    payload axis [..., P]."""
+    swapped = jnp.swapaxes(x_av, -1, -2)  # [..., V, A]
+    tiled = jnp.repeat(swapped[..., None], cfg.chunks_per_version, axis=-1)
+    return tiled.reshape(*x_av.shape[:-2], cfg.n_payloads)
+
+
+def version_active(injected: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """bool[A, V]: some chunk of the version was injected (the version
+    exists cluster-wide)."""
+    g = (injected > 0).reshape(cfg.n_versions, cfg.n_writers, cfg.chunks_per_version)
+    return g.any(axis=2).T
 
 
 class PayloadMeta(NamedTuple):
@@ -104,12 +219,40 @@ class SimState(NamedTuple):
     suspect_since: jnp.ndarray  # i32[N, N] or [0, 0]
     # per-node converged-at round (-1 while not converged) for p99 stats
     converged_at: jnp.ndarray  # i32[N]
+    # bookkeeping tensors (north-star layout; refreshed once per round from
+    # `have` by round_step, consumed by the next round's sync)
+    heads: jnp.ndarray  # i32[N, A] max version touched per (node, actor)
+    gap_lo: jnp.ndarray  # i32[N, A, K] needed-range starts (1-based, 0=empty)
+    gap_hi: jnp.ndarray  # i32[N, A, K] needed-range ends (inclusive)
+    # partial-view SWIM member tables ([0, 0] when disabled; see pswim.py)
+    pid: jnp.ndarray  # i32[N, M] member id per bucket, -1 = empty
+    pkey: jnp.ndarray  # i32[N, M] belief key inc*4 + state
+    psince: jnp.ndarray  # i32[N, M] round the entry became SUSPECT/DOWN, -1 = n/a
+
+
+def init_pview(cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
+    """i32[N, M] initial member tables: bucket b of node n holds a random
+    id with residue b mod M (a random M-member sample of the cluster —
+    the bootstrap-seeded member list each node starts from); -1 where the
+    draw lands on self or past N."""
+    n, m = cfg.n_nodes, cfg.member_slots
+    per = (n + m - 1) // m  # ids per residue class
+    r = jax.random.randint(key, (n, m), 0, per, jnp.int32)
+    pid = jnp.arange(m, dtype=jnp.int32)[None, :] + m * r
+    me = jnp.arange(n, dtype=jnp.int32)[:, None]
+    return jnp.where((pid < n) & (pid != me), pid, -1)
 
 
 def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
     n, p = cfg.n_nodes, cfg.n_payloads
     swim_n = cfg.n_nodes if cfg.swim_full_view else 0
-    key, sub = jax.random.split(key)
+    pm = cfg.member_slots if cfg.swim_partial_view else 0
+    key, sub, kview = jax.random.split(key, 3)
+    pid = (
+        init_pview(cfg, kview)
+        if cfg.swim_partial_view
+        else jnp.zeros((n, 0), jnp.int32)
+    )
     return SimState(
         t=jnp.zeros((), jnp.int32),
         key=key,
@@ -127,6 +270,14 @@ def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
         vinc=jnp.zeros((swim_n, swim_n), jnp.int32),
         suspect_since=jnp.full((swim_n, swim_n), -1, jnp.int32),
         converged_at=jnp.full((n,), -1, jnp.int32),
+        heads=jnp.zeros((n, cfg.n_writers), jnp.int32),
+        gap_lo=jnp.zeros((n, cfg.n_writers, cfg.gap_slots), jnp.int32),
+        gap_hi=jnp.zeros((n, cfg.n_writers, cfg.gap_slots), jnp.int32),
+        pid=pid,
+        pkey=jnp.where(pid >= 0, jnp.int32(ALIVE), jnp.int32(-1))
+        if cfg.swim_partial_view
+        else jnp.zeros((n, pm), jnp.int32),
+        psince=jnp.full((n, pm), -1, jnp.int32),
     )
 
 
@@ -152,27 +303,22 @@ def budget_prefix_mask(mask: jnp.ndarray, budget_bytes: int, cfg: SimConfig) -> 
 
 def uniform_payloads(
     cfg: SimConfig,
-    n_writers: int = 1,
-    versions_per_writer: Optional[int] = None,
-    chunks_per_version: int = 1,
     inject_every: int = 1,
     payload_bytes: Optional[int] = None,
 ) -> PayloadMeta:
-    """A write-storm scenario: ``n_writers`` origins each commit versions of
-    ``chunks_per_version`` chunks, injected ``inject_every`` rounds apart.
+    """A write-storm scenario: ``cfg.n_writers`` origins each commit
+    versions of ``cfg.chunks_per_version`` chunks, injected
+    ``inject_every`` rounds apart.
 
     The payload axis is **version-major** — index order IS (version,
     actor, chunk) order, which is also injection order since the inject
     round is monotone in version.  Both hot kernels rely on this: the
-    broadcast rate limiter drains oldest-first by index
-    (broadcast.py) and the sync budget grants oldest-version-first
-    WITHOUT any per-round permutation (sync.py)."""
+    broadcast rate limiter drains oldest-first by index (broadcast.py)
+    and the sync budget grants oldest-version-first WITHOUT any per-round
+    permutation (sync.py).  The layout lives on SimConfig so the kernels
+    can reshape have[N, P] into the (actor, version, chunk) grid."""
     p = cfg.n_payloads
-    if n_writers > p:
-        raise ValueError(
-            f"n_writers={n_writers} exceeds n_payloads={p}: every writer "
-            "needs at least one payload"
-        )
+    n_writers, chunks = cfg.n_writers, cfg.chunks_per_version
     if payload_bytes is not None and payload_bytes != cfg.default_payload_bytes:
         # the kernels' byte budgets are count-ranks derived from the
         # static cfg.default_payload_bytes — set that instead
@@ -180,34 +326,22 @@ def uniform_payloads(
             "payload_bytes must equal cfg.default_payload_bytes "
             f"({cfg.default_payload_bytes}); set it on SimConfig"
         )
-    wave = n_writers * chunks_per_version  # payloads per version wave
-    if wave > p:
-        # version-major layout fills whole waves; a partial first wave
-        # would silently leave the highest-index writers with nothing
-        raise ValueError(
-            f"n_writers*chunks_per_version={wave} exceeds n_payloads={p}: "
-            "every writer needs at least one full version"
-        )
-    per_writer = p // n_writers
-    vpw = versions_per_writer or max(1, per_writer // chunks_per_version)
+    wave = n_writers * chunks  # payloads per version wave
     idx = jnp.arange(p, dtype=jnp.int32)
-    raw_version = 1 + idx // wave
-    actor = (idx % wave) // chunks_per_version
-    chunk = idx % chunks_per_version
+    version = 1 + idx // wave
+    actor = (idx % wave) // chunks
+    chunk = idx % chunks
     # writers spread across the node id space
     actor_node = (actor * max(1, cfg.n_nodes // n_writers)) % cfg.n_nodes
     return PayloadMeta(
         actor=actor_node.astype(jnp.int32),
-        version=jnp.minimum(raw_version, vpw).astype(jnp.int32),
+        version=version.astype(jnp.int32),
         chunk=chunk.astype(jnp.int32),
-        nchunks=jnp.full((p,), chunks_per_version, jnp.int32),
+        nchunks=jnp.full((p,), chunks, jnp.int32),
         nbytes=jnp.full(
             (p,), payload_bytes or cfg.default_payload_bytes, jnp.int32
         ),
-        # schedule from the UNCLAMPED version so payloads past the vpw
-        # cap keep injecting inject_every rounds apart instead of
-        # collapsing into one burst
-        round=((raw_version - 1) * inject_every).astype(jnp.int32),
+        round=((version - 1) * inject_every).astype(jnp.int32),
     )
 
 

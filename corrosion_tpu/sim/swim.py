@@ -35,6 +35,44 @@ from .state import ALIVE, DOWN, SUSPECT, SimConfig, SimState
 from .topology import Topology
 
 
+def sample_member_targets(
+    state: SimState, cfg: SimConfig, key: jax.Array, count: int
+) -> jnp.ndarray:
+    """i32[N, count] fan-out targets drawn from each node's *believed*
+    member list; -1 marks unfilled slots.
+
+    The reference picks broadcast/sync/probe targets from `Members.states`
+    — a list that membership maintains and from which down members are
+    removed (broadcast/mod.rs:653-680, handlers.rs:330-352) — so a false
+    DOWN belief starves a live node of traffic until it rejoins.  Here:
+    sample 2×count uniform candidates, drop self and (in coupled
+    full-view mode) believed-DOWN nodes, prefix-compact the survivors
+    into the first slots.  Uncoupled or oracle-membership runs keep the
+    uniform sample (ground-truth delivery masks still apply).
+    """
+    if cfg.swim_partial_view and cfg.couple_membership:
+        from .pswim import psample_member_targets
+
+        return psample_member_targets(state, cfg, key, count)
+    n = state.alive.shape[0]
+    # 4× oversample: with fraction d of members believed DOWN, expected
+    # filled slots ≈ 4·count·(1-d) — still ≥ count at d=0.75, so coupled
+    # runs don't starve fanout beyond what the reference's pick-from-list
+    # sampling would (it only falls short when the live list itself is)
+    over = 4 * count
+    cand = jax.random.randint(key, (n, over), 0, n, jnp.int32)
+    me = jnp.arange(n, dtype=jnp.int32)[:, None]
+    valid = cand != me
+    if cfg.swim_full_view and cfg.couple_membership:
+        valid &= state.view[me, cand] != DOWN
+    rank = jnp.cumsum(valid, axis=1)
+    keep = valid & (rank <= count)
+    slot = jnp.clip(rank - 1, 0, count - 1)
+    rows = jnp.broadcast_to(me, (n, over))
+    out = jnp.full((n, count), -1, jnp.int32)
+    return out.at[rows, slot].max(jnp.where(keep, cand, -1))
+
+
 def _reachable(
     state: SimState, topo: Topology, key: jax.Array, src: jnp.ndarray, dst: jnp.ndarray
 ) -> jnp.ndarray:
@@ -52,21 +90,32 @@ def _reachable(
 def swim_step(
     state: SimState, cfg: SimConfig, topo: Topology, key: jax.Array
 ) -> SimState:
+    if cfg.swim_partial_view:
+        from .pswim import pswim_step
+
+        return pswim_step(state, cfg, topo, key)
     if not cfg.swim_full_view:
         return state
     n = state.alive.shape[0]
-    k_probe, k_ploss, k_relay, k_rloss, k_gossip, k_gloss = jax.random.split(key, 6)
+    (
+        k_probe, k_ploss, k_relay, k_rloss, k_gossip, k_gloss, k_ann, k_aloss,
+    ) = jax.random.split(key, 8)
     me = jnp.arange(n, dtype=jnp.int32)
     up = state.alive == ALIVE
 
     view, vinc, since = state.view, state.vinc, state.suspect_since
 
     # -- 1. probe ---------------------------------------------------------
-    do_probe = up & (state.t % cfg.probe_period_rounds == 0)
-    target = jax.random.randint(k_probe, (n,), 0, n, jnp.int32)
+    # probe targets come from the believed member list (foca probes active
+    # members only; down members left the list)
+    target = sample_member_targets(state, cfg, k_probe, 1)[:, 0]
+    do_probe = up & (state.t % cfg.probe_period_rounds == 0) & (target >= 0)
+    target = jnp.maximum(target, 0)
     direct = _reachable(state, topo, k_ploss, me, target)
-    # indirect probes through sampled relays (handlers: ping-req path)
-    relays = jax.random.randint(k_relay, (n, cfg.indirect_probes), 0, n, jnp.int32)
+    # indirect probes through sampled believed-member relays (ping-req)
+    relays = sample_member_targets(state, cfg, k_relay, cfg.indirect_probes)
+    relay_ok = relays >= 0
+    relays = jnp.maximum(relays, 0)
     hop_keys = jax.random.split(k_rloss, 2)
     leg1 = _reachable(
         state, topo, hop_keys[0],
@@ -76,7 +125,7 @@ def swim_step(
         state, topo, hop_keys[1],
         relays.reshape(-1), jnp.repeat(target, cfg.indirect_probes),
     ).reshape(n, cfg.indirect_probes)
-    indirect = (leg1 & leg2).any(axis=1)
+    indirect = (leg1 & leg2 & relay_ok).any(axis=1)
     acked = direct | indirect
     probe_failed = do_probe & ~acked & (target != me)
 
@@ -100,15 +149,47 @@ def swim_step(
     # Parallel scatter-max over sampled edges.  Beliefs are encoded as a
     # single key inc*4 + state so that max() implements SWIM precedence:
     # higher incarnation wins; at equal incarnation the worse state wins
-    # (DOWN=2 > SUSPECT=1 > ALIVE=0).
-    g_targets = jax.random.randint(k_gossip, (n, cfg.fanout), 0, n, jnp.int32)
+    # (DOWN=2 > SUSPECT=1 > ALIVE=0).  Targets come from the believed
+    # member list, and receivers IGNORE pushes from senders they believe
+    # DOWN (foca drops traffic from down members) — so a falsely-downed
+    # node is fully starved until the announce path (3b) rehabilitates it,
+    # exactly the reference's rejoin dynamics.
+    g_targets = sample_member_targets(state, cfg, k_gossip, cfg.fanout)
     gsrc = jnp.repeat(me, cfg.fanout)
     gdst = g_targets.reshape(-1)
-    g_ok = _reachable(state, topo, k_gloss, gsrc, gdst)
+    g_valid = gdst >= 0
+    gdst = jnp.maximum(gdst, 0)
+    g_ok = _reachable(state, topo, k_gloss, gsrc, gdst) & g_valid
+    g_ok &= view[gdst, gsrc] != DOWN  # receiver-side down filter
 
     belief_key = vinc.astype(jnp.int32) * 4 + view.astype(jnp.int32)  # [N, N]
     contrib = jnp.where(g_ok[:, None], belief_key[gsrc], jnp.int32(-1))  # [E, N]
     merged = belief_key.at[gdst].max(contrib)
+
+    # -- 3b. announce -----------------------------------------------------
+    # every announce tick each up node pushes its OWN claim
+    # (ALIVE @ own incarnation) to one uniformly random node, bypassing
+    # its member list — the bootstrap re-announce (spawn_swim_announcer,
+    # util.rs:104-123) that re-establishes contact after a partition has
+    # driven both sides' views mutually DOWN.  The reply path carries the
+    # receiver's belief back (feedback), so a refuted claim goes out one
+    # announce tick later at a winning incarnation.
+    stagger = (state.t + me) % cfg.announce_interval_rounds == 0
+    ann_target = jax.random.randint(k_ann, (n,), 0, n, jnp.int32)
+    ann_ok = (
+        stagger
+        & up
+        & (ann_target != me)
+        & _reachable(state, topo, k_aloss, me, ann_target)
+    )
+    self_claim = state.incarnation.astype(jnp.int32) * 4 + ALIVE
+    merged = merged.at[ann_target, me].max(
+        jnp.where(ann_ok, self_claim, jnp.int32(-1))
+    )
+    ann_fb = ann_ok & (view[ann_target, me] == DOWN)
+    heard_down = ann_fb
+    fb_inc = jnp.where(ann_fb, vinc[ann_target, me], -1)
+
     changed = merged > belief_key
     new_view = (merged % 4).astype(jnp.int8)
     view = jnp.where(changed, new_view, view)
@@ -116,9 +197,19 @@ def swim_step(
     since = jnp.where(changed & (new_view == SUSPECT), state.t, since)
 
     # -- 4. refute --------------------------------------------------------
+    # a live node that sees itself suspected/downed (in its own row via
+    # gossip, or via feedback) bumps its incarnation past every belief it
+    # knows of and re-asserts ALIVE (Actor::renew, actor.rs:199-209)
     self_belief = view[me, me]
-    refuting = up & (self_belief != ALIVE)
-    incarnation = state.incarnation + refuting.astype(jnp.uint32)
+    refuting = up & ((self_belief != ALIVE) | heard_down)
+    bumped = (
+        jnp.maximum(
+            jnp.maximum(state.incarnation.astype(jnp.int32), fb_inc),
+            vinc[me, me],
+        )
+        + 1
+    ).astype(jnp.uint32)
+    incarnation = jnp.where(refuting, bumped, state.incarnation)
     new_inc = incarnation.astype(jnp.int32)
     view = view.at[me, me].set(
         jnp.where(refuting, jnp.int8(ALIVE), self_belief)

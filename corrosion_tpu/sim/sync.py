@@ -1,18 +1,29 @@
-"""Anti-entropy sync kernel (L7).
+"""Anti-entropy sync kernel (L7) — interval algebra on gap tensors.
 
 Vectorized rebuild of `sync_loop`/`parallel_sync` (util.rs:347-393,
 peer/mod.rs:1003-1403): each node counts down to its next sync round
 (decorrelated 1-15 s backoff ≈ uniform re-arm over the interval); when due,
-it samples ``sync_peers`` peers and pulls what they can serve:
+it samples ``sync_peers`` peers and computes needs the way
+`compute_available_needs` (sync.rs:127-249, scalar spec:
+`corrosion_tpu.core.sync`) does — from the advertised bookkeeping state
+(``heads[N, A]`` + ``gap_lo/gap_hi[N, A, K]`` refreshed by round_step each
+round), not from ground-truth chunk bits:
 
-    pulled = ~have[i] & have[peer] & active      (per payload)
+1. **full needs** — my gap ranges ∩ the peer's fully-held set, where the
+   peer's fully-held set is [1..head_j] minus the peer's own gaps minus its
+   partial versions (spec's `other_haves`);
+2. **partial needs** — versions I hold some chunks of, served by peers
+   that fully hold them or hold overlapping chunks (the chunk-level grant
+   mask IS the seq-range overlap of sync.rs:176-227);
+3. **head catch-up** — (my_head, peer_head] (sync.rs:229-246).
 
-— which is the active-window form of `compute_available_needs`
-(sync.rs:127-249): the peer's fully-held set intersected with our needs.
-Transfers respect a per-round sync byte budget with oldest-version-first
-priority (the reference requests needs in version order and chunks at
-8 KiB); leftovers are picked up next round.  Sync delivery takes one round
-(the bi-stream RTT).
+The actual transfer grants only chunks the server really holds and the
+puller really lacks, so the K-clamped interval approximation can only slow
+convergence, never corrupt state (see sim/gaps.py).  Transfers respect a
+per-round sync byte budget with oldest-version-first priority (the
+reference requests needs in version order and chunks at 8 KiB); leftovers
+are picked up next round.  Sync delivery takes one round (the bi-stream
+RTT).
 """
 
 from __future__ import annotations
@@ -20,8 +31,64 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .state import PayloadMeta, SimConfig, SimState, budget_prefix_mask
+from .gaps import gaps_to_mask
+from .state import (
+    PayloadMeta,
+    SimConfig,
+    SimState,
+    budget_prefix_mask,
+    complete_versions,
+    grid_to_payload,
+)
+from .swim import sample_member_targets
 from .topology import Topology, edge_alive, edge_drop
+
+
+def node_sync_masks(state: SimState, cfg: SimConfig):
+    """Per-node version masks [N, A, V] derived from the advertised
+    bookkeeping tensors (the device form of `generate_sync`,
+    sync.rs:284-333) plus chunk truth for completeness.
+
+    Returns (miss_full, partial, haves):
+    - miss_full — versions in my advertised gap ranges (never seen);
+    - partial   — versions I touched but haven't completed;
+    - haves     — versions I can serve whole: [1..head] − gaps − partials
+      (spec's `other_haves`, sync.rs:150-160).
+    """
+    v = cfg.n_versions
+    v_idx = jnp.arange(1, v + 1, dtype=jnp.int32)
+    miss_full = gaps_to_mask(state.gap_lo, state.gap_hi, v)  # [N, A, V]
+    below_head = v_idx[None, None, :] <= state.heads[:, :, None]
+    comp = complete_versions(state.have, cfg)
+    partial = below_head & ~miss_full & ~comp
+    haves = below_head & ~miss_full & comp
+    return miss_full, partial, haves
+
+
+def edge_needs(
+    state: SimState, cfg: SimConfig, src: jnp.ndarray, dst: jnp.ndarray
+) -> jnp.ndarray:
+    """bool[E, P] — chunks ``dst`` (server) can supply to ``src`` (puller),
+    per the three need classes of `compute_available_needs`
+    (sync.rs:127-249) evaluated on the advertised interval state.  Shared
+    by the sync kernel and the kernel-vs-scalar-spec property test."""
+    miss_full, partial, haves = node_sync_masks(state, cfg)
+    v_idx = jnp.arange(1, cfg.n_versions + 1, dtype=jnp.int32)[None, None, :]
+    full_need = miss_full[src] & haves[dst]  # [E, A, V]
+    partial_need = partial[src] & (haves[dst] | partial[dst])
+    catchup = (v_idx > state.heads[src][:, :, None]) & (
+        v_idx <= state.heads[dst][:, :, None]
+    )
+    wanted = full_need | partial_need | catchup
+
+    # chunk-level grant: only chunks the server holds and the puller lacks
+    # (the seq-range overlap of partial needs, sync.rs:176-227, falls out
+    # of the have-bit intersection)
+    return (
+        grid_to_payload(wanted, cfg)
+        & (state.have[dst] > 0)
+        & (state.have[src] == 0)
+    )  # [E, P]
 
 
 def sync_step(
@@ -36,26 +103,25 @@ def sync_step(
     k_peers, k_drop, k_rearm = jax.random.split(key, 3)
 
     due = state.sync_countdown <= 0  # [N]
-    active = (state.injected > 0)[None, :]
 
-    peers = jax.random.randint(k_peers, (n, s), 0, n, jnp.int32)  # [N, S]
+    # sync peers come from the believed member list (handle_sync chooses
+    # candidates from Members.states, handlers.rs:808-863)
+    peers = sample_member_targets(state, cfg, k_peers, s)  # [N, S]
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s)  # [E] the puller
     dst = peers.reshape(-1)  # [E] the server
+    ok = dst >= 0
+    dst = jnp.maximum(dst, 0)
 
-    ok = edge_alive(state.group, state.alive, src, dst)
+    ok &= edge_alive(state.group, state.alive, src, dst)
     ok &= ~edge_drop(topo, k_drop, src.shape[0])
     ok &= due[src]
     ok &= dst != src
 
-    # need computation per edge: what the server has that the puller lacks
-    need = (state.have[dst] > 0) & (state.have[src] == 0) & active  # [E, P]
-    need &= ok[:, None]
+    need = edge_needs(state, cfg, src, dst) & ok[:, None]  # [E, P]
 
     # oldest-first budget: the payload axis is version-major BY
     # CONSTRUCTION (uniform_payloads), so index order is already global
     # (version, actor) request order — no per-round permutation needed
-    # (the argsort + two [E, P] permuted gathers this replaces dominated
-    # the whole round's cost)
     granted = budget_prefix_mask(need, cfg.sync_budget_bytes, cfg)
 
     # deliver next round via the delay ring (bi-stream round trip)

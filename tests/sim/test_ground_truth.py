@@ -46,7 +46,7 @@ def host_rounds_to_convergence() -> float:
 def sim_rounds_to_convergence() -> float:
     cfg = SimConfig(n_nodes=3, n_payloads=N_VERSIONS, fanout=2,
                     sync_interval_rounds=4)
-    meta = uniform_payloads(cfg, n_writers=1, inject_every=0)  # one burst
+    meta = uniform_payloads(cfg, inject_every=0)  # one burst
     state = new_sim(cfg, seed=0)
     final, metrics = run_to_convergence(state, meta, cfg, Topology(), 500)
     conv = np.asarray(metrics.converged_at)

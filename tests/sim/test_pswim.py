@@ -1,0 +1,125 @@
+"""Partial-view SWIM kernel tests (sim/pswim.py): detection, rejoin,
+coupled dissemination, and partition/heal at the O(N·M) scale tier."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.sim.round import new_metrics, new_sim, round_step, run_to_convergence
+from corrosion_tpu.sim.state import ALIVE, DOWN, SimConfig, uniform_payloads
+from corrosion_tpu.sim.topology import Topology, regions
+
+
+def drive(cfg, state, meta, rounds, topo=Topology()):
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+    for _ in range(rounds):
+        state, metrics = round_step(state, metrics, meta, cfg, topo, region)
+    return state, metrics
+
+
+def watched_state(state, watcher_up, member_mask):
+    """For every up watcher, the believed state of watched members in
+    member_mask; returns (n_watched, n_down) counts."""
+    pid = np.asarray(state.pid)
+    pkey = np.asarray(state.pkey)
+    watched = n_down = 0
+    for n in np.nonzero(watcher_up)[0]:
+        for b in range(pid.shape[1]):
+            mid = pid[n, b]
+            if mid >= 0 and member_mask[mid]:
+                watched += 1
+                if pkey[n, b] % 4 == DOWN:
+                    n_down += 1
+    return watched, n_down
+
+
+def test_pswim_detects_dead_members():
+    cfg = SimConfig.wan_tuned(
+        256, n_payloads=1, swim_partial_view=True, member_slots=16,
+        probe_period_rounds=1,
+    )
+    meta = uniform_payloads(cfg)
+    state = new_sim(cfg, 2)
+    dead = np.zeros(256, bool)
+    dead[::5] = True  # a fifth die
+    state = state._replace(
+        alive=jnp.where(jnp.asarray(dead), jnp.uint8(DOWN), jnp.uint8(ALIVE))
+    )
+    state, _ = drive(cfg, state, meta, 120)
+    up = ~dead
+    watched, n_down = watched_state(state, up, dead)
+    assert watched > 0
+    assert n_down / watched > 0.9, f"detected only {n_down}/{watched}"
+    # no false downs of live members
+    w_live, d_live = watched_state(state, up, up)
+    assert d_live / max(w_live, 1) < 0.02, f"false downs {d_live}/{w_live}"
+
+
+def test_pswim_rejoin_after_false_down():
+    """A live node falsely marked DOWN in every watcher's table must be
+    rehabilitated via the announce/refute path."""
+    cfg = SimConfig.wan_tuned(
+        64, n_payloads=1, swim_partial_view=True, member_slots=16,
+        announce_interval_rounds=4,
+    )
+    meta = uniform_payloads(cfg)
+    state = new_sim(cfg, 3)
+    victim = 7
+    pid = np.asarray(state.pid)
+    pkey = np.asarray(state.pkey)
+    psince = np.asarray(state.psince)
+    mask = pid == victim
+    pkey = np.where(mask, (pkey // 4) * 4 + DOWN, pkey)
+    psince = np.where(mask, 0, psince)  # down-since t=0 (GC age stamp)
+    state = state._replace(
+        pkey=jnp.asarray(pkey), psince=jnp.asarray(psince)
+    )
+    state, _ = drive(cfg, state, meta, 150)
+    v_mask = np.zeros(64, bool)
+    v_mask[victim] = True
+    watched, n_down = watched_state(state, np.ones(64, bool), v_mask)
+    assert watched > 0, "victim must be re-learned by some watchers"
+    assert n_down <= watched * 0.2, \
+        f"victim still believed down by {n_down}/{watched}"
+    assert int(np.asarray(state.incarnation)[victim]) > 0, \
+        "victim must have refuted (incarnation bump)"
+
+
+def test_pswim_coupled_dissemination_converges():
+    cfg = SimConfig.wan_tuned(
+        128, n_payloads=16, n_writers=2, chunks_per_version=2,
+        swim_partial_view=True, member_slots=16, sync_interval_rounds=6,
+    )
+    meta = uniform_payloads(cfg)
+    state = new_sim(cfg, 4)
+    final, metrics = run_to_convergence(state, meta, cfg, Topology(), 500)
+    conv = np.asarray(metrics.converged_at)
+    assert (conv >= 0).all(), f"{(conv < 0).sum()} nodes unconverged"
+
+
+def test_pswim_partition_heal_recovers():
+    """Partition → mutual DOWN in tables → heal → announce rejoin →
+    post-heal payloads converge (the config #4 shape with real SWIM)."""
+    cfg = SimConfig.wan_tuned(
+        64, n_payloads=8, swim_partial_view=True, member_slots=16,
+        suspect_timeout_rounds=4, sync_interval_rounds=6,
+        probe_period_rounds=1,
+    )
+    meta = uniform_payloads(cfg, inject_every=0)
+    meta = meta._replace(round=jnp.full_like(meta.round, 70))
+    topo = Topology()
+    state = new_sim(cfg, 5)
+    group = (jnp.arange(64) >= 32).astype(jnp.int32)
+    state = state._replace(group=group)
+    state, metrics = drive(cfg, state, meta, 50, topo)
+    # cross-partition watched entries must be largely DOWN by now
+    a_side = np.arange(64) < 32
+    watched, n_down = watched_state(state, a_side, ~a_side)
+    assert watched > 0 and n_down / watched > 0.8, (n_down, watched)
+    # heal and converge on payloads injected at round 70
+    state = state._replace(group=jnp.zeros((64,), jnp.int32))
+    region = regions(cfg.n_nodes, topo.n_regions)
+    final, metrics = run_to_convergence(state, meta, cfg, topo, 800)
+    conv = np.asarray(metrics.converged_at)
+    assert (conv >= 0).all(), \
+        f"post-heal wedge: {(conv < 0).sum()} nodes never converged"

@@ -23,7 +23,7 @@ def test_broadcast_only_full_coverage():
     """Pure epidemic broadcast (sync effectively off) reaches all nodes."""
     cfg = SimConfig(n_nodes=64, n_payloads=16, fanout=3,
                     sync_interval_rounds=10_000)
-    meta = uniform_payloads(cfg, n_writers=1)
+    meta = uniform_payloads(cfg)
     final, metrics = run(cfg, meta)
     assert bool((np.asarray(metrics.converged_at) >= 0).all())
     assert np.asarray(final.have).min() == 1
@@ -33,7 +33,7 @@ def test_sync_fills_what_broadcast_drops():
     """With heavy loss, broadcast alone stalls; anti-entropy converges."""
     cfg = SimConfig(n_nodes=64, n_payloads=16, fanout=2, max_transmissions=2,
                     sync_interval_rounds=4)
-    meta = uniform_payloads(cfg, n_writers=1)
+    meta = uniform_payloads(cfg)
     topo = Topology(loss=0.6)
     final, metrics = run(cfg, meta, topo=topo, max_rounds=800)
     assert bool((np.asarray(metrics.converged_at) >= 0).all()), \
@@ -42,7 +42,7 @@ def test_sync_fills_what_broadcast_drops():
 
 def test_down_nodes_excluded_from_convergence():
     cfg = SimConfig(n_nodes=32, n_payloads=8)
-    meta = uniform_payloads(cfg, n_writers=1)  # writer = node 0
+    meta = uniform_payloads(cfg)  # writer = node 0
 
     def kill_some(state):  # kill non-writers 8..15
         alive = state.alive.at[8:16].set(DOWN)
@@ -58,7 +58,7 @@ def test_dead_writer_payloads_never_activate():
     """Commits from an origin that was down at inject time don't exist and
     must not block cluster convergence."""
     cfg = SimConfig(n_nodes=16, n_payloads=4)
-    meta = uniform_payloads(cfg, n_writers=1)
+    meta = uniform_payloads(cfg)
 
     def kill_writer(state):
         return state._replace(alive=state.alive.at[0].set(DOWN))
@@ -70,7 +70,7 @@ def test_dead_writer_payloads_never_activate():
 
 def test_partition_blocks_then_heal_converges():
     cfg = SimConfig(n_nodes=64, n_payloads=8, sync_interval_rounds=4)
-    meta = uniform_payloads(cfg, n_writers=1)  # writer is node 0 (group 0)
+    meta = uniform_payloads(cfg)  # writer is node 0 (group 0)
     topo = Topology()
     region = regions(cfg.n_nodes, 1)
 
@@ -91,7 +91,7 @@ def test_partition_blocks_then_heal_converges():
 
 def test_swim_detects_dead_nodes():
     cfg = SimConfig(n_nodes=48, n_payloads=1, swim_full_view=True)
-    meta = uniform_payloads(cfg, n_writers=1)
+    meta = uniform_payloads(cfg)
     topo = Topology()
     region = regions(cfg.n_nodes, 1)
     state = new_sim(cfg, 3)
@@ -111,7 +111,7 @@ def test_swim_refutation_keeps_lossy_cluster_alive():
     prevent live nodes from being permanently marked down."""
     cfg = SimConfig(n_nodes=32, n_payloads=1, swim_full_view=True,
                     suspect_timeout_rounds=12)
-    meta = uniform_payloads(cfg, n_writers=1)
+    meta = uniform_payloads(cfg)
     topo = Topology(loss=0.3)
     region = regions(cfg.n_nodes, 1)
     state = new_sim(cfg, 5)
@@ -124,11 +124,97 @@ def test_swim_refutation_keeps_lossy_cluster_alive():
     assert np.asarray(state.incarnation).max() > 0, "refutations must have fired"
 
 
+def test_false_suspicion_delays_convergence():
+    """VERDICT r1 item 3: membership error must affect dissemination.
+    Falsely marking half the cluster DOWN in everyone's view slows
+    convergence vs a clean start — targets come from the believed member
+    list, so starved nodes wait for refutation to rehabilitate them."""
+    kw = dict(n_nodes=48, n_payloads=8, swim_full_view=True,
+              sync_interval_rounds=8, fanout=2)
+    cfg = SimConfig(**kw)
+    # single burst at t0: convergence is a few rounds, so the victims'
+    # refutation/rehabilitation latency is visible in the total
+    meta = uniform_payloads(cfg, inject_every=0)
+
+    def poison(state):
+        # everyone (except the victims themselves) believes nodes 24..48
+        # are DOWN at incarnation 0
+        view = state.view.at[:, 24:].set(DOWN)
+        view = view.at[jnp.arange(24, 48), jnp.arange(24, 48)].set(ALIVE)
+        return state._replace(view=view)
+
+    f_clean, m_clean = run(cfg, meta, max_rounds=600)
+    f_poison, m_poison = run(cfg, meta, mutate=poison, max_rounds=600)
+    clean_rounds = int(np.asarray(m_clean.converged_at).max())
+    poison_rounds = int(np.asarray(m_poison.converged_at).max())
+    assert (np.asarray(m_poison.converged_at) >= 0).all(), \
+        "refutation must eventually rehabilitate falsely-downed nodes"
+    # starved of push traffic, victims fall back to their own sync pulls /
+    # announce rejoin — several rounds slower than the clean run (measured
+    # 8-11 vs 5-6 across seeds)
+    assert poison_rounds >= clean_rounds + 2, (poison_rounds, clean_rounds)
+    # refutations fired: victims bumped incarnations past the false belief
+    assert np.asarray(f_poison.incarnation)[24:].max() > 0
+
+
+def test_uncoupled_membership_ignores_false_suspicion():
+    """couple_membership=False restores the oracle behavior (targets
+    uniform over the id space): poisoned views change nothing."""
+    kw = dict(n_nodes=32, n_payloads=8, swim_full_view=True,
+              couple_membership=False, probe_period_rounds=10_000)
+    cfg = SimConfig(**kw)
+    meta = uniform_payloads(cfg)
+
+    def poison(state):
+        view = state.view.at[:, 16:].set(DOWN)
+        return state._replace(view=view)
+
+    f_a, m_a = run(cfg, meta, max_rounds=400)
+    f_b, m_b = run(cfg, meta, mutate=poison, max_rounds=400)
+    assert (np.asarray(m_b.converged_at) >= 0).all()
+    # uncoupled targeting ignores view entirely: same seed ⇒ identical
+    # dissemination trajectory with or without the poisoned beliefs
+    assert (
+        np.asarray(m_a.converged_at) == np.asarray(m_b.converged_at)
+    ).all()
+
+
+def test_partition_heal_with_swim_recovers_mutual_down():
+    """Code-review r2 finding: a symmetric partition drives both sides'
+    views mutually DOWN; after heal, the announce/rejoin seam
+    (spawn_swim_announcer analog) must rehabilitate membership and let
+    payloads injected post-heal converge — not wedge forever."""
+    cfg = SimConfig(n_nodes=32, n_payloads=8, swim_full_view=True,
+                    suspect_timeout_rounds=4, sync_interval_rounds=6,
+                    fanout=2)
+    # payloads injected at round 80, well after the heal at 60
+    meta = uniform_payloads(cfg, inject_every=0)
+    meta = meta._replace(round=jnp.full_like(meta.round, 80))
+    topo = Topology()
+    region = regions(cfg.n_nodes, 1)
+
+    state = new_sim(cfg, 1)
+    group = (jnp.arange(32) >= 16).astype(jnp.int32)
+    state = state._replace(group=group)
+    metrics = new_metrics(cfg)
+    for _ in range(60):
+        state, metrics = round_step(state, metrics, meta, cfg, topo, region)
+    view = np.asarray(state.view)
+    assert (view[:16, 16:] == DOWN).all(), "A side must believe B down"
+    assert (view[16:, :16] == DOWN).all(), "B side must believe A down"
+    # heal
+    state = state._replace(group=jnp.zeros((32,), jnp.int32))
+    final, metrics = run_to_convergence(state, meta, cfg, topo, 800)
+    conv = np.asarray(metrics.converged_at)
+    assert (conv >= 0).all(), \
+        f"post-heal wedge: {(conv < 0).sum()} nodes never converged"
+
+
 def test_deterministic_replay():
     """Same seed ⇒ identical trajectory (the Antithesis-style determinism
     the reference outsources to a hypervisor, SURVEY §4.5)."""
-    cfg = SimConfig(n_nodes=32, n_payloads=8)
-    meta = uniform_payloads(cfg, n_writers=2)
+    cfg = SimConfig(n_nodes=32, n_payloads=8, n_writers=2)
+    meta = uniform_payloads(cfg)
     f1, m1 = run(cfg, meta, seed=9)
     f2, m2 = run(cfg, meta, seed=9)
     assert (np.asarray(f1.have) == np.asarray(f2.have)).all()
@@ -143,7 +229,7 @@ def test_sharded_run_matches_single_device():
     from corrosion_tpu.parallel.mesh import make_mesh, replicate_meta, shard_state
 
     cfg = SimConfig(n_nodes=64, n_payloads=16)
-    meta = uniform_payloads(cfg, n_writers=1)
+    meta = uniform_payloads(cfg)
     topo = Topology()
 
     final_a, metrics_a = run(cfg, meta, seed=4)
@@ -161,7 +247,6 @@ def test_sharded_run_matches_single_device():
 
 def test_rate_limit_slows_dissemination():
     """Choking the byte budget must strictly slow convergence."""
-    meta_kw = dict(n_writers=1)
     fast_cfg = SimConfig(n_nodes=48, n_payloads=32,
                          default_payload_bytes=64 * 1024,
                          rate_limit_bytes_round=10**9,
@@ -170,8 +255,8 @@ def test_rate_limit_slows_dissemination():
                          default_payload_bytes=64 * 1024,
                          rate_limit_bytes_round=64 * 1024,  # 1 payload/round
                          sync_interval_rounds=10_000)
-    fast_meta = uniform_payloads(fast_cfg, **meta_kw)
-    slow_meta = uniform_payloads(slow_cfg, **meta_kw)
+    fast_meta = uniform_payloads(fast_cfg)
+    slow_meta = uniform_payloads(slow_cfg)
     f_fast, m_fast = run(fast_cfg, fast_meta, max_rounds=800)
     f_slow, m_slow = run(slow_cfg, slow_meta, max_rounds=800)
     assert int(f_slow.t) > int(f_fast.t), (int(f_slow.t), int(f_fast.t))
@@ -180,8 +265,8 @@ def test_rate_limit_slows_dissemination():
 def test_chunked_versions_cover():
     """Multi-chunk versions: convergence requires every chunk (the
     seq-range/partial dimension, SURVEY §5 long-context analog)."""
-    cfg = SimConfig(n_nodes=32, n_payloads=32)
-    meta = uniform_payloads(cfg, n_writers=2, chunks_per_version=4)
+    cfg = SimConfig(n_nodes=32, n_payloads=32, n_writers=2, chunks_per_version=4)
+    meta = uniform_payloads(cfg)
     final, metrics = run(cfg, meta)
     assert bool((np.asarray(metrics.converged_at) >= 0).all())
     assert np.asarray(final.have).min() == 1
