@@ -36,6 +36,11 @@ class PerfConfig:
     # chunking (change.rs:180, peer/mod.rs:365-368)
     max_changes_byte_size: int = 8 * 1024
     min_changes_byte_size: int = 1024
+    # adaptive sync serving: halve the chunk size when a send takes this
+    # long (peer/mod.rs:365-368), abort the peer when one stalls this
+    # long (peer/mod.rs:729-790)
+    sync_slow_send_s: float = 0.5
+    sync_stall_abort_s: float = 5.0
     # SWIM (broadcast/mod.rs:951-960)
     swim_probe_interval_s: float = 1.0
     swim_probe_timeout_s: float = 0.5
@@ -66,6 +71,11 @@ class Config:
     perf: PerfConfig = field(default_factory=PerfConfig)
     admin_path: str = ""  # unix socket path; "" disables
     prometheus_addr: str = ""  # "host:port" scrape endpoint; "" disables
+    # [gossip.tls] — (m)TLS on the gossip transport (config.rs:170-193,
+    # api/peer/mod.rs:149-339).  Keys: cert_file, key_file, ca_file,
+    # insecure (bool), client.cert_file/key_file (mTLS),
+    # client.required (bool, server demands client certs)
+    gossip_tls: dict = field(default_factory=dict)
 
     @classmethod
     def load(cls, path: str) -> "Config":
@@ -95,6 +105,7 @@ class Config:
             gossip_addr=gossip.get("addr", ""),
             bootstrap=gossip.get("bootstrap", []),
             cluster_id=gossip.get("cluster_id", 0),
+            gossip_tls=gossip.get("tls", {}),
             admin_path=admin.get("path", ""),
             prometheus_addr=(
                 tel_prom.get("addr", "")

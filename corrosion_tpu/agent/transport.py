@@ -20,8 +20,10 @@ Addresses are opaque strings ("host:port" for sockets, any token in memory).
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import struct
+import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
@@ -32,10 +34,17 @@ BiHandler = Callable[[str, "BiStream"], Awaitable[None]]
 
 class BiStream:
     """One side of a bidirectional message stream (QUIC bi analog):
-    length-delimited frames both ways."""
+    length-delimited frames both ways.
+
+    The inbox is BOUNDED so `send` exerts backpressure when the receiver
+    stops reading — the flow-control QUIC streams give the reference.
+    Without it a stalled sync peer would buffer the whole backlog in
+    memory and the server's slow-peer abort could never fire."""
+
+    INBOX_FRAMES = 256
 
     def __init__(self):
-        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._inbox: asyncio.Queue = asyncio.Queue(self.INBOX_FRAMES)
         self.peer: Optional["BiStream"] = None
         self.closed = False
 
@@ -60,7 +69,10 @@ class BiStream:
     def close(self) -> None:
         self.closed = True
         if self.peer is not None:
-            self.peer._inbox.put_nowait(b"")  # EOF marker
+            try:
+                self.peer._inbox.put_nowait(b"")  # EOF marker
+            except asyncio.QueueFull:
+                pass  # receiver has a full backlog to drain anyway
 
 
 @dataclass
@@ -215,10 +227,19 @@ async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
 
 
 class _TcpBiStream(BiStream):
+    # small write high-water mark so drain() actually blocks when the
+    # peer stops reading — otherwise asyncio buffers 64 KiB+ in userspace
+    # and slow-peer detection (AdaptiveSender) never sees the stall
+    WRITE_HIGH_WATER = 16 * 1024
+
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         super().__init__()
         self.reader = reader
         self.writer = writer
+        try:
+            writer.transport.set_write_buffer_limits(high=self.WRITE_HIGH_WATER)
+        except Exception:
+            pass
 
     async def send(self, frame: bytes) -> None:
         self.writer.write(_frame(frame))
@@ -238,26 +259,79 @@ class _TcpBiStream(BiStream):
             pass
 
 
+class _CachedConn:
+    """One cached outbound TCP connection per peer (the QUIC-connection
+    analog of the reference's conn cache, transport.rs:55-70,200-233):
+    broadcast frames and — under TLS — SWIM datagrams multiplex over it
+    as tagged length-delimited frames instead of paying a fresh
+    handshake per message."""
+
+    __slots__ = ("reader", "writer", "lock")
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.lock = asyncio.Lock()
+
+    @property
+    def alive(self) -> bool:
+        return not self.writer.is_closing()
+
+
 class UdpTcpTransport(Transport):
     """Datagrams over UDP, uni/bi streams over TCP, one port each.
 
-    A uni stream is a TCP connection opened with a 1-byte tag; a bi stream
-    stays open for framed request/response exchange (the reference's QUIC
-    uni/bi distinction, api/peer/mod.rs:118-339)."""
+    Wire shape (the reference's QUIC uni/bi distinction,
+    api/peer/mod.rs:118-339, with TCP standing in for QUIC):
+
+    - ``TAG_UNI`` connection — long-lived, cached per peer, carrying a
+      stream of ``kind(1) + len(4) + payload`` frames where kind is
+      ``u`` (broadcast uni payload) or ``d`` (SWIM datagram, used when
+      TLS is on so membership traffic is encrypted too);
+    - ``TAG_BI`` connection — one per sync session, framed both ways;
+    - bare UDP datagrams for SWIM in plaintext mode (the
+      quinn-plaintext analog, config.rs:187).
+
+    With ``server_ssl``/``client_ssl`` contexts (utils/tls.py) all TCP
+    traffic is (m)TLS — the rustls path of api/peer/mod.rs:149-339.
+    Connection establishment time is sampled into ``on_rtt`` (the
+    reference samples path RTT into rtt_tx, transport.rs:220)."""
 
     TAG_UNI = b"u"
     TAG_BI = b"b"
+    KIND_UNI = b"u"
+    KIND_DGRAM = b"d"
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        server_ssl=None,
+        client_ssl=None,
+        on_rtt: Optional[Callable[[str, float], None]] = None,
+    ):
         self._host = host
         self._port = port
         self.addr = ""
         self.on_datagram = None
         self.on_uni = None
         self.on_bi = None
+        self.on_rtt = on_rtt  # (addr, rtt_seconds)
         self._udp = None
         self._tcp_server = None
         self._tasks: set = set()
+        self._server_ssl = server_ssl
+        self._client_ssl = client_ssl
+        self._conns: Dict[str, _CachedConn] = {}
+        self._dial_locks: Dict[str, asyncio.Lock] = {}
+        self._server_writers: set = set()
+        # reuse metrics: tests assert conns_opened ≪ frames sent
+        self.conns_opened = 0
+        self.server_conns_accepted = 0
+
+    @property
+    def tls(self) -> bool:
+        return self._server_ssl is not None or self._client_ssl is not None
 
     async def start(self) -> str:
         loop = asyncio.get_running_loop()
@@ -272,7 +346,7 @@ class UdpTcpTransport(Transport):
                     task.add_done_callback(outer._tasks.discard)
 
         self._tcp_server = await asyncio.start_server(
-            self._on_tcp, self._host, self._port
+            self._on_tcp, self._host, self._port, ssl=self._server_ssl
         )
         self._port = self._tcp_server.sockets[0].getsockname()[1]
         self._udp, _ = await loop.create_datagram_endpoint(
@@ -284,45 +358,217 @@ class UdpTcpTransport(Transport):
     async def _on_tcp(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer = writer.get_extra_info("peername")
         peer_addr = f"{peer[0]}:{peer[1]}" if peer else "?"
+        self.server_conns_accepted += 1
+        # tracked so close() can tear down long-lived server-side conns —
+        # Server.wait_closed() (py3.12+) blocks until every connection is
+        # gone, and cached uni conns live until the peer evicts them
+        self._server_writers.add(writer)
         try:
-            tag = await reader.readexactly(1)
-        except (asyncio.IncompleteReadError, ConnectionError):
-            writer.close()
-            return
-        if tag == self.TAG_UNI:
-            data = await _read_frame(reader)
-            writer.close()
-            if data is not None and self.on_uni is not None:
-                await self.on_uni(peer_addr, data)
-        elif tag == self.TAG_BI:
-            if self.on_bi is not None:
-                await self.on_bi(peer_addr, _TcpBiStream(reader, writer))
-        else:
-            writer.close()
+            try:
+                tag = await reader.readexactly(1)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                writer.close()
+                return
+            if tag == self.TAG_UNI:
+                # cached-connection frame pump: serve frames until EOF.
+                # One bad frame must not kill the long-lived conn (under
+                # TLS it also carries every SWIM datagram from the peer)
+                while True:
+                    try:
+                        kind = await reader.readexactly(1)
+                    except (asyncio.IncompleteReadError, ConnectionError):
+                        break
+                    data = await _read_frame(reader)
+                    if data is None:
+                        break
+                    try:
+                        if kind == self.KIND_UNI and self.on_uni is not None:
+                            # awaited inline: broadcast ingestion is the
+                            # natural backpressure point (handlers only
+                            # decode + enqueue)
+                            await self.on_uni(peer_addr, data)
+                        elif (
+                            kind == self.KIND_DGRAM
+                            and self.on_datagram is not None
+                        ):
+                            # dispatched off the pump: a SWIM ack must not
+                            # queue behind broadcast frame handling
+                            task = asyncio.get_running_loop().create_task(
+                                self.on_datagram(peer_addr, data)
+                            )
+                            self._tasks.add(task)
+                            task.add_done_callback(self._tasks.discard)
+                    except Exception:
+                        logging.getLogger("corrosion_tpu.transport").warning(
+                            "frame handler error from %s", peer_addr,
+                            exc_info=True,
+                        )
+            elif tag == self.TAG_BI:
+                if self.on_bi is not None:
+                    await self.on_bi(peer_addr, _TcpBiStream(reader, writer))
+        finally:
+            self._server_writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    CONNECT_TIMEOUT_S = 5.0
+
+    async def _connect(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        t0 = time.monotonic()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(
+                host,
+                int(port),
+                ssl=self._client_ssl,
+                server_hostname=host if self._client_ssl is not None else None,
+            ),
+            self.CONNECT_TIMEOUT_S,
+        )
+        if self.on_rtt is not None:
+            self.on_rtt(addr, time.monotonic() - t0)
+        self.conns_opened += 1
+        return reader, writer
+
+    async def _uni_conn(self, addr: str) -> _CachedConn:
+        conn = self._conns.get(addr)
+        if conn is not None and conn.alive:
+            return conn
+        # single-flight dial: concurrent first sends to the same peer
+        # must share one connection, not leak the loser's socket
+        lock = self._dial_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and conn.alive:
+                return conn
+            reader, writer = await self._connect(addr)
+            writer.write(self.TAG_UNI)
+            conn = _CachedConn(reader, writer)
+            self._conns[addr] = conn
+            return conn
+
+    def _evict(self, addr: str) -> None:
+        conn = self._conns.pop(addr, None)
+        if conn is not None:
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+    async def _send_frame(self, addr: str, kind: bytes, data: bytes) -> None:
+        # liveness-checked reuse with one reconnect (the reference tests
+        # the cached conn and reconnects on failure, transport.rs:200-233)
+        for attempt in (0, 1):
+            conn = await self._uni_conn(addr)
+            try:
+                async with conn.lock:
+                    conn.writer.write(kind + _frame(data))
+                    await conn.writer.drain()
+                return
+            except (ConnectionError, OSError):
+                self._evict(addr)
+                if attempt:
+                    raise
 
     async def send_datagram(self, addr: str, data: bytes) -> None:
+        if self.tls:
+            # SWIM rides the encrypted stream: plaintext UDP would leak
+            # membership traffic QUIC encrypts in the reference.  The
+            # datagram contract stays fire-and-forget: never block the
+            # probe loop on a TCP/TLS dial — warm the conn in the
+            # background and drop this datagram (SWIM tolerates loss)
+            conn = self._conns.get(addr)
+            if conn is None or not conn.alive:
+                self._background_dial(addr)
+                return
+            try:
+                async with conn.lock:
+                    conn.writer.write(self.KIND_DGRAM + _frame(data))
+                    await asyncio.wait_for(conn.writer.drain(), 2.0)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                self._evict(addr)
+            return
         host, port = addr.rsplit(":", 1)
         self._udp.sendto(data, (host, int(port)))
 
+    def _background_dial(self, addr: str) -> None:
+        async def dial():
+            try:
+                await self._uni_conn(addr)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass
+
+        task = asyncio.get_running_loop().create_task(dial())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
     async def send_uni(self, addr: str, data: bytes) -> None:
-        host, port = addr.rsplit(":", 1)
-        reader, writer = await asyncio.open_connection(host, int(port))
-        writer.write(self.TAG_UNI + _frame(data))
-        await writer.drain()
-        writer.close()
+        await self._send_frame(addr, self.KIND_UNI, data)
 
     async def open_bi(self, addr: str) -> BiStream:
-        host, port = addr.rsplit(":", 1)
-        reader, writer = await asyncio.open_connection(host, int(port))
+        reader, writer = await self._connect(addr)
         writer.write(self.TAG_BI)
         await writer.drain()
         return _TcpBiStream(reader, writer)
 
     async def close(self) -> None:
+        for addr in list(self._conns):
+            self._evict(addr)
+        for w in list(self._server_writers):
+            try:
+                w.close()
+            except Exception:
+                pass
         for t in list(self._tasks):
             t.cancel()
         if self._udp:
             self._udp.close()
         if self._tcp_server:
             self._tcp_server.close()
-            await self._tcp_server.wait_closed()
+            try:
+                await asyncio.wait_for(self._tcp_server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                pass
+
+
+def transport_from_config(cfg) -> UdpTcpTransport:
+    """Build the socket transport from an agent Config, wiring the
+    [gossip.tls] section into ssl contexts (config.rs:170-193 →
+    api/peer/mod.rs:149-339; plaintext mode when the section is absent,
+    the quinn-plaintext analog)."""
+    tls_cfg = getattr(cfg, "gossip_tls", None) or {}
+    server_ssl = client_ssl = None
+    if tls_cfg:
+        from ..utils import tls as tlsmod
+
+        missing = [k for k in ("cert_file", "key_file") if not tls_cfg.get(k)]
+        if missing:
+            raise ValueError(
+                "[gossip.tls] requires cert_file and key_file "
+                f"(missing: {', '.join(missing)}) — generate them with "
+                "`corrosion-tpu tls ca generate` + `tls server generate`"
+            )
+        client = tls_cfg.get("client", {})
+        if not isinstance(client, dict):
+            client = {}
+        server_ssl = tlsmod.server_ssl_context(
+            tls_cfg["cert_file"],
+            tls_cfg["key_file"],
+            ca_cert_path=tls_cfg.get("ca_file"),
+            require_client_cert=bool(client.get("required")),
+        )
+        client_ssl = tlsmod.client_ssl_context(
+            tls_cfg.get("ca_file"),
+            cert_path=client.get("cert_file"),
+            key_path=client.get("key_file"),
+            insecure=bool(tls_cfg.get("insecure")),
+        )
+    host, _, port = cfg.gossip_addr.rpartition(":")
+    return UdpTcpTransport(
+        host or "127.0.0.1",
+        int(port or 0),
+        server_ssl=server_ssl,
+        client_ssl=client_ssl,
+    )

@@ -77,11 +77,10 @@ def cmd_agent(args) -> int:
         import signal
 
         from ..agent.agent import Agent
-        from ..agent.transport import UdpTcpTransport
+        from ..agent.transport import transport_from_config
         from ..api.http import ApiServer
 
-        ghost, _, gport = cfg.gossip_addr.rpartition(":")
-        transport = UdpTcpTransport(ghost or "127.0.0.1", int(gport or 0))
+        transport = transport_from_config(cfg)
         bound = await transport.start()
         cfg.gossip_addr = bound  # port-0 binds resolve here
         agent = Agent(cfg, transport)

@@ -145,3 +145,50 @@ def generate_client_cert(
         ca_cert_path, ca_key_path, "corrosion-tpu client", out_dir, "client",
         server=False,
     )
+
+
+# -- ssl contexts for the gossip transport ----------------------------------
+#
+# The reference builds rustls ServerConfig/ClientConfig from the same PEM
+# material (api/peer/mod.rs:149-339): server verifies client certs against
+# the CA when mTLS is on; the client verifies the server cert (IP SAN)
+# unless `insecure`.
+
+
+def server_ssl_context(
+    cert_path: str,
+    key_path: str,
+    ca_cert_path: Optional[str] = None,
+    require_client_cert: bool = False,
+):
+    """TLS context for the gossip TCP listener (peer/mod.rs:149-231)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    if require_client_cert:
+        if not ca_cert_path:
+            raise ValueError("mTLS requires a CA cert to verify clients")
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(ca_cert_path)
+    return ctx
+
+
+def client_ssl_context(
+    ca_cert_path: Optional[str] = None,
+    cert_path: Optional[str] = None,
+    key_path: Optional[str] = None,
+    insecure: bool = False,
+):
+    """TLS context for outbound gossip connections (peer/mod.rs:233-339)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if insecure:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    elif ca_cert_path:
+        ctx.load_verify_locations(ca_cert_path)
+    if cert_path and key_path:
+        ctx.load_cert_chain(cert_path, key_path)
+    return ctx

@@ -1,0 +1,100 @@
+"""Adaptive sync serving (VERDICT r1 item 6): chunk shrink on slow sends
+and slow-peer abort, driven through `_serve_need` with an artificially
+slow BiStream (the reference's handle_need behavior,
+peer/mod.rs:365-368,729-790)."""
+
+import asyncio
+import tempfile
+
+import pytest
+
+from corrosion_tpu.agent.agent import AdaptiveSender, Agent, SlowPeerAbort
+from corrosion_tpu.agent.config import Config
+from corrosion_tpu.agent.transport import BiStream, MemoryNetwork
+from corrosion_tpu.core.types import SyncNeed
+from corrosion_tpu.testing import TEST_SCHEMA, fast_perf
+
+
+class SlowBiStream(BiStream):
+    """A stream whose sends take a configurable time (a congested peer)."""
+
+    def __init__(self, delay_s: float, hang_after: int = 10**9):
+        super().__init__()
+        self.delay_s = delay_s
+        self.hang_after = hang_after
+        self.frames = []
+
+    async def send(self, frame: bytes) -> None:
+        if len(self.frames) >= self.hang_after:
+            await asyncio.sleep(3600)  # stall forever
+        await asyncio.sleep(self.delay_s)
+        self.frames.append(frame)
+
+
+def _make_agent(tmp, rows=400):
+    net = MemoryNetwork()
+    cfg = Config(
+        db_path=f"{tmp}/a.db", gossip_addr="a", use_swim=False,
+        perf=fast_perf(),
+    )
+    cfg.perf.sync_slow_send_s = 0.01
+    cfg.perf.sync_stall_abort_s = 0.25
+    agent = Agent(cfg, net.transport("a"))
+    agent.store.execute_schema(TEST_SCHEMA)
+    agent.exec_transaction(
+        [
+            ("INSERT INTO tests (id, text) VALUES (?, ?)", (i, "x" * 64))
+            for i in range(rows)
+        ]
+    )
+    return agent
+
+
+def test_slow_sends_shrink_chunks():
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            agent = _make_agent(tmp)
+            sender = AdaptiveSender(agent.config.perf)
+            start_size = sender.chunk_size
+            bi = SlowBiStream(delay_s=0.02)  # above the slow threshold
+            need = SyncNeed.full(1, 1)
+            await agent._serve_need(bi, agent.actor_id, need, sender)
+            assert sender.shrinks > 0, "slow sends must shrink the chunk size"
+            assert sender.chunk_size < start_size
+            assert sender.chunk_size >= agent.config.perf.min_changes_byte_size
+            # shrinking means MORE chunks than one 8 KiB stream would need
+            assert len(bi.frames) > 3
+            agent.store.close()
+
+    asyncio.run(body())
+
+
+def test_chunk_size_floors_at_min():
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            agent = _make_agent(tmp)
+            sender = AdaptiveSender(agent.config.perf)
+            bi = SlowBiStream(delay_s=0.02)
+            await agent._serve_need(bi, agent.actor_id, SyncNeed.full(1, 1), sender)
+            assert sender.chunk_size == agent.config.perf.min_changes_byte_size
+            agent.store.close()
+
+    asyncio.run(body())
+
+
+def test_stalled_peer_aborts():
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            agent = _make_agent(tmp)
+            sender = AdaptiveSender(agent.config.perf)
+            bi = SlowBiStream(delay_s=0.0, hang_after=2)
+            t0 = asyncio.get_event_loop().time()
+            with pytest.raises(SlowPeerAbort):
+                await agent._serve_need(
+                    bi, agent.actor_id, SyncNeed.full(1, 1), sender
+                )
+            elapsed = asyncio.get_event_loop().time() - t0
+            assert elapsed < 5.0, "abort must fire at the stall threshold"
+            agent.store.close()
+
+    asyncio.run(body())
