@@ -195,6 +195,14 @@ class Agent:
         from ..utils.tripwire import spawn_counted
 
         self._tasks.append(spawn_counted(self._broadcast_loop(), "broadcast"))
+        # ONE apply lane.  The reference runs ≤5 concurrent
+        # process_multiple_changes jobs (handlers.rs:561-613) because its
+        # tokio workers overlap parsing with the single write conn; under
+        # Python's GIL that shape inverts — a hot event loop starves a
+        # worker thread into 30s+ applies (measured in round 2) and extra
+        # lanes just contend on write_sema.  Cost-capped batching
+        # (apply_queue_cost) provides the same throughput shape; the
+        # max_concurrent_applies knob documents the reference envelope.
         self._tasks.append(spawn_counted(self._ingest_loop(), "ingest"))
         self._tasks.append(spawn_counted(self._sync_loop(), "sync"))
         self._tasks.append(spawn_counted(self._lock_watchdog(), "lock-watchdog"))
@@ -394,7 +402,9 @@ class Agent:
             )
 
     async def _ingest_loop(self):
-        """Batched apply (process_multiple_changes, util.rs:691-1037)."""
+        """Batched apply (process_multiple_changes, util.rs:691-1037;
+        the reference's concurrency envelope, handlers.rs:561-613, maps
+        to cost-capped batches on one lane under the GIL)."""
         while not self._stopped.is_set():
             cs = await self._ingest_q.get()
             batch = [cs]
@@ -411,16 +421,29 @@ class Agent:
                     with _apply_hist.time(), Timed(
                         "changes-processing-under-budget", 60.0
                     ):
-                        self._process_changesets(batch)
+                        # the session runs INLINE on the loop (no awaits
+                        # inside): atomic w.r.t. all other loop code, and
+                        # the store's write_session lock serializes it
+                        # against genuinely threaded conn users (the
+                        # interrupt watchdog, close())
+                        matched = self._process_changesets_db(batch)
+                self._match_changes(matched)
             except Exception:  # keep the loop alive; reference logs + drops
                 import traceback
 
                 traceback.print_exc()
 
     def _process_changesets(self, batch: List[Changeset]):
+        """Synchronous apply entry (tests + non-loop callers)."""
+        self._match_changes(self._process_changesets_db(batch))
+
+    def _process_changesets_db(self, batch: List[Changeset]) -> List[Change]:
         """One snapshot per origin actor for the whole batch, committed to
         memory only after the data transaction lands (util.rs:691-1037,
-        892-932)."""
+        892-932).  Runs inline on the event loop under write_sema; the
+        store's write_session lock additionally guards the shared conn
+        against threaded users (watchdog, close).  Returns the committed
+        changes for subscription matching."""
         store = self.store
         snaps: Dict[ActorId, Tuple] = {}  # actor -> (booked, snap)
 
@@ -432,17 +455,23 @@ class Agent:
 
         partials: List[Changeset] = []
         matched: List[Change] = []
-        with self.locks.track("process_multiple_changes"):
-            self._apply_batch_tx(batch, store, snap_for, partials, matched)
-        # in-memory bookkeeping only after the data commit succeeded
-        for booked, snap in snaps.values():
-            booked.commit_snapshot(snap)
-        # subscriptions match committed changes only (util.rs:1026-1030)
-        self._match_changes(matched)
-        for actor_id, version in dict.fromkeys(partials):
-            partial = self.bookie.for_actor(actor_id).get_partial(version)
-            if partial is not None and partial.is_complete():
-                self._apply_fully_buffered(actor_id, version)
+        # the store's writer lock is held for the WHOLE session so this
+        # can safely run in a worker thread: loop-side conn users (WAL
+        # maintenance, exec_transaction) serialize against it and close()
+        # waits for it instead of yanking the conn mid-transaction
+        with store.write_session():
+            with self.locks.track("process_multiple_changes"):
+                self._apply_batch_tx(batch, store, snap_for, partials, matched)
+            # in-memory bookkeeping only after the data commit succeeded
+            for booked, snap in snaps.values():
+                booked.commit_snapshot(snap)
+            for actor_id, version in dict.fromkeys(partials):
+                partial = self.bookie.for_actor(actor_id).get_partial(version)
+                if partial is not None and partial.is_complete():
+                    self._apply_fully_buffered(actor_id, version)
+        # subscriptions match committed changes only (util.rs:1026-1030);
+        # returned so the async lanes can match on the event loop
+        return matched
 
     def _apply_batch_tx(self, batch, store, snap_for, partials, matched):
         store.begin_apply()
@@ -471,6 +500,19 @@ class Agent:
                     self.stats["changes_applied"] += impacted
                     matched.extend(cs.changes)
                 else:
+                    # version-level knowledge is recorded FIRST — and even
+                    # when incomplete (the reference insert_db's partial
+                    # versions too, util.rs:892-932); seq gaps ride
+                    # partial_need instead.  Order matters: insert_db pops
+                    # partial records for versions whose needed-gap it
+                    # removes (supersede semantics), so recording AFTER
+                    # inserting the partial would destroy it whenever the
+                    # version arrived out of order (below the current max)
+                    # — versions would look known while their rows sat in
+                    # the buffer table forever
+                    self.bookie.record_versions(
+                        cs.actor_id, snap, RangeSet([(cs.version, cs.version)])
+                    )
                     # merge seq coverage into the snapshot so later chunks of
                     # the same version in this batch aren't mistaken for known
                     p = snap.partials.get(cs.version)
@@ -483,12 +525,6 @@ class Agent:
                         p.seqs.insert(*cs.seqs)
                     self._buffer_rows(cs)
                     self.bookie.persist_partial(cs.actor_id, cs.version, p)
-                    # version-level knowledge is recorded even when incomplete
-                    # (the reference insert_db's partial versions too,
-                    # util.rs:892-932); seq gaps ride partial_need instead
-                    self.bookie.record_versions(
-                        cs.actor_id, snap, RangeSet([(cs.version, cs.version)])
-                    )
                     partials.append((cs.actor_id, cs.version))
             store.end_apply(commit=True)
         except Exception:
@@ -755,15 +791,27 @@ class Agent:
             sender = AdaptiveSender(perf)
         if need.kind == "full":
             lo, hi = need.versions
-            by_version = self.store.changes_for_version_range(actor_id, lo, hi)
             booked = self.bookie.for_actor(actor_id)
+            # ONE consistent bookkeeping view taken BEFORE the row scan:
+            # anything the view counts as known committed before the scan
+            # (its rows are visible below); anything newer is capped out
+            # by known_hi — so no freshly-committed version can fall into
+            # the cleared runs
+            needed, partial_keys, last = booked.serve_view()
+            by_version = self.store.changes_for_version_range(actor_id, lo, hi)
             # versions we know but hold no rows for → cleared (Empty) runs,
-            # computed with range algebra instead of a per-version scan
-            known_hi = min(hi, booked.last() or 0)
+            # computed with range algebra instead of a per-version scan.
+            # Versions held only as PARTIALS (rows still buffered, not in
+            # the clock tables) are NOT cleared — advertising them EMPTY
+            # poisons the puller into marking data it never got as known
+            # (the round-2 cold-catch-up stall)
+            known_hi = min(hi, last or 0)
             empty_runs = RangeSet([(lo, known_hi)] if lo <= known_hi else [])
-            for glo, ghi in list(booked.needed().overlapping(lo, hi)):
+            for glo, ghi in list(needed.overlapping(lo, hi)):
                 empty_runs.remove(glo, ghi)
             for v in by_version:
+                empty_runs.remove(v, v)
+            for v in partial_keys:
                 empty_runs.remove(v, v)
             for version in sorted(by_version, reverse=True):  # newest first
                 changes = by_version[version]
@@ -817,12 +865,13 @@ class Agent:
     def _buffered_changes(
         self, actor_id: ActorId, version: int, seq_range: Tuple[int, int]
     ) -> List[Change]:
-        rows = self.store.conn.execute(
-            'SELECT "table", pk, cid, val, col_version, db_version, seq, site_id, cl '
-            "FROM __corro_buffered_changes WHERE site_id = ? AND db_version = ? "
-            "AND seq BETWEEN ? AND ? ORDER BY seq",
-            (actor_id.bytes_, version, seq_range[0], seq_range[1]),
-        ).fetchall()
+        with self.store.write_session():
+            rows = self.store.conn.execute(
+                'SELECT "table", pk, cid, val, col_version, db_version, seq, site_id, cl '
+                "FROM __corro_buffered_changes WHERE site_id = ? AND db_version = ? "
+                "AND seq BETWEEN ? AND ? ORDER BY seq",
+                (actor_id.bytes_, version, seq_range[0], seq_range[1]),
+            ).fetchall()
         return [
             Change(
                 table=r[0], pk=r[1], cid=r[2], val=r[3], col_version=r[4],

@@ -974,6 +974,17 @@ class CrrStore:
                 impacted += len(wins)
         return impacted
 
+    def write_session(self):
+        """The writer RLock, exposed for multi-statement apply sessions.
+
+        A worker-thread apply (the concurrent ingest lanes) must hold it
+        across its WHOLE begin..commit + follow-up statements so that
+        loop-side users of the shared write conn (WAL maintenance,
+        exec_transaction, sync serving's buffered reads) serialize
+        against it, and `close()` (which also takes the lock) waits for
+        an in-flight session instead of closing the conn under it."""
+        return self._lock
+
     def begin_apply(self):
         with self._lock:
             self._applying = True

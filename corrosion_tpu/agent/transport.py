@@ -45,6 +45,7 @@ class BiStream:
 
     def __init__(self):
         self._inbox: asyncio.Queue = asyncio.Queue(self.INBOX_FRAMES)
+        self._eof = asyncio.Event()
         self.peer: Optional["BiStream"] = None
         self.closed = False
 
@@ -60,19 +61,35 @@ class BiStream:
         await self.peer._inbox.put(frame)
 
     async def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
-        try:
-            frame = await asyncio.wait_for(self._inbox.get(), timeout)
-        except asyncio.TimeoutError:
-            return None
-        return frame
+        """Next frame, b"" at EOF (peer closed, inbox drained), None on
+        timeout.  EOF rides an Event, not a queue sentinel — a sentinel
+        is silently lost when the bounded inbox is full at close time,
+        wedging the reader for its whole round timeout."""
+        if not self._inbox.empty():
+            return self._inbox.get_nowait()
+        if self._eof.is_set():
+            return b""
+        get_t = asyncio.create_task(self._inbox.get())
+        eof_t = asyncio.create_task(self._eof.wait())
+        done, pending = await asyncio.wait(
+            {get_t, eof_t}, timeout=timeout,
+            return_when=asyncio.FIRST_COMPLETED,
+        )
+        for t in pending:
+            t.cancel()
+        if get_t in done:
+            return get_t.result()
+        if eof_t in done:
+            # frames may have raced in alongside the close: drain first
+            if not self._inbox.empty():
+                return self._inbox.get_nowait()
+            return b""
+        return None  # timeout
 
     def close(self) -> None:
         self.closed = True
         if self.peer is not None:
-            try:
-                self.peer._inbox.put_nowait(b"")  # EOF marker
-            except asyncio.QueueFull:
-                pass  # receiver has a full backlog to drain anyway
+            self.peer._eof.set()
 
 
 @dataclass

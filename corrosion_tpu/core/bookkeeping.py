@@ -18,6 +18,7 @@ reference's own unit test (`agent.rs:1600-1922`) is ported in
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Protocol, Tuple
 
@@ -209,54 +210,81 @@ class VersionsSnapshot:
 
 
 class BookedVersions:
-    """Per-origin-actor version knowledge (reference `agent.rs:1260-1437`)."""
+    """Per-origin-actor version knowledge (reference `agent.rs:1260-1437`).
+
+    Thread-safe for the concurrent-apply-lane architecture: apply
+    sessions run in worker threads (commit_snapshot) while the event
+    loop dedups incoming changesets against the same state (contains*).
+    An internal lock makes every read see a CONSISTENT
+    (needed, partials, max) triple — a torn read can judge a chunk
+    "already known" and silently drop it (the round-2 lost-chunk bug:
+    expected 173 duplicate frames, observed 177 dedups)."""
 
     def __init__(self, actor_id: ActorId):
         self.actor_id = actor_id
         self.partials: Dict[int, PartialVersion] = {}
         self._needed = RangeSet()
         self._max: Optional[int] = None
+        self._tlock = threading.RLock()
 
     # -- snapshots --------------------------------------------------------
 
     def snapshot(self) -> VersionsSnapshot:
         # deep-copy partials: the snapshot mutates them mid-transaction and
         # must not leak into the committed view before commit_snapshot
-        return VersionsSnapshot(
-            self.actor_id,
-            self._needed.copy(),
-            {
-                v: PartialVersion(seqs=p.seqs.copy(), last_seq=p.last_seq, ts=p.ts)
-                for v, p in self.partials.items()
-            },
-            self._max,
-        )
+        with self._tlock:
+            return VersionsSnapshot(
+                self.actor_id,
+                self._needed.copy(),
+                {
+                    v: PartialVersion(seqs=p.seqs.copy(), last_seq=p.last_seq, ts=p.ts)
+                    for v, p in self.partials.items()
+                },
+                self._max,
+            )
 
     def commit_snapshot(self, snap: VersionsSnapshot) -> None:
-        self._needed = snap.needed
-        self.partials = snap.partials
-        self._max = snap.max
+        with self._tlock:
+            self._needed = snap.needed
+            self.partials = snap.partials
+            self._max = snap.max
 
     # -- queries ----------------------------------------------------------
 
     def contains_version(self, version: int) -> bool:
         """Reference `agent.rs:1353-1362`: known iff not needed and <= max."""
-        return not self._needed.contains(version) and (self._max or 0) >= version
+        with self._tlock:
+            return not self._needed.contains(version) and (self._max or 0) >= version
 
     def contains(self, version: int, seqs: Optional[Range] = None) -> bool:
-        return _contains(self._needed, self.partials, self._max, version, seqs)
+        with self._tlock:
+            return _contains(self._needed, self.partials, self._max, version, seqs)
 
     def contains_all(self, versions: Range, seqs: Optional[Range] = None) -> bool:
-        return _contains_all(self._needed, self.partials, self._max, versions, seqs)
+        with self._tlock:
+            return _contains_all(
+                self._needed, self.partials, self._max, versions, seqs
+            )
 
     def last(self) -> Optional[int]:
-        return self._max
+        with self._tlock:
+            return self._max
+
+    def serve_view(self):
+        """One CONSISTENT (needed copy, partial version keys, max) triple
+        for serve-side computations: the empty-runs derivation in
+        _serve_need must not mix attributes from different commits, or a
+        freshly committed version can be mis-advertised as cleared."""
+        with self._tlock:
+            return self._needed.copy(), list(self.partials.keys()), self._max
 
     def needed(self) -> RangeSet:
-        return self._needed
+        with self._tlock:
+            return self._needed.copy()
 
     def get_partial(self, version: int) -> Optional[PartialVersion]:
-        return self.partials.get(version)
+        with self._tlock:
+            return self.partials.get(version)
 
     # -- mutation ---------------------------------------------------------
 

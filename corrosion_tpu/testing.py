@@ -88,6 +88,26 @@ class Cluster:
         for agent in self.agents:
             await agent.start()
 
+    async def add_node(self) -> Agent:
+        """Boot a COLD late joiner (the large_tx_sync shape,
+        tests.rs:602-650): fresh empty DB, bootstrap = existing nodes, must
+        catch up through anti-entropy sync."""
+        i = len(self.agents)
+        addr = f"node{i}"
+        cfg = Config(
+            db_path=f"{self.tmp.name}/node{i}.db",
+            gossip_addr=addr,
+            bootstrap=[a.transport.addr for a in self.agents],
+            use_swim=self.use_swim,
+            perf=fast_perf(),
+        )
+        agent = Agent(cfg, self.net.transport(addr))
+        agent.store.execute_schema(self.schema)
+        self.agents.append(agent)
+        self.n += 1
+        await agent.start()
+        return agent
+
     async def stop(self):
         for agent in self.agents:
             await agent.stop()
@@ -95,12 +115,18 @@ class Cluster:
 
     def converged(self) -> bool:
         """The cluster-wide convergence property the reference checks in
-        check_bookkeeping.py:6-27: all needs empty, all heads equal."""
+        check_bookkeeping.py:6-27: all needs empty, all heads equal —
+        plus NO partials at all: a complete-but-not-yet-applied partial
+        is invisible to generate_sync (it advertises no gaps) but its
+        data has not landed in the tables yet."""
         heads = {}
         for agent in self.agents:
             s = agent.sync_state()
             if s.need or s.partial_need:
                 return False
+            for booked in agent.bookie.by_actor.values():
+                if booked.partials:
+                    return False
             for actor, head in s.heads.items():
                 if heads.setdefault(actor, head) != head:
                     return False
