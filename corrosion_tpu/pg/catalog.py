@@ -134,6 +134,53 @@ def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
     )
     conn.create_function("pg_table_is_visible", 1, lambda _o: 1, deterministic=True)
     conn.create_function("obj_description", 2, lambda _o, _c: None)
+    conn.create_function("col_description", 2, lambda _o, _c: None)
+    conn.create_function(
+        "quote_ident", 1,
+        lambda s: '"' + str(s).replace('"', '""') + '"' if s is not None else None,
+        deterministic=True,
+    )
+
+    db_file = conn.execute(
+        "SELECT file FROM pragma_database_list WHERE name = 'main'"
+    ).fetchone()[0]
+
+    def _to_regclass(name):
+        # a real existence probe (the standard PG idiom
+        # `to_regclass(x) IS NOT NULL` gates CREATE TABLE): resolve via a
+        # SEPARATE short-lived connection — a UDF must not re-enter the
+        # connection that is executing it.  :memory: stores (no file to
+        # reopen) stay permissive.
+        if not name:
+            return None
+        bare = str(name).split(".")[-1].strip('"')
+        if not db_file:
+            return name
+        probe = sqlite3.connect(db_file)
+        try:
+            row = probe.execute(
+                "SELECT 1 FROM sqlite_master WHERE name = ?", (bare,)
+            ).fetchone()
+        finally:
+            probe.close()
+        return name if row else None
+
+    conn.create_function("to_regclass", 1, _to_regclass)
+    conn.create_function("has_schema_privilege", 2, lambda _s, _p: 1)
+    conn.create_function("has_schema_privilege", 3, lambda _u, _s, _p: 1)
+    conn.create_function("has_table_privilege", 2, lambda _t, _p: 1)
+    conn.create_function("has_table_privilege", 3, lambda _u, _t, _p: 1)
+    conn.create_function(
+        "pg_encoding_to_char", 1, lambda _e: "UTF8", deterministic=True
+    )
+    conn.create_function("pg_get_expr", 2, lambda _e, _r: None)
+    conn.create_function("pg_get_expr", 3, lambda _e, _r, _p: None)
+    conn.create_function("txid_current", 0, lambda: 1)
+    conn.create_function(
+        "pg_size_pretty", 1,
+        lambda n: f"{n} bytes" if n is not None else None,
+        deterministic=True,
+    )
 
 
 _OID_NAMES = {o: n for o, n, *_ in _TYPES}

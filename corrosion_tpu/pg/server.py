@@ -589,12 +589,38 @@ class _Session:
         self.writer.write(p.command_complete(t.tag))
 
     async def _run_write(self, t: tr.Translated, params):
+        # RETURNING rows (SQLite ≥3.35 evaluates it natively) must be
+        # fetched BEFORE commit — the DML isn't finished until its cursor
+        # is exhausted ("SQL statements in progress" otherwise)
+        rows = []
+        desc = None
         if self.tx is not None:
-            self.tx.execute(t.sql, tuple(params))
+            cur = self.tx.execute(t.sql, tuple(params))
+            if cur is not None and cur.description:
+                desc = cur.description
+                rows = cur.fetchall()
         else:
             async with self.agent.write_sema:
-                self.agent.exec_transaction_cursors([(t.sql, tuple(params))])
-        n = max(self.agent.store.last_dml_changes, 0)
+                tx = self.agent.interactive_tx()
+                tx.begin()
+                try:
+                    cur = tx.execute(t.sql, tuple(params))
+                    if cur is not None and cur.description:
+                        desc = cur.description
+                        rows = cur.fetchall()
+                    tx.commit()
+                except Exception:
+                    tx.rollback()
+                    raise
+        # emit the row set before CommandComplete (reference write path)
+        if desc is not None:
+            fields = [
+                p.FieldDesc(name=d[0], oid=p.OID_TEXT, fmt=0) for d in desc
+            ]
+            self.writer.write(p.row_description(fields))
+            for row in rows:
+                self.writer.write(p.data_row(self._encode_row(row, fields, 0)))
+        n = len(rows) if rows else max(self.agent.store.last_dml_changes, 0)
         if t.tag == "INSERT":
             self.writer.write(p.command_complete(f"INSERT 0 {n}"))
         else:

@@ -269,6 +269,38 @@ def _rewrite_tokens(sql: str) -> Tuple[str, int]:
             out.append(sql[i : j + 1])
             i = j + 1
             continue
+        if c.isalpha() or c == "_":
+            # identifier: handle schema qualification.  `public.` is
+            # stripped everywhere (tables live unqualified in SQLite);
+            # `pg_catalog.` is stripped ONLY before a function call —
+            # catalog TABLES (pg_catalog.pg_class …) stay qualified and
+            # resolve against the attached catalog DB (catalog.py), while
+            # qualified FUNCTIONS (pg_catalog.version()) must hit the
+            # registered SQLite UDFs, which have no schema
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j]
+            k = j
+            while k < n and sql[k] in " \t":
+                k += 1
+            if word.lower() in ("public", "pg_catalog") and k < n and sql[k] == ".":
+                m = k + 1
+                while m < n and sql[m] in " \t":
+                    m += 1
+                e = m
+                while e < n and (sql[e].isalnum() or sql[e] == "_"):
+                    e += 1
+                f = e
+                while f < n and sql[f] in " \t":
+                    f += 1
+                is_call = f < n and sql[f] == "("
+                if word.lower() == "public" or is_call:
+                    i = m  # drop the qualifier, keep the identifier
+                    continue
+            out.append(word)
+            i = j
+            continue
         if c == "$" and i + 1 < n and sql[i + 1].isdigit():
             j = i + 1
             while j < n and sql[j].isdigit():
@@ -311,11 +343,26 @@ def _map_ddl_types(sql: str) -> str:
     return pat.sub(repl, sql)
 
 
+_ON_CONSTRAINT_RE = re.compile(r"\bON\s+CONFLICT\s+ON\s+CONSTRAINT\b", re.I)
+
+
 def translate(sql: str) -> Translated:
-    """One PG statement → executable SQLite SQL + classification."""
+    """One PG statement → executable SQLite SQL + classification.
+
+    SQLite natively covers most of the PG write dialect the reference
+    translates AST-to-AST (corro-pg/src/lib.rs:546-1906): RETURNING
+    (3.35+), upsert `ON CONFLICT (cols) DO UPDATE/NOTHING` with
+    `excluded.` refs (3.24+), and TRUE/FALSE literals — those pass
+    through untouched.  The constraint-name upsert form has no SQLite
+    equivalent and is rejected with guidance."""
     tag, kind = classify(sql)
     if kind in ("empty", "tx", "session"):
         return Translated(sql=sql.strip(), tag=tag, kind=kind)
+    if _ON_CONSTRAINT_RE.search(sql):
+        raise UnsupportedStatement(
+            "ON CONFLICT ON CONSTRAINT is not supported: name the "
+            "conflict target's column list instead (SQLite upsert form)"
+        )
     body, n_params = _rewrite_tokens(sql.strip().rstrip(";"))
     if kind == "ddl":
         body = _map_ddl_types(body)
