@@ -270,21 +270,46 @@ class UpdatesStream:
 
 
 class PooledClient:
-    """Multi-address failover client (CorrosionPooledClient analog)."""
+    """Multi-address failover client (CorrosionPooledClient,
+    corro-client/src/lib.rs:400+): requests rotate to the next address
+    on transport errors, retrying rounds with decorrelated-jitter
+    backoff (the reference's reconnect parity, backoff/src/lib.rs:7);
+    pooled subscription streams survive a node death by re-subscribing
+    on another address."""
 
-    def __init__(self, addrs: Sequence[str], authz_token: Optional[str] = None):
+    def __init__(
+        self,
+        addrs: Sequence[str],
+        authz_token: Optional[str] = None,
+        max_rounds: int = 3,
+    ):
         self.clients = [ApiClient(a, authz_token) for a in addrs]
         self._i = 0
+        self.max_rounds = max_rounds
+
+    def current(self) -> ApiClient:
+        return self.clients[self._i % len(self.clients)]
+
+    def rotate(self) -> None:
+        self._i += 1
 
     async def _try(self, fn):
+        from ..utils.backoff import Backoff
+
+        backoff = Backoff(0.05, 1.0)
         last_err: Optional[Exception] = None
-        for _ in range(len(self.clients)):
-            client = self.clients[self._i % len(self.clients)]
+        total = self.max_rounds * len(self.clients)
+        for attempt in range(total):
+            client = self.current()
             try:
                 return await fn(client)
             except (OSError, RuntimeError, asyncio.IncompleteReadError) as e:
                 last_err = e
-                self._i += 1  # failover to the next address
+                self.rotate()  # failover to the next address
+                # back off between full rounds, but not after the final
+                # attempt — that would just delay the terminal error
+                if (attempt + 1) % len(self.clients) == 0 and attempt + 1 < total:
+                    await asyncio.sleep(next(backoff))
         raise last_err if last_err else RuntimeError("no clients")
 
     async def execute(self, statements):
@@ -292,3 +317,75 @@ class PooledClient:
 
     async def query(self, statement):
         return await self._try(lambda c: c.query(statement))
+
+    async def schema(self, statements):
+        return await self._try(lambda c: c.schema(statements))
+
+    async def table_stats(self):
+        return await self._try(lambda c: c.table_stats())
+
+    def subscribe(self, statement) -> "PooledSubscriptionStream":
+        """A subscription that outlives any single node (the kill-one-
+        node contract): same-node hiccups resume from the last change id
+        (SubscriptionStream's own reconnect); a dead node triggers
+        re-subscription on the next address.  Change ids are per-node
+        state, so cross-node failover restarts the stream with a fresh
+        snapshot — consumers must treat row events as upserts."""
+        return PooledSubscriptionStream(self, statement)
+
+
+class PooledSubscriptionStream:
+    def __init__(self, pool: PooledClient, statement):
+        self.pool = pool
+        self.statement = statement
+        self._stream: Optional[SubscriptionStream] = None
+        self.failovers = 0
+
+    async def _connect(self) -> None:
+        self._stream = await self.pool._try(
+            lambda c: c.subscribe(self.statement)
+        )
+
+    def __aiter__(self):
+        return self._iter()
+
+    MAX_CONSECUTIVE_FAILOVERS = 16
+
+    async def _iter(self):
+        from ..utils.backoff import Backoff
+
+        backoff = Backoff(0.05, 2.0)
+        barren = 0  # consecutive failovers with zero events delivered
+        while True:
+            if self._stream is None:
+                await self._connect()
+            got_any = False
+            err: Optional[Exception] = None
+            try:
+                async for event in self._stream:
+                    got_any = True
+                    barren = 0
+                    backoff.reset()
+                    yield event
+                # subscriptions are infinite: a "clean" EOF means the
+                # node died mid-stream (server close reads as EOF, not
+                # an error) — fail over like any other disconnect
+            except (OSError, RuntimeError, asyncio.IncompleteReadError, ValueError) as e:
+                err = e  # node gone (its own reconnect budget included)
+            self.failovers += 1
+            self.pool.rotate()
+            self._stream = None
+            if not got_any:
+                # a stream that dies before delivering ANYTHING is not a
+                # node failure pattern worth spinning on: back off, and
+                # surface the root cause once the budget is spent
+                barren += 1
+                if barren >= self.MAX_CONSECUTIVE_FAILOVERS:
+                    raise err if err is not None else RuntimeError(
+                        "subscription failed on every address"
+                    )
+                await asyncio.sleep(next(backoff))
+
+    def close(self):
+        if self._stream is not None:
+            self._stream.close()

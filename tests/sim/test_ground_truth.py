@@ -1,25 +1,32 @@
-"""Ground-truth calibration (BASELINE config #1): the TPU sim's 3-node
-convergence behavior must match the real in-process host-agent cluster.
+"""Ground-truth calibration (BASELINE config #1 + VERDICT r1 item 4):
+the TPU sim's convergence behavior must match the real in-process
+host-agent cluster as a DISTRIBUTION, not a single scalar in a ×10 band.
 
-Both tiers run the same scenario — 3 nodes, 1 writer, a burst of versions —
-and we compare convergence latency measured in broadcast-flush ticks
-(1 sim round ≡ 1 flush interval).  The sim is a round-synchronous
-discretization, so the assertion is a band, not equality: the reference's
-own tests accept seconds of slack (tests.rs:52 sleeps 1 s and checks)."""
+Two comparisons, both normalized to protocol-native time units so the
+round discretization is what's under test (SURVEY §7 hard part #3):
+
+1. 3-node single-writer burst: p50/p99 rounds-to-convergence over ≥10
+   seeds on each tier, within ×2 (+2 rounds additive discretization
+   slack).  One sim round ≡ one broadcast flush tick.
+2. 64-node SWIM kill: detection latency (all survivors mark all dead
+   DOWN), measured in PROBE PERIODS on each tier, within ×2.  Both
+   tiers run probe-every-period with a 10-probe suspicion window.
+"""
 
 import asyncio
 
 import numpy as np
 
-from corrosion_tpu.sim.round import new_sim, run_to_convergence
-from corrosion_tpu.sim.state import SimConfig, uniform_payloads
-from corrosion_tpu.sim.topology import Topology
+from corrosion_tpu.sim.round import new_metrics, new_sim, round_step, run_to_convergence
+from corrosion_tpu.sim.state import ALIVE, DOWN, SimConfig, uniform_payloads
+from corrosion_tpu.sim.topology import Topology, regions
 from corrosion_tpu.testing import Cluster
 
 N_VERSIONS = 20
+N_SEEDS = 10
 
 
-def host_rounds_to_convergence() -> float:
+def host_rounds_once() -> float:
     """Real 3-node agent cluster: write N versions, measure convergence
     wall-clock in units of the broadcast flush interval."""
 
@@ -43,23 +50,124 @@ def host_rounds_to_convergence() -> float:
     return asyncio.run(body())
 
 
-def sim_rounds_to_convergence() -> float:
+def sim_rounds_once(seed: int) -> float:
     cfg = SimConfig(n_nodes=3, n_payloads=N_VERSIONS, fanout=2,
                     sync_interval_rounds=4)
     meta = uniform_payloads(cfg, inject_every=0)  # one burst
-    state = new_sim(cfg, seed=0)
+    state = new_sim(cfg, seed=seed)
     final, metrics = run_to_convergence(state, meta, cfg, Topology(), 500)
     conv = np.asarray(metrics.converged_at)
     assert (conv >= 0).all()
     return float(conv.max())
 
 
-def test_sim_matches_host_ground_truth():
-    host = host_rounds_to_convergence()
-    sim = sim_rounds_to_convergence()
-    # both tiers must settle a 20-version burst within a handful of flush
-    # ticks of each other; an order-of-magnitude drift means the round
-    # discretization is distorting convergence (SURVEY §7 hard part #3)
-    assert sim <= host * 10 + 10, f"sim={sim} rounds vs host={host:.1f} ticks"
-    assert host <= sim * 10 + 10, f"host={host:.1f} ticks vs sim={sim} rounds"
-    print(f"ground truth: host={host:.1f} flush-ticks, sim={sim} rounds")
+def test_convergence_distribution_matches_host():
+    host = np.array([host_rounds_once() for _ in range(N_SEEDS)])
+    sim = np.array([sim_rounds_once(s) for s in range(N_SEEDS)])
+    for q in (50, 99):
+        h = float(np.percentile(host, q))
+        s = float(np.percentile(sim, q))
+        assert s <= h * 2 + 2, f"p{q}: sim={s:.1f} vs host={h:.1f} ticks"
+        assert h <= s * 2 + 2, f"p{q}: host={h:.1f} ticks vs sim={s:.1f}"
+    print(
+        f"calibration: host p50/p99 = {np.percentile(host, 50):.1f}/"
+        f"{np.percentile(host, 99):.1f} ticks, sim = "
+        f"{np.percentile(sim, 50):.1f}/{np.percentile(sim, 99):.1f} rounds"
+    )
+
+
+# -- 64-node SWIM detection latency ----------------------------------------
+
+N_SWIM = 64
+N_KILL = 8
+SUSPECT_PROBES = 10  # suspicion window in probe periods, both tiers
+HOST_PROBE_S = 0.1  # large vs event-loop scheduling lag at 64 in-process agents
+
+
+def host_swim_detection_probe_periods() -> float:
+    """64 in-process agents with real SWIM; kill N_KILL, measure
+    wall-clock until every survivor marks every victim DOWN, in probe
+    periods."""
+    from corrosion_tpu.agent.swim import DOWN as H_DOWN
+
+    async def body():
+        cluster = Cluster(N_SWIM)
+        await cluster.start()
+        # align the suspicion window with the sim tier (10 probe
+        # periods); the runtime reads perf live each loop tick
+        for a in cluster.agents:
+            a.config.perf.swim_probe_interval_s = HOST_PROBE_S
+            a.config.perf.swim_suspect_timeout_s = HOST_PROBE_S * SUSPECT_PROBES
+        try:
+            # let membership form: everyone knows everyone
+            deadline = asyncio.get_event_loop().time() + 30
+            while asyncio.get_event_loop().time() < deadline:
+                if all(
+                    len(a.swim.members) >= N_SWIM - 1 for a in cluster.agents
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            victims = cluster.agents[:N_KILL]
+            victim_ids = [v.actor_id for v in victims]
+            survivors = cluster.agents[N_KILL:]
+            t0 = asyncio.get_event_loop().time()
+            for v in victims:
+                await v.stop()
+
+            def all_detected():
+                return all(
+                    a.swim.members.get(vid) is not None
+                    and a.swim.members[vid].status == H_DOWN
+                    for a in survivors
+                    for vid in victim_ids
+                )
+
+            deadline = asyncio.get_event_loop().time() + 90
+            while asyncio.get_event_loop().time() < deadline:
+                if all_detected():
+                    break
+                await asyncio.sleep(0.1)
+            assert all_detected(), "host survivors must detect all victims"
+            elapsed = asyncio.get_event_loop().time() - t0
+            return elapsed / HOST_PROBE_S
+        finally:
+            for a in cluster.agents[N_KILL:]:
+                await a.stop()
+            cluster.tmp.cleanup()
+
+    return asyncio.run(body())
+
+
+def sim_swim_detection_probe_periods(seed: int) -> float:
+    import jax.numpy as jnp
+
+    cfg = SimConfig(
+        n_nodes=N_SWIM, n_payloads=1, swim_full_view=True,
+        probe_period_rounds=1, suspect_timeout_rounds=SUSPECT_PROBES,
+    )
+    meta = uniform_payloads(cfg)
+    topo = Topology()
+    region = regions(N_SWIM, 1)
+    state = new_sim(cfg, seed)
+    kill = np.zeros(N_SWIM, bool)
+    kill[:N_KILL] = True
+    state = state._replace(
+        alive=jnp.where(jnp.asarray(kill), jnp.uint8(DOWN), jnp.uint8(ALIVE))
+    )
+    metrics = new_metrics(cfg)
+    for _ in range(400):
+        state, metrics = round_step(state, metrics, meta, cfg, topo, region)
+        view = np.asarray(state.view)
+        up = np.asarray(state.alive) == ALIVE
+        if (view[np.ix_(up, ~up)] == DOWN).all():
+            return float(int(state.t)) / cfg.probe_period_rounds
+    raise AssertionError("sim survivors never detected all victims")
+
+
+def test_swim_detection_latency_matches_host():
+    host = host_swim_detection_probe_periods()
+    sims = [sim_swim_detection_probe_periods(s) for s in range(5)]
+    sim = float(np.median(sims))
+    assert sim <= host * 2 + 2, f"sim={sim:.1f} vs host={host:.1f} probe periods"
+    assert host <= sim * 2 + 2, f"host={host:.1f} vs sim={sim:.1f} probe periods"
+    print(f"swim detection: host={host:.1f}, sim median={sim:.1f} probe periods")
