@@ -33,6 +33,11 @@ class PerfConfig:
     apply_queue_timeout_s: float = 0.01
     changes_queue_cap: int = 20000
     max_concurrent_applies: int = 5
+    # dedup (seen) cache: sized to the queue-cap envelope with a TTL so
+    # re-gossip of long-evicted keys re-enters the (idempotent) apply
+    # path instead of aging forever (handlers.rs:671-686 seen cache)
+    seen_cache_cap: int = 20000
+    seen_cache_ttl_s: float = 60.0
     # chunking (change.rs:180, peer/mod.rs:365-368)
     max_changes_byte_size: int = 8 * 1024
     min_changes_byte_size: int = 1024

@@ -33,7 +33,7 @@ def broadcast_step(
 ) -> SimState:
     n, p = state.have.shape
     f = cfg.fanout
-    k_targets, k_drop = jax.random.split(key)
+    k_targets, k_drop, k_ring0 = jax.random.split(key, 3)
 
     active = (state.injected > 0)[None, :]  # [1, P]
     # what each node would send: held, budget left, payload active
@@ -43,13 +43,46 @@ def broadcast_step(
     # version-major layout guarantee) within the per-round byte budget —
     # the reference drains its broadcast queue oldest-first under the
     # governor (broadcast/mod.rs:453-463)
-    sending = budget_prefix_mask(eligible, cfg.rate_limit_bytes_round, cfg)
+    sending = budget_prefix_mask(
+        eligible, cfg.rate_limit_bytes_round, meta.nbytes
+    )
 
     # fanout targets come from each node's believed member list (the
     # reference's choose_count sample over Members.states,
     # broadcast/mod.rs:653-680) — false suspicion starves a live node;
     # ground-truth delivery masks still apply below
     targets = sample_member_targets(state, cfg, k_targets, f)  # [N, F]
+    if cfg.ring0_first and topo.n_regions > 1:
+        # ring0 tiering: slot 0 targets a SAME-REGION member (the lowest
+        # RTT ring), so local broadcasts land intra-region first
+        # (members.rs:38-178 ring buckets, broadcast/mod.rs:589-651).
+        # The ring0 candidate must STILL be a believed member in coupled
+        # modes — the reference picks ring0 from the member list's RTT
+        # buckets, so a believed-down (or unknown) node stays starved
+        me = jnp.arange(n, dtype=jnp.int32)
+        per = max(1, n // topo.n_regions)
+        start = region * per
+        size = jnp.where(
+            region == topo.n_regions - 1, n - start, per
+        ).astype(jnp.int32)
+        local = start + jax.random.randint(
+            k_ring0, (n,), 0, jnp.iinfo(jnp.int32).max
+        ) % jnp.maximum(size, 1)
+        ok_local = local != me
+        if cfg.couple_membership and cfg.swim_full_view:
+            from .state import DOWN
+
+            ok_local &= state.view[me, local] != DOWN
+        elif cfg.couple_membership and cfg.swim_partial_view:
+            from .state import DOWN
+
+            m = state.pid.shape[1]
+            bucket = local % m
+            known = state.pid[me, bucket] == local
+            ok_local &= known & (state.pkey[me, bucket] % 4 != DOWN)
+        targets = targets.at[:, 0].set(
+            jnp.where(ok_local, local, targets[:, 0])
+        )
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
     dst = targets.reshape(-1)  # [E]
     ok = dst >= 0

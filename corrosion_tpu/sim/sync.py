@@ -122,7 +122,7 @@ def sync_step(
     # oldest-first budget: the payload axis is version-major BY
     # CONSTRUCTION (uniform_payloads), so index order is already global
     # (version, actor) request order — no per-round permutation needed
-    granted = budget_prefix_mask(need, cfg.sync_budget_bytes, cfg)
+    granted = budget_prefix_mask(need, cfg.sync_budget_bytes, meta.nbytes)
 
     # deliver next round via the delay ring (bi-stream round trip)
     d_slots = state.inflight.shape[0]

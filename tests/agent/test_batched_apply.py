@@ -115,3 +115,57 @@ def test_batched_idempotent_redelivery():
     assert s.apply_changes(changes) == 0
     assert snapshot(s) == before
     s.close()
+
+
+def test_seen_cache_ttl_and_cap():
+    """VERDICT r1 weak #6: the dedup cache is TTL'd and sized to the
+    queue-cap envelope — an expired key is re-admitted (idempotent apply
+    re-checks bookkeeping), and the cache never exceeds its cap."""
+    import asyncio
+    import tempfile
+
+    from corrosion_tpu.agent.agent import Agent
+    from corrosion_tpu.agent.config import Config
+    from corrosion_tpu.agent.transport import MemoryNetwork
+    from corrosion_tpu.core.types import ActorId, Change, Changeset, ChangesetPart, ChangeSource
+    from corrosion_tpu.testing import TEST_SCHEMA, fast_perf
+
+    async def body():
+        net = MemoryNetwork()
+        cfg = Config(db_path=":memory:", gossip_addr="a", use_swim=False,
+                     perf=fast_perf())
+        cfg.perf.seen_cache_cap = 8
+        cfg.perf.seen_cache_ttl_s = 0.05
+        agent = Agent(cfg, net.transport("a"))
+        agent.store.execute_schema(TEST_SCHEMA)
+        actor = ActorId(bytes([9] * 16))
+
+        def cs(v):
+            ch = Change(table="tests", pk=b"\x01", cid="text", val=f"v{v}",
+                        col_version=1, db_version=v, seq=0,
+                        site_id=actor, cl=1)
+            return Changeset(actor_id=actor, version=v, changes=(ch,),
+                             seqs=(0, 0), last_seq=0, part=ChangesetPart.FULL)
+
+        # cap: 20 distinct keys, cache holds at most 8
+        for v in range(1, 21):
+            await agent._enqueue_changeset(cs(v), ChangeSource.BROADCAST)
+        assert len(agent._seen) <= 8
+
+        # TTL: a fresh duplicate is deduped; an expired one is re-admitted
+        # (the idempotent apply path / bookkeeping re-check absorbs it)
+        before = agent.stats["changes_deduped"]
+        await agent._enqueue_changeset(cs(20), ChangeSource.BROADCAST)
+        assert agent.stats["changes_deduped"] == before + 1
+        await asyncio.sleep(0.08)  # expire
+        q_before = agent._ingest_q.qsize()
+        d_before = agent.stats["changes_deduped"]
+        await agent._enqueue_changeset(cs(20), ChangeSource.BROADCAST)
+        # nothing was applied yet (no ingest loop running), so the bookie
+        # check can't dedup it either: the expired key MUST re-enqueue
+        assert agent._ingest_q.qsize() == q_before + 1
+        assert agent.stats["changes_deduped"] == d_before
+        assert len(agent._seen) <= 8
+        agent.store.close()
+
+    asyncio.run(body())
