@@ -1,0 +1,78 @@
+"""Sim-round invariant catalog — the device-state twin of the host's
+`corrosion_tpu.invariants` (SURVEY §4.5: the reference bakes Antithesis
+`assert_always` properties into production code; the sim's analog is a
+set of always-properties over the state tensors, evaluated between
+rounds by tests and debug runs).
+
+Checked properties:
+
+- **no-phantom-data** — ``have ⊆ injected``: no node holds a chunk that
+  never entered the system (inject_step is the only creation point).
+- **bookkeeping-heads** — ``state.heads`` equals the max touched version
+  per (node, actor) derived from ``have`` (round_step's refresh
+  contract; `BookedVersions.last()`).
+- **bookkeeping-gaps** — the gap interval tensors cover EXACTLY the
+  missing-run decomposition of touched versions below the head when runs
+  fit in K slots, and a superset (never a subset) under K-overflow
+  clamping — under-coverage would silently starve sync needs.
+- **relay-budget** — ``relay_left ≤ max_transmissions``.
+- **dead-nodes-inert** — nodes down since round 0 hold nothing (their
+  edges are masked at delivery).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .gaps import gaps_to_mask
+from .state import ALIVE, SimConfig, SimState, touched_versions, version_heads
+
+
+def check_state(
+    state: SimState,
+    cfg: SimConfig,
+    dead_since_start: np.ndarray | None = None,
+) -> None:
+    """Assert the always-properties on a (host-fetched) state snapshot.
+    Raises AssertionError with the violated property's name."""
+    have = np.asarray(state.have)
+    injected = np.asarray(state.injected)
+    assert (have <= injected[None, :]).all(), (
+        "no-phantom-data: a node holds a never-injected chunk"
+    )
+
+    touched = np.asarray(touched_versions(state.have, cfg))
+    heads = np.asarray(state.heads)
+    expect_heads = np.asarray(version_heads(touched))
+    assert (heads == expect_heads).all(), (
+        "bookkeeping-heads: state.heads diverged from chunk truth"
+    )
+
+    v = cfg.n_versions
+    v_idx = np.arange(1, v + 1)
+    missing = (~touched) & (v_idx[None, None, :] <= heads[:, :, None])
+    covered = np.asarray(gaps_to_mask(state.gap_lo, state.gap_hi, v))
+    # never under-cover (would starve sync); exact when runs fit in K
+    assert (covered >= missing).all(), (
+        "bookkeeping-gaps: gap tensors under-cover the missing runs"
+    )
+    n_runs = (missing & ~np.pad(missing[:, :, :-1], ((0, 0), (0, 0), (1, 0)))).sum(
+        axis=2
+    )
+    fits = n_runs <= cfg.gap_slots
+    assert (covered[fits] == missing[fits]).all(), (
+        "bookkeeping-gaps: inexact coverage without K-overflow"
+    )
+    # gaps never extend past the head
+    assert not (covered & (v_idx[None, None, :] > heads[:, :, None])).any(), (
+        "bookkeeping-gaps: gap covers a version above the head"
+    )
+
+    relay = np.asarray(state.relay_left)
+    assert (relay <= cfg.max_transmissions).all(), "relay-budget exceeded"
+
+    if dead_since_start is not None:
+        dead = np.asarray(dead_since_start, bool)
+        assert (have[dead] == 0).all(), (
+            "dead-nodes-inert: a node down since round 0 holds data"
+        )

@@ -29,3 +29,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from corrosion_tpu.invariants import CATALOG  # noqa: E402
 
 CATALOG.strict = True
+
+# a wedged test (deadlocked event loop, stuck TLS handshake) should dump
+# every thread's traceback instead of stalling CI silently: re-armed per
+# test by the autouse fixture below; 300 s is far above the slowest test
+import faulthandler  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _hang_watchdog():
+    faulthandler.dump_traceback_later(300, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()

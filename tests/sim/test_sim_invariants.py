@@ -1,0 +1,52 @@
+"""The sim invariant catalog (SURVEY §4.5 analog) holds every round
+across dissemination, loss, chunking, partitions, and membership modes."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.sim.invariants import check_state
+from corrosion_tpu.sim.round import new_metrics, new_sim, round_step
+from corrosion_tpu.sim.state import ALIVE, DOWN, SimConfig, uniform_payloads
+from corrosion_tpu.sim.topology import Topology, regions
+
+
+def drive_checked(cfg, topo=Topology(), seed=0, rounds=60, mutate=None,
+                  dead=None):
+    region = regions(cfg.n_nodes, topo.n_regions)
+    meta = uniform_payloads(cfg)
+    state = new_sim(cfg, seed)
+    if mutate:
+        state = mutate(state)
+    metrics = new_metrics(cfg)
+    for _ in range(rounds):
+        state, metrics = round_step(state, metrics, meta, cfg, topo, region)
+        check_state(state, cfg, dead_since_start=dead)
+    return state
+
+
+def test_invariants_chunked_lossy():
+    cfg = SimConfig(n_nodes=48, n_payloads=24, n_writers=2,
+                    chunks_per_version=3, gap_slots=4,
+                    sync_interval_rounds=4)
+    drive_checked(cfg, topo=Topology(loss=0.4), rounds=80)
+
+
+def test_invariants_with_dead_nodes_and_partition():
+    cfg = SimConfig(n_nodes=32, n_payloads=8, sync_interval_rounds=4)
+    dead = np.zeros(32, bool)
+    dead[8:12] = True
+
+    def mutate(state):
+        alive = state.alive.at[8:12].set(DOWN)
+        group = (jnp.arange(32) >= 16).astype(jnp.int32)
+        return state._replace(alive=alive, group=group)
+
+    drive_checked(cfg, rounds=50, mutate=mutate, dead=dead)
+
+
+def test_invariants_partial_view_swim():
+    cfg = SimConfig.wan_tuned(
+        64, n_payloads=8, swim_partial_view=True, member_slots=16,
+        sync_interval_rounds=4,
+    )
+    drive_checked(cfg, rounds=50)
