@@ -54,6 +54,10 @@ class PerfConfig:
     swim_max_transmissions: int = 10
     swim_max_packet_size: int = 1178
     swim_down_gc_s: float = 48 * 3600.0
+    # scale the suspicion window ~log2(cluster size) like the reference
+    # re-tuning foca's WAN config live (broadcast/mod.rs:236-256,951-960);
+    # off = the configured window verbatim (calibration tests)
+    swim_adaptive_timing: bool = True
     # db maintenance (handlers.rs:470-540, config.rs PerfConfig wal)
     wal_threshold_bytes: int = 10 * 1024 * 1024
     db_maintenance_interval_s: float = 300.0

@@ -49,6 +49,7 @@ class MemberInfo:
     status: int = ALIVE
     ts: int = 0  # identity timestamp (renew() bumps)
     suspect_since: float = -1.0
+    down_since: float = -1.0  # monotonic stamp for down-member GC
 
     def key(self):
         return (self.incarnation, self.status)
@@ -352,11 +353,43 @@ class SwimRuntime:
                 target.suspect_since = time.monotonic()
                 self._disseminate(target)
 
+    def _suspect_timeout_s(self) -> float:
+        """Cluster-size-adaptive suspicion window: the reference re-tunes
+        foca's WAN config as its cluster-size estimate moves
+        (broadcast/mod.rs:236-256, 951-960) — suspicion must outlast the
+        longer gossip paths of a bigger cluster, scaling ~log₂(N)."""
+        import math
+
+        base = self.agent.config.perf.swim_suspect_timeout_s
+        if not self.agent.config.perf.swim_adaptive_timing:
+            return base
+        # LIVE cluster size: DOWN members linger until their GC window
+        # and would otherwise inflate the window with all-time churn
+        live = sum(1 for m in self.members.values() if m.status != DOWN)
+        n = max(2, live + 1)
+        # normalized so a small test cluster keeps the configured window
+        return base * max(1.0, math.log2(n) / 3.0)
+
     def _expire_suspects(self):
-        timeout = self.agent.config.perf.swim_suspect_timeout_s
+        timeout = self._suspect_timeout_s()
         now = time.monotonic()
+        gc_after = self.agent.config.perf.swim_down_gc_s
+        drop = []
         for m in self.members.values():
             if m.status == SUSPECT and now - m.suspect_since > timeout:
                 m.status = DOWN
+                m.down_since = now
                 self._apply_to_agent(m)
                 self._disseminate(m)
+            elif m.status == DOWN:
+                # down-member GC (foca remove_down_after=48h,
+                # broadcast/mod.rs:951-960): forget long-dead members so
+                # the roster reflects the live cluster
+                if m.down_since < 0:
+                    m.down_since = now
+                elif now - m.down_since > gc_after:
+                    drop.append(m.actor_id)
+        for actor_id in drop:
+            self.members.pop(actor_id, None)
+        if drop:
+            self._persist_members()

@@ -139,3 +139,46 @@ def test_members_persisted_across_reboot(tmp_path):
             await a0.stop()
 
     asyncio.run(body())
+
+
+def test_down_member_gc():
+    """foca remove_down_after analog: DOWN members are forgotten after
+    swim_down_gc_s, and the adaptive suspicion window counts only live
+    members."""
+    import asyncio
+
+    from corrosion_tpu.agent.swim import DOWN as S_DOWN
+
+    async def body():
+        cluster = Cluster(3)
+        await cluster.start()
+        try:
+            a = cluster.agents[0]
+            a.config.perf.swim_down_gc_s = 0.2
+            a.config.perf.swim_probe_interval_s = 0.05
+            # wait for membership to form
+            for _ in range(100):
+                if len(a.swim.members) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            victim = cluster.agents[2]
+            vid = victim.actor_id
+            await victim.stop()
+            # detected DOWN, then GC'd from the roster
+            for _ in range(200):
+                m = a.swim.members.get(vid)
+                if m is not None and m.status == S_DOWN:
+                    break
+                await asyncio.sleep(0.05)
+            assert a.swim.members[vid].status == S_DOWN
+            for _ in range(200):
+                if vid not in a.swim.members:
+                    break
+                await asyncio.sleep(0.05)
+            assert vid not in a.swim.members, "down member must be GC'd"
+        finally:
+            for ag in cluster.agents[:2]:
+                await ag.stop()
+            cluster.tmp.cleanup()
+
+    asyncio.run(body())

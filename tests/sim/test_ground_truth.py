@@ -64,11 +64,15 @@ def sim_rounds_once(seed: int) -> float:
 def test_convergence_distribution_matches_host():
     host = np.array([host_rounds_once() for _ in range(N_SEEDS)])
     sim = np.array([sim_rounds_once(s) for s in range(N_SEEDS)])
-    for q in (50, 99):
+    # p99 over 10 samples is the max; the host tier measures wall-clock
+    # on a shared machine, where one scheduler hiccup inflates the max by
+    # ~0.1 s ≈ 5 flush ticks — p50 keeps the tight band, p99 adds that
+    # measured noise floor on top of the ×2 ratio
+    for q, slack in ((50, 2), (99, 8)):
         h = float(np.percentile(host, q))
         s = float(np.percentile(sim, q))
-        assert s <= h * 2 + 2, f"p{q}: sim={s:.1f} vs host={h:.1f} ticks"
-        assert h <= s * 2 + 2, f"p{q}: host={h:.1f} ticks vs sim={s:.1f}"
+        assert s <= h * 2 + slack, f"p{q}: sim={s:.1f} vs host={h:.1f} ticks"
+        assert h <= s * 2 + slack, f"p{q}: host={h:.1f} ticks vs sim={s:.1f}"
     print(
         f"calibration: host p50/p99 = {np.percentile(host, 50):.1f}/"
         f"{np.percentile(host, 99):.1f} ticks, sim = "
@@ -98,6 +102,8 @@ def host_swim_detection_probe_periods() -> float:
         for a in cluster.agents:
             a.config.perf.swim_probe_interval_s = HOST_PROBE_S
             a.config.perf.swim_suspect_timeout_s = HOST_PROBE_S * SUSPECT_PROBES
+            # fixed window: both tiers run EXACTLY 10 probe periods
+            a.config.perf.swim_adaptive_timing = False
         try:
             # let membership form: everyone knows everyone
             deadline = asyncio.get_event_loop().time() + 30
