@@ -357,10 +357,33 @@ def cmd_sim(args) -> int:
         "write-storm-100k": runner.config_write_storm_100k,
     }
     fn = fns[args.scenario]
-    kwargs = {"seed": args.seed}
+    kwargs = {}
     if args.scenario == "write-storm-100k" and args.nodes:
         kwargs["n_nodes"] = args.nodes
-    print(json.dumps(fn(**kwargs), default=float))
+    if args.seeds <= 1:
+        print(json.dumps(fn(seed=args.seed, **kwargs), default=float))
+        return 0
+    # multi-seed distribution: per-seed records plus cross-seed
+    # percentiles of every numeric field (the convergence-round
+    # DISTRIBUTION the calibration contract compares, not one scalar)
+    runs = [fn(seed=args.seed + i, **kwargs) for i in range(args.seeds)]
+    numeric = {
+        k for k in runs[0]
+        if all(isinstance(r.get(k), (int, float)) for r in runs)
+    }
+    summary = {}
+    for k in sorted(numeric):
+        vals = sorted(float(r[k]) for r in runs)
+        summary[k] = {
+            "p50": vals[len(vals) // 2],
+            "p99": vals[min(len(vals) - 1, int(len(vals) * 0.99))],
+            "min": vals[0],
+            "max": vals[-1],
+        }
+    print(json.dumps(
+        {"seeds": args.seeds, "summary": summary, "runs": runs},
+        default=float,
+    ))
     return 0
 
 
@@ -501,6 +524,10 @@ def build_parser() -> argparse.ArgumentParser:
         ],
     )
     sm.add_argument("--seed", type=int, default=0)
+    sm.add_argument(
+        "--seeds", type=int, default=1,
+        help="run N seeds and report cross-seed percentiles",
+    )
     sm.add_argument("--nodes", type=int, default=None)
     sm.set_defaults(fn=cmd_sim)
 
