@@ -352,6 +352,7 @@ def cmd_sim(args) -> int:
     fns = {
         "ground-truth-3node": runner.config_ground_truth_3node,
         "swim-churn-64": runner.config_swim_churn_64,
+        "swim-churn-partial-4k": runner.config_swim_churn_partial,
         "broadcast-1k": runner.config_broadcast_1k,
         "partition-heal-10k": runner.config_partition_heal_10k,
         "write-storm-100k": runner.config_write_storm_100k,
@@ -519,7 +520,8 @@ def build_parser() -> argparse.ArgumentParser:
     sm.add_argument(
         "scenario",
         choices=[
-            "ground-truth-3node", "swim-churn-64", "broadcast-1k",
+            "ground-truth-3node", "swim-churn-64",
+            "swim-churn-partial-4k", "broadcast-1k",
             "partition-heal-10k", "write-storm-100k",
         ],
     )

@@ -158,6 +158,85 @@ def config_swim_churn_64(
     }
 
 
+def config_swim_churn_partial(
+    seed: int = 0, max_rounds: int = 600, n: int = 4096
+) -> Dict[str, float]:
+    """Config #2 at the partial-view scale tier: kill a third of an
+    n-node cluster running O(N·M) member tables (sim/pswim.py) and
+    measure rounds until every LIVE table entry referencing a dead
+    member is marked DOWN — the detection predicate runs on device
+    inside one while_loop, like the full-view variant."""
+    cfg = SimConfig.wan_tuned(
+        n, n_payloads=1, swim_partial_view=True,
+        probe_period_rounds=1,
+    )
+    topo = Topology()
+    region = regions(n, topo.n_regions)
+    meta = uniform_payloads(cfg)
+
+    state = new_sim(cfg, seed)
+    kill = jnp.arange(n) % 3 == 0
+    state = state._replace(
+        alive=jnp.where(kill, jnp.uint8(DOWN), jnp.uint8(ALIVE))
+    )
+    metrics = new_metrics(cfg)
+
+    @jax.jit
+    def run(state, metrics):
+        up_mask = state.alive == ALIVE  # static after t=0
+
+        def detected(state):
+            watcher_up = up_mask[:, None]
+            entry_dead = (state.pid >= 0) & ~up_mask[
+                jnp.maximum(state.pid, 0)
+            ]
+            marked = state.pkey % 4 == DOWN
+            return jnp.all(
+                jnp.where(watcher_up & entry_dead, marked, True)
+            )
+
+        def cond(carry):
+            state, metrics, detect_round = carry
+            return (detect_round < 0) & (state.t < max_rounds)
+
+        def body(carry):
+            state, metrics, detect_round = carry
+            state, metrics = round_step(state, metrics, meta, cfg, topo, region)
+            detect_round = jnp.where(
+                (detect_round < 0) & detected(state), state.t, detect_round
+            )
+            return state, metrics, detect_round
+
+        return jax.lax.while_loop(
+            cond, body, (state, metrics, jnp.int32(-1))
+        )
+
+    t0 = time.monotonic()
+    state, metrics, detect_round = run(state, metrics)
+    jax.block_until_ready(state.t)
+    wall = time.monotonic() - t0
+    detect_round = int(detect_round)
+    pid = np.asarray(state.pid)
+    pkey = np.asarray(state.pkey)
+    up = np.asarray(state.alive) == ALIVE
+    watched_dead = (pid >= 0) & ~up[np.maximum(pid, 0)] & up[:, None]
+    marked = pkey % 4 == DOWN
+    frac = (
+        float((watched_dead & marked).sum() / watched_dead.sum())
+        if watched_dead.any()
+        else 1.0
+    )
+    return {
+        "n_nodes": n,
+        "member_slots": cfg.member_slots,
+        "detect_round": detect_round,
+        "detect_sim_s": detect_round * ROUND_SECONDS if detect_round >= 0 else -1,
+        "detected_fraction": frac,
+        "wall_clock_s": wall,
+        "converged": detect_round >= 0,
+    }
+
+
 def config_broadcast_1k(seed: int = 0) -> Dict[str, float]:
     cfg = SimConfig(n_nodes=1000, n_payloads=256, n_writers=8, fanout=3)
     meta = uniform_payloads(cfg, inject_every=2)

@@ -123,3 +123,13 @@ def test_pswim_partition_heal_recovers():
     conv = np.asarray(metrics.converged_at)
     assert (conv >= 0).all(), \
         f"post-heal wedge: {(conv < 0).sum()} nodes never converged"
+
+
+def test_partial_churn_config_detects_all():
+    """The partial-view churn benchmark (config #2 scale tier) reaches
+    full detection with its on-device predicate at a CI-sized cluster."""
+    from corrosion_tpu.sim.runner import config_swim_churn_partial
+
+    m = config_swim_churn_partial(seed=1, n=512, max_rounds=800)
+    assert m["converged"], m
+    assert m["detected_fraction"] == 1.0
