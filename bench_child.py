@@ -67,20 +67,39 @@ def main() -> int:
             res["ok"] = True
 
         elif spec["mode"] == "storm":
-            from corrosion_tpu.sim.runner import config_write_storm_100k
+            from corrosion_tpu.sim.runner import config_write_storm_verified
 
             n, p = int(spec["nodes"]), int(spec["payloads"])
-            # warmup: AOT lower+compile primes the XLA cache without paying
-            # for a full convergence run
-            config_write_storm_100k(
-                seed=0, n_nodes=n, n_payloads=p, compile_only=True
+            # on a real multi-chip slice the storm runs node-axis-sharded
+            # over the whole mesh (VERDICT r2 item 4); single chip = None
+            mesh = None
+            if len(devs) > 1:
+                from corrosion_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh()
+            # verified protocol (VERDICT r2 item 1): per-round microbench
+            # + HBM bound + ×3 consistency; wall_clock_s is the defensible
+            # (conservative) wall, sanity carries the raw record.  Compile
+            # warmup happens inside (microbench warmup + an AOT prime of
+            # the convergence loop), so no separate warmup call here.
+            m = config_write_storm_verified(
+                seed=1, n_nodes=n, n_payloads=p, mesh=mesh
             )
-            res["compile_s"] = round(time.time() - t0, 1)
-            m = config_write_storm_100k(seed=1, n_nodes=n, n_payloads=p)
+            # setup = everything before the measured run (compile + the
+            # per-round microbench); subtract the RAW wall, not the
+            # corrected one, which can exceed real elapsed time
+            raw_wall = m["sanity"]["full_run_wall_s"]
+            res["setup_s"] = round(time.time() - t0 - raw_wall, 1)
             res["metrics"] = m
-            res["ok"] = bool(m.get("converged"))
-            if not res["ok"]:
+            verdict = m.get("sanity", {}).get("verdict", "missing")
+            res["ok"] = bool(m.get("converged")) and verdict != "hbm-bound-violated"
+            if not m.get("converged"):
                 res["error"] = "ran but did not converge"
+            elif verdict == "hbm-bound-violated":
+                res["error"] = (
+                    "measurement chain broken: per-round wall implies "
+                    "impossible HBM bandwidth (see metrics.sanity)"
+                )
 
         elif spec["mode"] == "aux":
             from corrosion_tpu.sim import runner

@@ -159,6 +159,20 @@ class Agent:
             "broadcasts_sent": 0, "broadcasts_recv": 0, "sync_rounds": 0,
             "ingest_dropped": 0, "empties_recv": 0,
         }
+        # protocol-native clock for calibration (VERDICT r2 item 2): the
+        # broadcast flush tick counter and per-version apply ticks.  A
+        # loaded machine stretches every timer equally, so latency
+        # DENOMINATED IN TICKS stays stable where wall-clock does not —
+        # the ground-truth tests read these instead of the wall.
+        self.flush_tick = 0
+        self.apply_tick: Dict[Tuple[ActorId, int], int] = {}
+
+    _APPLY_TICK_CAP = 65536  # calibration-only record; never unbounded
+
+    def _record_apply_tick(self, actor_id: ActorId, version: int) -> None:
+        self.apply_tick.setdefault((actor_id, version), self.flush_tick)
+        while len(self.apply_tick) > self._APPLY_TICK_CAP:
+            self.apply_tick.pop(next(iter(self.apply_tick)))
 
     # -- lifecycle --------------------------------------------------------
 
@@ -306,6 +320,7 @@ class Agent:
         interval = perf.broadcast_flush_interval_s
         while not self._stopped.is_set():
             await asyncio.sleep(interval)
+            self.flush_tick += 1
             budget = perf.broadcast_rate_limit_bytes_s * interval
             requeue = []
             while self._bcast_q and budget > 0:
@@ -510,6 +525,7 @@ class Agent:
                     self.bookie.clear_partial(cs.actor_id, cs.version)
                     self._clear_buffered(cs.actor_id, cs.version)
                     self.stats["changes_applied"] += impacted
+                    self._record_apply_tick(cs.actor_id, cs.version)
                     matched.extend(cs.changes)
                 else:
                     # version-level knowledge is recorded FIRST — and even
@@ -595,6 +611,7 @@ class Agent:
         booked.commit_snapshot(snap)
         booked.partials.pop(version, None)
         self.stats["changes_applied"] += impacted
+        self._record_apply_tick(actor_id, version)
         self._match_changes(changes)
 
     def _match_changes(self, changes: List[Change]):

@@ -86,6 +86,11 @@ class SwimRuntime:
         self._rng = random.Random(agent.actor_id.bytes_ + b"swim")
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
+        # protocol-native clock for calibration (VERDICT r2 item 2): probe
+        # periods elapsed and the period at which each member went DOWN —
+        # load-robust detection latency in probe periods, not wall-clock
+        self.probe_tick = 0
+        self.down_tick: Dict[ActorId, int] = {}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -276,6 +281,13 @@ class SwimRuntime:
             info.suspect_since = time.monotonic()
         if info.status == ALIVE:
             info.suspect_since = -1.0
+            # a refuted member was never really down: drop the mark so
+            # detection-latency readers only see DOWNs that stuck
+            self.down_tick.pop(info.actor_id, None)
+        if info.status == DOWN:
+            self.down_tick.setdefault(info.actor_id, self.probe_tick)
+            while len(self.down_tick) > 65536:
+                self.down_tick.pop(next(iter(self.down_tick)))
         self.members[info.actor_id] = info
         self._apply_to_agent(info)
         self._disseminate(info)
@@ -314,6 +326,7 @@ class SwimRuntime:
         perf = self.agent.config.perf
         while not self._stopped:
             await asyncio.sleep(perf.swim_probe_interval_s)
+            self.probe_tick += 1
             self._expire_suspects()
             candidates = [
                 m for m in self.members.values() if m.status != DOWN
@@ -379,6 +392,7 @@ class SwimRuntime:
             if m.status == SUSPECT and now - m.suspect_since > timeout:
                 m.status = DOWN
                 m.down_since = now
+                self.down_tick.setdefault(m.actor_id, self.probe_tick)
                 self._apply_to_agent(m)
                 self._disseminate(m)
             elif m.status == DOWN:

@@ -366,9 +366,21 @@ class UdpTcpTransport(Transport):
             self._on_tcp, self._host, self._port, ssl=self._server_ssl
         )
         self._port = self._tcp_server.sockets[0].getsockname()[1]
-        self._udp, _ = await loop.create_datagram_endpoint(
-            Proto, local_addr=(self._host, self._port)
-        )
+        if self.tls:
+            # ADVICE r2 (high): with TLS on, SWIM must be TLS-only in BOTH
+            # directions.  Binding the plaintext UDP socket would let any
+            # unauthenticated host inject forged SWIM messages (suspect/
+            # down/alive, fake members) even though our sends are
+            # encrypted — so the endpoint is simply never bound and the
+            # OS rejects the packets.
+            logging.getLogger("corrosion_tpu.transport").info(
+                "TLS enabled: plaintext UDP endpoint NOT bound; SWIM "
+                "datagrams ride the encrypted stream only"
+            )
+        else:
+            self._udp, _ = await loop.create_datagram_endpoint(
+                Proto, local_addr=(self._host, self._port)
+            )
         self.addr = f"{self._host}:{self._port}"
         return self.addr
 

@@ -384,7 +384,12 @@ class PooledSubscriptionStream:
                     raise err if err is not None else RuntimeError(
                         "subscription failed on every address"
                     )
-                await asyncio.sleep(next(backoff))
+            # ADVICE r2 (low): back off on EVERY failover, not only barren
+            # ones — a flapping node that delivers a few events per
+            # connection would otherwise drive a zero-delay resubscribe
+            # loop hammering the cluster.  The backoff resets on delivery,
+            # so a healthy failover still reconnects in ~50 ms.
+            await asyncio.sleep(next(backoff))
 
     def close(self):
         if self._stream is not None:

@@ -144,25 +144,45 @@ def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
     db_file = conn.execute(
         "SELECT file FROM pragma_database_list WHERE name = 'main'"
     ).fetchone()[0]
+    # the attached catalog schema's relations, snapshotted once: a UDF
+    # cannot re-enter `conn`, and these are static DDL (attach())
+    catalog_rels = frozenset(
+        r[0]
+        for r in conn.execute(
+            "SELECT name FROM pg_catalog.sqlite_master WHERE type = 'table'"
+        ).fetchall()
+    )
+    # ADVICE r2 (low): one cached probe connection per session instead of
+    # an open/close per call on the event loop.  Created EAGERLY: the UDF
+    # runs on varying to_thread executor workers, so lazy init would race
+    # and leak the loser's connection.
+    probe_box: list = [
+        sqlite3.connect(db_file, check_same_thread=False) if db_file else None
+    ]
 
     def _to_regclass(name):
         # a real existence probe (the standard PG idiom
         # `to_regclass(x) IS NOT NULL` gates CREATE TABLE): resolve via a
-        # SEPARATE short-lived connection — a UDF must not re-enter the
+        # SEPARATE cached connection — a UDF must not re-enter the
         # connection that is executing it.  :memory: stores (no file to
         # reopen) stay permissive.
         if not name:
             return None
-        bare = str(name).split(".")[-1].strip('"')
-        if not db_file:
+        text = str(name)
+        schema, _, tail = text.rpartition(".")
+        bare = (tail or text).strip('"')
+        schema = schema.strip('"')
+        # schema-qualified catalog relations resolve against the attached
+        # pg_catalog schema (ADVICE r2: they exist, so NULL was wrong)
+        if schema in ("pg_catalog", "") and bare in catalog_rels:
             return name
-        probe = sqlite3.connect(db_file)
-        try:
-            row = probe.execute(
-                "SELECT 1 FROM sqlite_master WHERE name = ?", (bare,)
-            ).fetchone()
-        finally:
-            probe.close()
+        if schema not in ("", "public", "main"):
+            return None
+        if probe_box[0] is None:  # :memory: store — nothing to probe
+            return name
+        row = probe_box[0].execute(
+            "SELECT 1 FROM sqlite_master WHERE name = ?", (bare,)
+        ).fetchone()
         return name if row else None
 
     conn.create_function("to_regclass", 1, _to_regclass)

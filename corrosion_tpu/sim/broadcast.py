@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from .state import ALIVE, PayloadMeta, SimConfig, SimState, budget_prefix_mask
 from .swim import sample_member_targets
-from .topology import Topology, edge_alive, edge_delay, edge_drop
+from .topology import Topology, edge_alive, edge_delay, edge_payload_drop
 
 
 def broadcast_step(
@@ -89,12 +89,16 @@ def broadcast_step(
     dst = jnp.maximum(dst, 0)
 
     ok &= edge_alive(state.group, state.alive, src, dst)
-    ok &= ~edge_drop(topo, k_drop, src.shape[0])
     ok &= dst != src
     delay = edge_delay(topo, region, src, dst)  # [E]
 
+    # loss is drawn per (edge, payload): each changeset is its own uni
+    # frame on the wire (see edge_payload_drop)
+    drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
     payload = state.have.dtype
-    sent = jnp.where(ok[:, None], sending[src], 0).astype(payload)  # [E, P]
+    sent = jnp.where(
+        ok[:, None] & ~drop, sending[src], 0
+    ).astype(payload)  # [E, P]
 
     # scatter into the delay ring: slot (t + delay) mod D per edge
     d_slots = state.inflight.shape[0]

@@ -41,7 +41,7 @@ from .state import (
     grid_to_payload,
 )
 from .swim import sample_member_targets
-from .topology import Topology, edge_alive, edge_drop
+from .topology import Topology, edge_alive
 
 
 def node_sync_masks(state: SimState, cfg: SimConfig):
@@ -113,7 +113,10 @@ def sync_step(
     dst = jnp.maximum(dst, 0)
 
     ok &= edge_alive(state.group, state.alive, src, dst)
-    ok &= ~edge_drop(topo, k_drop, src.shape[0])
+    # no stochastic loss on sync edges: sync is a reliable bi-stream
+    # session (QUIC bi / our TCP TAG_BI), which retransmits within the
+    # round — packet loss only starves the fire-and-forget uni/datagram
+    # paths (LinkModel marks bi streams reliable on the host tier too)
     ok &= due[src]
     ok &= dst != src
 

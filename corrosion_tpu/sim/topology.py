@@ -55,10 +55,18 @@ def edge_alive(
     )
 
 
-def edge_drop(
-    topo: Topology, key: jax.Array, n_edges: int
+def edge_payload_drop(
+    topo: Topology, key: jax.Array, n_edges: int, n_payloads: int
 ) -> jnp.ndarray:
-    """Per-edge Bernoulli loss (the Antithesis-style fault injection knob)."""
+    """Per-(edge, payload) Bernoulli loss for fire-and-forget traffic.
+
+    Each broadcast changeset rides its own uni frame (the reference
+    length-delimits changesets individually inside the flush,
+    broadcast/mod.rs:529-571; the host tier's LinkModel drops per
+    send_uni call), so loss must be drawn per payload, not per edge —
+    one edge-level draw would make 20 versions share a single coin flip
+    and collapse the retransmission dynamics the calibration tier
+    measures.  Free when loss == 0 (trace-time constant zeros)."""
     if topo.loss <= 0.0:
-        return jnp.zeros((n_edges,), jnp.bool_)
-    return jax.random.bernoulli(key, topo.loss, (n_edges,))
+        return jnp.zeros((n_edges, n_payloads), jnp.bool_)
+    return jax.random.bernoulli(key, topo.loss, (n_edges, n_payloads))

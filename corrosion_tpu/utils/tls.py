@@ -189,6 +189,17 @@ def client_ssl_context(
         ctx.verify_mode = ssl.CERT_NONE
     elif ca_cert_path:
         ctx.load_verify_locations(ca_cert_path)
+    else:
+        # ADVICE r2 (low): an empty trust store fails EVERY outbound dial
+        # with an opaque certificate error — a silent misconfiguration
+        # trap.  Gossip peers use a self-signed cluster CA, never a
+        # public one, so "no CA, not insecure" is always a mistake.
+        raise ValueError(
+            "[gossip.tls] is enabled but no ca_file is set and "
+            "insecure=false: outbound dials cannot verify any peer. "
+            "Set ca_file (generate one with `corrosion-tpu tls ca "
+            "generate`) or set insecure=true for trusted networks."
+        )
     if cert_path and key_path:
         ctx.load_cert_chain(cert_path, key_path)
     return ctx

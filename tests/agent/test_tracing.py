@@ -75,13 +75,16 @@ def test_sync_trace_spans_both_nodes():
         cluster = Cluster(2, use_swim=False, link=LinkModel(loss=1.0))
         await cluster.start()
         try:
-            before = len(TRACER.finished)
+            # clear, don't len-snapshot: the ring is bounded, so once the
+            # suite has filled it len() saturates at maxlen and a
+            # [before:] slice silently reads as empty
+            TRACER.finished.clear()
             cluster.agents[0].exec_transaction(
                 [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "t"))]
             )
             ok = await cluster.wait_converged(timeout=30.0)
             assert ok
-            spans = list(TRACER.finished)[before:]
+            spans = list(TRACER.finished)
             clients = [s for s in spans if s.name == "parallel_sync"]
             servers = [s for s in spans if s.name == "serve_sync"]
             assert clients and servers

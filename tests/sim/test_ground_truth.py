@@ -1,22 +1,33 @@
-"""Ground-truth calibration (BASELINE config #1 + VERDICT r1 item 4):
+"""Ground-truth calibration (BASELINE config #1 + VERDICT r2 item 2):
 the TPU sim's convergence behavior must match the real in-process
-host-agent cluster as a DISTRIBUTION, not a single scalar in a ×10 band.
+host-agent cluster as a DISTRIBUTION, with real dynamic range.
 
-Two comparisons, both normalized to protocol-native time units so the
-round discretization is what's under test (SURVEY §7 hard part #3):
+Round-2's scenario converged in ONE sim round (fanout 2 reached both
+peers, intra-region delay 0), so the "×2 match" was carried entirely by
+additive slack, and the host side measured wall-clock — which failed
+under judge-time machine load.  Both defects are fixed here:
 
-1. 3-node single-writer burst: p50/p99 rounds-to-convergence over ≥10
-   seeds on each tier, within ×2 (+2 rounds additive discretization
-   slack).  One sim round ≡ one broadcast flush tick.
-2. 64-node SWIM kill: detection latency (all survivors mark all dead
-   DOWN), measured in PROBE PERIODS on each tier, within ×2.  Both
-   tiers run probe-every-period with a 10-probe suspicion window.
+1. **Dynamic range**: every link drops each message with p=0.5
+   (`LinkModel(loss=...)` on the host tier, `Topology(loss=...)` on the
+   sim tier), so convergence takes multiple retransmission rounds and
+   the test asserts sim p99 > 3 rounds — the discretization distortion
+   SURVEY §7 warns about has something to distort.
+2. **Load-robust host measurement**: the host tier is measured from
+   agent-INTERNAL protocol clocks — `Agent.flush_tick` (broadcast flush
+   counter) and `Agent.apply_tick[(actor, version)]`, and for SWIM
+   `SwimRuntime.probe_tick` / `down_tick` — not wall-clock.  A loaded
+   machine stretches every asyncio timer equally, so tick-denominated
+   latency is invariant where wall-clock is not.
+
+Comparisons (p50/p99 over ≥10 seeds) must agree within ×2 with at most
+2 rounds of additive discretization slack.
 """
 
 import asyncio
 
 import numpy as np
 
+from corrosion_tpu.agent.transport import LinkModel
 from corrosion_tpu.sim.round import new_metrics, new_sim, round_step, run_to_convergence
 from corrosion_tpu.sim.state import ALIVE, DOWN, SimConfig, uniform_payloads
 from corrosion_tpu.sim.topology import Topology, regions
@@ -24,26 +35,38 @@ from corrosion_tpu.testing import Cluster
 
 N_VERSIONS = 20
 N_SEEDS = 10
+LOSS = 0.5  # per-message drop probability, both tiers
 
 
-def host_rounds_once() -> float:
-    """Real 3-node agent cluster: write N versions, measure convergence
-    wall-clock in units of the broadcast flush interval."""
+def host_rounds_once(seed: int) -> float:
+    """Real 3-node agent cluster on lossy links: write N versions in one
+    burst, measure rounds-to-convergence in broadcast flush TICKS from
+    the agents' internal clocks (never wall-clock)."""
 
     async def body():
-        cluster = Cluster(3)
+        cluster = Cluster(
+            3, link=LinkModel(loss=LOSS, seed=seed), use_swim=False
+        )
         await cluster.start()
         try:
-            flush = cluster.agents[0].config.perf.broadcast_flush_interval_s
-            a = cluster.agents[0]
-            t0 = asyncio.get_event_loop().time()
+            writer = cluster.agents[0]
+            receivers = cluster.agents[1:]
+            t0 = {id(a): a.flush_tick for a in receivers}
             for i in range(N_VERSIONS):
-                a.exec_transaction(
+                writer.exec_transaction(
                     [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"v{i}"))]
                 )
-            assert await cluster.wait_converged(30)
-            elapsed = asyncio.get_event_loop().time() - t0
-            return elapsed / flush
+            assert await cluster.wait_converged(60)
+            rounds = 0.0
+            for a in receivers:
+                ticks = [
+                    t
+                    for (aid, _v), t in a.apply_tick.items()
+                    if aid == writer.actor_id
+                ]
+                assert len(ticks) == N_VERSIONS
+                rounds = max(rounds, float(max(ticks) - t0[id(a)]))
+            return rounds
         finally:
             await cluster.stop()
 
@@ -54,21 +77,24 @@ def sim_rounds_once(seed: int) -> float:
     cfg = SimConfig(n_nodes=3, n_payloads=N_VERSIONS, fanout=2,
                     sync_interval_rounds=4)
     meta = uniform_payloads(cfg, inject_every=0)  # one burst
+    topo = Topology(loss=LOSS)
     state = new_sim(cfg, seed=seed)
-    final, metrics = run_to_convergence(state, meta, cfg, Topology(), 500)
+    final, metrics = run_to_convergence(state, meta, cfg, topo, 500)
     conv = np.asarray(metrics.converged_at)
     assert (conv >= 0).all()
     return float(conv.max())
 
 
 def test_convergence_distribution_matches_host():
-    host = np.array([host_rounds_once() for _ in range(N_SEEDS)])
+    host = np.array([host_rounds_once(s) for s in range(N_SEEDS)])
     sim = np.array([sim_rounds_once(s) for s in range(N_SEEDS)])
-    # p99 over 10 samples is the max; the host tier measures wall-clock
-    # on a shared machine, where one scheduler hiccup inflates the max by
-    # ~0.1 s ≈ 5 flush ticks — p50 keeps the tight band, p99 adds that
-    # measured noise floor on top of the ×2 ratio
-    for q, slack in ((50, 2), (99, 8)):
+    # dynamic range guard (VERDICT r2 item 2): with p=0.5 loss the sim
+    # must need real retransmission rounds, or the ×2 band is vacuous
+    assert float(np.percentile(sim, 99)) > 3, (
+        f"scenario lost its dynamic range: sim p99 = "
+        f"{np.percentile(sim, 99):.1f} rounds"
+    )
+    for q, slack in ((50, 2), (99, 2)):
         h = float(np.percentile(host, q))
         s = float(np.percentile(sim, q))
         assert s <= h * 2 + slack, f"p{q}: sim={s:.1f} vs host={h:.1f} ticks"
@@ -89,10 +115,9 @@ HOST_PROBE_S = 0.1  # large vs event-loop scheduling lag at 64 in-process agents
 
 
 def host_swim_detection_probe_periods() -> float:
-    """64 in-process agents with real SWIM; kill N_KILL, measure
-    wall-clock until every survivor marks every victim DOWN, in probe
-    periods."""
-    from corrosion_tpu.agent.swim import DOWN as H_DOWN
+    """64 in-process agents with real SWIM; kill N_KILL, measure probe
+    PERIODS until every survivor marks every victim DOWN — from each
+    survivor's internal probe_tick/down_tick counters, not wall-clock."""
 
     async def body():
         cluster = Cluster(N_SWIM)
@@ -116,26 +141,28 @@ def host_swim_detection_probe_periods() -> float:
             victims = cluster.agents[:N_KILL]
             victim_ids = [v.actor_id for v in victims]
             survivors = cluster.agents[N_KILL:]
-            t0 = asyncio.get_event_loop().time()
+            kill_tick = {id(a): a.swim.probe_tick for a in survivors}
             for v in victims:
                 await v.stop()
 
             def all_detected():
                 return all(
-                    a.swim.members.get(vid) is not None
-                    and a.swim.members[vid].status == H_DOWN
+                    vid in a.swim.down_tick
                     for a in survivors
                     for vid in victim_ids
                 )
 
-            deadline = asyncio.get_event_loop().time() + 90
+            deadline = asyncio.get_event_loop().time() + 120
             while asyncio.get_event_loop().time() < deadline:
                 if all_detected():
                     break
                 await asyncio.sleep(0.1)
             assert all_detected(), "host survivors must detect all victims"
-            elapsed = asyncio.get_event_loop().time() - t0
-            return elapsed / HOST_PROBE_S
+            periods = 0.0
+            for a in survivors:
+                last = max(a.swim.down_tick[vid] for vid in victim_ids)
+                periods = max(periods, float(last - kill_tick[id(a)]))
+            return periods
         finally:
             for a in cluster.agents[N_KILL:]:
                 await a.stop()
@@ -174,6 +201,8 @@ def test_swim_detection_latency_matches_host():
     host = host_swim_detection_probe_periods()
     sims = [sim_swim_detection_probe_periods(s) for s in range(5)]
     sim = float(np.median(sims))
+    # the 10-period suspicion window guarantees real dynamic range
+    assert sim > 5, f"sim detection collapsed to {sim:.1f} probe periods"
     assert sim <= host * 2 + 2, f"sim={sim:.1f} vs host={host:.1f} probe periods"
     assert host <= sim * 2 + 2, f"host={host:.1f} vs sim={sim:.1f} probe periods"
     print(f"swim detection: host={host:.1f}, sim median={sim:.1f} probe periods")
