@@ -141,8 +141,10 @@ def kill_stale_device_holders() -> list[int]:
     chip's context alive and is the documented way the backend degrades
     across a session (doc/experiments/TPU_BACKEND_NOTES.md).  Before
     preflight, SIGKILL any python process that (a) is running this repo's
-    bench_child.py / pytest / coo_spike, and (b) is not this process or
-    an ancestor.  Best-effort: /proc scan, never raises."""
+    bench_child.py / coo_spike (the only spawns that touch the chip —
+    repo pytest runs are CPU-pinned by tests/conftest.py and deliberately
+    spared), and (b) is not this process or an ancestor.  Best-effort:
+    /proc scan, never raises."""
     me = os.getpid()
     ancestors = set()
     pid = me
@@ -285,6 +287,7 @@ def main() -> int:
             "config_swim_churn_64",
             "config_broadcast_1k",
             "config_partition_heal_10k",
+            "config_gapstress_distortion",  # #5b: V≫K overflow + control
         ):
             rem = _remaining()
             if rem < 60:

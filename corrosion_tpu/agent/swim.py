@@ -285,12 +285,16 @@ class SwimRuntime:
             # detection-latency readers only see DOWNs that stuck
             self.down_tick.pop(info.actor_id, None)
         if info.status == DOWN:
-            self.down_tick.setdefault(info.actor_id, self.probe_tick)
-            while len(self.down_tick) > 65536:
-                self.down_tick.pop(next(iter(self.down_tick)))
+            self._record_down_tick(info.actor_id)
         self.members[info.actor_id] = info
         self._apply_to_agent(info)
         self._disseminate(info)
+
+    def _record_down_tick(self, actor_id: ActorId) -> None:
+        """Calibration record (see probe_tick); capped, never unbounded."""
+        self.down_tick.setdefault(actor_id, self.probe_tick)
+        while len(self.down_tick) > 65536:
+            self.down_tick.pop(next(iter(self.down_tick)))
 
     def _apply_to_agent(self, info: MemberInfo):
         """Bridge to the agent's Members (the reference's DispatchRuntime →
@@ -392,7 +396,7 @@ class SwimRuntime:
             if m.status == SUSPECT and now - m.suspect_since > timeout:
                 m.status = DOWN
                 m.down_since = now
-                self.down_tick.setdefault(m.actor_id, self.probe_tick)
+                self._record_down_tick(m.actor_id)
                 self._apply_to_agent(m)
                 self._disseminate(m)
             elif m.status == DOWN:

@@ -55,6 +55,7 @@ def metrics_shardings(mesh: Mesh) -> RunMetrics:
     return RunMetrics(
         coverage_at=NamedSharding(mesh, P()),
         converged_at=NamedSharding(mesh, P(NODE_AXIS)),
+        overflow_frac=NamedSharding(mesh, P()),
     )
 
 

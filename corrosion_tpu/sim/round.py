@@ -42,12 +42,18 @@ class RunMetrics(NamedTuple):
 
     coverage_at: jnp.ndarray  # i32[P] round when payload's VERSION was applied cluster-wide
     converged_at: jnp.ndarray  # i32[N] round when node applied all active versions
+    # f32 scalar: max over rounds of the fraction of (node, actor) pairs
+    # whose gap run-count exceeded the fixed K slots (the clamp path,
+    # gaps.py:78-85) — config #5b reports this so K-overflow distortion
+    # is measured, not assumed away (VERDICT r2 weak #4)
+    overflow_frac: jnp.ndarray
 
 
 def new_metrics(cfg: SimConfig) -> RunMetrics:
     return RunMetrics(
         coverage_at=jnp.full((cfg.n_payloads,), -1, jnp.int32),
         converged_at=jnp.full((cfg.n_nodes,), -1, jnp.int32),
+        overflow_frac=jnp.zeros((), jnp.float32),
     )
 
 
@@ -86,6 +92,9 @@ def round_step(
     heads = version_heads(touched)  # [N, A]
     gaps = extract_gaps(touched, heads, cfg)
     state = state._replace(heads=heads, gap_lo=gaps.lo, gap_hi=gaps.hi)
+    overflow_frac = jnp.maximum(
+        metrics.overflow_frac, gaps.overflow.mean(dtype=jnp.float32)
+    )
 
     # convergence bookkeeping: a node holds a version only when EVERY
     # chunk arrived (the fully-buffered apply gate, util.rs:986-1005);
@@ -111,7 +120,11 @@ def round_step(
     )
 
     state = state._replace(t=state.t + 1)
-    return state, RunMetrics(coverage_at=coverage_at, converged_at=converged_at)
+    return state, RunMetrics(
+        coverage_at=coverage_at,
+        converged_at=converged_at,
+        overflow_frac=overflow_frac,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "topo", "max_rounds"))
