@@ -356,10 +356,13 @@ def cmd_sim(args) -> int:
         "broadcast-1k": runner.config_broadcast_1k,
         "partition-heal-10k": runner.config_partition_heal_10k,
         "write-storm-100k": runner.config_write_storm_100k,
+        "gapstress": runner.config_write_storm_gapstress,
+        "gapstress-distortion": runner.config_gapstress_distortion,
     }
     fn = fns[args.scenario]
     kwargs = {}
-    if args.scenario == "write-storm-100k" and args.nodes:
+    scalable = ("write-storm-100k", "gapstress", "gapstress-distortion")
+    if args.scenario in scalable and args.nodes:
         kwargs["n_nodes"] = args.nodes
     if args.seeds <= 1:
         print(json.dumps(fn(seed=args.seed, **kwargs), default=float))
@@ -523,6 +526,7 @@ def build_parser() -> argparse.ArgumentParser:
             "ground-truth-3node", "swim-churn-64",
             "swim-churn-partial-4k", "broadcast-1k",
             "partition-heal-10k", "write-storm-100k",
+            "gapstress", "gapstress-distortion",
         ],
     )
     sm.add_argument("--seed", type=int, default=0)

@@ -212,8 +212,8 @@ def _payload_sizes(p: int, payload_bytes, cfg: SimConfig) -> jnp.ndarray:
     if hi > MAX_PAYLOAD_BYTES:
         raise ValueError(
             f"payload sizes must be ≤ {MAX_PAYLOAD_BYTES} B (got {hi}): "
-            "the byte-budget cumsum is i32-exact only up to 64 KiB × "
-            "32767 payloads"
+            "the two-lane byte-budget cumsum (budget_prefix_mask) is "
+            "exact only for sizes ≤ 64 KiB"
         )
     return sizes
 
