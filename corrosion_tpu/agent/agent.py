@@ -192,6 +192,16 @@ class Agent:
             if sql.strip():
                 self.store.execute_schema(sql)
         self.subs.restore()
+        # [telemetry] OTLP pipeline (main.rs:57-150): spans leave the
+        # process once an endpoint is configured; otherwise they stay in
+        # the in-process ring only
+        from ..otlp import exporter_from_config
+
+        self._otlp = exporter_from_config(self.config)
+        if self._otlp is not None:
+            from ..tracing import TRACER
+
+            self._otlp.install(TRACER)
         if self.config.use_swim:
             from .swim import SwimRuntime
 
@@ -254,6 +264,11 @@ class Agent:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         await self.transport.close()
         self.store.close()
+        if getattr(self, "_otlp", None) is not None:
+            from ..tracing import TRACER
+
+            # final batch flush happens off-loop; bounded join
+            await asyncio.to_thread(self._otlp.shutdown, TRACER)
 
     # -- write path (L10 → L6) -------------------------------------------
 

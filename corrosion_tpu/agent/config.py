@@ -80,6 +80,10 @@ class Config:
     perf: PerfConfig = field(default_factory=PerfConfig)
     admin_path: str = ""  # unix socket path; "" disables
     prometheus_addr: str = ""  # "host:port" scrape endpoint; "" disables
+    # [telemetry] OTLP/HTTP trace export (the reference's open-telemetry
+    # batch pipeline, corrosion/src/main.rs:57-150); "" disables
+    otlp_endpoint: str = ""  # collector base URL or full /v1/traces path
+    otlp_service_name: str = "corrosion-tpu"
     # [gossip.tls] — (m)TLS on the gossip transport (config.rs:170-193,
     # api/peer/mod.rs:149-339).  Keys: cert_file, key_file, ca_file,
     # insecure (bool), client.cert_file/key_file (mTLS),
@@ -103,6 +107,11 @@ class Config:
         admin = raw.get("admin", {})
         tel = raw.get("telemetry", {})
         tel_prom = tel.get("prometheus")
+        # reference-style nested `open-telemetry = { endpoint = ... }`;
+        # tolerate non-dict shapes (e.g. a bare exporter string)
+        tel_otel = tel.get("open-telemetry") or tel.get("open_telemetry")
+        if not isinstance(tel_otel, dict):
+            tel_otel = {}
         perf_raw = {**raw.get("perf", {})}
         cfg = cls(
             db_path=db.get("path", ":memory:"),
@@ -121,6 +130,10 @@ class Config:
                 if isinstance(tel_prom, dict)
                 else tel.get("prometheus_addr", "")
             ),
+            otlp_endpoint=(
+                tel.get("otlp_endpoint", "") or tel_otel.get("endpoint", "")
+            ),
+            otlp_service_name=tel.get("service_name", "corrosion-tpu"),
         )
         for k, v in perf_raw.items():
             if hasattr(cfg.perf, k):

@@ -1,0 +1,218 @@
+"""OTLP/HTTP span exporter — the tracing ring finally leaves the process.
+
+The reference builds a full OpenTelemetry OTLP batch pipeline with
+service metadata at agent boot (corrosion/src/main.rs:57-150, enabled by
+the [telemetry] config, command/agent.rs:132-188).  This is the
+tpu-rebuild equivalent with zero external dependencies: spans recorded
+by `corrosion_tpu.tracing.TRACER` are batched on a daemon thread and
+POSTed as OTLP/HTTP **JSON** (the protobuf-free encoding every OTLP
+collector accepts on :4318/v1/traces).
+
+Design notes:
+- a THREAD, not an asyncio task: `Tracer.record` fires synchronously
+  from whatever thread finishes a span (event loop, executor workers,
+  CLI), so the handoff must be a thread-safe queue and the network I/O
+  must never touch the event loop;
+- batch flush at ``batch_size`` spans or ``flush_interval_s``, whichever
+  first (the reference's batch exporter shape);
+- export failures are counted and logged once per streak, never raised —
+  telemetry must not take the agent down;
+- bounded queue: if the collector stalls, spans drop oldest-first
+  (matching the ring-buffer semantics of the in-process collector).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import urllib.request
+from typing import Dict, List, Optional
+
+from .tracing import Span, TRACER, Tracer
+
+log = logging.getLogger("corrosion_tpu.otlp")
+
+
+def _attr(key: str, value) -> dict:
+    if isinstance(value, bool):
+        v = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+def span_to_otlp(s: Span) -> dict:
+    """One tracing.Span → an OTLP JSON span object."""
+    end_s = s.end_s if s.end_s is not None else s.start_s
+    out = {
+        "traceId": f"{s.context.trace_id:032x}",
+        "spanId": f"{s.context.span_id:016x}",
+        "name": s.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(int(s.start_s * 1e9)),
+        "endTimeUnixNano": str(int(end_s * 1e9)),
+        "attributes": [_attr(k, v) for k, v in s.attributes.items()],
+        "status": (
+            {"code": 1}
+            if s.status == "ok"
+            else {"code": 2, "message": s.status}
+        ),
+    }
+    if s.parent_span_id:
+        out["parentSpanId"] = f"{s.parent_span_id:016x}"
+    return out
+
+
+class OtlpHttpExporter:
+    """Batching OTLP/HTTP JSON exporter; wire with ``install()``."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        service_name: str = "corrosion-tpu",
+        headers: Optional[Dict[str, str]] = None,
+        batch_size: int = 64,
+        flush_interval_s: float = 2.0,
+        queue_cap: int = 8192,
+        resource_attributes: Optional[Dict[str, object]] = None,
+    ):
+        # accept both a collector base URL and a full path
+        ep = endpoint.rstrip("/")
+        self.url = ep if ep.endswith("/v1/traces") else ep + "/v1/traces"
+        self.headers = {"content-type": "application/json", **(headers or {})}
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self._q: "queue.Queue[Optional[Span]]" = queue.Queue(maxsize=queue_cap)
+        self._resource = [
+            _attr("service.name", service_name),
+            *(_attr(k, v) for k, v in (resource_attributes or {}).items()),
+        ]
+        self.exported = 0
+        self.dropped = 0
+        self.failures = 0
+        self._fail_streak = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    # -- producer side (called from Tracer.record, any thread) -----------
+
+    def export(self, s: Span) -> None:
+        try:
+            self._q.put_nowait(s)
+        except queue.Full:
+            try:  # drop oldest, keep newest (ring semantics)
+                self._q.get_nowait()
+                self._q.put_nowait(s)
+            except (queue.Empty, queue.Full):
+                pass
+            self.dropped += 1
+
+    # -- lifecycle --------------------------------------------------------
+
+    def install(self, tracer: Tracer = TRACER) -> "OtlpHttpExporter":
+        self._thread = threading.Thread(
+            target=self._run, name="otlp-exporter", daemon=True
+        )
+        self._thread.start()
+        # add, don't set: several agents in one process (devcluster,
+        # tests) may each install an exporter on the shared TRACER
+        tracer.add_exporter(self.export)
+        return self
+
+    def shutdown(self, tracer: Optional[Tracer] = None, timeout: float = 15.0):
+        """Stop accepting spans, flush what's queued (one bounded final
+        post), join the thread.  Removes only OUR exporter hook, so other
+        agents' telemetry in the same process keeps flowing."""
+        if tracer is not None:
+            tracer.remove_exporter(self.export)
+        self._stopped.set()
+        try:  # wake the batcher; the Event alone breaks a stalled backlog
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- batcher thread ----------------------------------------------------
+
+    def _run(self) -> None:
+        import time as _time
+
+        batch: List[Span] = []
+        next_flush = _time.monotonic() + self.flush_interval_s
+        while not self._stopped.is_set():
+            wait = max(0.05, next_flush - _time.monotonic())
+            try:
+                item = self._q.get(timeout=wait)
+            except queue.Empty:
+                item = None
+            if item is not None:
+                batch.append(item)
+            # flush on size OR deadline — a steady trickle must not sit
+            # buffered until batch_size accumulates
+            now = _time.monotonic()
+            if batch and (len(batch) >= self.batch_size or now >= next_flush):
+                self._post(batch)
+                batch = []
+                next_flush = now + self.flush_interval_s
+            elif not batch:
+                next_flush = now + self.flush_interval_s
+        # shutdown: drain whatever is queued into ONE bounded final post —
+        # never chew through a dead-collector backlog batch by batch
+        while True:
+            try:
+                s = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if s is not None:
+                batch.append(s)
+        if batch:
+            self._post(batch)
+
+    def _post(self, batch: List[Span]) -> None:
+        body = json.dumps(
+            {
+                "resourceSpans": [
+                    {
+                        "resource": {"attributes": self._resource},
+                        "scopeSpans": [
+                            {
+                                "scope": {"name": "corrosion_tpu"},
+                                "spans": [span_to_otlp(s) for s in batch],
+                            }
+                        ],
+                    }
+                ]
+            }
+        ).encode()
+        req = urllib.request.Request(self.url, body, self.headers)
+        try:
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+            self.exported += len(batch)
+            if self._fail_streak:
+                log.info("otlp export recovered after %d failures", self._fail_streak)
+                self._fail_streak = 0
+        except Exception as exc:
+            self.failures += 1
+            self._fail_streak += 1
+            if self._fail_streak == 1:  # log once per streak, not per batch
+                log.warning("otlp export to %s failed: %s", self.url, exc)
+
+
+def exporter_from_config(cfg) -> Optional[OtlpHttpExporter]:
+    """Build (but do not install) the exporter from Config.otlp_endpoint
+    (the [telemetry] section; None when telemetry is off)."""
+    endpoint = getattr(cfg, "otlp_endpoint", "")
+    if not endpoint:
+        return None
+    return OtlpHttpExporter(
+        endpoint,
+        service_name=getattr(cfg, "otlp_service_name", "") or "corrosion-tpu",
+    )

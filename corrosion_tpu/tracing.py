@@ -117,17 +117,35 @@ class Tracer:
     def __init__(self, capacity: int = 2048):
         self._lock = threading.Lock()
         self.finished: Deque[Span] = deque(maxlen=capacity)
-        self._exporter: Optional[Callable[[Span], None]] = None
+        self._exporters: List[Callable[[Span], None]] = []
+
+    @property
+    def _exporter(self) -> Optional[Callable[[Span], None]]:
+        # compat view: the first registered exporter (tests/introspection)
+        return self._exporters[0] if self._exporters else None
 
     def set_exporter(self, exporter: Optional[Callable[[Span], None]]):
-        self._exporter = exporter
+        """Replace ALL exporters (None clears).  Multi-consumer callers
+        (several agents sharing the process tracer) should use
+        add_exporter/remove_exporter so they don't clobber each other."""
+        self._exporters = [] if exporter is None else [exporter]
+
+    def add_exporter(self, exporter: Callable[[Span], None]) -> None:
+        if exporter not in self._exporters:
+            self._exporters.append(exporter)
+
+    def remove_exporter(self, exporter: Callable[[Span], None]) -> None:
+        try:
+            self._exporters.remove(exporter)
+        except ValueError:
+            pass
 
     def record(self, s: Span):
         with self._lock:
             self.finished.append(s)
-        if self._exporter is not None:
+        for exporter in list(self._exporters):
             try:
-                self._exporter(s)
+                exporter(s)
             except Exception:
                 log.exception("span exporter failed")
 

@@ -1,0 +1,204 @@
+"""OTLP/HTTP export (VERDICT r2 item 7): spans must actually leave the
+process — batched OTLP JSON against a stub collector, plus the
+[telemetry]-config wiring through a real agent lifecycle."""
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from corrosion_tpu.otlp import OtlpHttpExporter, exporter_from_config
+from corrosion_tpu.tracing import Tracer, span
+
+
+class StubCollector:
+    """Minimal OTLP/HTTP collector: records every POST body."""
+
+    def __init__(self):
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["content-length"]))
+                outer.requests.append((self.path, json.loads(body)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def spans(self):
+        out = []
+        for _path, body in self.requests:
+            for rs in body["resourceSpans"]:
+                for ss in rs["scopeSpans"]:
+                    out.extend(ss["spans"])
+        return out
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_exporter_batches_spans_to_collector():
+    col = StubCollector()
+    tracer = Tracer()
+    exp = OtlpHttpExporter(
+        col.endpoint, service_name="corro-test", batch_size=4,
+        flush_interval_s=0.2,
+    ).install(tracer)
+    try:
+        with span("outer", tracer=tracer, peer="n1") as outer:
+            with span("inner", tracer=tracer):
+                pass
+        try:
+            with span("boom", tracer=tracer):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        deadline = 50
+        while len(col.spans()) < 3 and deadline:
+            deadline -= 1
+            import time
+
+            time.sleep(0.1)
+        got = {s["name"]: s for s in col.spans()}
+        assert set(got) == {"outer", "inner", "boom"}
+        # parentage + trace continuity survive the wire format
+        assert got["inner"]["parentSpanId"] == got["outer"]["spanId"]
+        assert got["inner"]["traceId"] == got["outer"]["traceId"]
+        assert got["boom"]["status"]["code"] == 2
+        assert got["outer"]["attributes"] == [
+            {"key": "peer", "value": {"stringValue": "n1"}}
+        ]
+        # resource carries the service identity
+        res = col.requests[0][1]["resourceSpans"][0]["resource"]["attributes"]
+        assert {"key": "service.name", "value": {"stringValue": "corro-test"}} in res
+        assert col.requests[0][0] == "/v1/traces"
+        assert exp.exported == 3 and exp.failures == 0
+    finally:
+        exp.shutdown(tracer)
+        col.close()
+
+
+def test_steady_trickle_flushes_on_interval_not_batch_size():
+    """Spans arriving slower than batch_size must still export within
+    ~flush_interval_s, not wait for 64 to accumulate."""
+    import time
+
+    col = StubCollector()
+    tracer = Tracer()
+    exp = OtlpHttpExporter(
+        col.endpoint, batch_size=64, flush_interval_s=0.2
+    ).install(tracer)
+    try:
+        t0 = time.monotonic()
+        with span("trickle-1", tracer=tracer):
+            pass
+        while not col.spans() and time.monotonic() - t0 < 5:
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+        assert col.spans(), "span never exported"
+        assert elapsed < 2.0, f"interval flush took {elapsed:.1f}s"
+    finally:
+        exp.shutdown(tracer)
+        col.close()
+
+
+def test_two_exporters_coexist_and_detach_independently():
+    """Several agents share the process TRACER: installing/removing one
+    exporter must not clobber the other."""
+    col1, col2 = StubCollector(), StubCollector()
+    tracer = Tracer()
+    e1 = OtlpHttpExporter(col1.endpoint, batch_size=1).install(tracer)
+    e2 = OtlpHttpExporter(col2.endpoint, batch_size=1).install(tracer)
+    try:
+        with span("both", tracer=tracer):
+            pass
+        e1.shutdown(tracer)  # must leave e2 attached
+        with span("only-2", tracer=tracer):
+            pass
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if {"both", "only-2"} <= {s["name"] for s in col2.spans()}:
+                break
+            time.sleep(0.05)
+        names2 = {s["name"] for s in col2.spans()}
+        assert {"both", "only-2"} <= names2, names2
+        names1 = {s["name"] for s in col1.spans()}
+        assert "only-2" not in names1
+    finally:
+        e2.shutdown(tracer)
+        col1.close()
+        col2.close()
+
+
+def test_config_tolerates_non_dict_open_telemetry():
+    from corrosion_tpu.agent.config import Config
+
+    cfg = Config.from_dict({"telemetry": {"open-telemetry": "otlp"}})
+    assert cfg.otlp_endpoint == ""
+
+
+def test_exporter_survives_dead_collector():
+    tracer = Tracer()
+    exp = OtlpHttpExporter(
+        "http://127.0.0.1:9", batch_size=1, flush_interval_s=0.1
+    ).install(tracer)
+    try:
+        for _ in range(5):
+            with span("s", tracer=tracer):
+                pass
+        import time
+
+        time.sleep(0.5)
+        assert exp.failures > 0  # failed, logged, never raised
+    finally:
+        exp.shutdown(tracer)
+
+
+def test_agent_telemetry_config_exports_spans():
+    """[telemetry] wiring end-to-end: an agent with otlp_endpoint set
+    exports its spans; shutdown flushes the final batch."""
+    from corrosion_tpu.agent.agent import Agent
+    from corrosion_tpu.agent.config import Config
+    from corrosion_tpu.agent.transport import MemoryNetwork
+    from corrosion_tpu.tracing import TRACER, span as tspan
+
+    col = StubCollector()
+    cfg = Config.from_dict(
+        {"telemetry": {"open-telemetry": {"endpoint": col.endpoint},
+                       "service_name": "agent-under-test"}}
+    )
+    assert cfg.otlp_endpoint == col.endpoint
+    assert exporter_from_config(cfg) is not None
+
+    async def body():
+        net = MemoryNetwork()
+        agent = Agent(cfg, net.transport("n0"))
+        await agent.start()
+        with tspan("from-agent-process"):
+            pass
+        await agent.stop()  # must flush the pending batch
+
+    try:
+        asyncio.run(body())
+        names = [s["name"] for s in col.spans()]
+        assert "from-agent-process" in names
+        assert TRACER._exporter is None  # uninstalled on stop
+    finally:
+        col.close()
