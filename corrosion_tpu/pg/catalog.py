@@ -5,8 +5,14 @@ pg_range as SQLite virtual tables (corro-pg/src/vtab/).  Here the same
 tables are ordinary rows in an in-memory database ATTACHed to the store
 connection under the schema name ``pg_catalog`` — so both
 ``pg_catalog.pg_type`` and bare ``pg_type`` resolve with zero query
-rewriting.  ``pg_class`` is refreshed from ``sqlite_schema`` before any
-statement that mentions it, which is how the vtab's live scan behaves.
+rewriting.  ``pg_class`` (and the ``\\d``-level tables: pg_attribute,
+pg_index, pg_constraint, pg_attrdef, pg_am) are refreshed from
+``sqlite_schema``/PRAGMA introspection before any statement that
+mentions them, which is how the vtab's live scan behaves.  The psql
+``\\d`` query sequence (name resolution with OPERATOR(pg_catalog.~)
+regex match, relation flags, pg_attribute column walk, index/constraint
+listing) runs unmodified — tests/pg/test_psql_describe.py drives the
+exact v14 shapes.
 """
 
 from __future__ import annotations
@@ -65,7 +71,8 @@ def attach(conn: sqlite3.Connection, dbname: str) -> None:
             oid INTEGER PRIMARY KEY, typname TEXT, typlen INTEGER,
             typtype TEXT, typcategory TEXT, typnamespace INTEGER,
             typrelid INTEGER DEFAULT 0, typelem INTEGER DEFAULT 0,
-            typbasetype INTEGER DEFAULT 0, typtypmod INTEGER DEFAULT -1
+            typbasetype INTEGER DEFAULT 0, typtypmod INTEGER DEFAULT -1,
+            typcollation INTEGER DEFAULT 0
         );
         CREATE TABLE IF NOT EXISTS pg_catalog.pg_namespace (
             oid INTEGER PRIMARY KEY, nspname TEXT, nspowner INTEGER DEFAULT 10
@@ -76,12 +83,52 @@ def attach(conn: sqlite3.Connection, dbname: str) -> None:
         );
         CREATE TABLE IF NOT EXISTS pg_catalog.pg_class (
             oid INTEGER PRIMARY KEY, relname TEXT, relnamespace INTEGER,
-            relkind TEXT, reltuples REAL DEFAULT -1, relowner INTEGER DEFAULT 10
+            relkind TEXT, reltuples REAL DEFAULT -1, relowner INTEGER DEFAULT 10,
+            relchecks INTEGER DEFAULT 0, relhasindex INTEGER DEFAULT 0,
+            relhasrules INTEGER DEFAULT 0, relhastriggers INTEGER DEFAULT 0,
+            relrowsecurity INTEGER DEFAULT 0,
+            relforcerowsecurity INTEGER DEFAULT 0,
+            relispartition INTEGER DEFAULT 0, reltablespace INTEGER DEFAULT 0,
+            reloftype INTEGER DEFAULT 0, relpersistence TEXT DEFAULT 'p',
+            relreplident TEXT DEFAULT 'd', relam INTEGER DEFAULT 2,
+            relhasoids INTEGER DEFAULT 0
         );
         CREATE TABLE IF NOT EXISTS pg_catalog.pg_range (
             rngtypid INTEGER PRIMARY KEY, rngsubtype INTEGER
         );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_am (
+            oid INTEGER PRIMARY KEY, amname TEXT, amtype TEXT DEFAULT 't'
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_attribute (
+            attrelid INTEGER, attname TEXT, atttypid INTEGER,
+            atttypmod INTEGER DEFAULT -1, attnotnull INTEGER DEFAULT 0,
+            attnum INTEGER, attisdropped INTEGER DEFAULT 0,
+            atthasdef INTEGER DEFAULT 0, attidentity TEXT DEFAULT '',
+            attgenerated TEXT DEFAULT '', attcollation INTEGER DEFAULT 0,
+            PRIMARY KEY (attrelid, attnum)
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_attrdef (
+            oid INTEGER PRIMARY KEY, adrelid INTEGER, adnum INTEGER,
+            adbin TEXT
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_index (
+            indexrelid INTEGER PRIMARY KEY, indrelid INTEGER,
+            indisprimary INTEGER DEFAULT 0, indisunique INTEGER DEFAULT 0,
+            indisclustered INTEGER DEFAULT 0, indisvalid INTEGER DEFAULT 1,
+            indisreplident INTEGER DEFAULT 0, indnatts INTEGER DEFAULT 0
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_constraint (
+            oid INTEGER PRIMARY KEY, conname TEXT, conrelid INTEGER,
+            conindid INTEGER, contype TEXT,
+            condeferrable INTEGER DEFAULT 0, condeferred INTEGER DEFAULT 0
+        );
+        CREATE TABLE IF NOT EXISTS pg_catalog.pg_collation (
+            oid INTEGER PRIMARY KEY, collname TEXT
+        );
         """
+    )
+    conn.execute(
+        "INSERT OR IGNORE INTO pg_catalog.pg_am (oid, amname) VALUES (2, 'heap')"
     )
     cur = conn.execute("SELECT count(*) FROM pg_catalog.pg_type")
     if cur.fetchone()[0] == 0:
@@ -102,21 +149,213 @@ def attach(conn: sqlite3.Connection, dbname: str) -> None:
     refresh_pg_class(conn)
 
 
+# SQLite storage class → PG type oid for pg_attribute.atttypid
+_AFFINITY_OID = {
+    "INTEGER": OID_INT8, "INT": OID_INT8, "REAL": OID_FLOAT8,
+    "BLOB": OID_BYTEA, "TEXT": OID_TEXT, "": OID_TEXT,
+}
+
+# per-connection {index oid: (pg_get_indexdef text, constraintdef text)}.
+# sqlite3.Connection is not weakref-able, so the registry is keyed by
+# id(conn): register_functions(conn) installs a fresh dict (the UDF
+# closures capture the dict OBJECT, so a recycled id can never point an
+# old closure at new data), refresh_pg_class(conn) updates it in place.
+_INDEX_DEFS: dict = {}
+# Backstop bound only: a process holds ~20 long-lived conns (writer + RO
+# pool), so 4096 is never reached in practice — which matters, because
+# evicting a LIVE conn's dict would orphan its UDF closures onto stale
+# data.  The bound exists purely so a pathological conn-churn loop can't
+# grow the registry forever.
+_INDEX_DEFS_CAP = 4096
+
+
+def _defs_for(conn: sqlite3.Connection) -> dict:
+    key = id(conn)
+    if key not in _INDEX_DEFS:
+        while len(_INDEX_DEFS) >= _INDEX_DEFS_CAP:
+            _INDEX_DEFS.pop(next(iter(_INDEX_DEFS)))
+        _INDEX_DEFS[key] = {}
+    return _INDEX_DEFS[key]
+
+
+def _affinity_oid(decl: str) -> int:
+    d = (decl or "").upper()
+    for k, oid in _AFFINITY_OID.items():
+        if k and k in d:
+            return oid
+    return OID_TEXT
+
+
 def refresh_pg_class(conn: sqlite3.Connection) -> None:
-    """Mirror sqlite_schema into pg_class (vtab live-scan analog)."""
-    conn.execute("DELETE FROM pg_catalog.pg_class")
+    """Mirror sqlite_schema + PRAGMA introspection into the catalog
+    (vtab live-scan analog): pg_class relations, pg_attribute columns,
+    synthesized pg_index/pg_constraint rows for primary keys and unique
+    constraints (PG default names: <table>_pkey), and pg_attrdef
+    defaults — the tables psql's ``\\d`` sequence reads."""
+    for t in ("pg_class", "pg_attribute", "pg_attrdef", "pg_index",
+              "pg_constraint"):
+        conn.execute(f"DELETE FROM pg_catalog.{t}")
+    defs = _defs_for(conn)
+    defs.clear()
     rows = conn.execute(
         "SELECT rowid, name, type FROM sqlite_schema "
         "WHERE name NOT LIKE 'sqlite_%' AND name NOT LIKE '\\_\\_%' ESCAPE '\\'"
     ).fetchall()
+    cls_rows = []
+    attr_rows = []
+    attrdef_rows = []
+    index_rows = []
+    con_rows = []
+    next_oid = [200000]  # synthetic oids for implicit PK "indexes"
+    name_to_oid = {name: 100000 + rid for rid, name, typ in rows}
+    for rid, name, typ in rows:
+        oid = 100000 + rid
+        cls_rows.append((oid, name, PUBLIC_NS_OID,
+                         "r" if typ == "table" else "i"))
+        if typ != "table":
+            continue
+        cols = conn.execute(f'PRAGMA table_info("{name}")').fetchall()
+        pk_cols = [r for r in cols if r[5] > 0]
+        for cid, cname, decl, notnull, dflt, pk in cols:
+            attr_rows.append(
+                (oid, cname, _affinity_oid(decl), 1 if notnull or pk else 0,
+                 cid + 1, 1 if dflt is not None else 0)
+            )
+            if dflt is not None:
+                attrdef_rows.append((next_oid[0], oid, cid + 1, str(dflt)))
+                next_oid[0] += 1
+        # primary key → <table>_pkey constraint + synthetic index
+        if pk_cols:
+            idx_oid = next_oid[0]
+            next_oid[0] += 1
+            pkname = f"{name}_pkey"
+            collist = ", ".join(r[1] for r in sorted(pk_cols, key=lambda r: r[5]))
+            cls_rows.append((idx_oid, pkname, PUBLIC_NS_OID, "i"))
+            index_rows.append((idx_oid, oid, 1, 1, len(pk_cols)))
+            con_rows.append((idx_oid, pkname, oid, idx_oid, "p"))
+            defs[idx_oid] = (
+                f"CREATE UNIQUE INDEX {pkname} ON {name} ({collist})",
+                f"PRIMARY KEY ({collist})",
+            )
+        # real indexes: unique ones become constraints ('u' origin)
+        for _seq, iname, unique, origin, _partial in conn.execute(
+            f'PRAGMA index_list("{name}")'
+        ).fetchall():
+            if iname.startswith("sqlite_autoindex"):
+                continue
+            idx_oid = name_to_oid.get(iname)
+            if idx_oid is None:
+                idx_oid = next_oid[0]
+                next_oid[0] += 1
+                cls_rows.append((idx_oid, iname, PUBLIC_NS_OID, "i"))
+            icols = [
+                r[2]
+                for r in conn.execute(f'PRAGMA index_info("{iname}")')
+                if r[2] is not None
+            ]
+            collist = ", ".join(icols)
+            index_rows.append((idx_oid, oid, 0, 1 if unique else 0, len(icols)))
+            defs[idx_oid] = (
+                f"CREATE {'UNIQUE ' if unique else ''}INDEX {iname} "
+                f"ON {name} ({collist})",
+                f"UNIQUE ({collist})" if unique else "",
+            )
+            if unique and origin == "u":
+                con_rows.append((idx_oid, iname, oid, idx_oid, "u"))
     conn.executemany(
         "INSERT OR IGNORE INTO pg_catalog.pg_class "
         "(oid, relname, relnamespace, relkind) VALUES (?, ?, ?, ?)",
-        [
-            (100000 + rid, name, PUBLIC_NS_OID, "r" if typ == "table" else "i")
-            for rid, name, typ in rows
-        ],
+        cls_rows,
     )
+    conn.executemany(
+        "INSERT OR IGNORE INTO pg_catalog.pg_attribute "
+        "(attrelid, attname, atttypid, attnotnull, attnum, atthasdef) "
+        "VALUES (?, ?, ?, ?, ?, ?)",
+        attr_rows,
+    )
+    conn.executemany(
+        "INSERT OR IGNORE INTO pg_catalog.pg_attrdef "
+        "(oid, adrelid, adnum, adbin) VALUES (?, ?, ?, ?)",
+        attrdef_rows,
+    )
+    conn.executemany(
+        "INSERT OR IGNORE INTO pg_catalog.pg_index "
+        "(indexrelid, indrelid, indisprimary, indisunique, indnatts) "
+        "VALUES (?, ?, ?, ?, ?)",
+        index_rows,
+    )
+    conn.executemany(
+        "INSERT OR IGNORE INTO pg_catalog.pg_constraint "
+        "(oid, conname, conrelid, conindid, contype) VALUES (?, ?, ?, ?, ?)",
+        con_rows,
+    )
+    conn.execute(
+        "UPDATE pg_catalog.pg_class SET relhasindex = 1 WHERE oid IN "
+        "(SELECT indrelid FROM pg_catalog.pg_index)"
+    )
+
+
+def constraint_columns(
+    conn: sqlite3.Connection, table: str, name: str
+) -> list:
+    """Resolve a PG constraint NAME to its column list for
+    ``ON CONFLICT ON CONSTRAINT`` (parser.py; the reference resolves the
+    same form through its catalog, corro-pg/src/lib.rs:2840+).
+
+    Sources, in order:
+    1. explicit ``CONSTRAINT <name> PRIMARY KEY/UNIQUE (cols)`` in the
+       stored CREATE TABLE DDL;
+    2. the PG default-name conventions: ``<table>_pkey`` → the table's
+       primary key; ``<table>_<col>_key`` → that column if it is unique;
+    3. a unique INDEX of that name (indexes are constraints in SQLite).
+
+    Returns [] when nothing matches (→ SQLSTATE 42704).
+    """
+    import re as _re
+
+    row = conn.execute(
+        "SELECT sql FROM sqlite_master WHERE type='table' AND name=?",
+        (table,),
+    ).fetchone()
+    ddl = row[0] if row else ""
+    if ddl:
+        pat = _re.compile(
+            r'CONSTRAINT\s+(?:"?' + _re.escape(name) + r'"?)\s+'
+            r"(?:PRIMARY\s+KEY|UNIQUE)\s*\(([^)]*)\)",
+            _re.I,
+        )
+        m = pat.search(ddl)
+        if m:
+            return [
+                c.strip().strip('"').strip("`")
+                for c in m.group(1).split(",")
+                if c.strip()
+            ]
+    # PG default names
+    if name == f"{table}_pkey":
+        pk = [
+            r[1]
+            for r in conn.execute(f'PRAGMA table_info("{table}")')
+            if r[5] > 0
+        ]
+        if pk:
+            return pk
+    m = _re.fullmatch(_re.escape(table) + r"_(.+)_key", name)
+    if m and ddl:
+        col = m.group(1)
+        cols = {r[1] for r in conn.execute(f'PRAGMA table_info("{table}")')}
+        if col in cols:
+            return [col]
+    # unique index with that exact name
+    for idx_name, unique, *_rest in (
+        (r[1], r[2]) for r in conn.execute(f'PRAGMA index_list("{table}")')
+    ):
+        if idx_name == name and unique:
+            return [
+                r[2]
+                for r in conn.execute(f'PRAGMA index_info("{idx_name}")')
+            ]
+    return []
 
 
 def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
@@ -193,9 +432,58 @@ def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
     conn.create_function(
         "pg_encoding_to_char", 1, lambda _e: "UTF8", deterministic=True
     )
-    conn.create_function("pg_get_expr", 2, lambda _e, _r: None)
-    conn.create_function("pg_get_expr", 3, lambda _e, _r, _p: None)
+    # pg_get_expr renders stored default expressions (pg_attrdef.adbin
+    # holds the raw DEFAULT text here, so rendering is identity)
+    conn.create_function("pg_get_expr", 2, lambda e, _r: e)
+    conn.create_function("pg_get_expr", 3, lambda e, _r, _p: e)
+
+    # psql \d name resolution matches relnames with OPERATOR(pg_catalog.~):
+    # the parser rewrites that to REGEXP, which SQLite routes to
+    # regexp(pattern, value)
+    import re as _re_mod
+
+    def _regexp(pattern, value):
+        if pattern is None or value is None:
+            return None
+        try:
+            return 1 if _re_mod.search(pattern, str(value)) else 0
+        except _re_mod.error:
+            return 0
+
+    conn.create_function("regexp", 2, _regexp, deterministic=True)
+
+    defs = _defs_for(conn)
+
+    def _indexdef(oid, *_a):
+        entry = defs.get(oid)
+        return entry[0] if entry else None
+
+    def _constraintdef(oid, *_a):
+        entry = defs.get(oid)
+        return entry[1] if entry and entry[1] else None
+
+    for nargs in (1, 2, 3):
+        conn.create_function("pg_get_indexdef", nargs, _indexdef)
+    for nargs in (1, 2):
+        conn.create_function("pg_get_constraintdef", nargs, _constraintdef)
+    conn.create_function(
+        "set_config", 3, lambda _n, v, _local: v
+    )
+    conn.create_function(
+        "array_to_string", 2, lambda _a, _sep: None
+    )
+    conn.create_function(
+        "array_to_string", 3, lambda _a, _sep, _null: None
+    )
     conn.create_function("txid_current", 0, lambda: 1)
+    import datetime as _dt
+
+    conn.create_function(
+        "now", 0,
+        lambda: _dt.datetime.now(_dt.timezone.utc).strftime(
+            "%Y-%m-%d %H:%M:%S+00"
+        ),
+    )
     conn.create_function(
         "pg_size_pretty", 1,
         lambda n: f"{n} bytes" if n is not None else None,
@@ -215,4 +503,10 @@ def _format_type(oid, _typmod):
 
 def mentions_catalog(sql: str) -> bool:
     low = sql.lower()
-    return "pg_class" in low or "pg_catalog" in low or "pg_namespace" in low
+    return any(
+        t in low
+        for t in (
+            "pg_class", "pg_catalog", "pg_namespace", "pg_attribute",
+            "pg_index", "pg_constraint", "pg_attrdef", "pg_am",
+        )
+    )

@@ -1,0 +1,1197 @@
+"""Recursive-descent PostgreSQL-subset parser + SQLite emitter.
+
+The reference translates PG SQL by round-tripping two full ASTs
+(sqlparser → sqlite3-parser, corro-pg/src/lib.rs:546-1906, 2840+).  This
+module is the rebuild's equivalent: a real lexer (PG string forms,
+dollar-quoting, nested comments, multi-char operators), a
+recursive-descent grammar over statements (CTEs, set operations,
+sub-selects, INSERT conflict clauses parsed structurally), and an
+emitter that regenerates SQLite SQL applying dialect rewrites:
+
+- ``$N`` placeholders → ``?N``;
+- ``expr::type`` → ``CAST(expr AS type)`` with PG→SQLite type mapping
+  (the old token scanner DROPPED casts; the parser preserves them);
+- ``public.``/qualified-function stripping, catalog tables kept;
+- ``ON CONFLICT ON CONSTRAINT name`` → ``ON CONFLICT (cols)`` via a
+  schema-resolver callback (42704 when the constraint is unknown);
+- ``OPERATOR(pg_catalog.~)`` and friends → plain operators (``~`` →
+  ``REGEXP``, registered as a UDF) — the forms psql's ``\\d`` emits;
+- ``COLLATE pg_catalog.default`` dropped; type names mapped in DDL.
+
+Parse errors raise ``ParseError`` (→ SQLSTATE 42601 at the wire).
+Statement classification (read/write/ddl/tx/session) falls out of the
+grammar instead of regex prefix sniffing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+# ---------------------------------------------------------------------------
+# errors
+
+
+class ParseError(ValueError):
+    """Syntax error (SQLSTATE 42601)."""
+
+    def __init__(self, message: str, pos: int = -1):
+        super().__init__(message)
+        self.pos = pos
+
+
+class UnknownConstraint(ValueError):
+    """ON CONSTRAINT name not found (SQLSTATE 42704)."""
+
+
+# ---------------------------------------------------------------------------
+# lexer
+
+IDENT, NUMBER, STRING, PARAM, OP, PUNCT, EOF = (
+    "ident", "number", "string", "param", "op", "punct", "eof",
+)
+
+_OPERATOR_CHARS = set("+-*/<>=~!@#%^&|`?")
+# multi-char operators PG clients actually send (longest first)
+_MULTI_OPS = (
+    "::", "<=", ">=", "<>", "!=", "||", "->>", "->", "#>>", "#>", "~*",
+    "!~*", "!~", "@>", "<@",
+)
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str
+    value: str
+    pos: int
+    quoted: bool = False  # IDENT was "double-quoted"
+
+    def iskw(self, *words: str) -> bool:
+        return (
+            self.kind == IDENT
+            and not self.quoted
+            and self.value.upper() in words
+        )
+
+
+def tokenize(sql: str) -> List[Token]:
+    toks: List[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        # comments (PG block comments nest)
+        if sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            depth, j = 1, i + 2
+            while j < n and depth:
+                if sql.startswith("/*", j):
+                    depth += 1
+                    j += 2
+                elif sql.startswith("*/", j):
+                    depth -= 1
+                    j += 2
+                else:
+                    j += 1
+            if depth:
+                raise ParseError("unterminated /* comment", i)
+            i = j
+            continue
+        # strings
+        if c == "'" or (
+            c in "eEbBxX" and i + 1 < n and sql[i + 1] == "'"
+        ):
+            start = i
+            escape_form = c in "eE" and sql[i + 1] == "'"
+            if c != "'":
+                i += 1  # skip the prefix letter
+            i += 1  # opening quote
+            while i < n:
+                if escape_form and sql[i] == "\\":
+                    i += 2
+                    continue
+                if sql[i] == "'":
+                    if i + 1 < n and sql[i + 1] == "'":
+                        i += 2
+                        continue
+                    break
+                i += 1
+            if i >= n:
+                raise ParseError("unterminated string literal", start)
+            i += 1
+            toks.append(Token(STRING, sql[start:i], start))
+            continue
+        if c == "$":
+            # dollar-quoted string: $$...$$ or $tag$...$tag$
+            j = i + 1
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            if j < n and sql[j] == "$" and not sql[i + 1 : j].isdigit():
+                delim = sql[i : j + 1]
+                end = sql.find(delim, j + 1)
+                if end < 0:
+                    raise ParseError("unterminated dollar-quoted string", i)
+                end += len(delim)
+                toks.append(Token(STRING, sql[i:end], i))
+                i = end
+                continue
+            if i + 1 < n and sql[i + 1].isdigit():
+                j = i + 1
+                while j < n and sql[j].isdigit():
+                    j += 1
+                toks.append(Token(PARAM, sql[i:j], i))
+                i = j
+                continue
+            raise ParseError("unexpected '$'", i)
+        if c == '"':
+            start, j = i, i + 1
+            while j < n:
+                if sql[j] == '"':
+                    if j + 1 < n and sql[j + 1] == '"':
+                        j += 2
+                        continue
+                    break
+                j += 1
+            if j >= n:
+                raise ParseError("unterminated quoted identifier", start)
+            toks.append(
+                Token(IDENT, sql[start : j + 1], start, quoted=True)
+            )
+            i = j + 1
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] in "_$"):
+                j += 1
+            toks.append(Token(IDENT, sql[i:j], i))
+            i = j
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] in "._"):
+                # 1e+5 / 1e-5
+                if sql[j] in "eE" and j + 1 < n and sql[j + 1] in "+-":
+                    j += 2
+                    continue
+                j += 1
+            toks.append(Token(NUMBER, sql[i:j], i))
+            i = j
+            continue
+        if c in "(),.;[]":
+            toks.append(Token(PUNCT, c, i))
+            i += 1
+            continue
+        if c == ":" and sql.startswith("::", i):
+            toks.append(Token(OP, "::", i))
+            i += 2
+            continue
+        if c in _OPERATOR_CHARS or c == ":":
+            for m in _MULTI_OPS:
+                if sql.startswith(m, i):
+                    toks.append(Token(OP, m, i))
+                    i += len(m)
+                    break
+            else:
+                toks.append(Token(OP, c, i))
+                i += 1
+            continue
+        raise ParseError(f"unexpected character {c!r}", i)
+    toks.append(Token(EOF, "", n))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# AST: expressions are ordered item sequences (re-emitted in order); ::
+# binds to the PREVIOUS item (PG's tightest precedence), parens/calls/
+# CASE recurse.
+
+
+@dataclass
+class Name:
+    """Possibly-qualified identifier (a.b.c); parts keep their quoting."""
+
+    parts: List[Token]
+
+    @property
+    def last(self) -> str:
+        t = self.parts[-1]
+        return t.value[1:-1].replace('""', '"') if t.quoted else t.value
+
+    def schema(self) -> Optional[str]:
+        if len(self.parts) < 2:
+            return None
+        t = self.parts[-2]
+        return (t.value[1:-1] if t.quoted else t.value).lower()
+
+
+@dataclass
+class Group:
+    """( items... ) — sub-select, expression parens, or column lists."""
+
+    items: List["Item"]
+    is_select: bool = False
+
+
+@dataclass
+class Call:
+    name: Name
+    args: List["Item"]
+
+
+@dataclass
+class Cast:
+    operand: "Item"
+    pg_type: str  # normalized lower-case PG type name
+
+
+@dataclass
+class Case:
+    items: List["Item"]  # WHEN/THEN/ELSE structure re-emitted in order
+
+
+Item = Union[Token, Name, Group, Call, Cast, Case]
+
+
+def item_is_kw(it: "Item", *words: str) -> bool:
+    """Keyword test for parsed items: bare keywords surface as Tokens OR
+    single-part unquoted Names (the name/call parser claims any IDENT)."""
+    if isinstance(it, Token):
+        return it.iskw(*words)
+    if isinstance(it, Name) and len(it.parts) == 1:
+        return it.parts[0].iskw(*words)
+    return False
+
+
+@dataclass
+class Statement:
+    verb: str  # SELECT/INSERT/UPDATE/DELETE/VALUES/CREATE TABLE/...
+    kind: str  # read | write | ddl | tx | session
+    items: List[Item] = field(default_factory=list)
+    ctes: List[Tuple[Token, List[Item], "Statement"]] = field(
+        default_factory=list
+    )  # (name, opt column list items, sub-statement)
+    recursive: bool = False
+    n_params: int = 0
+    returning: bool = False
+
+
+# ---------------------------------------------------------------------------
+# parser
+
+_CLAUSE_STOP = ()  # item loop stops only on ) , ; EOF at depth 0
+
+_TX_WORDS = {"BEGIN", "COMMIT", "END", "ROLLBACK", "ABORT", "START"}
+_SESSION_WORDS = {
+    "SET", "SHOW", "DEALLOCATE", "DISCARD", "RESET", "LISTEN", "UNLISTEN",
+    "NOTIFY",
+}
+_READ_VERBS = {"SELECT", "VALUES", "TABLE", "EXPLAIN"}
+_WRITE_VERBS = {"INSERT", "UPDATE", "DELETE", "REPLACE"}
+_DDL_VERBS = {"CREATE", "DROP", "ALTER", "TRUNCATE"}
+
+
+class Parser:
+    def __init__(self, toks: List[Token]):
+        self.toks = toks
+        self.i = 0
+        self.max_param = 0
+
+    # -- token plumbing ----------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        j = min(self.i + ahead, len(self.toks) - 1)
+        return self.toks[j]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != EOF:
+            self.i += 1
+        return t
+
+    def expect_kw(self, word: str) -> Token:
+        t = self.next()
+        if not t.iskw(word):
+            raise ParseError(f"expected {word}, got {t.value!r}", t.pos)
+        return t
+
+    def expect_punct(self, ch: str) -> Token:
+        t = self.next()
+        if not (t.kind == PUNCT and t.value == ch):
+            raise ParseError(f"expected {ch!r}, got {t.value!r}", t.pos)
+        return t
+
+    # -- expressions -------------------------------------------------------
+
+    def parse_items(self, *, stop_parens: bool = True) -> List[Item]:
+        """The generic ordered item loop: consume until ``)`` (when
+        ``stop_parens``), ``;`` or EOF at this nesting level.  Commas are
+        plain tokens here — clause structure that needs them (column
+        lists) re-walks the returned items."""
+        items: List[Item] = []
+        while True:
+            t = self.peek()
+            if t.kind == EOF:
+                return items
+            if t.kind == PUNCT and t.value == ";":
+                return items
+            if t.kind == PUNCT and t.value == ")" and stop_parens:
+                return items
+            items.append(self.parse_item())
+
+    def parse_item(self) -> Item:
+        t = self.peek()
+        item: Item
+        if t.kind == PUNCT and t.value == "(":
+            self.next()
+            is_select = self.peek().iskw("SELECT", "VALUES", "WITH", "TABLE")
+            inner = self.parse_items()
+            self.expect_punct(")")
+            item = Group(inner, is_select=is_select)
+        elif t.iskw("CASE"):
+            self.next()
+            inner: List[Item] = [t]
+            while True:
+                nt = self.peek()
+                if nt.kind == EOF:
+                    raise ParseError("unterminated CASE", t.pos)
+                if nt.iskw("END"):
+                    inner.append(self.next())
+                    break
+                inner.append(self.parse_item())
+            item = Case(inner)
+        elif t.iskw("CAST"):
+            # CAST(expr AS type): keep structure so the type name maps
+            self.next()
+            self.expect_punct("(")
+            inner = self.parse_items()
+            self.expect_punct(")")
+            item = Call(Name([t]), inner)
+        elif t.kind == IDENT:
+            # note: OPERATOR(pg_catalog.~) parses as a Call and is mapped
+            # to the plain operator by the emitter (emit_call)
+            item = self.parse_name_or_call()
+        elif t.kind == PARAM:
+            self.max_param = max(self.max_param, int(t.value[1:]))
+            item = self.next()
+        else:
+            item = self.next()
+        # postfix :: casts (left-binding, tightest; chains allowed)
+        while self.peek().kind == OP and self.peek().value == "::":
+            self.next()
+            item = Cast(item, self.parse_type_name())
+        return item
+
+    def parse_name(self) -> Name:
+        """Qualified name WITHOUT call detection (table positions, where
+        `name (cols)` is a column list, not a function call)."""
+        parts = [self.next()]
+        if parts[0].kind != IDENT:
+            raise ParseError(f"expected name, got {parts[0].value!r}",
+                             parts[0].pos)
+        while (
+            self.peek().kind == PUNCT
+            and self.peek().value == "."
+            and self.peek(1).kind == IDENT
+        ):
+            self.next()
+            parts.append(self.next())
+        return Name(parts)
+
+    def parse_name_or_call(self) -> Item:
+        parts = [self.next()]
+        while (
+            self.peek().kind == PUNCT
+            and self.peek().value == "."
+            and (
+                self.peek(1).kind == IDENT
+                or (self.peek(1).kind == OP and self.peek(1).value == "*")
+            )
+        ):
+            self.next()
+            nxt = self.next()
+            if nxt.kind == OP:  # tbl.*
+                return Name(parts + [nxt])
+            parts.append(nxt)
+        name = Name(parts)
+        if (
+            self.peek().kind == PUNCT
+            and self.peek().value == "("
+            and not (
+                len(parts) == 1 and parts[0].iskw(*self._NOT_CALLABLE)
+            )
+        ):
+            self.next()
+            args = self.parse_items()
+            self.expect_punct(")")
+            return Call(name, args)
+        return name
+
+    # clause keywords followed by "(" open a sub-expression/subquery, not
+    # a function call — FROM (VALUES ...) must parse as Name + Group
+    _NOT_CALLABLE = (
+        "FROM", "JOIN", "WHERE", "AND", "OR", "NOT", "ON", "THEN", "ELSE",
+        "WHEN", "HAVING", "UNION", "INTERSECT", "EXCEPT", "ALL",
+        "DISTINCT", "BY", "SET", "LIMIT", "OFFSET", "RETURNING", "USING",
+        "CROSS", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "SELECT",
+    )
+
+    def parse_type_name(self) -> str:
+        """Type after ``::`` or ``AS`` in CAST: ident chain, optional
+        (n[,m]) modifier, optional [] array suffix, two-word forms."""
+        t = self.next()
+        if t.kind == STRING:  # '...'::regclass-style literal casts
+            raise ParseError("string where type name expected", t.pos)
+        if t.kind != IDENT:
+            raise ParseError(f"expected type name, got {t.value!r}", t.pos)
+        words = [t.value]
+        # qualified pg_catalog.int4
+        while self.peek().kind == PUNCT and self.peek().value == ".":
+            self.next()
+            words = [self.next().value]  # keep only the last component
+        two_word = {
+            ("double", "precision"), ("character", "varying"),
+            ("bit", "varying"), ("timestamp", "with"), ("timestamp",
+            "without"), ("time", "with"), ("time", "without"),
+        }
+        while (
+            self.peek().kind == IDENT
+            and (words[-1].lower(), self.peek().value.lower()) in two_word
+        ):
+            words.append(self.next().value)
+            # swallow "time zone" tail of with/without forms
+            if words[-1].lower() in ("with", "without"):
+                for _ in range(2):
+                    if self.peek().kind == IDENT:
+                        words.append(self.next().value)
+        if self.peek().kind == PUNCT and self.peek().value == "(":
+            self.next()
+            self.parse_items()
+            self.expect_punct(")")
+        while (
+            self.peek().kind == PUNCT and self.peek().value == "["
+        ):
+            self.next()
+            if self.peek().value == "]":
+                self.next()
+        return " ".join(w.lower() for w in words)
+
+    # -- statements --------------------------------------------------------
+
+    def parse_statement(self) -> Statement:
+        t = self.peek()
+        if t.kind == EOF:
+            return Statement(verb="", kind="empty")
+        if t.iskw("WITH"):
+            return self.parse_with()
+        if t.kind == IDENT and not t.quoted:
+            word = t.value.upper()
+            if word in _TX_WORDS:
+                return self.parse_plain(word, "tx")
+            if word in _SESSION_WORDS:
+                return self.parse_plain(word, "session")
+            if word == "PRAGMA":
+                return self.parse_plain("PRAGMA", "pragma")
+            if word in _READ_VERBS:
+                # verb keeps the original word (TABLE needs a rewrite in
+                # translate); the command tag maps to SELECT later
+                return self.parse_plain(word, "read")
+            if word == "INSERT" or word == "REPLACE":
+                return self.parse_insert()
+            if word in _WRITE_VERBS:
+                return self.parse_plain(word, "write")
+            if word == "CREATE" and (
+                self.peek(1).iskw("TABLE")
+                or (self.peek(1).iskw("TEMP", "TEMPORARY")
+                    and self.peek(2).iskw("TABLE"))
+            ):
+                return self.parse_create_table()
+            if word in _DDL_VERBS:
+                st = self.parse_plain(word, "ddl")
+                # two-word tag: CREATE TABLE / DROP INDEX / ... skipping
+                # modifiers (PG's tag for CREATE UNIQUE INDEX is
+                # "CREATE INDEX")
+                skip = ("UNIQUE", "TEMP", "TEMPORARY", "OR", "REPLACE",
+                        "IF", "CONCURRENTLY")
+                for it in st.items[1:6]:
+                    w = None
+                    if isinstance(it, Token) and it.kind == IDENT:
+                        w = it.value.upper()
+                    elif isinstance(it, Name):
+                        w = it.parts[0].value.upper()
+                    if w is None or w in skip:
+                        continue
+                    st.verb = f"{word} {w}"
+                    break
+                return st
+        raise ParseError(f"unrecognized statement start {t.value!r}", t.pos)
+
+    def parse_plain(self, verb: str, kind: str) -> Statement:
+        # stop at a depth-0 ")": sub-statements inside CTE/subquery parens
+        # must leave the closer for their caller; at top level a stray ")"
+        # surfaces as trailing-input in parse()
+        items = self.parse_items()
+        st = Statement(verb=verb, kind=kind, items=items)
+        st.returning = any(item_is_kw(it, "RETURNING") for it in items)
+        st.n_params = self.max_param
+        return st
+
+    def parse_with(self) -> Statement:
+        self.expect_kw("WITH")
+        recursive = False
+        if self.peek().iskw("RECURSIVE"):
+            self.next()
+            recursive = True
+        ctes: List[Tuple[Token, List[Item], Statement]] = []
+        while True:
+            name = self.next()
+            if name.kind != IDENT:
+                raise ParseError("expected CTE name", name.pos)
+            cols: List[Item] = []
+            if self.peek().kind == PUNCT and self.peek().value == "(":
+                self.next()
+                cols = self.parse_items()
+                self.expect_punct(")")
+            self.expect_kw("AS")
+            # [NOT] MATERIALIZED
+            if self.peek().iskw("NOT"):
+                self.next()
+                self.expect_kw("MATERIALIZED")
+            elif self.peek().iskw("MATERIALIZED"):
+                self.next()
+            self.expect_punct("(")
+            sub = self.parse_statement()
+            self.expect_punct(")")
+            ctes.append((name, cols, sub))
+            if self.peek().kind == PUNCT and self.peek().value == ",":
+                self.next()
+                continue
+            break
+        main = self.parse_statement()
+        if main.kind not in ("read", "write"):
+            raise ParseError(
+                f"WITH cannot precede a {main.kind} statement",
+                self.peek().pos,
+            )
+        main.ctes = ctes + main.ctes
+        main.recursive = recursive or main.recursive
+        main.n_params = self.max_param
+        return main
+
+    def parse_insert(self) -> Statement:
+        verb_tok = self.next()  # INSERT | REPLACE
+        verb = verb_tok.value.upper()
+        items: List[Item] = [verb_tok]
+        if verb == "INSERT":
+            items.append(self.expect_kw("INTO"))
+        table = self.parse_name()
+        items.append(table)
+        # optional alias / column list / body — the generic loop handles
+        # everything except the conflict clause, which we lift out
+        while True:
+            t = self.peek()
+            if t.kind == EOF or (t.kind == PUNCT and t.value in ");"):
+                break
+            if t.iskw("ON") and self.peek(1).iskw("CONFLICT"):
+                items.append(self.parse_conflict_clause(table))
+                continue
+            items.append(self.parse_item())
+        st = Statement(verb=verb, kind="write", items=items)
+        st.returning = any(item_is_kw(it, "RETURNING") for it in items)
+        st.n_params = self.max_param
+        return st
+
+    _TABLE_CONSTRAINT_WORDS = (
+        "CONSTRAINT", "PRIMARY", "UNIQUE", "CHECK", "FOREIGN",
+    )
+
+    def parse_create_table(self) -> Statement:
+        items: List[Item] = [self.next()]  # CREATE
+        while self.peek().iskw("TEMP", "TEMPORARY", "TABLE"):
+            items.append(self.next())
+        if self.peek().iskw("IF"):
+            items.append(self.next())
+            items.append(self.expect_kw("NOT"))
+            items.append(self.expect_kw("EXISTS"))
+        items.append(self.parse_name())
+        if self.peek().iskw("AS"):
+            # CTAS: no column list to parse structurally — keep generic
+            # items; the schema layer decides supportability (0A000)
+            items.extend(self.parse_items())
+            st = Statement(verb="CREATE TABLE", kind="ddl", items=items)
+            st.n_params = self.max_param
+            return st
+        self.expect_punct("(")
+        elements: List[Union[ColumnDef, List[Item]]] = []
+        while True:
+            t = self.peek()
+            if t.kind == EOF:
+                raise ParseError("unterminated CREATE TABLE body", t.pos)
+            if t.kind == PUNCT and t.value == ")":
+                self.next()
+                break
+            if t.iskw(*self._TABLE_CONSTRAINT_WORDS):
+                elements.append(self._parse_table_element_rest())
+            else:
+                elements.append(self._parse_column_def())
+            if self.peek().kind == PUNCT and self.peek().value == ",":
+                self.next()
+        items.append(TableBody(elements))
+        # table options tail (WITHOUT ROWID, STRICT, ...) passes through
+        items.extend(self.parse_items())
+        st = Statement(verb="CREATE TABLE", kind="ddl", items=items)
+        st.n_params = self.max_param
+        return st
+
+    def _parse_table_element_rest(self) -> List[Item]:
+        out: List[Item] = []
+        while True:
+            t = self.peek()
+            if t.kind == EOF or (
+                t.kind == PUNCT and t.value in "),"
+            ):
+                return out
+            out.append(self.parse_item())
+
+    def _parse_column_def(self) -> ColumnDef:
+        name = self.next()
+        if name.kind != IDENT:
+            raise ParseError(f"expected column name, got {name.value!r}",
+                             name.pos)
+        pg_type: Optional[str] = None
+        type_mod: Optional[Group] = None
+        t = self.peek()
+        if t.kind == IDENT and not t.iskw(
+            "PRIMARY", "NOT", "NULL", "DEFAULT", "UNIQUE", "CHECK",
+            "REFERENCES", "COLLATE", "GENERATED", "AS", "CONSTRAINT",
+        ):
+            # the TYPE position: ident chain + optional (n[,m]) + []
+            words = [self.next().value]
+            two_word = {
+                ("double", "precision"), ("character", "varying"),
+            }
+            while (
+                self.peek().kind == IDENT
+                and (words[-1].lower(), self.peek().value.lower()) in two_word
+            ):
+                words.append(self.next().value)
+            if words[-1].lower() in ("timestamp", "time") and self.peek().iskw(
+                "WITH", "WITHOUT"
+            ):
+                words.append(self.next().value)  # with/without
+                for _ in range(2):  # time zone
+                    if self.peek().kind == IDENT:
+                        words.append(self.next().value)
+            pg_type = " ".join(w.lower() for w in words)
+            if self.peek().kind == PUNCT and self.peek().value == "(":
+                self.next()
+                type_mod = Group(self.parse_items())
+                self.expect_punct(")")
+            while self.peek().kind == PUNCT and self.peek().value == "[":
+                self.next()
+                if self.peek().value == "]":
+                    self.next()
+        rest = self._parse_table_element_rest()
+        return ColumnDef(name=name, pg_type=pg_type, type_mod=type_mod,
+                         rest=rest)
+
+    def parse_conflict_clause(self, table: Name) -> "ConflictClause":
+        on = self.next()
+        self.expect_kw("CONFLICT")
+        target_cols: Optional[Group] = None
+        constraint: Optional[Token] = None
+        where: List[Item] = []
+        if self.peek().kind == PUNCT and self.peek().value == "(":
+            self.next()
+            target_cols = Group(self.parse_items())
+            self.expect_punct(")")
+            if self.peek().iskw("WHERE"):
+                where.append(self.next())
+                while not self.peek().iskw("DO") and self.peek().kind != EOF:
+                    where.append(self.parse_item())
+        elif self.peek().iskw("ON"):
+            self.next()
+            self.expect_kw("CONSTRAINT")
+            constraint = self.next()
+            if constraint.kind != IDENT:
+                raise ParseError("expected constraint name", constraint.pos)
+        # DO NOTHING | DO UPDATE SET ...
+        action: List[Item] = [self.expect_kw("DO")]
+        if self.peek().iskw("NOTHING"):
+            action.append(self.next())
+        else:
+            action.append(self.expect_kw("UPDATE"))
+            action.append(self.expect_kw("SET"))
+            while True:
+                t = self.peek()
+                if (
+                    t.kind == EOF
+                    or (t.kind == PUNCT and t.value in ");")
+                    or t.iskw("RETURNING")
+                ):
+                    break
+                action.append(self.parse_item())
+        return ConflictClause(
+            on=on, table=table, target_cols=target_cols,
+            constraint=constraint, where=where, action=action,
+        )
+
+
+@dataclass
+class ColumnDef:
+    """One CREATE TABLE column: name, optional PG type (structurally
+    parsed so a column NAMED like a type — `name`, `text`, `uuid` — is
+    never type-mapped), optional (n[,m]) modifier, trailing constraints."""
+
+    name: Token
+    pg_type: Optional[str]
+    type_mod: Optional[Group]
+    rest: List[Item]
+
+
+@dataclass
+class TableBody:
+    """CREATE TABLE (...) element list: ColumnDefs + table constraints."""
+
+    elements: List[Union[ColumnDef, List[Item]]]
+
+
+@dataclass
+class ConflictClause:
+    on: Token
+    table: Name
+    target_cols: Optional[Group]
+    constraint: Optional[Token]
+    where: List[Item]
+    action: List[Item]
+
+
+def parse(sql: str) -> Statement:
+    p = Parser(tokenize(sql))
+    st = p.parse_statement()
+    # trailing ; tolerated; anything else is a syntax error
+    while p.peek().kind == PUNCT and p.peek().value == ";":
+        p.next()
+    if p.peek().kind != EOF:
+        t = p.peek()
+        raise ParseError(f"unexpected trailing input {t.value!r}", t.pos)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# emitter
+
+_TYPE_MAP = {
+    "int2": "INTEGER", "int4": "INTEGER", "int8": "INTEGER",
+    "smallint": "INTEGER", "int": "INTEGER", "integer": "INTEGER",
+    "bigint": "INTEGER", "serial": "INTEGER", "bigserial": "INTEGER",
+    "smallserial": "INTEGER", "oid": "INTEGER",
+    "float4": "REAL", "float8": "REAL", "double precision": "REAL",
+    "real": "REAL", "numeric": "REAL", "decimal": "REAL",
+    "bool": "INTEGER", "boolean": "INTEGER",
+    "bytea": "BLOB",
+    "json": "TEXT", "jsonb": "TEXT", "uuid": "TEXT", "text": "TEXT",
+    "varchar": "TEXT", "character varying": "TEXT", "character": "TEXT",
+    "char": "TEXT", "name": "TEXT", "regclass": "TEXT", "citext": "TEXT",
+    "date": "TEXT", "timestamptz": "TEXT", "timestamp": "TEXT",
+    "timestamp with time zone": "TEXT",
+    "timestamp without time zone": "TEXT",
+    "time": "TEXT", "time with time zone": "TEXT",
+    "time without time zone": "TEXT", "interval": "TEXT",
+}
+
+# operator spellings inside OPERATOR(pg_catalog.X) → SQLite operator
+_OPERATOR_MAP = {"~": "REGEXP", "~~": "LIKE", "=": "=", "<>": "<>",
+                 "!=": "!=", "~*": "REGEXP"}
+
+_E_ESCAPES = {
+    "n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
+    "\\": "\\", "'": "'", '"': '"', "0": "\0",
+}
+
+
+def _sqlite_string(raw: str) -> str:
+    """PG string literal → SQLite string literal.  Standard '...' passes
+    through; E'...' decodes backslash escapes; $tag$...$tag$ re-quotes;
+    X'...'/B'...' pass through (SQLite knows blob literals)."""
+    if raw.startswith("'"):
+        return raw
+    head = raw[0].lower()
+    if head in "xb":
+        return raw
+    if head == "e":
+        body = raw[2:-1]
+        out: List[str] = []
+        i = 0
+        while i < len(body):
+            c = body[i]
+            if c == "\\" and i + 1 < len(body):
+                nxt = body[i + 1]
+                if nxt in _E_ESCAPES:
+                    out.append(_E_ESCAPES[nxt])
+                    i += 2
+                    continue
+                if nxt in "xX" and i + 3 < len(body) + 1:
+                    hexs = body[i + 2 : i + 4]
+                    try:
+                        out.append(chr(int(hexs, 16)))
+                        i += 2 + len(hexs)
+                        continue
+                    except ValueError:
+                        pass
+                if nxt == "u" and i + 6 <= len(body) + 1:
+                    try:
+                        out.append(chr(int(body[i + 2 : i + 6], 16)))
+                        i += 6
+                        continue
+                    except ValueError:
+                        pass
+                out.append(nxt)
+                i += 2
+                continue
+            if c == "'" and body[i : i + 2] == "''":
+                out.append("'")
+                i += 2
+                continue
+            out.append(c)
+            i += 1
+        return "'" + "".join(out).replace("'", "''") + "'"
+    if head == "$":
+        delim_end = raw.find("$", 1) + 1
+        body = raw[delim_end : len(raw) - delim_end]
+        return "'" + body.replace("'", "''") + "'"
+    return raw
+
+ConstraintResolver = Callable[[str, str], Sequence[str]]
+
+
+class Emitter:
+    def __init__(
+        self,
+        constraint_resolver: Optional[ConstraintResolver] = None,
+    ):
+        self.resolver = constraint_resolver
+        self.out: List[str] = []
+
+    # one space between emitted atoms except after ( . and before ) , . (
+    _NO_SPACE_BEFORE = {")", ",", ".", ";", "[", "]", "("}
+    _NO_SPACE_AFTER = {"(", ".", "["}
+
+    def _emit(self, text: str) -> None:
+        if (
+            self.out
+            and text not in self._NO_SPACE_BEFORE
+            and self.out[-1] not in self._NO_SPACE_AFTER
+        ):
+            # no space before ( when it follows a function name — handled
+            # by Call emission passing "(" directly
+            self.out.append(" ")
+        self.out.append(text)
+
+    def text(self) -> str:
+        return "".join(self.out)
+
+    # -- item dispatch -----------------------------------------------------
+
+    def emit_items(self, items: Sequence[Item]) -> None:
+        idx = 0
+        while idx < len(items):
+            it = items[idx]
+            # COLLATE pg_catalog.default / COLLATE "default" → dropped
+            if (
+                item_is_kw(it, "COLLATE")
+                and idx + 1 < len(items)
+                and isinstance(items[idx + 1], Name)
+                and items[idx + 1].last.lower() in ("default", "c", "posix")
+            ):
+                idx += 2
+                continue
+            if item_is_kw(it, "ILIKE"):
+                # SQLite LIKE is already case-insensitive for ASCII
+                self._emit("LIKE")
+                idx += 1
+                continue
+            # (VALUES ...) AS t(c1, c2): SQLite has no column aliases on
+            # subqueries — re-emit as a positional rename over the
+            # guaranteed column1..columnN names of a VALUES list
+            rewritten = self._try_values_alias(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            self.emit_item(it)
+            idx += 1
+
+    def _try_values_alias(self, items: Sequence[Item], idx: int) -> int:
+        """Detect ``Group(VALUES …) [AS] alias (col, …)`` starting at idx;
+        emit the SQLite rewrite and return how many items were consumed
+        (0 = no match)."""
+        it = items[idx]
+        if not (isinstance(it, Group) and it.is_select and it.items):
+            return 0
+        first = it.items[0]
+        is_values = item_is_kw(first, "VALUES") or (
+            # `VALUES (1)` parses as a Call named VALUES
+            isinstance(first, Call)
+            and len(first.name.parts) == 1
+            and first.name.parts[0].iskw("VALUES")
+        )
+        if not is_values:
+            return 0
+        j = idx + 1
+        if j < len(items) and item_is_kw(items[j], "AS"):
+            j += 1
+        # alias may parse as Name or as Call(alias, cols) when the column
+        # list directly follows
+        alias: Optional[str] = None
+        cols: Optional[List[str]] = None
+        if j < len(items) and isinstance(items[j], Call):
+            call = items[j]
+            if len(call.name.parts) == 1:
+                alias = call.name.parts[0].value
+                cols = [
+                    a.parts[0].value
+                    for a in call.args
+                    if isinstance(a, Name) and len(a.parts) == 1
+                ]
+                if len(cols) != sum(
+                    0 if (isinstance(a, Token) and a.value == ",") else 1
+                    for a in call.args
+                ):
+                    cols = None
+            j += 1
+        elif (
+            j + 1 < len(items)
+            and isinstance(items[j], Name)
+            and isinstance(items[j + 1], Group)
+        ):
+            alias = items[j].parts[0].value
+            cols = [
+                a.parts[0].value
+                for a in items[j + 1].items
+                if isinstance(a, Name) and len(a.parts) == 1
+            ]
+            j += 2
+        if alias is None or not cols:
+            return 0
+        self._emit("(")
+        self._emit("SELECT")
+        for k, cname in enumerate(cols):
+            if k:
+                self._emit(",")
+            self._emit(f"column{k + 1}")
+            self._emit("AS")
+            self._emit(cname)
+        self._emit("FROM")
+        self.emit_item(items[idx])
+        self._emit(")")
+        self._emit("AS")
+        self._emit(alias)
+        return j - idx
+
+    def _operator_group(self, grp: Group) -> Optional[str]:
+        # Group items: [Name(pg_catalog)? , '.', OP] or just [OP]
+        ops = [
+            t.value
+            for t in grp.items
+            if isinstance(t, Token) and t.kind == OP
+        ]
+        names = [it for it in grp.items if isinstance(it, Name)]
+        if len(ops) == 1 and len(grp.items) <= 3:
+            return _OPERATOR_MAP.get(ops[0], ops[0])
+        if not ops and len(names) == 1:
+            return None
+        return None
+
+    def emit_item(self, it: Item) -> None:
+        if isinstance(it, Token):
+            if it.kind == PARAM:
+                self._emit("?" + it.value[1:])
+            elif it.kind == STRING:
+                self._emit(_sqlite_string(it.value))
+            else:
+                self._emit(it.value)
+            return
+        if isinstance(it, TableBody):
+            self._emit("(")
+            for k, el in enumerate(it.elements):
+                if k:
+                    self._emit(",")
+                if isinstance(el, ColumnDef):
+                    self._emit(el.name.value)
+                    if el.pg_type is not None:
+                        self._emit(
+                            _TYPE_MAP.get(el.pg_type, el.pg_type.upper())
+                        )
+                        if el.type_mod is not None:
+                            self.emit_item(el.type_mod)
+                    self.emit_items(el.rest)
+                else:
+                    self.emit_items(el)
+            self._emit(")")
+            return
+        if isinstance(it, Name):
+            self.emit_name(it)
+            return
+        if isinstance(it, Group):
+            self._emit("(")
+            self.emit_items(it.items)
+            self._emit(")")
+            return
+        if isinstance(it, Call):
+            self.emit_call(it)
+            return
+        if isinstance(it, Cast):
+            self._emit("CAST")
+            self._emit("(")
+            self.emit_item(it.operand)
+            self._emit("AS")
+            self._emit(_TYPE_MAP.get(it.pg_type, it.pg_type.upper()))
+            self._emit(")")
+            return
+        if isinstance(it, Case):
+            self.emit_items(it.items)
+            return
+        if isinstance(it, ConflictClause):
+            self.emit_conflict(it)
+            return
+        raise TypeError(f"unknown item {it!r}")
+
+    def emit_name(self, name: Name) -> None:
+        parts = name.parts
+        schema = name.schema()
+        if schema in ("public", "main") and len(parts) >= 2:
+            parts = parts[-1:]
+        self._emit(
+            ".".join(
+                p.value if p.kind != OP else "*"  # tbl.*
+                for p in parts
+            )
+        )
+
+    def emit_call(self, call: Call) -> None:
+        name = call.name
+        if (
+            len(name.parts) == 1
+            and name.parts[0].iskw("OPERATOR")
+            and call.args
+        ):
+            # OPERATOR(pg_catalog.~) → the mapped plain operator
+            op = self._operator_group(Group(call.args))
+            if op is not None:
+                self._emit(op)
+                return
+        if call.name.parts[0].iskw("CAST"):
+            # CAST(expr AS type): map the trailing type name
+            self._emit("CAST")
+            self._emit("(")
+            self._emit_cast_args(call.args)
+            self._emit(")")
+            return
+        parts = name.parts
+        if name.schema() in ("pg_catalog", "public", "information_schema"):
+            parts = parts[-1:]  # UDFs have no schema in SQLite
+        self._emit(".".join(p.value for p in parts))
+        self.out.append("(")  # no space: f(x)
+        self.emit_items(call.args)
+        self._emit(")")
+
+    def _emit_cast_args(self, args: Sequence[Item]) -> None:
+        # ... AS <type words>: everything before AS emits normally.  Bare
+        # keywords parse as single-part Names, so the AS split and the
+        # type words must use item-level matching, not raw Tokens.
+        split = None
+        for k, a in enumerate(args):
+            if item_is_kw(a, "AS"):
+                split = k
+        if split is None:
+            self.emit_items(args)
+            return
+        self.emit_items(args[:split])
+        self._emit("AS")
+        tail = list(args[split + 1 :])
+        type_words: List[str] = []
+        for a in tail:
+            if isinstance(a, Token) and a.kind == IDENT:
+                type_words.append(a.value.lower())
+            elif isinstance(a, Name) and len(a.parts) == 1:
+                type_words.append(a.parts[0].value.lower())
+            elif isinstance(a, Call) and len(a.name.parts) == 1:
+                # VARCHAR(10): type word + modifier in one Call
+                type_words.append(a.name.parts[0].value.lower())
+        tname = " ".join(type_words)
+        if tname in _TYPE_MAP:
+            self._emit(_TYPE_MAP[tname])
+            # re-emit any modifier group (e.g. VARCHAR(10) keeps (10))
+            for a in tail:
+                if isinstance(a, Group):
+                    self.emit_item(a)
+                elif isinstance(a, Call):
+                    self._emit("(")
+                    self.emit_items(a.args)
+                    self._emit(")")
+        else:
+            self.emit_items(tail)
+
+    def emit_conflict(self, c: ConflictClause) -> None:
+        self._emit("ON")
+        self._emit("CONFLICT")
+        if c.constraint is not None:
+            if self.resolver is None:
+                raise UnknownConstraint(
+                    "ON CONFLICT ON CONSTRAINT requires schema access "
+                    "to resolve the constraint's columns"
+                )
+            cname = (
+                c.constraint.value[1:-1].replace('""', '"')
+                if c.constraint.quoted
+                else c.constraint.value
+            )
+            cols = self.resolver(c.table.last, cname)
+            if not cols:
+                raise UnknownConstraint(
+                    f'constraint "{cname}" for table '
+                    f'"{c.table.last}" does not exist'
+                )
+            self._emit("(")
+            for k, col in enumerate(cols):
+                if k:
+                    self._emit(",")
+                self._emit(f'"{col}"')
+            self._emit(")")
+        elif c.target_cols is not None:
+            self.emit_item(c.target_cols)
+            if c.where:
+                self.emit_items(c.where)
+        self.emit_items(c.action)
+
+
+def emit(
+    st: Statement,
+    constraint_resolver: Optional[ConstraintResolver] = None,
+) -> str:
+    em = Emitter(constraint_resolver=constraint_resolver)
+    if st.ctes:
+        em._emit("WITH")
+        if st.recursive:
+            em._emit("RECURSIVE")
+        for k, (name, cols, sub) in enumerate(st.ctes):
+            if k:
+                em._emit(",")
+            em._emit(name.value)
+            if cols:
+                em._emit("(")
+                em.emit_items(cols)
+                em._emit(")")
+            em._emit("AS")
+            em._emit("(")
+            em.out.append(emit(sub, constraint_resolver))
+            em._emit(")")
+    # DDL type mapping happens structurally in TableBody/ColumnDef
+    # emission; everything else re-emits with the standard rewrites
+    # (SQLite's affinity rules understand unmapped PG type names anyway)
+    em.emit_items(st.items)
+    return em.text()

@@ -112,6 +112,13 @@ class _Session:
         self.tx_failed = False
         self._discard_until_sync = False
 
+    def _constraint_resolver(self, table: str, name: str):
+        """ON CONFLICT ON CONSTRAINT schema lookup (parser.py): resolve a
+        PG constraint name to its column list against the live store."""
+        from .catalog import constraint_columns
+
+        return constraint_columns(self.agent.store.conn, table, name)
+
     # -- transaction status char for ReadyForQuery ----------------------
 
     @property
@@ -151,6 +158,14 @@ class _Session:
                     done = await self._dispatch(msg)
                 except PgError as e:
                     await self._send_error(e, msg)
+                except tr.ParseError as e:
+                    await self._send_error(
+                        PgError(sql_state.SYNTAX_ERROR, str(e)), msg
+                    )
+                except tr.UnknownConstraint as e:
+                    await self._send_error(
+                        PgError(sql_state.UNDEFINED_OBJECT, str(e)), msg
+                    )
                 except tr.UnsupportedStatement as e:
                     await self._send_error(
                         PgError(sql_state.FEATURE_NOT_SUPPORTED, str(e)), msg
@@ -265,8 +280,22 @@ class _Session:
             return
         for stmt in stmts:
             try:
-                t = tr.translate(stmt)
+                t = tr.translate(stmt, self._constraint_resolver)
                 await self._run_statement(t, (), (), describe_rows=True)
+            except tr.ParseError as e:
+                self.writer.write(
+                    p.error_response(sql_state.SYNTAX_ERROR, str(e))
+                )
+                if self.tx is not None:
+                    self.tx_failed = True
+                break
+            except tr.UnknownConstraint as e:
+                self.writer.write(
+                    p.error_response(sql_state.UNDEFINED_OBJECT, str(e))
+                )
+                if self.tx is not None:
+                    self.tx_failed = True
+                break
             except tr.UnsupportedStatement as e:
                 self.writer.write(
                     p.error_response(sql_state.FEATURE_NOT_SUPPORTED, str(e))
@@ -296,7 +325,7 @@ class _Session:
                 sql_state.DUPLICATE_PREPARED_STATEMENT,
                 f'prepared statement "{msg.name}" already exists',
             )
-        t = tr.translate(msg.sql)
+        t = tr.translate(msg.sql, self._constraint_resolver)
         oids = tuple(msg.param_oids) + tuple(
             [p.OID_TEXT] * max(0, t.n_params - len(msg.param_oids))
         )
@@ -576,10 +605,42 @@ class _Session:
                 sql_state.ACTIVE_SQL_TRANSACTION,
                 "schema changes are not supported inside a transaction block",
             )
-        first = t.sql.split(None, 2)
-        if first[0].upper() == "CREATE" and first[1].upper() in ("TABLE", "INDEX"):
-            async with self.agent.write_sema:
-                self.agent.store.merge_schema([t.sql])
+        first = t.sql.split(None, 3)
+        words = [w.upper() for w in first[:3]]
+        is_create_table = words[:2] == ["CREATE", "TABLE"]
+        is_create_index = words[0] == "CREATE" and (
+            words[1] == "INDEX" or words[1:3] == ["UNIQUE", "INDEX"]
+        )
+        if is_create_table or is_create_index:
+            stmts = [t.sql]
+            if is_create_index:
+                # a lone CREATE INDEX can't parse in the scratch schema
+                # without its table: merge alongside the table's live DDL
+                import re as _re
+
+                m = _re.search(
+                    r'\bON\s+("(?:[^"]|"")+"|[\w$]+)', t.sql, _re.I
+                )
+                if m:
+                    tname = m.group(1)
+                    if tname.startswith('"'):
+                        tname = tname[1:-1].replace('""', '"')
+                    row = self.agent.store.conn.execute(
+                        "SELECT sql FROM sqlite_master WHERE type='table' "
+                        "AND name=?",
+                        (tname,),
+                    ).fetchone()
+                    if row and row[0]:
+                        stmts = [row[0], t.sql]
+            from ..core.schema import SchemaError
+
+            try:
+                async with self.agent.write_sema:
+                    self.agent.store.merge_schema(stmts)
+            except SchemaError as e:
+                # CRR constraints (unique indexes, FK, droppped tables...)
+                # surface as feature errors, not internal ones
+                raise PgError(sql_state.FEATURE_NOT_SUPPORTED, str(e))
         else:
             raise PgError(
                 sql_state.FEATURE_NOT_SUPPORTED,

@@ -18,6 +18,7 @@ SYNTAX_ERROR = "42601"
 UNDEFINED_TABLE = "42P01"
 UNDEFINED_COLUMN = "42703"
 DUPLICATE_PREPARED_STATEMENT = "42P05"
+UNDEFINED_OBJECT = "42704"
 UNIQUE_VIOLATION = "23505"
 NOT_NULL_VIOLATION = "23502"
 CHECK_VIOLATION = "23514"

@@ -1,0 +1,123 @@
+r"""psql `\d <table>` against the PG front-end (VERDICT r2 item 6).
+
+psql implements \d as a fixed sequence of pg_catalog queries; these are
+the literal shapes psql 14 sends (captured from describe.c), exercising
+the parser's OPERATOR(pg_catalog.~) / COLLATE pg_catalog.default /
+chained-::-cast handling and the pg_attribute / pg_index /
+pg_constraint / pg_attrdef catalog tables."""
+
+import asyncio
+
+from corrosion_tpu.pg.client import PgClient
+
+from .test_pg import _with_pg  # the shared agent+pg fixture
+
+SCHEMA_EXTRA = (
+    "CREATE TABLE IF NOT EXISTS described ("
+    " id INTEGER PRIMARY KEY NOT NULL,"
+    " label TEXT NOT NULL DEFAULT 'x',"
+    " score REAL);"
+    "CREATE UNIQUE INDEX IF NOT EXISTS described_label_key ON described (label);"
+)
+
+Q_RESOLVE = (
+    "SELECT c.oid,\n  n.nspname,\n  c.relname\n"
+    "FROM pg_catalog.pg_class c\n"
+    "     LEFT JOIN pg_catalog.pg_namespace n ON n.oid = c.relnamespace\n"
+    "WHERE c.relname OPERATOR(pg_catalog.~) '^(described)$' COLLATE pg_catalog.default\n"
+    "  AND pg_catalog.pg_table_is_visible(c.oid)\n"
+    "ORDER BY 2, 3;"
+)
+
+Q_RELFLAGS = (
+    "SELECT c.relchecks, c.relkind, c.relhasindex, c.relhasrules, "
+    "c.relhastriggers, c.relrowsecurity, c.relforcerowsecurity, "
+    "false AS relhasoids, c.relispartition, '', c.reltablespace, "
+    "CASE WHEN c.reloftype = 0 THEN '' ELSE "
+    "c.reloftype::pg_catalog.regtype::pg_catalog.text END, "
+    "c.relpersistence, c.relreplident, am.amname\n"
+    "FROM pg_catalog.pg_class c\n"
+    " LEFT JOIN pg_catalog.pg_am am ON (c.relam = am.oid)\n"
+    "WHERE c.oid = '{oid}';"
+)
+
+Q_COLUMNS = (
+    "SELECT a.attname,\n"
+    "  pg_catalog.format_type(a.atttypid, a.atttypmod),\n"
+    "  (SELECT pg_catalog.pg_get_expr(d.adbin, d.adrelid, true)\n"
+    "   FROM pg_catalog.pg_attrdef d\n"
+    "   WHERE d.adrelid = a.attrelid AND d.adnum = a.attnum AND a.atthasdef),\n"
+    "  a.attnotnull,\n"
+    "  (SELECT c.collname FROM pg_catalog.pg_collation c, pg_catalog.pg_type t\n"
+    "   WHERE c.oid = a.attcollation AND t.oid = a.atttypid "
+    "AND a.attcollation <> t.typcollation) AS attcollation,\n"
+    "  a.attidentity,\n"
+    "  a.attgenerated\n"
+    "FROM pg_catalog.pg_attribute a\n"
+    "WHERE a.attrelid = '{oid}' AND a.attnum > 0 AND NOT a.attisdropped\n"
+    "ORDER BY a.attnum;"
+)
+
+Q_INDEXES = (
+    "SELECT c2.relname, i.indisprimary, i.indisunique, i.indisclustered, "
+    "i.indisvalid, pg_catalog.pg_get_indexdef(i.indexrelid, 0, true),\n"
+    "  pg_catalog.pg_get_constraintdef(con.oid, true), contype, "
+    "condeferrable, condeferred, i.indisreplident, c2.reltablespace\n"
+    "FROM pg_catalog.pg_class c, pg_catalog.pg_class c2, "
+    "pg_catalog.pg_index i\n"
+    "  LEFT JOIN pg_catalog.pg_constraint con ON (conrelid = i.indrelid "
+    "AND conindid = i.indexrelid AND contype IN ('p','u','x'))\n"
+    "WHERE c.oid = '{oid}' AND c.oid = i.indrelid AND i.indexrelid = c2.oid\n"
+    "ORDER BY i.indisprimary DESC, c2.relname;"
+)
+
+
+def test_psql_backslash_d_sequence():
+    async def body(cluster, clients):
+        c: PgClient = clients[0]
+        for stmt in SCHEMA_EXTRA.rstrip(";").split(";"):
+            cluster.agents[0].store.conn.execute(stmt)
+
+        # psql startup also runs set_config for search_path
+        res = await c.query(
+            "SELECT pg_catalog.set_config('search_path', '', false)"
+        )
+        assert res[0].rows
+
+        # 1. name resolution (regex operator + collate + visibility UDF)
+        res = await c.query(Q_RESOLVE)
+        assert len(res[0].rows) == 1, res[0].rows
+        oid, nsp, relname = res[0].rows[0]
+        assert relname == "described" and nsp == "public"
+
+        # 2. relation flags (chained :: casts inside CASE)
+        res = await c.query(Q_RELFLAGS.format(oid=oid))
+        row = res[0].rows[0]
+        assert row[1] == "r"  # relkind
+        assert row[2] == "1"  # relhasindex (pkey + unique index)
+        assert row[14] == "heap"  # am.amname
+
+        # 3. column list with types, defaults, not-null
+        res = await c.query(Q_COLUMNS.format(oid=oid))
+        cols = {r[0]: r for r in res[0].rows}
+        assert set(cols) == {"id", "label", "score"}
+        assert cols["id"][3] == "1"  # pk ⇒ not null
+        assert cols["label"][2] == "'x'"  # default expression text
+        assert cols["label"][3] == "1"
+        assert cols["score"][3] == "0"
+        assert cols["id"][1] == "int8"  # format_type of the affinity oid
+
+        # 4. index + constraint listing
+        res = await c.query(Q_INDEXES.format(oid=oid))
+        by_name = {r[0]: r for r in res[0].rows}
+        assert "described_pkey" in by_name, by_name
+        pkey = by_name["described_pkey"]
+        assert pkey[1] == "1" and pkey[2] == "1"  # primary, unique
+        assert "ON described" in pkey[5]
+        assert pkey[6] == "PRIMARY KEY (id)"
+        assert pkey[7] == "p"
+        uniq = by_name["described_label_key"]
+        assert uniq[1] == "0" and uniq[2] == "1"
+        assert "UNIQUE" in uniq[5]
+
+    asyncio.run(_with_pg(1, body))
