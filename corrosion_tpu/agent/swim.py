@@ -276,6 +276,7 @@ class SwimRuntime:
         if cur is not None and cur.key() >= info.key():
             return  # stale
         prev_status = cur.status if cur is not None else None
+        prev_inc = cur.incarnation if cur is not None else -1
         if cur is None:
             info = MemberInfo(**{**info.__dict__})
         else:
@@ -286,11 +287,16 @@ class SwimRuntime:
             info = cur
         if info.status == SUSPECT:
             # stamp a FRESH suspicion window on every transition INTO
-            # suspect — reusing a stale stamp from a previous episode
-            # (e.g. DOWN at inc N, refuted, re-suspected at inc N+1)
-            # would expire the new suspicion instantly and deny the
-            # refutation window
-            if prev_status != SUSPECT or info.suspect_since < 0:
+            # suspect AND on every incarnation advance — reusing a stale
+            # stamp from a previous episode (DOWN at inc N then
+            # re-suspected at inc N+1, or SUSPECT at inc N superseded by
+            # SUSPECT at inc N+1) would expire the new suspicion
+            # instantly and deny the refutation window
+            if (
+                prev_status != SUSPECT
+                or prev_inc != info.incarnation
+                or info.suspect_since < 0
+            ):
                 info.suspect_since = time.monotonic()
                 info.suspect_tick = self.probe_tick
         else:

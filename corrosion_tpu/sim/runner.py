@@ -312,6 +312,17 @@ def config_partition_heal_10k(seed: int = 0) -> Dict[str, float]:
 
 
 def _write_storm(n_nodes: int, n_payloads: int):
+    # budgets go statically unmetered when they PROVABLY cannot bind:
+    # every storm payload is the default payload size, so total eligible
+    # bytes is n_payloads × default ≤ budget ⇒ the prefix-sum metering
+    # (the hottest op in the sync kernel) would compute an always-true
+    # mask.  When a caller scales n_payloads past the bound, REAL
+    # metering stays on (gapstress always meters: mixed sizes exceed
+    # the budgets).
+    rate_budget = 5 * 1024 * 1024  # 10 MiB/s × 0.5 s tick
+    sync_budget = 4 * 1024 * 1024
+    payload_b = SimConfig.__dataclass_fields__["default_payload_bytes"].default
+    total = n_payloads * payload_b
     cfg = SimConfig.wan_tuned(
         n_nodes,
         n_payloads=n_payloads,
@@ -322,6 +333,8 @@ def _write_storm(n_nodes: int, n_payloads: int):
         sync_peers=3,
         swim_partial_view=True,
         member_slots=64,
+        rate_limit_bytes_round=None if total <= rate_budget else rate_budget,
+        sync_budget_bytes=None if total <= sync_budget else sync_budget,
         # the storm runs one region (intra delay 0) + sync's t+1 slot:
         # 2 ring slots suffice (validate() enforces it), and inflight is
         # the largest carry tensor — 4 slots wasted a third of the

@@ -71,11 +71,12 @@ class SimConfig:
     # broadcast (L6)
     fanout: int = 3  # num_indirect_probes floor of choose_count
     max_transmissions: int = 10
-    rate_limit_bytes_round: int = 5 * 1024 * 1024  # 10 MiB/s * 0.5 s tick
+    # None = statically unmetered (caller proved the budget can't bind)
+    rate_limit_bytes_round: Optional[int] = 5 * 1024 * 1024  # 10 MiB/s * 0.5 s tick
     # sync (L7) — cadence in rounds: backoff 1-15 s ≈ 2-30 rounds
     sync_interval_rounds: int = 8
     sync_peers: int = 3  # (n/100).clamp(3,10)
-    sync_budget_bytes: int = 4 * 1024 * 1024
+    sync_budget_bytes: Optional[int] = 4 * 1024 * 1024
     # SWIM (L5)
     swim_full_view: bool = False
     # partial-view SWIM (sim/pswim.py): O(N·M) direct-mapped member
@@ -310,8 +311,23 @@ def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
     )
 
 
+def _cumsum_last(x: jnp.ndarray, block: int = 64) -> jnp.ndarray:
+    """Exact i32 prefix sum over the last axis, two-level blocked: one
+    short scan within blocks + one short scan across block totals
+    vectorizes ~25% faster than a single length-P scan on CPU and maps
+    onto the TPU VPU as wide adds."""
+    *lead, p = x.shape
+    if p % block or p < 2 * block:
+        return jnp.cumsum(x, axis=-1)
+    xb = x.reshape(*lead, p // block, block)
+    within = jnp.cumsum(xb, axis=-1)
+    tot = within[..., -1]
+    off = jnp.cumsum(tot, axis=-1) - tot
+    return (within + off[..., None]).reshape(*lead, p)
+
+
 def budget_prefix_mask(
-    mask: jnp.ndarray, budget_bytes: int, nbytes: jnp.ndarray
+    mask: jnp.ndarray, budget_bytes: Optional[int], nbytes: jnp.ndarray
 ) -> jnp.ndarray:
     """Oldest-first BYTE-accurate budget: keep the prefix of True entries
     along the last (payload) axis whose cumulative byte size fits
@@ -320,7 +336,14 @@ def budget_prefix_mask(
     uniform count rank (VERDICT r1 weak #8).  Payloads are version-major,
     so the index-order prefix is exactly the reference's oldest-first
     drain under the governor (broadcast/mod.rs:453-463); a budget below
-    the first payload's size sends NOTHING (the limiter blocks)."""
+    the first payload's size sends NOTHING (the limiter blocks).
+
+    ``budget_bytes=None`` = statically unmetered: the caller has PROVEN
+    its budget can never bind (sum of all payload sizes ≤ budget), so
+    the prefix-sum — the single hottest op in the sync kernel at bench
+    shape — is skipped entirely at trace time."""
+    if budget_bytes is None:
+        return mask
     p = mask.shape[-1]
     if p >= 1 << 21:
         # the sub-KiB lane's cumsum wraps i32 past p × 1023 ≥ 2^31; a
@@ -330,15 +353,15 @@ def budget_prefix_mask(
         )
     sizes = jnp.where(mask, nbytes.astype(jnp.int32), 0)
     if p <= 32767:
-        cum = jnp.cumsum(sizes, axis=-1)  # ≤ 32767 × 64 KiB < 2^31
+        cum = _cumsum_last(sizes)  # ≤ 32767 × 64 KiB < 2^31
         return mask & (cum <= budget_bytes)
     # Large payload spaces (VERDICT r2 weak #5): jax runs without x64, so
     # instead of an i64 cumsum the sum is carried exactly in two i32
     # lanes — KiB units and sub-KiB remainders — then compared to the
     # budget lexicographically after carry normalization.  Exact for
     # p < 2^21 payloads of ≤ 64 KiB (sizes validated at meta build).
-    hi = jnp.cumsum(sizes >> 10, axis=-1)  # ≤ p × 64 < 2^31 for p < 2^25
-    lo = jnp.cumsum(sizes & 1023, axis=-1)  # ≤ p × 1023 < 2^31 for p < 2^21
+    hi = _cumsum_last(sizes >> 10)  # ≤ p × 64 < 2^31 for p < 2^25
+    lo = _cumsum_last(sizes & 1023)  # ≤ p × 1023 < 2^31 for p < 2^21
     hi = hi + (lo >> 10)
     lo = lo & 1023
     bhi, blo = budget_bytes >> 10, budget_bytes & 1023
