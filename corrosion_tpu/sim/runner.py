@@ -22,7 +22,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from .round import new_metrics, new_sim, round_step, run_to_convergence
-from .state import ALIVE, DOWN, PayloadMeta, SimConfig, uniform_payloads
+from .state import (
+    ALIVE,
+    DOWN,
+    PayloadMeta,
+    SimConfig,
+    optimize_budgets,
+    uniform_payloads,
+)
 from .topology import Topology, regions
 
 ROUND_SECONDS = 0.5
@@ -260,7 +267,9 @@ def config_swim_churn_partial(
 def config_broadcast_1k(seed: int = 0) -> Dict[str, float]:
     cfg = SimConfig(n_nodes=1000, n_payloads=256, n_writers=8, fanout=3)
     meta = uniform_payloads(cfg, inject_every=2)
-    return run_scenario(cfg, meta, seed=seed)
+    # 256 × 8 KiB = 2 MiB ≤ both budgets ⇒ metering skipped (proof
+    # derived from meta.nbytes in optimize_budgets)
+    return run_scenario(optimize_budgets(cfg, meta), meta, seed=seed)
 
 
 def config_partition_heal_10k(seed: int = 0) -> Dict[str, float]:
@@ -271,8 +280,12 @@ def config_partition_heal_10k(seed: int = 0) -> Dict[str, float]:
     cfg = SimConfig.wan_tuned(
         10_000, n_payloads=256, n_writers=4, fanout=3,
         swim_partial_view=True, member_slots=32,
+        # inter_delay 2 + sync t+1 fit in 3 ring slots (validate checks)
+        n_delay_slots=3,
     )
     meta = uniform_payloads(cfg, inject_every=1)
+    # 2 MiB total ≤ both budgets ⇒ metering skipped (optimize_budgets)
+    cfg = optimize_budgets(cfg, meta)
     topo = Topology(n_regions=2, inter_delay=2)
     region = regions(cfg.n_nodes, topo.n_regions)
 
@@ -312,17 +325,6 @@ def config_partition_heal_10k(seed: int = 0) -> Dict[str, float]:
 
 
 def _write_storm(n_nodes: int, n_payloads: int):
-    # budgets go statically unmetered when they PROVABLY cannot bind:
-    # every storm payload is the default payload size, so total eligible
-    # bytes is n_payloads × default ≤ budget ⇒ the prefix-sum metering
-    # (the hottest op in the sync kernel) would compute an always-true
-    # mask.  When a caller scales n_payloads past the bound, REAL
-    # metering stays on (gapstress always meters: mixed sizes exceed
-    # the budgets).
-    rate_budget = 5 * 1024 * 1024  # 10 MiB/s × 0.5 s tick
-    sync_budget = 4 * 1024 * 1024
-    payload_b = SimConfig.__dataclass_fields__["default_payload_bytes"].default
-    total = n_payloads * payload_b
     cfg = SimConfig.wan_tuned(
         n_nodes,
         n_payloads=n_payloads,
@@ -333,8 +335,6 @@ def _write_storm(n_nodes: int, n_payloads: int):
         sync_peers=3,
         swim_partial_view=True,
         member_slots=64,
-        rate_limit_bytes_round=None if total <= rate_budget else rate_budget,
-        sync_budget_bytes=None if total <= sync_budget else sync_budget,
         # the storm runs one region (intra delay 0) + sync's t+1 slot:
         # 2 ring slots suffice (validate() enforces it), and inflight is
         # the largest carry tensor — 4 slots wasted a third of the
@@ -342,7 +342,9 @@ def _write_storm(n_nodes: int, n_payloads: int):
         n_delay_slots=2,
     )
     meta = uniform_payloads(cfg, inject_every=2)
-    return cfg, meta
+    # 512 × 8 KiB = 4 MiB fits both budgets ⇒ metering skipped; derived
+    # from meta.nbytes itself so changed payload shapes re-enable it
+    return optimize_budgets(cfg, meta), meta
 
 
 def config_write_storm_100k(
@@ -415,7 +417,7 @@ def config_write_storm_gapstress(
 
 
 def config_gapstress_distortion(
-    seed: int = 0, n_nodes: int = 4096, control_slots: int = 64
+    seed: int = 0, n_nodes: int = 1024, control_slots: int = 64
 ) -> Dict[str, object]:
     """Quantify the K-clamp distortion: the same #5b scenario at K=8
     (overflow forced) vs a large-K control where every gap run fits.

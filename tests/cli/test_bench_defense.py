@@ -1,0 +1,57 @@
+"""Wedge self-defense (VERDICT r2 item 8): bench.py must SIGKILL stale
+repo-spawned TPU-client processes (bench_child remnants) before
+preflight, and must NOT touch unrelated processes."""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_kills_stale_bench_child_but_spares_others():
+    # SANDBOXED: a unique marker + temp repo root so the test can never
+    # shoot a concurrently-running real bench child
+    import tempfile
+
+    sandbox = tempfile.mkdtemp(prefix="benchdef_")
+    marker = "sandbox_fake_child_a7x.py"
+    # a fake stale bench child: python process whose cmdline carries the
+    # marker (as an inert extra argv) and cwd inside the sandbox repo
+    stale = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)", marker],
+        cwd=sandbox,
+    )
+    # an unrelated sandbox-cwd python process without any marker
+    bystander = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+        cwd=sandbox,
+    )
+    # a marker process OUTSIDE the sandbox (sibling-checkout scenario)
+    outside = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)", marker],
+        cwd="/tmp",
+    )
+    try:
+        time.sleep(0.3)
+        killed = bench.kill_stale_device_holders(
+            markers=(marker,), repo=sandbox
+        )
+        assert stale.pid in killed, killed
+        deadline = time.time() + 5
+        while stale.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert stale.poll() is not None, "stale bench child must die"
+        assert bystander.poll() is None, "unmarked process must survive"
+        assert outside.poll() is None, "outside-repo process must survive"
+        assert bystander.pid not in killed
+        assert outside.pid not in killed
+    finally:
+        for p in (stale, bystander, outside):
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=5)
