@@ -251,7 +251,10 @@ def main() -> int:
     _diag["platform"] = actual or plat or "default(axon/tpu)"
     _diag["ladder"] = ladder
 
-    for n in ladder:
+    ladder_i = 0
+    while ladder_i < len(ladder):
+        n = ladder[ladder_i]
+        ladder_i += 1
         rem = _remaining()
         if rem < 60:
             _diag["attempts"].append(
@@ -271,6 +274,22 @@ def main() -> int:
         )
         _diag["attempts"].append({"phase": "storm", "nodes": n, **res})
         _write_diag()
+        if res.get("timeout") and not on_cpu:
+            # mid-ladder wedge: the chip survived preflight but hung on a
+            # real shape (the documented degradation mode,
+            # TPU_BACKEND_NOTES.md) — drop to CPU and retry this rung
+            # rather than burning the rest of the budget on a dead
+            # device.  Only TIMEOUTS divert (a deterministic sim failure
+            # would fail identically on CPU); any earlier TPU rung's
+            # record stays and a larger converged CPU rung supersedes it.
+            _diag["midladder_cpu_fallback_at"] = n
+            plat, actual, on_cpu = "cpu", "cpu", True
+            _diag["platform"] = "cpu"
+            _diag.setdefault("stale_killed", []).extend(
+                kill_stale_device_holders()
+            )
+            ladder_i -= 1
+            continue
         if res.get("ok") and res.get("metrics", {}).get("converged"):
             m = res["metrics"]
             value = round(float(m["wall_clock_s"]), 3)
