@@ -68,7 +68,7 @@ class TemplateEngine:
         )
         self.queries_used: List[str] = []
 
-    async def _sql(self, query: str) -> List[Row]:
+    async def _sql_with_columns(self, query: str):
         self.queries_used.append(query)
         columns: List[str] = []
         rows: List[Row] = []
@@ -79,11 +79,33 @@ class TemplateEngine:
                 rows.append(Row(columns, ev["row"][1]))
             elif "error" in ev:
                 raise RuntimeError(f"sql() failed: {ev['error']}")
+        return columns, rows
+
+    async def _sql(self, query: str) -> List[Row]:
+        _, rows = await self._sql_with_columns(query)
         return rows
 
-    async def _sql_json(self, query: str) -> str:
+    async def _sql_json(self, query: str, pretty: bool = False) -> str:
+        # to_json / to_json(#{pretty: true}) parity (corro-tpl lib.rs:487-488)
         rows = await self._sql(query)
-        return json.dumps([r.to_dict() for r in rows])
+        data = [r.to_dict() for r in rows]
+        return json.dumps(data, indent=2 if pretty else None)
+
+    async def _sql_csv(self, query: str, header: bool = True) -> str:
+        # to_csv parity (corro-tpl lib.rs:489, template.example.csv.rhai);
+        # column names come from the columns event, so a zero-row result
+        # still renders its header line
+        import csv
+        import io
+
+        columns, rows = await self._sql_with_columns(query)
+        buf = io.StringIO()
+        w = csv.writer(buf, lineterminator="\n")
+        if header and columns:
+            w.writerow(columns)
+        for r in rows:
+            w.writerow(list(r))
+        return buf.getvalue()
 
     async def render(self, source: str) -> str:
         self.queries_used = []
@@ -91,6 +113,7 @@ class TemplateEngine:
         return await template.render_async(
             sql=self._sql,
             sql_json=self._sql_json,
+            sql_csv=self._sql_csv,
             hostname=socket.gethostname,
             env=os.environ.get,
         )

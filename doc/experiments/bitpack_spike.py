@@ -1,0 +1,100 @@
+"""Bitpacked payload-axis spike (round 3): is packing have/inflight into
+u32 lanes worth a production rewrite?
+
+The sim's carry is HBM-bound: have/relay_left/inflight are u8 arrays
+with one BYTE per (node, payload) bit of information.  Packing the
+payload axis into u32 words (32 payloads/word) cuts carry traffic 8×
+and turns delivery/merge into bitwise ops the VPU chews through.  The
+catch: relay_left is a 0..10 COUNTER (can't bitpack), and the
+budget/grant masks need per-payload granularity — so a production
+bitpack only covers have + inflight, and every kernel that reshapes
+have into the (actor, version, chunk) grid pays an unpack.
+
+This spike measures the core round primitive both ways at bench shape:
+    deliver:  have |= inflight[slot];  inflight[slot] = 0
+    scatter:  inflight[slot] |= sent (per-edge OR into rows)
+plus the unpack cost (packed -> per-payload bool grid).
+
+Run: JAX_PLATFORMS=cpu python doc/experiments/bitpack_spike.py [n_nodes]
+Results land in BITPACK_SPIKE.md.
+"""
+
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 25_000
+P = 512
+W = P // 32  # u32 words per node
+E = N * 3  # fanout edges
+REPS = 10
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(REPS):
+        out = f(*args)
+    jax.block_until_ready(out)
+    ms = (time.monotonic() - t0) / REPS * 1e3
+    print(f"{name:34s} {ms:8.2f} ms")
+    return ms
+
+
+def main():
+    rng = np.random.default_rng(0)
+    have8 = jnp.asarray(rng.integers(0, 2, (N, P)).astype(np.uint8))
+    infl8 = jnp.asarray(rng.integers(0, 2, (N, P)).astype(np.uint8))
+    sent8 = jnp.asarray(rng.integers(0, 2, (E, P)).astype(np.uint8))
+    dst = jnp.asarray(rng.integers(0, N, (E,)).astype(np.int32))
+
+    def pack(x8):
+        b = x8.reshape(x8.shape[0], W, 32).astype(jnp.uint32)
+        return (b << jnp.arange(32, dtype=jnp.uint32)).sum(axis=2)
+
+    have32 = jax.jit(pack)(have8)
+    infl32 = jax.jit(pack)(infl8)
+    sent32 = jax.jit(pack)(sent8)
+
+    print(f"shape: N={N} P={P} E={E}  (u8 carry row {P}B, packed {W * 4}B)")
+
+    # -- deliver: have |= inflight; clear slot --------------------------
+    d8 = timeit("deliver u8 (max + zero)",
+                lambda h, i: (jnp.maximum(h, i), jnp.zeros_like(i)),
+                have8, infl8)
+    d32 = timeit("deliver u32 (or + zero)",
+                 lambda h, i: (h | i, jnp.zeros_like(i)),
+                 have32, infl32)
+
+    # -- scatter: inflight[dst] |= sent ---------------------------------
+    s8 = timeit("scatter u8 (.at[].max)",
+                lambda i, s: i.at[dst].max(s), infl8, sent8)
+    s32 = timeit("scatter u32 (.at[].|)",
+                 lambda i, s: i.at[dst].set(i[dst] | s), infl32, sent32)
+
+    # -- unpack cost: packed -> bool[N, P] (the grid-view tax every
+    #    bookkeeping/convergence kernel would pay) ----------------------
+    u = timeit("unpack u32 -> bool[N,P]",
+               lambda h: (h[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)
+                          & 1).astype(jnp.bool_).reshape(N, P),
+               have32)
+
+    # correctness of the packed ops
+    got = np.asarray(jax.jit(lambda h, i: h | i)(have32, infl32))
+    want = np.asarray(jax.jit(pack)(jnp.maximum(have8, infl8)))
+    assert (got == want).all(), "packed deliver mismatch"
+
+    print(f"\ndeliver speedup ×{d8 / d32:.1f}, scatter ×{s8 / s32:.1f}, "
+          f"unpack tax {u:.1f} ms/use")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,22 @@ def test_render_sql_rows_and_json():
         out = await engine.render("{{ hostname() }}")
         assert out == socket.gethostname()
 
+        # to_csv / pretty-json parity (corro-tpl lib.rs:487-489,
+        # template.example.csv.rhai)
+        out = await engine.render(
+            '{{ sql_csv("SELECT id, text FROM tests ORDER BY id") }}'
+        )
+        assert out == "id,text\n1,alpha\n2,beta\n"
+        out = await engine.render(
+            '{{ sql_json("SELECT id FROM tests WHERE id = 1", pretty=True) }}'
+        )
+        assert out == '[\n  {\n    "id": 1\n  }\n]'
+        # zero-row CSV keeps its header line (consumers parse headered CSV)
+        out = await engine.render(
+            '{{ sql_csv("SELECT id, text FROM tests WHERE 1=0") }}'
+        )
+        assert out == "id,text\n"
+
     asyncio.run(_with_api(body))
 
 
