@@ -1,0 +1,430 @@
+"""Bitpacked round kernels: the payload axis as u32 words.
+
+The dense round keeps one BYTE per (node, payload) bit in its hottest
+carries (`have`, `inflight`) and per-edge masks; doc/experiments/
+BITPACK_SPIKE.md measured the packed equivalents at ×4-×30 per
+primitive (8× less HBM traffic, VPU-friendly bitwise ops).  This module
+is the full packed round for the scenario class the headline bench
+runs, kept EXACTLY equivalent to the dense kernels (tests/sim/
+test_packed_equivalence.py compares round-by-round bit-for-bit):
+
+- ``have_p[N, W] u32`` — W = P/32 words, payload p lives at word p//32
+  bit p%32 (LSB-first);
+- ``inflight_p[D, N, W] u32`` — the delay ring, bitwise-OR merged;
+- ``relay planes r0..r3[N, W] u32`` — the 0..15 retransmission counter
+  BITSLICED: bit b of plane k is bit k of payload b's counter.
+  Decrement-where-mask is 4 bitwise ops of ripple borrow; "counter > 0"
+  is ``r0|r1|r2|r3`` — the counter never leaves packed form;
+- chunk completeness without unpacking: ``chunks_per_version`` is a
+  power of two ≤ 32, so a version's chunks are CONTIGUOUS bits inside
+  one word and "all chunks present" is a log2(C)-step bitwise fold.
+
+Supported scenario envelope (validated by ``packed_supported``):
+P % 32 == 0, chunks_per_version ∈ {1, 2, 4, 8, 16, 32}, statically
+unmetered budgets (optimize_budgets), zero payload loss, and
+max_transmissions < 16.  Everything outside stays on the dense path —
+same results, just slower.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .state import ALIVE, PayloadMeta, SimConfig, SimState
+from .swim import sample_member_targets
+from .topology import Topology, edge_alive, edge_delay
+
+U32 = jnp.uint32
+ONES = jnp.uint32(0xFFFFFFFF)
+
+
+def packed_supported(cfg: SimConfig, topo: Topology) -> bool:
+    c = cfg.chunks_per_version
+    return (
+        cfg.n_payloads % 32 == 0
+        and c in (1, 2, 4, 8, 16, 32)
+        and cfg.rate_limit_bytes_round is None
+        and cfg.sync_budget_bytes is None
+        and topo.loss == 0.0
+        and cfg.max_transmissions < 16
+    )
+
+
+def pack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """bool/u8[..., P] → u32[..., P/32], LSB-first within each word."""
+    *lead, p = x.shape
+    b = (x > 0).reshape(*lead, p // 32, 32).astype(U32)
+    return (b << jnp.arange(32, dtype=U32)).sum(axis=-1, dtype=U32)
+
+
+def unpack_bits(w: jnp.ndarray, p: int) -> jnp.ndarray:
+    """u32[..., W] → bool[..., P]."""
+    bits = (w[..., None] >> jnp.arange(32, dtype=U32)) & U32(1)
+    return bits.astype(jnp.bool_).reshape(*w.shape[:-1], p)
+
+
+# -- bitsliced 4-bit counters ------------------------------------------------
+
+
+class Planes(NamedTuple):
+    r0: jnp.ndarray
+    r1: jnp.ndarray
+    r2: jnp.ndarray
+    r3: jnp.ndarray
+
+    @property
+    def nonzero(self) -> jnp.ndarray:
+        return self.r0 | self.r1 | self.r2 | self.r3
+
+
+def planes_set(planes: Planes, where: jnp.ndarray, value: int) -> Planes:
+    """Set the counter to ``value`` (0..15) at every bit of ``where``."""
+    out = []
+    for k, plane in enumerate(planes):
+        bit = (value >> k) & 1
+        plane = (plane & ~where) | (where if bit else U32(0))
+        out.append(plane)
+    return Planes(*out)
+
+
+def planes_dec(planes: Planes, where: jnp.ndarray) -> Planes:
+    """Saturating decrement at every bit of ``where`` (ripple borrow);
+    callers guarantee where ⊆ nonzero, so saturation never triggers."""
+    r0, r1, r2, r3 = planes
+    borrow = where
+    n0 = r0 ^ borrow
+    borrow &= ~r0
+    n1 = r1 ^ borrow
+    borrow &= ~r1
+    n2 = r2 ^ borrow
+    borrow &= ~r2
+    n3 = r3 ^ borrow
+    return Planes(n0, n1, n2, n3)
+
+
+# -- chunk-group folds (all/any chunks of each version, packed) --------------
+
+
+def _fold_all(w: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Within every aligned c-bit group: all bits set ⇒ the group's LOW
+    bit is 1 in the result (other group bits undefined — mask after)."""
+    step = 1
+    while step < c:
+        w = w & (w >> step)
+        step *= 2
+    return w
+
+
+def _fold_any(w: jnp.ndarray, c: int) -> jnp.ndarray:
+    step = 1
+    while step < c:
+        w = w | (w >> step)
+        step *= 2
+    return w
+
+
+def _group_low_bits_mask(c: int) -> jnp.ndarray:
+    """u32 mask with bit set at every multiple of c (group low bits)."""
+    m = 0
+    for i in range(0, 32, c):
+        m |= 1 << i
+    return U32(m)
+
+
+def group_grid(w: jnp.ndarray, cfg: SimConfig, mode: str) -> jnp.ndarray:
+    """have-words [..., W] → bool[..., A, V] version grid (all/any chunks).
+
+    Payload index = (v * A + a) * C + c (version-major), so each (v, a)
+    owns C contiguous bits; with C a power of two ≤ 32 groups never
+    straddle words."""
+    c = cfg.chunks_per_version
+    fold = _fold_all if mode == "all" else _fold_any
+    low = fold(w, c) & _group_low_bits_mask(c)
+    # extract the 32/c group bits per word → [..., P/C] = [..., V*A]
+    groups_per_word = 32 // c
+    shifts = jnp.arange(0, 32, c, dtype=U32)
+    bits = (low[..., None] >> shifts) & U32(1)  # [..., W, 32/c]
+    va = bits.reshape(*w.shape[:-1], cfg.n_versions * cfg.n_writers)
+    grid = va.reshape(*w.shape[:-1], cfg.n_versions, cfg.n_writers)
+    return jnp.swapaxes(grid, -1, -2).astype(jnp.bool_)  # [..., A, V]
+
+
+def grid_to_words(x_av: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """bool[..., A, V] → u32 words [..., W] with each (v, a) group's C
+    bits all set where the grid is True (inverse of group_grid)."""
+    c = cfg.chunks_per_version
+    va = jnp.swapaxes(x_av, -1, -2).reshape(
+        *x_av.shape[:-2], cfg.n_versions * cfg.n_writers
+    )  # [..., V*A] in payload-group order
+    groups_per_word = 32 // c
+    g = va.reshape(*va.shape[:-1], va.shape[-1] // groups_per_word,
+                   groups_per_word).astype(U32)
+    shifts = jnp.arange(0, 32, c, dtype=U32)
+    low = (g << shifts).sum(axis=-1, dtype=U32)  # group low bits
+    # smear each group's low bit across its C bits
+    w = low
+    step = 1
+    while step < c:
+        w = w | (w << step)
+        step *= 2
+    return w
+
+
+# -- packed state ------------------------------------------------------------
+
+
+class PackedCarry(NamedTuple):
+    have: jnp.ndarray  # u32[N, W]
+    inflight: jnp.ndarray  # u32[D, N, W]
+    relay: Planes  # 4 × u32[N, W]
+
+
+def pack_state(state: SimState, cfg: SimConfig) -> PackedCarry:
+    relay = state.relay_left.astype(jnp.int32)
+    planes = Planes(*(
+        pack_bits((relay >> k) & 1) for k in range(4)
+    ))
+    return PackedCarry(
+        have=pack_bits(state.have),
+        inflight=pack_bits(state.inflight),
+        relay=planes,
+    )
+
+
+def unpack_into_state(carry: PackedCarry, state: SimState, cfg: SimConfig) -> SimState:
+    p = cfg.n_payloads
+    relay = sum(
+        unpack_bits(plane, p).astype(jnp.uint8) << k
+        for k, plane in enumerate(carry.relay)
+    )
+    return state._replace(
+        have=unpack_bits(carry.have, p).astype(jnp.uint8),
+        inflight=unpack_bits(carry.inflight, p).astype(jnp.uint8),
+        relay_left=relay.astype(jnp.uint8),
+    )
+
+
+# -- the packed phases -------------------------------------------------------
+
+
+def inject_packed(
+    carry: PackedCarry,
+    injected_p: jnp.ndarray,
+    t: jnp.ndarray,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    alive: jnp.ndarray,
+) -> Tuple[PackedCarry, jnp.ndarray]:
+    n = cfg.n_nodes
+    w = cfg.n_payloads // 32
+    injecting = (meta.round == t) & (alive[meta.actor] == ALIVE)  # [P]
+    inj_words = pack_bits(injecting)  # [W]
+    # scatter each payload's bit into its origin row: build [N, W] where
+    # row meta.actor[p] gets bit p.  Payloads share origin rows, so OR
+    # via segment: one-hot word contribution per payload is heavy; use
+    # the (actor, word) scatter over the P payloads instead.
+    word_idx = jnp.arange(cfg.n_payloads, dtype=jnp.int32) // 32
+    bit = (U32(1) << (jnp.arange(cfg.n_payloads, dtype=U32) % 32))
+    contrib = jnp.where(injecting, bit, U32(0))
+    own = jnp.zeros((n, w), U32)
+    # add == OR here: every payload owns a DISTINCT bit, so contributions
+    # landing on the same (actor, word) cell never overlap
+    own = own.at[meta.actor, word_idx].add(contrib)
+    newly = own & ~carry.have
+    have = carry.have | own
+    relay = planes_set(carry.relay, newly, cfg.max_transmissions)
+    return (
+        PackedCarry(have=have, inflight=carry.inflight, relay=relay),
+        injected_p | inj_words,
+    )
+
+
+def broadcast_packed(
+    carry: PackedCarry,
+    injected_p: jnp.ndarray,
+    state: SimState,
+    cfg: SimConfig,
+    topo: Topology,
+    region: jnp.ndarray,
+    key: jax.Array,
+) -> PackedCarry:
+    n = cfg.n_nodes
+    f = cfg.fanout
+    k_targets, _k_drop, k_ring0 = jax.random.split(key, 3)
+
+    eligible = carry.have & carry.relay.nonzero & injected_p[None, :]  # [N, W]
+
+    targets = sample_member_targets(state, cfg, k_targets, f)  # [N, F]
+    if cfg.ring0_first and topo.n_regions > 1:
+        me = jnp.arange(n, dtype=jnp.int32)
+        per = max(1, n // topo.n_regions)
+        start = region * per
+        size = jnp.where(
+            region == topo.n_regions - 1, n - start, per
+        ).astype(jnp.int32)
+        local = start + jax.random.randint(
+            k_ring0, (n,), 0, jnp.iinfo(jnp.int32).max
+        ) % jnp.maximum(size, 1)
+        ok_local = local != me
+        if cfg.couple_membership and cfg.swim_full_view:
+            from .state import DOWN
+
+            ok_local &= state.view[me, local] != DOWN
+        elif cfg.couple_membership and cfg.swim_partial_view:
+            from .state import DOWN
+
+            m = state.pid.shape[1]
+            bucket = local % m
+            known = state.pid[me, bucket] == local
+            ok_local &= known & (state.pkey[me, bucket] % 4 != DOWN)
+        targets = targets.at[:, 0].set(
+            jnp.where(ok_local, local, targets[:, 0])
+        )
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
+    dst = targets.reshape(-1)
+    ok = dst >= 0
+    dst = jnp.maximum(dst, 0)
+    ok &= edge_alive(state.group, state.alive, src, dst)
+    ok &= dst != src
+    delay = edge_delay(topo, region, src, dst)
+
+    sent = jnp.where(ok[:, None], eligible[src], U32(0))  # [E, W]
+
+    d_slots = carry.inflight.shape[0]
+    slot = (state.t + delay) % d_slots
+    flat_idx = slot * n + dst
+    inflight = carry.inflight.reshape(d_slots * n, -1)
+    # .at[].max == OR here? not for u32 words with differing bits — use
+    # a real OR scatter via bitwise accumulation: max is WRONG for
+    # packed words, so scatter-OR through index_add on disjoint... use
+    # jnp's scatter with `or` mode via segment trick: at[].apply is slow;
+    # instead: at[].max is wrong; at[].add overflows.  Use the supported
+    # scatter mode: jax.lax.scatter with or is not exposed — emulate by
+    # int32 bitwise trick: split into two scatters of 16-bit halves via
+    # max?  Simplest correct: at[flat_idx].max on each BIT PLANE is
+    # still wrong.  jnp.ndarray.at[].max works per ELEMENT (u32 compare)
+    # — not bitwise OR.  Use at[idx].set(current | value) is racy for
+    # duplicate indices.  The robust primitive: at[].add on one-hot is
+    # out.  => use at[].max on the BITWISE-EXPANDED representation is
+    # the dense path.  jax DOES expose at[].max/min/add/mul/set — and
+    # 'or' arrives via at[].max only for booleans.  For u32 words use
+    # the two-pass trick below instead.
+    inflight = _scatter_or(inflight, flat_idx, sent)
+    inflight = inflight.reshape(d_slots, n, -1)
+
+    any_edge_ok = ok.reshape(n, f).any(axis=1)
+    spent = eligible & jnp.where(any_edge_ok[:, None], ONES, U32(0))
+    relay = planes_dec(carry.relay, spent)
+    return PackedCarry(have=carry.have, inflight=inflight, relay=relay)
+
+
+def _scatter_or(table: jnp.ndarray, idx: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    """Exact OR-scatter of u32 words into table rows, duplicate indices
+    allowed.  jnp's at[].max is ARITHMETIC max — wrong for packed words
+    (max(0b01, 0b10) drops a bit) — and no public scatter exposes a
+    bitwise combiner.  OR does hold per BIT, so the scatter runs on the
+    boolean expansion: unpack updates to bool planes, one at[].max into
+    a bool view of the table, repack.  XLA fuses the unpack/repack into
+    the scatter's operand/result, so this costs about the DENSE bool
+    scatter — acceptable for the broadcast fan-out (random duplicate
+    destinations); regular-pattern callers (sync: exactly S edges per
+    source) must use _fold_or_regular instead, which stays packed."""
+    rows = table.shape[0]
+    w = table.shape[1]
+    tbl_bits = unpack_bits(table, w * 32).reshape(rows, w, 32)
+    upd_bits = unpack_bits(words, w * 32).reshape(words.shape[0], w, 32)
+    tbl_bits = tbl_bits.at[idx].max(upd_bits)
+    packed = (
+        tbl_bits.astype(U32) << jnp.arange(32, dtype=U32)[None, None, :]
+    ).sum(axis=2, dtype=U32)
+    return packed
+
+
+def _fold_or_regular(words: jnp.ndarray, n: int, per: int) -> jnp.ndarray:
+    """OR-reduce [n*per, W] edge words to [n, W] — the regular pattern
+    where edge e belongs to source e // per.  Pure reshape + OR-reduce:
+    no scatter, fully packed."""
+    w = words.shape[-1]
+    grouped = words.reshape(n, per, w)
+    out = grouped[:, 0]
+    for k in range(1, per):  # per is small & static (sync_peers)
+        out = out | grouped[:, k]
+    return out
+
+
+def deliver_packed(
+    carry: PackedCarry, t: jnp.ndarray, cfg: SimConfig
+) -> PackedCarry:
+    d_slots = carry.inflight.shape[0]
+    slot = t % d_slots
+    arriving = carry.inflight[slot]  # [N, W]
+    newly = arriving & ~carry.have
+    have = carry.have | arriving
+    relay = planes_set(carry.relay, newly, max(cfg.max_transmissions - 1, 1))
+    inflight = carry.inflight.at[slot].set(U32(0))
+    return PackedCarry(have=have, inflight=inflight, relay=relay)
+
+
+def sync_packed(
+    carry: PackedCarry,
+    state: SimState,
+    cfg: SimConfig,
+    topo: Topology,
+    key: jax.Array,
+) -> Tuple[PackedCarry, jnp.ndarray]:
+    """Anti-entropy on packed words: needs computed from the SAME
+    advertised gap/head tensors as the dense path (state.heads/gap_lo/
+    gap_hi), grants as word masks."""
+    from .gaps import gaps_to_mask
+
+    n = cfg.n_nodes
+    s = cfg.sync_peers
+    k_peers, _k_drop, k_rearm = jax.random.split(key, 3)
+
+    due = state.sync_countdown <= 0
+
+    peers = sample_member_targets(state, cfg, k_peers, s)
+    src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s)
+    dst = peers.reshape(-1)
+    ok = dst >= 0
+    dst = jnp.maximum(dst, 0)
+    ok &= edge_alive(state.group, state.alive, src, dst)
+    ok &= due[src]
+    ok &= dst != src
+
+    v = cfg.n_versions
+    v_idx = jnp.arange(1, v + 1, dtype=jnp.int32)
+    miss_full = gaps_to_mask(state.gap_lo, state.gap_hi, v)  # [N, A, V]
+    below_head = v_idx[None, None, :] <= state.heads[:, :, None]
+    comp = group_grid(carry.have, cfg, "all")  # [N, A, V]
+    partial = below_head & ~miss_full & ~comp
+    haves = below_head & ~miss_full & comp
+
+    full_need = miss_full[src] & haves[dst]
+    partial_need = partial[src] & (haves[dst] | partial[dst])
+    catchup = (v_idx[None, None, :] > state.heads[src][:, :, None]) & (
+        v_idx[None, None, :] <= state.heads[dst][:, :, None]
+    )
+    wanted = full_need | partial_need | catchup  # [E, A, V]
+    wanted_w = grid_to_words(wanted, cfg)  # [E, W]
+    need = wanted_w & carry.have[dst] & ~carry.have[src]
+    need &= jnp.where(ok[:, None], ONES, U32(0))
+
+    # pulls land at the PULLER (src): exactly S edges per source in a
+    # regular layout, so the OR-reduce is a packed fold — no scatter
+    pulled = _fold_or_regular(need, n, s)  # [N, W]
+    d_slots = carry.inflight.shape[0]
+    slot = (state.t + 1) % d_slots
+    inflight = carry.inflight.at[slot].set(carry.inflight[slot] | pulled)
+
+    rearm = jax.random.randint(
+        k_rearm, (n,), 1, cfg.sync_interval_rounds + 1, jnp.int32
+    )
+    countdown = jnp.where(due, rearm, state.sync_countdown - 1)
+    return (
+        PackedCarry(have=carry.have, inflight=inflight, relay=carry.relay),
+        countdown,
+    )
