@@ -24,10 +24,15 @@ class Result:
 
 
 class PgClientError(Exception):
-    def __init__(self, code: str, message: str):
+    def __init__(self, code: str, message: str, position: int = 0,
+                 fields: Optional[dict] = None):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
+        # 1-based char index from the ErrorResponse `P` field (0 = none)
+        self.position = position
+        # all raw ErrorResponse fields by tag char (S/V/C/M/P/...)
+        self.fields = fields or {}
 
 
 class PgClient:
@@ -77,7 +82,8 @@ class PgClient:
             if tag == b"E":
                 fields = _error_fields(body)
                 error = error or PgClientError(
-                    fields.get("C", "?????"), fields.get("M", "")
+                    fields.get("C", "?????"), fields.get("M", ""),
+                    position=int(fields.get("P", 0) or 0), fields=fields,
                 )
             elif tag == b"T":
                 current = Result(tag="", columns=_columns(body))

@@ -363,14 +363,22 @@ def parameter_description(oids: Sequence[int]) -> bytes:
     return _frame(b"t", struct.pack(f"!h{len(oids)}i", len(oids), *oids))
 
 
-def error_response(sqlstate: str, message: str, severity: str = "ERROR") -> bytes:
+def error_response(
+    sqlstate: str, message: str, severity: str = "ERROR",
+    position: int = 0,
+) -> bytes:
+    """ErrorResponse frame.  ``position`` is the 1-based character index
+    into the original query string (PG's `P` field, which psql uses to
+    point its error caret); 0 = no position."""
     body = (
         b"S" + severity.encode() + b"\x00"
         + b"V" + severity.encode() + b"\x00"
         + b"C" + sqlstate.encode() + b"\x00"
         + b"M" + message.encode("utf-8", "replace") + b"\x00"
-        + b"\x00"
     )
+    if position > 0:
+        body += b"P" + str(position).encode() + b"\x00"
+    body += b"\x00"
     return _frame(b"E", body)
 
 

@@ -22,10 +22,12 @@ log = logging.getLogger("corrosion_tpu.pg")
 
 
 class PgError(Exception):
-    def __init__(self, code: str, message: str):
+    def __init__(self, code: str, message: str, position: int = 0):
         super().__init__(message)
         self.code = code
         self.message = message
+        # 1-based char index into the query (ErrorResponse `P` field)
+        self.position = position
 
 
 def _to_pg_error(e: Exception) -> PgError:
@@ -34,7 +36,9 @@ def _to_pg_error(e: Exception) -> PgError:
     if isinstance(e, PgError):
         return e
     if isinstance(e, tr.ParseError):
-        return PgError(sql_state.SYNTAX_ERROR, str(e))
+        pos = getattr(e, "pos", -1)
+        return PgError(sql_state.SYNTAX_ERROR, str(e),
+                       position=pos + 1 if pos >= 0 else 0)
     if isinstance(e, tr.UnknownConstraint):
         return PgError(sql_state.UNDEFINED_OBJECT, str(e))
     if isinstance(e, tr.UnsupportedStatement):
@@ -204,7 +208,9 @@ class _Session:
             return True
 
     async def _send_error(self, e: PgError, msg) -> None:
-        self.writer.write(p.error_response(e.code, e.message))
+        self.writer.write(
+            p.error_response(e.code, e.message, position=e.position)
+        )
         if self.tx is not None:
             self.tx_failed = True
         if isinstance(msg, p.Query):
@@ -271,18 +277,23 @@ class _Session:
     # -- simple query ----------------------------------------------------
 
     async def _simple_query(self, sql: str):
-        stmts = tr.split_statements(sql)
+        stmts = tr.split_statements_with_offsets(sql)
         if not stmts:
             self.writer.write(p.empty_query_response())
             self.writer.write(p.ready_for_query(self._status))
             return
-        for stmt in stmts:
+        for stmt, offset in stmts:
             try:
                 t = tr.translate(stmt, self._constraint_resolver)
                 await self._run_statement(t, (), (), describe_rows=True)
             except Exception as e:
                 err = _to_pg_error(e)
-                self.writer.write(p.error_response(err.code, err.message))
+                # err.position indexes the split statement; the P field
+                # must index the query string the client sent
+                pos = err.position + offset if err.position > 0 else 0
+                self.writer.write(
+                    p.error_response(err.code, err.message, position=pos)
+                )
                 if self.tx is not None:
                     self.tx_failed = True
                 break

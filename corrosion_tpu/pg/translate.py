@@ -40,7 +40,8 @@ from .parser import (
 
 __all__ = [
     "Translated", "UnsupportedStatement", "UnknownConstraint", "ParseError",
-    "classify", "split_statements", "translate", "session_statement",
+    "classify", "split_statements", "split_statements_with_offsets",
+    "translate", "session_statement",
 ]
 
 
@@ -123,26 +124,40 @@ def _tag_kind(st: Statement, raw: str) -> Tuple[str, str]:
     return st.verb, st.kind
 
 
-def split_statements(sql: str) -> List[str]:
+def split_statements_with_offsets(sql: str) -> List[tuple]:
     """Split a simple-Query batch on top-level semicolons — via the real
-    lexer, so dollar-quoted strings and nested comments split correctly."""
+    lexer, so dollar-quoted strings and nested comments split correctly.
+    Returns (statement, offset) pairs where ``offset`` is the statement's
+    0-based char index in the ORIGINAL string, so parse-error positions
+    can be reported against the query the client actually sent (the PG
+    `P` field indexes the full query, not the split substring)."""
     try:
         toks = tokenize(sql)
     except ParseError:
-        return [sql.strip()] if sql.strip() else []
-    out: List[str] = []
+        stripped = sql.strip()
+        if not stripped:
+            return []
+        return [(stripped, len(sql) - len(sql.lstrip()))]
+    out: List[tuple] = []
     start = 0
+
+    def push(end: int) -> None:
+        seg = sql[start:end]
+        stmt = seg.strip()
+        if stmt:
+            out.append((stmt, start + len(seg) - len(seg.lstrip())))
+
     for t in toks:
         if t.kind == PUNCT and t.value == ";":
-            stmt = sql[start : t.pos].strip()
-            if stmt:
-                out.append(stmt)
+            push(t.pos)
             start = t.pos + 1
         elif t.kind == EOF:
-            stmt = sql[start : t.pos].strip()
-            if stmt:
-                out.append(stmt)
+            push(t.pos)
     return out
+
+
+def split_statements(sql: str) -> List[str]:
+    return [s for s, _ in split_statements_with_offsets(sql)]
 
 
 def translate(

@@ -10,7 +10,10 @@ test_packed_equivalence.py compares round-by-round bit-for-bit):
 
 - ``have_p[N, W] u32`` — W = P/32 words, payload p lives at word p//32
   bit p%32 (LSB-first);
-- ``inflight_p[D, N, W] u32`` — the delay ring, bitwise-OR merged;
+- ``inflight[D, N, P] u8`` — the delay ring stays DENSE: it is the
+  broadcast scatter's target and XLA has no fast bitwise-OR scatter on
+  words (see PackedCarry docstring); the ring boundary pays one
+  pack/unpack per round instead;
 - ``relay planes r0..r3[N, W] u32`` — the 0..15 retransmission counter
   BITSLICED: bit b of plane k is bit k of payload b's counter.
   Decrement-where-mask is 4 bitwise ops of ripple borrow; "counter > 0"
@@ -44,7 +47,9 @@ ONES = jnp.uint32(0xFFFFFFFF)
 def packed_supported(cfg: SimConfig, topo: Topology) -> bool:
     c = cfg.chunks_per_version
     return (
-        cfg.n_payloads % 32 == 0
+        cfg.allow_packed
+        and cfg.n_nodes * cfg.n_payloads >= cfg.packed_min_cells
+        and cfg.n_payloads % 32 == 0
         and c in (1, 2, 4, 8, 16, 32)
         and cfg.rate_limit_bytes_round is None
         and cfg.sync_budget_bytes is None
@@ -164,21 +169,24 @@ def grid_to_words(x_av: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
                    groups_per_word).astype(U32)
     shifts = jnp.arange(0, 32, c, dtype=U32)
     low = (g << shifts).sum(axis=-1, dtype=U32)  # group low bits
-    # smear each group's low bit across its C bits
-    w = low
-    step = 1
-    while step < c:
-        w = w | (w << step)
-        step *= 2
-    return w
+    return _smear_groups(low, c)
 
 
 # -- packed state ------------------------------------------------------------
 
 
 class PackedCarry(NamedTuple):
+    """Hybrid carry: ``have``/``relay`` ride as u32 words (8× less HBM
+    traffic on the elementwise-heavy fields), but the ``inflight`` delay
+    ring stays DENSE u8 — it is the target of the broadcast fan-out
+    scatter, and a bitwise-OR scatter on packed words has no cheap XLA
+    primitive (at[].max is arithmetic max, wrong for words; the bool-
+    plane expansion measured 7× slower than the plain u8 scatter).  The
+    u8 ring keeps the dense path's proven scatter and pays one
+    pack/unpack per round at the ring boundary instead."""
+
     have: jnp.ndarray  # u32[N, W]
-    inflight: jnp.ndarray  # u32[D, N, W]
+    inflight: jnp.ndarray  # u8[D, N, P] — dense, see docstring
     relay: Planes  # 4 × u32[N, W]
 
 
@@ -189,7 +197,7 @@ def pack_state(state: SimState, cfg: SimConfig) -> PackedCarry:
     ))
     return PackedCarry(
         have=pack_bits(state.have),
-        inflight=pack_bits(state.inflight),
+        inflight=state.inflight,
         relay=planes,
     )
 
@@ -202,7 +210,7 @@ def unpack_into_state(carry: PackedCarry, state: SimState, cfg: SimConfig) -> Si
     )
     return state._replace(
         have=unpack_bits(carry.have, p).astype(jnp.uint8),
-        inflight=unpack_bits(carry.inflight, p).astype(jnp.uint8),
+        inflight=carry.inflight,
         relay_left=relay.astype(jnp.uint8),
     )
 
@@ -291,56 +299,24 @@ def broadcast_packed(
     ok &= dst != src
     delay = edge_delay(topo, region, src, dst)
 
-    sent = jnp.where(ok[:, None], eligible[src], U32(0))  # [E, W]
+    # the ring is dense u8 (PackedCarry docstring): unpack the eligible
+    # words once, then the fan-out scatter is the dense path's plain
+    # at[].max — the only correct-and-fast OR scatter XLA offers
+    p = cfg.n_payloads
+    elig8 = unpack_bits(eligible, p).astype(carry.inflight.dtype)  # [N, P]
+    sent = jnp.where(ok[:, None], elig8[src], jnp.uint8(0))  # [E, P]
 
     d_slots = carry.inflight.shape[0]
     slot = (state.t + delay) % d_slots
     flat_idx = slot * n + dst
-    inflight = carry.inflight.reshape(d_slots * n, -1)
-    # .at[].max == OR here? not for u32 words with differing bits — use
-    # a real OR scatter via bitwise accumulation: max is WRONG for
-    # packed words, so scatter-OR through index_add on disjoint... use
-    # jnp's scatter with `or` mode via segment trick: at[].apply is slow;
-    # instead: at[].max is wrong; at[].add overflows.  Use the supported
-    # scatter mode: jax.lax.scatter with or is not exposed — emulate by
-    # int32 bitwise trick: split into two scatters of 16-bit halves via
-    # max?  Simplest correct: at[flat_idx].max on each BIT PLANE is
-    # still wrong.  jnp.ndarray.at[].max works per ELEMENT (u32 compare)
-    # — not bitwise OR.  Use at[idx].set(current | value) is racy for
-    # duplicate indices.  The robust primitive: at[].add on one-hot is
-    # out.  => use at[].max on the BITWISE-EXPANDED representation is
-    # the dense path.  jax DOES expose at[].max/min/add/mul/set — and
-    # 'or' arrives via at[].max only for booleans.  For u32 words use
-    # the two-pass trick below instead.
-    inflight = _scatter_or(inflight, flat_idx, sent)
-    inflight = inflight.reshape(d_slots, n, -1)
+    inflight = carry.inflight.reshape(d_slots * n, p)
+    inflight = inflight.at[flat_idx].max(sent)
+    inflight = inflight.reshape(d_slots, n, p)
 
     any_edge_ok = ok.reshape(n, f).any(axis=1)
     spent = eligible & jnp.where(any_edge_ok[:, None], ONES, U32(0))
     relay = planes_dec(carry.relay, spent)
     return PackedCarry(have=carry.have, inflight=inflight, relay=relay)
-
-
-def _scatter_or(table: jnp.ndarray, idx: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
-    """Exact OR-scatter of u32 words into table rows, duplicate indices
-    allowed.  jnp's at[].max is ARITHMETIC max — wrong for packed words
-    (max(0b01, 0b10) drops a bit) — and no public scatter exposes a
-    bitwise combiner.  OR does hold per BIT, so the scatter runs on the
-    boolean expansion: unpack updates to bool planes, one at[].max into
-    a bool view of the table, repack.  XLA fuses the unpack/repack into
-    the scatter's operand/result, so this costs about the DENSE bool
-    scatter — acceptable for the broadcast fan-out (random duplicate
-    destinations); regular-pattern callers (sync: exactly S edges per
-    source) must use _fold_or_regular instead, which stays packed."""
-    rows = table.shape[0]
-    w = table.shape[1]
-    tbl_bits = unpack_bits(table, w * 32).reshape(rows, w, 32)
-    upd_bits = unpack_bits(words, w * 32).reshape(words.shape[0], w, 32)
-    tbl_bits = tbl_bits.at[idx].max(upd_bits)
-    packed = (
-        tbl_bits.astype(U32) << jnp.arange(32, dtype=U32)[None, None, :]
-    ).sum(axis=2, dtype=U32)
-    return packed
 
 
 def _fold_or_regular(words: jnp.ndarray, n: int, per: int) -> jnp.ndarray:
@@ -360,12 +336,158 @@ def deliver_packed(
 ) -> PackedCarry:
     d_slots = carry.inflight.shape[0]
     slot = t % d_slots
-    arriving = carry.inflight[slot]  # [N, W]
+    arriving = pack_bits(carry.inflight[slot])  # u8[N, P] → u32[N, W]
     newly = arriving & ~carry.have
     have = carry.have | arriving
     relay = planes_set(carry.relay, newly, max(cfg.max_transmissions - 1, 1))
-    inflight = carry.inflight.at[slot].set(U32(0))
+    inflight = carry.inflight.at[slot].set(jnp.uint8(0))
     return PackedCarry(have=have, inflight=inflight, relay=relay)
+
+
+def shrink_state(state: SimState) -> SimState:
+    """Zero-width payload-axis tensors: the packed while_loop carries the
+    PackedCarry instead, so the dense [N, P]/[D, N, P] arrays must not
+    ride the loop carry (they'd cost the HBM traffic packing removes).
+    SWIM/sync/sampling only read membership + bookkeeping fields, which
+    stay full-size."""
+    n = state.have.shape[0]
+    d = state.inflight.shape[0]
+    u8 = state.have.dtype
+    return state._replace(
+        have=jnp.zeros((n, 0), u8),
+        injected=jnp.zeros((0,), u8),
+        relay_left=jnp.zeros((n, 0), u8),
+        inflight=jnp.zeros((d, n, 0), u8),
+    )
+
+
+def packed_round_step(
+    state: SimState,
+    carry: PackedCarry,
+    injected_p: jnp.ndarray,
+    metrics,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    region: jnp.ndarray,
+):
+    """One gossip tick on packed words — phase-for-phase and PRNG-stream
+    identical to `round.round_step` (inject → broadcast → sync → deliver →
+    SWIM → bookkeeping refresh → convergence record); tests/sim/
+    test_packed_equivalence.py holds the two bit-for-bit equal."""
+    from .gaps import extract_gaps
+    from .round import RunMetrics
+    from .state import grid_to_payload, version_heads
+
+    key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
+    state = state._replace(key=key)
+
+    carry, injected_p = inject_packed(
+        carry, injected_p, state.t, meta, cfg, state.alive
+    )
+    carry = broadcast_packed(
+        carry, injected_p, state, cfg, topo, region, k_bcast
+    )
+    carry, countdown = sync_packed(carry, state, cfg, topo, k_sync)
+    state = state._replace(sync_countdown=countdown)
+    carry = deliver_packed(carry, state.t, cfg)
+
+    from .swim import swim_step
+
+    state = swim_step(state, cfg, topo, k_swim)
+
+    touched = group_grid(carry.have, cfg, "any")  # [N, A, V]
+    heads = version_heads(touched)
+    gaps = extract_gaps(touched, heads, cfg)
+    state = state._replace(heads=heads, gap_lo=gaps.lo, gap_hi=gaps.hi)
+    overflow_frac = jnp.maximum(
+        metrics.overflow_frac, gaps.overflow.mean(dtype=jnp.float32)
+    )
+
+    up = state.alive == ALIVE
+    comp = group_grid(carry.have, cfg, "all")  # [N, A, V]
+    act = group_grid(injected_p, cfg, "any")  # [A, V]
+    version_done = jnp.all(comp | ~up[:, None, None], axis=0) & act
+    payload_done = grid_to_payload(version_done, cfg)
+    coverage_at = jnp.where(
+        (metrics.coverage_at < 0) & payload_done, state.t, metrics.coverage_at
+    )
+    node_done = jnp.all(comp | ~act[None], axis=(1, 2)) & up
+    all_injected = jnp.all(meta.round <= state.t)
+    converged_at = jnp.where(
+        (metrics.converged_at < 0) & node_done & all_injected,
+        state.t,
+        metrics.converged_at,
+    )
+
+    state = state._replace(t=state.t + 1)
+    return state, carry, injected_p, RunMetrics(
+        coverage_at=coverage_at,
+        converged_at=converged_at,
+        overflow_frac=overflow_frac,
+    )
+
+
+def run_packed(
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    max_rounds: int,
+):
+    """Packed-carry `run_to_convergence` body: pack once, loop on u32
+    words, unpack once at the end.  Returns the same (SimState,
+    RunMetrics) as the dense loop — bit-identical over the supported
+    envelope.  Called from round.run_to_convergence under jit when
+    `packed_supported(cfg, topo)`; not jitted itself."""
+    from .round import new_metrics
+    from .topology import regions
+
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+    carry0 = pack_state(state, cfg)
+    injected0 = pack_bits(state.injected)
+    slim = shrink_state(state)
+
+    def cond(c):
+        s, _carry, _inj, m = c
+        all_injected = jnp.all(meta.round <= s.t)
+        done = all_injected & jnp.all(
+            (m.converged_at >= 0) | (s.alive != ALIVE)
+        )
+        return (s.t < max_rounds) & ~done
+
+    def body(c):
+        s, carry, inj, m = c
+        return packed_round_step(s, carry, inj, m, meta, cfg, topo, region)
+
+    slim, carry, inj, metrics = jax.lax.while_loop(
+        cond, body, (slim, carry0, injected0, metrics)
+    )
+    full = unpack_into_state(carry, slim, cfg)
+    full = full._replace(
+        injected=unpack_bits(inj, cfg.n_payloads).astype(full.have.dtype)
+    )
+    return full, metrics
+
+
+def _smear_groups(low: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Broadcast each aligned c-bit group's LOW bit across the group."""
+    w = low
+    step = 1
+    while step < c:
+        w = w | (w << step)
+        step *= 2
+    return w
+
+
+def all_chunks_words(have_w: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """u32[..., W] word mask: every bit of version v's C-bit group set
+    iff ALL of v's chunks are held — `complete_versions` as group-uniform
+    words, no [..., A, V] grid round-trip."""
+    c = cfg.chunks_per_version
+    low = _fold_all(have_w, c) & _group_low_bits_mask(c)
+    return _smear_groups(low, c)
 
 
 def sync_packed(
@@ -377,7 +499,12 @@ def sync_packed(
 ) -> Tuple[PackedCarry, jnp.ndarray]:
     """Anti-entropy on packed words: needs computed from the SAME
     advertised gap/head tensors as the dense path (state.heads/gap_lo/
-    gap_hi), grants as word masks."""
+    gap_hi), but factored into per-NODE group-uniform word masks first —
+    the per-edge work is then eight u32 gathers + bitwise ops on
+    [E, W] words, never an [E, A, V] grid (the dense kernel's hottest
+    tensor).  Group-uniformity (every chunk bit of a version carries the
+    version's value) makes the word algebra exactly `edge_needs`:
+    full/partial/head-catchup classes per sync.rs:127-249."""
     from .gaps import gaps_to_mask
 
     n = cfg.n_nodes
@@ -399,26 +526,31 @@ def sync_packed(
     v_idx = jnp.arange(1, v + 1, dtype=jnp.int32)
     miss_full = gaps_to_mask(state.gap_lo, state.gap_hi, v)  # [N, A, V]
     below_head = v_idx[None, None, :] <= state.heads[:, :, None]
-    comp = group_grid(carry.have, cfg, "all")  # [N, A, V]
-    partial = below_head & ~miss_full & ~comp
-    haves = below_head & ~miss_full & comp
+    # node-level word masks (all group-uniform by construction)
+    miss_w = grid_to_words(miss_full, cfg)  # [N, W]
+    below_w = grid_to_words(below_head, cfg)  # [N, W]
+    comp_w = all_chunks_words(carry.have, cfg)  # [N, W]
+    haves_w = below_w & ~miss_w & comp_w
+    partial_w = below_w & ~miss_w & ~comp_w
 
-    full_need = miss_full[src] & haves[dst]
-    partial_need = partial[src] & (haves[dst] | partial[dst])
-    catchup = (v_idx[None, None, :] > state.heads[src][:, :, None]) & (
-        v_idx[None, None, :] <= state.heads[dst][:, :, None]
-    )
-    wanted = full_need | partial_need | catchup  # [E, A, V]
-    wanted_w = grid_to_words(wanted, cfg)  # [E, W]
-    need = wanted_w & carry.have[dst] & ~carry.have[src]
+    wanted = (
+        (miss_w[src] & haves_w[dst])  # full needs
+        | (partial_w[src] & (haves_w[dst] | partial_w[dst]))  # partial
+        | (~below_w[src] & below_w[dst])  # head catch-up
+    )  # [E, W]
+    need = wanted & carry.have[dst] & ~carry.have[src]
     need &= jnp.where(ok[:, None], ONES, U32(0))
 
     # pulls land at the PULLER (src): exactly S edges per source in a
-    # regular layout, so the OR-reduce is a packed fold — no scatter
+    # regular layout, so the OR-reduce is a packed fold — no scatter;
+    # the dense u8 ring takes the pulls after one unpack
     pulled = _fold_or_regular(need, n, s)  # [N, W]
+    pulled8 = unpack_bits(pulled, cfg.n_payloads).astype(carry.inflight.dtype)
     d_slots = carry.inflight.shape[0]
     slot = (state.t + 1) % d_slots
-    inflight = carry.inflight.at[slot].set(carry.inflight[slot] | pulled)
+    inflight = carry.inflight.at[slot].set(
+        jnp.maximum(carry.inflight[slot], pulled8)
+    )
 
     rearm = jax.random.randint(
         k_rearm, (n,), 1, cfg.sync_interval_rounds + 1, jnp.int32

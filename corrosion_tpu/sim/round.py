@@ -136,7 +136,20 @@ def run_to_convergence(
     max_rounds: int = 1000,
 ) -> Tuple[SimState, RunMetrics]:
     """Advance rounds until every up node holds every payload (the
-    check_bookkeeping.py property: need == 0 ∧ equal heads) or max_rounds."""
+    check_bookkeeping.py property: need == 0 ∧ equal heads) or max_rounds.
+
+    Over the bitpack envelope (P % 32 == 0, power-of-two chunking,
+    statically unmetered budgets, zero loss — `packed.packed_supported`)
+    the loop runs on u32-packed payload words instead: 8× less HBM
+    traffic on the hot carries, bit-identical results
+    (tests/sim/test_packed_equivalence.py).  cfg/topo are static args,
+    so the dispatch is a trace-time Python branch — one path compiles.
+    """
+    from .packed import packed_supported, run_packed
+
+    validate(cfg, topo)
+    if packed_supported(cfg, topo):
+        return run_packed(state, meta, cfg, topo, max_rounds)
     region = regions(cfg.n_nodes, topo.n_regions)
     metrics = new_metrics(cfg)
 

@@ -111,6 +111,16 @@ class SimConfig:
     ring0_first: bool = True
     # latency model: delivery delay in rounds per latency class
     n_delay_slots: int = 4
+    # opt-out of the bitpacked round (sim/packed.py) even when the
+    # scenario fits its envelope — a SimConfig field (not an env var) so
+    # the choice is part of the jit cache key; the bench A/B rung flips
+    # it to measure packed-vs-dense on identical scenarios
+    allow_packed: bool = True
+    # minimum n_nodes*n_payloads before the packed round dispatches: the
+    # pack/unpack boundary has per-round fixed cost, so packing only wins
+    # once the payload tensors are HBM-sized (measured CPU A/B r4:
+    # 0.79x at 8k×512=4M cells, 1.20x at 100k×512=51M); tests force 0
+    packed_min_cells: int = 1 << 24
     # payload byte size assumed when metadata gives none
     default_payload_bytes: int = 8 * 1024
 

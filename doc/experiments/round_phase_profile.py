@@ -1,0 +1,141 @@
+"""Per-phase round-cost profile at headline storm shape (round 4).
+
+Times each phase of the dense and packed rounds separately (jitted,
+block_until_ready) to locate where the 100k-node round actually spends
+its wall — the end-to-end A/B showed packed 0.74x on CPU despite the
+primitive spike's wins, so the phase breakdown decides where packing
+pays and where it costs.
+
+Run: JAX_PLATFORMS=cpu python doc/experiments/round_phase_profile.py [n_nodes]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from corrosion_tpu.sim import packed as pk  # noqa: E402
+from corrosion_tpu.sim.broadcast import (  # noqa: E402
+    broadcast_step,
+    deliver_step,
+    inject_step,
+)
+from corrosion_tpu.sim.gaps import extract_gaps  # noqa: E402
+from corrosion_tpu.sim.round import new_sim  # noqa: E402
+from corrosion_tpu.sim.runner import _write_storm  # noqa: E402
+from corrosion_tpu.sim.state import (  # noqa: E402
+    touched_versions,
+    version_heads,
+)
+from corrosion_tpu.sim.swim import swim_step  # noqa: E402
+from corrosion_tpu.sim.sync import sync_step  # noqa: E402
+from corrosion_tpu.sim.topology import regions  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+REPS = 5
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    ms = (time.monotonic() - t0) / REPS * 1e3
+    print(f"{name:30s} {ms:9.2f} ms")
+    return ms
+
+
+def main():
+    cfg, meta = _write_storm(N, 512)
+    topo = __import__("corrosion_tpu.sim.topology", fromlist=["Topology"]).Topology()
+    region = regions(cfg.n_nodes, topo.n_regions)
+    state = new_sim(cfg, 0)
+    key = jax.random.PRNGKey(42)
+
+    # advance a few rounds so tensors are non-trivial
+    from corrosion_tpu.sim.round import new_metrics, round_step
+
+    @jax.jit
+    def warm(state, metrics):
+        for _ in range(4):
+            state, metrics = round_step(state, metrics, meta, cfg, topo, region)
+        return state, metrics
+
+    state, _ = warm(state, new_metrics(cfg))
+    jax.block_until_ready(state.t)
+
+    print(f"== dense phases, N={N} ==")
+    d = {}
+    d["inject"] = timeit("inject", jax.jit(lambda s: inject_step(s, meta, cfg)), state)
+    d["broadcast"] = timeit(
+        "broadcast",
+        jax.jit(lambda s, k: broadcast_step(s, meta, cfg, topo, region, k)),
+        state, key,
+    )
+    d["sync"] = timeit(
+        "sync", jax.jit(lambda s, k: sync_step(s, meta, cfg, topo, k)), state, key
+    )
+    d["deliver"] = timeit("deliver", jax.jit(lambda s: deliver_step(s, cfg)), state)
+    d["swim"] = timeit(
+        "swim", jax.jit(lambda s, k: swim_step(s, cfg, topo, k)), state, key
+    )
+
+    def book(s):
+        touched = touched_versions(s.have, cfg)
+        heads = version_heads(touched)
+        gaps = extract_gaps(touched, heads, cfg)
+        return heads, gaps
+
+    d["bookkeeping"] = timeit("bookkeeping+gaps", jax.jit(book), state)
+    print(f"dense total {sum(d.values()):9.2f} ms")
+
+    print(f"\n== packed phases, N={N} ==")
+    carry = jax.jit(lambda s: pk.pack_state(s, cfg))(state)
+    injected_p = jax.jit(pk.pack_bits)(state.injected)
+    slim = pk.shrink_state(state)
+    q = {}
+    q["inject"] = timeit(
+        "inject",
+        jax.jit(lambda c, i, s: pk.inject_packed(c, i, s.t, meta, cfg, s.alive)),
+        carry, injected_p, slim,
+    )
+    q["broadcast"] = timeit(
+        "broadcast",
+        jax.jit(lambda c, i, s, k: pk.broadcast_packed(c, i, s, cfg, topo, region, k)),
+        carry, injected_p, slim, key,
+    )
+    q["sync"] = timeit(
+        "sync",
+        jax.jit(lambda c, s, k: pk.sync_packed(c, s, cfg, topo, k)),
+        carry, slim, key,
+    )
+    q["deliver"] = timeit(
+        "deliver",
+        jax.jit(lambda c, s: pk.deliver_packed(c, s.t, cfg)),
+        carry, slim,
+    )
+    q["swim"] = timeit(
+        "swim", jax.jit(lambda s, k: swim_step(s, cfg, topo, k)), slim, key
+    )
+
+    def bookp(c):
+        touched = pk.group_grid(c.have, cfg, "any")
+        heads = version_heads(touched)
+        gaps = extract_gaps(touched, heads, cfg)
+        return heads, gaps
+
+    q["bookkeeping"] = timeit("bookkeeping+gaps", jax.jit(bookp), carry)
+    print(f"packed total {sum(q.values()):9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""TPU health probe + retry log (VERDICT r03 item 1 evidence trail).
+
+Runs one bounded bench_child preflight against the default (TPU) platform
+and appends a timestamped JSON line to ``doc/experiments/TPU_RETRY_r04.jsonl``.
+The judge asked for either a healthy-chip capture or an auditable retry log
+with <=30 min cadence; this script is the logger for the latter and the
+trigger condition for the former (exit code 0 == chip healthy).
+
+Usage: python doc/experiments/tpu_probe.py [timeout_seconds]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+LOG = os.path.join(REPO, "doc", "experiments", "TPU_RETRY_r04.jsonl")
+
+
+def probe(timeout: float = 180.0) -> dict:
+    out = tempfile.mktemp(suffix=".json")
+    spec = {"mode": "preflight", "out": out}
+    t0 = time.time()
+    rec: dict = {"ts_unix": t0, "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
+                 "timeout_s": timeout}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench_child.py"), json.dumps(spec)],
+            timeout=timeout, capture_output=True, text=True, cwd=REPO,
+        )
+        rec["elapsed_s"] = round(time.time() - t0, 2)
+        rec["returncode"] = proc.returncode
+        try:
+            with open(out) as f:
+                child = json.load(f)
+            rec["ok"] = bool(child.get("ok"))
+            rec["platform"] = child.get("platform")
+            rec["detail"] = {k: v for k, v in child.items() if k not in ("ok", "platform")}
+        except (OSError, json.JSONDecodeError):
+            rec["ok"] = False
+            rec["error"] = "no result file"
+            if proc.stderr:
+                rec["stderr_tail"] = proc.stderr[-500:]
+    except subprocess.TimeoutExpired:
+        rec["elapsed_s"] = round(time.time() - t0, 2)
+        rec["ok"] = False
+        rec["error"] = f"timeout after {timeout}s (wedged tunnel)"
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+if __name__ == "__main__":
+    t = float(sys.argv[1]) if len(sys.argv) > 1 else 180.0
+    r = probe(t)
+    print(json.dumps(r))
+    sys.exit(0 if r.get("ok") and r.get("platform") == "tpu" else 1)

@@ -1,0 +1,175 @@
+"""SQLSTATE fidelity (VERDICT r3 item 5).
+
+The reference carries the complete PG error-code space
+(corro-pg/src/sql_state.rs:1-1336) because client libraries match on
+codes — psycopg's ``errors.lookup(code)`` resolves a code to an
+exception class via exactly this table.  psycopg itself isn't in the
+test image, so the lookup contract is asserted directly: the table is
+complete (class coverage, key conditions), and the server emits the
+right codes — with the ErrorResponse `P` position field for syntax
+errors — over a real wire connection.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.pg import sql_state
+from corrosion_tpu.pg.client import PgClientError
+
+from .test_pg import _with_pg
+
+
+def lookup(code: str) -> str:
+    """psycopg's errors.lookup analog: code -> condition name."""
+    name = sql_state.CODE_TO_NAME.get(code)
+    if name is None:
+        raise KeyError(code)
+    return name
+
+
+# -- the table itself -------------------------------------------------------
+
+
+def test_table_is_complete():
+    # the upstream errcodes list the reference generates from has 260+
+    # conditions across 43 classes; the rebuild must carry all of them
+    assert len(sql_state.ALL_CODES) >= 260
+    classes = {c[:2] for c in sql_state.ALL_CODES.values()}
+    assert len(classes) >= 40
+    # every code is a 5-char SQLSTATE in the PG alphabet
+    alphabet = set("0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ")
+    for name, code in sql_state.ALL_CODES.items():
+        assert len(code) == 5 and set(code) <= alphabet, (name, code)
+
+
+@pytest.mark.parametrize(
+    "name,code",
+    [
+        ("SUCCESSFUL_COMPLETION", "00000"),
+        ("PROTOCOL_VIOLATION", "08P01"),
+        ("FEATURE_NOT_SUPPORTED", "0A000"),
+        ("INTEGRITY_CONSTRAINT_VIOLATION", "23000"),
+        ("FOREIGN_KEY_VIOLATION", "23503"),
+        ("UNIQUE_VIOLATION", "23505"),
+        ("T_R_SERIALIZATION_FAILURE", "40001"),
+        ("SYNTAX_ERROR", "42601"),
+        ("UNDEFINED_TABLE", "42P01"),
+        ("UNDEFINED_FUNCTION", "42883"),
+        ("INSUFFICIENT_PRIVILEGE", "42501"),
+        ("DIVISION_BY_ZERO", "22012"),
+        ("NUMERIC_VALUE_OUT_OF_RANGE", "22003"),
+        ("ADMIN_SHUTDOWN", "57P01"),
+        ("QUERY_CANCELED", "57014"),
+        ("LOCK_NOT_AVAILABLE", "55P03"),
+        ("DISK_FULL", "53100"),
+        ("T_R_DEADLOCK_DETECTED", "40P01"),
+        ("INVALID_PASSWORD", "28P01"),
+        ("IO_ERROR", "58030"),
+    ],
+)
+def test_key_conditions_present(name, code):
+    assert getattr(sql_state, name) == code
+    assert lookup(code) == name or sql_state.ALL_CODES[name] == code
+
+
+def test_lookup_roundtrip_every_code():
+    for name, code in sql_state.ALL_CODES.items():
+        # every emitted code must be resolvable back to a condition name
+        assert lookup(code) in sql_state.ALL_CODES
+        assert sql_state.ALL_CODES[lookup(code)] == code
+
+
+# -- wire-level emission ----------------------------------------------------
+
+
+def _error_from(client_call):
+    async def run(cluster, clients):
+        with pytest.raises(PgClientError) as ei:
+            await client_call(clients[0])
+        run.err = ei.value
+
+    return run
+
+
+def test_syntax_error_code_and_position():
+    async def body(cluster, clients):
+        c = clients[0]
+        # a query OUR parser rejects (with a token position), not one
+        # that limps through to SQLite (whose errors carry no position)
+        q = "INSERT INTO t VALUES (1,"
+        with pytest.raises(PgClientError) as ei:
+            await c.query(q)
+        e = ei.value
+        assert e.code == sql_state.SYNTAX_ERROR
+        assert lookup(e.code) == "SYNTAX_ERROR"
+        # P field: 1-based char position inside the query string, at or
+        # after the bogus token ("psql's error caret")
+        assert e.position == len(q) + 1  # EOF position, 1-based
+        assert e.fields.get("S") == "ERROR"
+        # sqlite-surfaced syntax errors still carry the right code,
+        # just no position
+        with pytest.raises(PgClientError) as ei2:
+            await c.query("SELECT * FROMM t")
+        assert ei2.value.code == sql_state.SYNTAX_ERROR
+        assert ei2.value.position == 0
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_undefined_table_code():
+    async def body(cluster, clients):
+        with pytest.raises(PgClientError) as ei:
+            await clients[0].query("SELECT * FROM never_created")
+        assert ei.value.code == sql_state.UNDEFINED_TABLE
+        assert lookup(ei.value.code) == "UNDEFINED_TABLE"
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_unique_violation_code():
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query(
+            "CREATE TABLE uv (id INTEGER PRIMARY KEY, v TEXT) WITHOUT ROWID"
+        )
+        await c.query("INSERT INTO uv VALUES (1, 'a')")
+        with pytest.raises(PgClientError) as ei:
+            await c.query("INSERT INTO uv VALUES (1, 'b')")
+        assert ei.value.code == sql_state.UNIQUE_VIOLATION
+        assert lookup(ei.value.code) == "UNIQUE_VIOLATION"
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_in_failed_transaction_code():
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query("BEGIN")
+        with pytest.raises(PgClientError):
+            await c.query("SELECT * FROM never_created")
+        # any statement in an aborted tx must fail 25P02 (the sticky
+        # state psycopg maps to InFailedSqlTransaction)
+        with pytest.raises(PgClientError) as ei:
+            await c.query("SELECT 1")
+        assert ei.value.code == sql_state.IN_FAILED_SQL_TRANSACTION
+        assert lookup(ei.value.code) == "IN_FAILED_SQL_TRANSACTION"
+        await c.query("ROLLBACK")
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_batch_syntax_error_position_offset():
+    """In a multi-statement simple-Query batch, the P field must index
+    the ORIGINAL query string, not the split substring."""
+    async def body(cluster, clients):
+        c = clients[0]
+        q = "SELECT 1; INSERT INTO t VALUES (1,"
+        with pytest.raises(PgClientError) as ei:
+            await c.query(q)
+        e = ei.value
+        assert e.code == sql_state.SYNTAX_ERROR
+        # EOF of the second statement, 1-based in the full string
+        assert e.position == len(q) + 1
+
+    asyncio.run(_with_pg(1, body))

@@ -1,0 +1,310 @@
+"""Packed u32 round vs dense round: round-by-round bit-for-bit equality.
+
+The bitpacked kernels (sim/packed.py) claim EXACT equivalence with the
+dense round over the supported envelope (P % 32 == 0, power-of-two
+chunking, statically unmetered budgets, zero loss, max_transmissions < 16).
+This test holds them to it: both paths advance the same initial state with
+the same PRNG stream, and after EVERY round the packed carry is unpacked
+and compared bit-for-bit against the dense state — have, relay counters,
+the in-flight delay ring, injected flags, advertised bookkeeping
+(heads/gaps), sync countdowns, the full SWIM state, and the convergence
+metrics.  Scenarios cover multi-writer chunked storms, partial-view SWIM,
+full-view SWIM with node kills, multi-region ring0 tiering, and a
+mid-run partition + heal (VERDICT r3 item 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim.packed import (
+    PackedCarry,
+    pack_bits,
+    pack_state,
+    packed_round_step,
+    packed_supported,
+    run_packed,
+    shrink_state,
+    unpack_bits,
+    unpack_into_state,
+)
+from corrosion_tpu.sim.round import (
+    new_metrics,
+    new_sim,
+    round_step,
+    run_to_convergence,
+)
+from corrosion_tpu.sim.state import (
+    ALIVE,
+    DOWN,
+    SimConfig,
+    uniform_payloads,
+)
+from corrosion_tpu.sim.topology import Topology, regions
+
+
+def _dense_step(cfg, topo):
+    region = regions(cfg.n_nodes, topo.n_regions)
+
+    @jax.jit
+    def step(state, metrics, meta):
+        return round_step(state, metrics, meta, cfg, topo, region)
+
+    return step
+
+
+def _packed_step(cfg, topo):
+    region = regions(cfg.n_nodes, topo.n_regions)
+
+    @jax.jit
+    def step(state, carry, inj, metrics, meta):
+        return packed_round_step(
+            state, carry, inj, metrics, meta, cfg, topo, region
+        )
+
+    return step
+
+
+def _assert_equal(tag, a, b):
+    a = np.asarray(a)
+    b = np.asarray(b)
+    assert a.dtype == b.dtype or a.shape == b.shape, tag
+    if not (a == b).all():
+        bad = np.argwhere(a != b)[:5]
+        raise AssertionError(
+            f"{tag}: {int((a != b).sum())} mismatches, first at {bad.tolist()}"
+        )
+
+
+def _compare_round(t, sd, md, sp, carry, inj, mp, cfg):
+    full = unpack_into_state(carry, sp, cfg)
+    _assert_equal(f"have@r{t}", sd.have, full.have)
+    _assert_equal(f"relay_left@r{t}", sd.relay_left, full.relay_left)
+    _assert_equal(f"inflight@r{t}", sd.inflight, full.inflight)
+    _assert_equal(
+        f"injected@r{t}",
+        sd.injected,
+        unpack_bits(inj, cfg.n_payloads).astype(sd.injected.dtype),
+    )
+    _assert_equal(f"heads@r{t}", sd.heads, sp.heads)
+    _assert_equal(f"gap_lo@r{t}", sd.gap_lo, sp.gap_lo)
+    _assert_equal(f"gap_hi@r{t}", sd.gap_hi, sp.gap_hi)
+    _assert_equal(f"sync_countdown@r{t}", sd.sync_countdown, sp.sync_countdown)
+    _assert_equal(f"key@r{t}", sd.key, sp.key)
+    _assert_equal(f"view@r{t}", sd.view, sp.view)
+    _assert_equal(f"vinc@r{t}", sd.vinc, sp.vinc)
+    _assert_equal(f"pid@r{t}", sd.pid, sp.pid)
+    _assert_equal(f"pkey@r{t}", sd.pkey, sp.pkey)
+    _assert_equal(f"psince@r{t}", sd.psince, sp.psince)
+    _assert_equal(f"coverage_at@r{t}", md.coverage_at, mp.coverage_at)
+    _assert_equal(f"converged_at@r{t}", md.converged_at, mp.converged_at)
+    _assert_equal(f"overflow@r{t}", md.overflow_frac, mp.overflow_frac)
+
+
+def _run_lockstep(cfg, topo, meta, rounds, seed=0, mutators=None):
+    """Advance dense and packed paths side by side, comparing every round.
+    ``mutators`` maps round -> fn(state) applied to BOTH paths (partition
+    flips, node kills) before that round executes."""
+    assert packed_supported(cfg, topo), "scenario must be in the envelope"
+    mutators = mutators or {}
+    sd = new_sim(cfg, seed)
+    md = new_metrics(cfg)
+    dense = _dense_step(cfg, topo)
+    packed = _packed_step(cfg, topo)
+
+    carry = pack_state(sd, cfg)
+    inj = pack_bits(sd.injected)
+    sp = shrink_state(sd)
+    mp = new_metrics(cfg)
+
+    for t in range(rounds):
+        if t in mutators:
+            # mutators touch membership/partition fields only; the packed
+            # payload carry is unaffected
+            sd = mutators[t](sd)
+            sp = mutators[t](sp)
+        sd, md = dense(sd, md, meta)
+        sp, carry, inj, mp = packed(sp, carry, inj, mp, meta)
+        _compare_round(t, sd, md, sp, carry, inj, mp, cfg)
+    return sd, md
+
+
+def test_multiwriter_chunked_storm_pswim():
+    """The headline-storm shape scaled down: multi-writer, 4-chunk
+    versions, partial-view SWIM coupled to dissemination."""
+    cfg = SimConfig.wan_tuned(
+        48,
+        n_payloads=128,  # 8 versions x 4 writers x 4 chunks
+        n_writers=4,
+        chunks_per_version=4,
+        fanout=3,
+        sync_interval_rounds=4,
+        swim_partial_view=True,
+        member_slots=16,
+        rate_limit_bytes_round=None,
+        sync_budget_bytes=None,
+        packed_min_cells=0,
+        n_delay_slots=2,
+    )
+    meta = uniform_payloads(cfg, inject_every=2)
+    _run_lockstep(cfg, Topology(), meta, rounds=40, seed=3)
+
+
+def test_multiregion_ring0_and_delay_ring():
+    """Two regions, inter-region delay 2: exercises ring0-first target
+    override and multi-slot delay-ring scatter."""
+    cfg = SimConfig.wan_tuned(
+        32,
+        n_payloads=64,  # 16 versions x 2 writers x 2 chunks
+        n_writers=2,
+        chunks_per_version=2,
+        fanout=2,
+        sync_interval_rounds=3,
+        swim_partial_view=True,
+        member_slots=16,
+        rate_limit_bytes_round=None,
+        sync_budget_bytes=None,
+        packed_min_cells=0,
+        n_delay_slots=4,
+    )
+    topo = Topology(n_regions=2, inter_delay=2)
+    meta = uniform_payloads(cfg, inject_every=1)
+    _run_lockstep(cfg, topo, meta, rounds=40, seed=7)
+
+
+def test_partition_heal_and_kill_fullview():
+    """Full-view SWIM, mid-run partition + heal, plus node kills: the
+    membership-coupled eligibility masks must diverge identically."""
+    cfg = SimConfig.wan_tuned(
+        24,
+        n_payloads=32,  # 16 versions x 2 writers x 1 chunk
+        n_writers=2,
+        chunks_per_version=1,
+        fanout=2,
+        sync_interval_rounds=4,
+        swim_full_view=True,
+        rate_limit_bytes_round=None,
+        sync_budget_bytes=None,
+        packed_min_cells=0,
+    )
+    meta = uniform_payloads(cfg, inject_every=1)
+
+    def split(state):
+        n = cfg.n_nodes
+        group = (jnp.arange(n) >= n // 2).astype(jnp.int32)
+        return state._replace(group=group)
+
+    def heal_and_kill(state):
+        n = cfg.n_nodes
+        alive = state.alive.at[1].set(jnp.uint8(DOWN))
+        return state._replace(group=jnp.zeros((n,), jnp.int32), alive=alive)
+
+    _run_lockstep(
+        cfg, Topology(), meta, rounds=50, seed=11,
+        mutators={5: split, 25: heal_and_kill},
+    )
+
+
+def test_burst_injection_gap_overflow():
+    """Burst injection (all versions at round 0) drives the gap extractor
+    into its K-overflow clamp; the packed bookkeeping refresh must clamp
+    identically (overflow_frac compared every round)."""
+    cfg = SimConfig.wan_tuned(
+        16,
+        n_payloads=256,  # 64 versions x 2 writers x 2 chunks, K=4 slots
+        n_writers=2,
+        chunks_per_version=2,
+        gap_slots=4,
+        fanout=2,
+        sync_interval_rounds=3,
+        swim_partial_view=True,
+        member_slots=8,
+        rate_limit_bytes_round=None,
+        sync_budget_bytes=None,
+        packed_min_cells=0,
+        n_delay_slots=2,
+    )
+    meta = uniform_payloads(cfg, inject_every=0)
+    _run_lockstep(cfg, Topology(), meta, rounds=30, seed=13)
+
+
+def test_run_to_convergence_dispatches_packed():
+    """The public entry routes the storm shape through the packed loop
+    and returns the same results as the dense loop forced via a
+    budget-metered (but never-binding at sum level... so force via loss)
+    equivalent is impractical; instead: run_packed directly vs the dense
+    while-loop body, full-run equality of final state and metrics."""
+    cfg = SimConfig.wan_tuned(
+        32,
+        n_payloads=64,
+        n_writers=4,
+        chunks_per_version=4,
+        fanout=3,
+        sync_interval_rounds=4,
+        swim_partial_view=True,
+        member_slots=16,
+        rate_limit_bytes_round=None,
+        sync_budget_bytes=None,
+        packed_min_cells=0,
+        n_delay_slots=2,
+    )
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=2)
+    assert packed_supported(cfg, topo)
+
+    # packed path through the public (dispatching) entry
+    final_p, metrics_p = run_to_convergence(
+        new_sim(cfg, 19), meta, cfg, topo, 300
+    )
+    # dense path, same math, stepped manually with the same seeds
+    sd = new_sim(cfg, 19)
+    md = new_metrics(cfg)
+    dense = _dense_step(cfg, topo)
+    t = 0
+    while t < int(final_p.t):
+        sd, md = dense(sd, md, meta)
+        t += 1
+    assert int(final_p.t) == int(sd.t)
+    _assert_equal("final have", sd.have, final_p.have)
+    _assert_equal("final relay", sd.relay_left, final_p.relay_left)
+    _assert_equal("final injected", sd.injected, final_p.injected)
+    _assert_equal("final coverage", md.coverage_at, metrics_p.coverage_at)
+    _assert_equal("final converged", md.converged_at, metrics_p.converged_at)
+    # and the run actually converged (the while_loop exit was the
+    # convergence predicate, not max_rounds)
+    assert (np.asarray(metrics_p.converged_at) >= 0).all()
+
+
+def test_envelope_gate():
+    """packed_supported must reject every envelope violation."""
+    base = dict(
+        n_payloads=64, n_writers=2, chunks_per_version=2,
+        rate_limit_bytes_round=None, sync_budget_bytes=None,
+        packed_min_cells=0,
+    )
+    ok = SimConfig(n_nodes=8, **base)
+    assert packed_supported(ok, Topology())
+    assert not packed_supported(ok, Topology(loss=0.1))
+    assert not packed_supported(
+        dataclasses.replace(ok, rate_limit_bytes_round=1024), Topology()
+    )
+    assert not packed_supported(
+        dataclasses.replace(ok, sync_budget_bytes=1024), Topology()
+    )
+    assert not packed_supported(
+        dataclasses.replace(ok, max_transmissions=16), Topology()
+    )
+    bad_p = SimConfig(n_nodes=8, n_payloads=72, n_writers=2,
+                      chunks_per_version=2, rate_limit_bytes_round=None,
+                      sync_budget_bytes=None, packed_min_cells=0)
+    assert not packed_supported(bad_p, Topology())
+    # the size gate: small scenarios stay dense under the default
+    # threshold (packing only pays at HBM scale — CPU A/B r4)
+    small = dataclasses.replace(ok, packed_min_cells=1 << 24)
+    assert not packed_supported(small, Topology())
