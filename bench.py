@@ -52,6 +52,7 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
 
 # best-so-far, printed exactly once (normal exit or signal)
 _best: dict | None = None
+_secondary: dict | None = None
 _printed = False
 _diag: dict = {"attempts": [], "preflight": None, "started_unix": time.time()}
 
@@ -67,7 +68,7 @@ def _emit_and_exit(code: int = 0) -> None:
         os._exit(code)
     _printed = True
     if _best is not None:
-        out = _best
+        out = dict(_best)
     else:
         out = {
             "metric": "sim_write_storm_p99_convergence_wallclock",
@@ -75,6 +76,11 @@ def _emit_and_exit(code: int = 0) -> None:
             "unit": "s",
             "vs_baseline": 0.0,
         }
+    # the adversarial gapstress rung rides the same line as a secondary
+    # record (VERDICT r3 item 3: both rungs official, each with its own
+    # vs_baseline); the driver's primary schema is unchanged
+    if _secondary is not None:
+        out["secondary"] = _secondary
     print(json.dumps(out), flush=True)
     _write_diag()
     os._exit(code)
@@ -169,6 +175,11 @@ def kill_stale_device_holders(
     # in a sandbox without shooting a real bench run.)
     repo = repo or REPO
     killed: list[int] = []
+    if os.environ.get("BENCH_NO_KILL") == "1":
+        # opt-out (ADVICE r3): a concurrent healthy bench / a developer
+        # debugging bench_child under pdb must not be shot
+        return killed
+    min_age = float(os.environ.get("BENCH_KILL_MIN_AGE_S", "0"))
     try:
         pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
     except OSError:
@@ -186,6 +197,12 @@ def kill_stale_device_holders(
             cwd = os.readlink(f"/proc/{pid}/cwd")
             if cwd != repo and not cwd.startswith(repo + os.sep):
                 continue
+            if min_age > 0:
+                # spare freshly-started processes (likely a live bench,
+                # not a stale remnant)
+                age = time.time() - os.stat(f"/proc/{pid}").st_mtime
+                if age < min_age:
+                    continue
             os.kill(pid, signal.SIGKILL)
             killed.append(pid)
         except (OSError, ValueError):
@@ -304,6 +321,65 @@ def main() -> int:
             _diag["best"] = {"nodes": n, **m}
         elif res.get("timeout") and _best is not None:
             break  # bigger sizes will only be slower; keep what we have
+
+    # official rung #2: the ADVERSARIAL storm (VERDICT r3 item 3) — mixed
+    # 1 B-8 KiB payloads so the byte-budget actually meters, 30% loss,
+    # burst injection overflowing the K gap slots (gap_overflow > 0), at
+    # 10k nodes.  The friendly 100k rung stays the primary metric; this
+    # rung is the same machinery with every limiter engaged, reported as
+    # the `secondary` record with its own budget-derived vs_baseline.
+    global _secondary
+    # 4096 nodes: 145 s wall / 31 rounds / overflow 0.26 on CPU (r4
+    # calibration) — heavy enough to overflow K and meter mixed sizes,
+    # light enough to fit the bench budget alongside the primary ladder
+    gs_nodes = int(os.environ.get("BENCH_GAPSTRESS_NODES", "4096"))
+    gs_target = float(os.environ.get("BENCH_GAPSTRESS_TARGET_S", "240"))
+    if _remaining() > 240:
+        res = run_child(
+            {
+                "mode": "aux",
+                "platform": plat or None,
+                "fn": "config_write_storm_gapstress",
+                "seed": 1,
+                "kwargs": {"n_nodes": gs_nodes},
+            },
+            timeout=min(_remaining() - 60, 900.0),
+        )
+        _diag["attempts"].append({"phase": "gapstress", "nodes": gs_nodes, **res})
+        m = res.get("metrics") or {}
+        if res.get("ok") and m.get("converged"):
+            value = round(float(m["wall_clock_s"]), 3)
+            suffix = "_cpu_fallback" if on_cpu else ""
+            _secondary = {
+                "metric": (
+                    f"sim_write_storm_gapstress_{gs_nodes // 1000}k_"
+                    f"p99_convergence_wallclock{suffix}"
+                ),
+                "value": value,
+                "unit": "s",
+                "vs_baseline": round(gs_target / value, 3) if value > 0 else 0.0,
+                "gap_overflow_frac_max": m.get("gap_overflow_frac_max"),
+            }
+            _diag["gapstress"] = {"nodes": gs_nodes, **m}
+        _write_diag()
+
+    # packed-vs-dense A/B on the headline shape (VERDICT r3 item 2: the
+    # realized speedup belongs in BENCH_DIAG, not just the spike doc)
+    if os.environ.get("BENCH_AB", "1") != "0" and _remaining() > 420:
+        res = run_child(
+            {
+                "mode": "aux",
+                "platform": plat or None,
+                "fn": "config_storm_ab",
+                "seed": 1,
+                "kwargs": {"n_nodes": cap, "n_payloads": n_payloads},
+            },
+            timeout=min(_remaining() - 60, 900.0),
+        )
+        _diag["storm_ab"] = res.get("metrics") or {
+            "ok": False, "error": res.get("error")
+        }
+        _write_diag()
 
     # aux configs #2-#4 (VERDICT item 1: "record configs #2-#4 outputs")
     if os.environ.get("BENCH_AUX", "1") != "0" and _remaining() > 90:

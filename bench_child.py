@@ -105,7 +105,7 @@ def main() -> int:
             from corrosion_tpu.sim import runner
 
             fn = getattr(runner, spec["fn"])
-            m = fn(seed=int(spec.get("seed", 0)))
+            m = fn(seed=int(spec.get("seed", 0)), **spec.get("kwargs", {}))
             res["metrics"] = m
             res["ok"] = True
 

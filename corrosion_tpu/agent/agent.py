@@ -208,8 +208,23 @@ class Agent:
             SwimRuntime.attach(self)
             await self.swim.start()
         else:
-            # static membership straight from the bootstrap list
-            for i, addr in enumerate(self.config.bootstrap):
+            # static membership from the bootstrap list; on real network
+            # transports DNS entries resolve to all their records
+            # (agent/bootstrap.py) — memory-transport addrs are symbolic
+            # and pass through literally
+            if self.transport.resolves_dns:
+                from .bootstrap import resolve_bootstrap
+
+                resolved = sorted(
+                    await resolve_bootstrap(
+                        self.config.bootstrap,
+                        self.transport.addr,
+                        resolver=getattr(self, "bootstrap_resolver", None),
+                    )
+                )
+            else:
+                resolved = list(self.config.bootstrap)
+            for i, addr in enumerate(resolved):
                 if addr != self.transport.addr:
                     self.members.add_member(
                         Actor(id=ActorId(bytes([0] * 15 + [i + 1])), addr=addr, ts=0)

@@ -1,0 +1,177 @@
+"""Bootstrap address resolution (DNS + in-db fallback).
+
+Rebuild of `generate_bootstrap`/`resolve_bootstrap`
+(corro-agent/src/agent/bootstrap.rs:14-150): bootstrap entries that are
+not literal `ip:port` pairs are DNS names resolved to ALL their A/AAAA
+records (real deploys bootstrap via a headless-service name that
+resolves to every pod), the node's own address and mismatched address
+families are filtered out, and when nothing resolves the agent falls
+back to a random sample of previously-known members persisted in
+``__corro_members``.  Resolution happens at every (re)join attempt —
+the announcer loop calls back in here, so a changed DNS answer is
+picked up on rejoin, as in the reference.
+
+Entry forms accepted (bootstrap.rs:73-97):
+- ``1.2.3.4:8787``            — literal, used as-is
+- ``gossip.svc``              — resolved, default gossip port
+- ``gossip.svc:9999``         — resolved, explicit port
+- ``gossip.svc:9999@10.0.0.2``— resolved via a specific DNS server; the
+  stdlib has no per-server resolver, so this form resolves through the
+  system resolver and the `@server` part is recorded in the returned
+  diagnostics (callers may inject a custom ``resolver`` for real
+  split-horizon setups — the seam the tests use).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ipaddress
+import logging
+import random
+import socket
+from typing import Awaitable, Callable, Iterable, List, Optional, Sequence, Set
+
+log = logging.getLogger("corrosion_tpu.bootstrap")
+
+#: the reference's default gossip port (bootstrap.rs DEFAULT_GOSSIP_PORT)
+DEFAULT_GOSSIP_PORT = 8787
+#: how many resolved/fallback nodes a join round targets
+#: (bootstrap.rs RANDOM_NODES_CHOICES)
+RANDOM_NODES_CHOICES = 10
+
+#: resolver(host) -> list of IP strings (A + AAAA answers)
+Resolver = Callable[[str], Awaitable[List[str]]]
+
+
+async def system_resolver(host: str) -> List[str]:
+    """All A/AAAA answers via the system resolver (getaddrinfo)."""
+    loop = asyncio.get_running_loop()
+    try:
+        infos = await loop.getaddrinfo(
+            host, None, type=socket.SOCK_DGRAM, proto=socket.IPPROTO_UDP
+        )
+    except socket.gaierror:
+        return []
+    out: List[str] = []
+    for _family, _type, _proto, _canon, sockaddr in infos:
+        ip = sockaddr[0]
+        if ip not in out:
+            out.append(ip)
+    return out
+
+
+def _split_entry(entry: str) -> tuple[str, int, Optional[str]]:
+    """(host, port, dns_server) from ``host[:port][@dns_server]``."""
+    host_port, _, dns_server = entry.partition("@")
+    host, sep, port_s = host_port.rpartition(":")
+    if not sep:
+        return host_port, DEFAULT_GOSSIP_PORT, dns_server or None
+    try:
+        port = int(port_s)
+    except ValueError:
+        # "host:notaport" — treat the whole thing as a hostname
+        return host_port, DEFAULT_GOSSIP_PORT, dns_server or None
+    return host, port, dns_server or None
+
+
+def _is_literal(entry: str) -> bool:
+    host, _, _ = entry.partition("@")
+    addr, sep, port = host.rpartition(":")
+    if not sep:
+        return False
+    try:
+        int(port)
+        ipaddress.ip_address(addr.strip("[]"))
+        return True
+    except ValueError:
+        return False
+
+
+def _family(addr: str) -> int:
+    """4 or 6 for a bare IP or an ``ip:port`` / ``[ip6]:port`` string."""
+    for candidate in (addr, addr.strip("[]"),
+                      addr.rpartition(":")[0].strip("[]")):
+        if not candidate:
+            continue
+        try:
+            return ipaddress.ip_address(candidate).version
+        except ValueError:
+            continue
+    return 4
+
+
+async def resolve_bootstrap(
+    bootstrap: Sequence[str],
+    our_addr: str,
+    resolver: Optional[Resolver] = None,
+) -> Set[str]:
+    """Resolve every bootstrap entry to ``ip:port`` strings: literals
+    pass through, hostnames expand to ALL their address records; our own
+    address and cross-family answers are dropped (bootstrap.rs:124-133).
+    """
+    resolver = resolver or system_resolver
+    our_family = _family(our_addr) if our_addr else 4
+    addrs: Set[str] = set()
+    for entry in bootstrap:
+        if not entry:
+            continue
+        if _is_literal(entry):
+            host, port, _ = _split_entry(entry)
+            addr = f"{host}:{port}"
+            if addr != our_addr:
+                addrs.add(addr)
+            continue
+        host, port, dns_server = _split_entry(entry)
+        if dns_server:
+            log.debug(
+                "bootstrap %s requests resolver %s; using injected/system "
+                "resolver", host, dns_server,
+            )
+        try:
+            ips = await resolver(host)
+        except Exception as e:  # noqa: BLE001 — resolution is best-effort
+            log.warning("could not resolve %r: %s", host, e)
+            continue
+        for ip in ips:
+            if _family(ip) != our_family:
+                continue
+            addr = f"[{ip}]:{port}" if ":" in ip else f"{ip}:{port}"
+            if addr != our_addr:
+                addrs.add(addr)
+    return addrs
+
+
+def _db_fallback(store, our_addr: str) -> Set[str]:
+    """Random previously-known members from ``__corro_members``
+    (bootstrap.rs:28-48) — lets a node rejoin a cluster whose bootstrap
+    DNS is gone."""
+    try:
+        rows = store.conn.execute(
+            "SELECT address FROM __corro_members ORDER BY RANDOM() LIMIT 5"
+        ).fetchall()
+    except Exception:  # noqa: BLE001 — schema may not exist yet
+        return set()
+    return {
+        r[0]
+        for r in rows
+        if r[0] and r[0] != our_addr and _family(r[0]) == _family(our_addr)
+    }
+
+
+async def generate_bootstrap(
+    bootstrap: Sequence[str],
+    our_addr: str,
+    store=None,
+    resolver: Optional[Resolver] = None,
+    rng: Optional[random.Random] = None,
+) -> List[str]:
+    """The join-target list for one (re)announce round: resolved
+    bootstrap addrs, or the in-db member fallback when resolution comes
+    up empty, sampled down to ``RANDOM_NODES_CHOICES``."""
+    addrs = await resolve_bootstrap(bootstrap, our_addr, resolver)
+    if not addrs and store is not None:
+        addrs = _db_fallback(store, our_addr)
+    pool = sorted(addrs)
+    if len(pool) <= RANDOM_NODES_CHOICES:
+        return pool
+    return (rng or random).sample(pool, RANDOM_NODES_CHOICES)

@@ -20,6 +20,7 @@ Addresses are opaque strings ("host:port" for sockets, any token in memory).
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import random
 import struct
@@ -111,6 +112,11 @@ class LinkModel:
 
 class Transport:
     """Abstract transport verbs (reference transport.rs:79-162)."""
+
+    #: whether bootstrap entries may be DNS hostnames needing resolution
+    #: (real socket transports only — MemoryTransport addrs are symbolic
+    #: names like "node0" and must pass through literally)
+    resolves_dns = False
 
     addr: str
 
@@ -295,7 +301,27 @@ class _CachedConn:
         return not self.writer.is_closing()
 
 
+@dataclasses.dataclass
+class PathStats:
+    """Per-peer transport path statistics, aggregated across reconnects
+    (the TCP/UDP analog of the reference's per-connection QUIC
+    path/frame stats rollup, transport.rs:235-419).  Surfaced by
+    `UdpTcpTransport.path_samples()` into the Prometheus scrape."""
+
+    frames_tx_uni: int = 0
+    frames_tx_dgram: int = 0
+    frames_rx_uni: int = 0
+    frames_rx_dgram: int = 0
+    bytes_tx: int = 0
+    bytes_rx: int = 0
+    bi_opened: int = 0
+    connects: int = 0
+    send_errors: int = 0
+    rtt_last_s: float = 0.0
+
+
 class UdpTcpTransport(Transport):
+    resolves_dns = True
     """Datagrams over UDP, uni/bi streams over TCP, one port each.
 
     Wire shape (the reference's QUIC uni/bi distinction,
@@ -345,6 +371,19 @@ class UdpTcpTransport(Transport):
         # reuse metrics: tests assert conns_opened ≪ frames sent
         self.conns_opened = 0
         self.server_conns_accepted = 0
+        # per-peer path statistics (bounded: one entry per peer addr,
+        # evicted with the member; cap guards a churn pathology)
+        self.path_stats: Dict[str, PathStats] = {}
+
+    _PATH_STATS_CAP = 4096
+
+    def _pstats(self, addr: str) -> PathStats:
+        st = self.path_stats.get(addr)
+        if st is None:
+            while len(self.path_stats) >= self._PATH_STATS_CAP:
+                self.path_stats.pop(next(iter(self.path_stats)))
+            st = self.path_stats[addr] = PathStats()
+        return st
 
     @property
     def tls(self) -> bool:
@@ -410,6 +449,17 @@ class UdpTcpTransport(Transport):
                     data = await _read_frame(reader)
                     if data is None:
                         break
+                    # rx keyed by the peer's IP: the inbound socket's
+                    # source port is EPHEMERAL — keying by peername would
+                    # mint a fresh label series per reconnect (cardinality
+                    # churn) and never aggregate with the canonical
+                    # gossip addr the tx stats use
+                    st = self._pstats(peer_addr.rsplit(":", 1)[0])
+                    if kind == self.KIND_UNI:
+                        st.frames_rx_uni += 1
+                    else:
+                        st.frames_rx_dgram += 1
+                    st.bytes_rx += len(data)
                     try:
                         if kind == self.KIND_UNI and self.on_uni is not None:
                             # awaited inline: broadcast ingestion is the
@@ -456,9 +506,16 @@ class UdpTcpTransport(Transport):
             ),
             self.CONNECT_TIMEOUT_S,
         )
+        dt = time.monotonic() - t0
         if self.on_rtt is not None:
-            self.on_rtt(addr, time.monotonic() - t0)
+            self.on_rtt(addr, dt)
         self.conns_opened += 1
+        st = self._pstats(addr)
+        st.connects += 1
+        st.rtt_last_s = dt
+        from ..metrics import REGISTRY
+
+        REGISTRY.histogram("corro_transport_connect_time_seconds").observe(dt)
         return reader, writer
 
     async def _uni_conn(self, addr: str) -> _CachedConn:
@@ -495,10 +552,17 @@ class UdpTcpTransport(Transport):
                 async with conn.lock:
                     conn.writer.write(kind + _frame(data))
                     await conn.writer.drain()
+                st = self._pstats(addr)
+                if kind == self.KIND_UNI:
+                    st.frames_tx_uni += 1
+                else:
+                    st.frames_tx_dgram += 1
+                st.bytes_tx += len(data)
                 return
             except (ConnectionError, OSError):
                 self._evict(addr)
                 if attempt:
+                    self._pstats(addr).send_errors += 1
                     raise
 
     async def send_datagram(self, addr: str, data: bytes) -> None:
@@ -516,11 +580,18 @@ class UdpTcpTransport(Transport):
                 async with conn.lock:
                     conn.writer.write(self.KIND_DGRAM + _frame(data))
                     await asyncio.wait_for(conn.writer.drain(), 2.0)
+                st = self._pstats(addr)
+                st.frames_tx_dgram += 1
+                st.bytes_tx += len(data)
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 self._evict(addr)
+                self._pstats(addr).send_errors += 1
             return
         host, port = addr.rsplit(":", 1)
         self._udp.sendto(data, (host, int(port)))
+        st = self._pstats(addr)
+        st.frames_tx_dgram += 1
+        st.bytes_tx += len(data)
 
     def _background_dial(self, addr: str) -> None:
         async def dial():
@@ -540,7 +611,62 @@ class UdpTcpTransport(Transport):
         reader, writer = await self._connect(addr)
         writer.write(self.TAG_BI)
         await writer.drain()
+        self._pstats(addr).bi_opened += 1
         return _TcpBiStream(reader, writer)
+
+    def path_samples(self) -> str:
+        """Prometheus text families for the per-path stats (the
+        reference's emit_metrics aggregation, transport.rs:235-419:
+        per-addr gauges + cluster-wide totals)."""
+        live = sum(1 for c in self._conns.values() if c.alive)
+        lines = [
+            "# TYPE corro_transport_connections gauge",
+            f"corro_transport_connections {live}",
+        ]
+        agg = PathStats()
+        for st in self.path_stats.values():
+            agg.frames_tx_uni += st.frames_tx_uni
+            agg.frames_tx_dgram += st.frames_tx_dgram
+            agg.frames_rx_uni += st.frames_rx_uni
+            agg.frames_rx_dgram += st.frames_rx_dgram
+            agg.bytes_tx += st.bytes_tx
+            agg.bytes_rx += st.bytes_rx
+            agg.bi_opened += st.bi_opened
+            agg.connects += st.connects
+            agg.send_errors += st.send_errors
+        lines += [
+            "# TYPE corro_transport_frames_tx counter",
+            f'corro_transport_frames_tx{{type="uni"}} {agg.frames_tx_uni}',
+            f'corro_transport_frames_tx{{type="dgram"}} {agg.frames_tx_dgram}',
+            "# TYPE corro_transport_frames_rx counter",
+            f'corro_transport_frames_rx{{type="uni"}} {agg.frames_rx_uni}',
+            f'corro_transport_frames_rx{{type="dgram"}} {agg.frames_rx_dgram}',
+            "# TYPE corro_transport_path_bytes_tx counter",
+            f"corro_transport_path_bytes_tx {agg.bytes_tx}",
+            "# TYPE corro_transport_path_bytes_rx counter",
+            f"corro_transport_path_bytes_rx {agg.bytes_rx}",
+            "# TYPE corro_transport_bi_streams_opened counter",
+            f"corro_transport_bi_streams_opened {agg.bi_opened}",
+            "# TYPE corro_transport_connects counter",
+            f"corro_transport_connects {agg.connects}",
+            "# TYPE corro_transport_send_errors counter",
+            f"corro_transport_send_errors {agg.send_errors}",
+        ]
+        # per-addr rollup (the reference labels cwnd/congestion per addr;
+        # here bytes + last connect RTT are the TCP-visible analogs)
+        lines.append("# TYPE corro_transport_path_peer_bytes_tx counter")
+        for addr, st in sorted(self.path_stats.items()):
+            lines.append(
+                f'corro_transport_path_peer_bytes_tx{{addr="{addr}"}} '
+                f"{st.bytes_tx}"
+            )
+        lines.append("# TYPE corro_transport_path_peer_rtt_seconds gauge")
+        for addr, st in sorted(self.path_stats.items()):
+            lines.append(
+                f'corro_transport_path_peer_rtt_seconds{{addr="{addr}"}} '
+                f"{st.rtt_last_s:.6f}"
+            )
+        return "\n".join(lines) + "\n"
 
     async def close(self) -> None:
         for addr in list(self._conns):

@@ -290,6 +290,11 @@ class MetricsServer:
         agent = self.agent
         lines: List[str] = []
 
+        # transport path statistics (transport.rs:235-419 rollup)
+        path_samples = getattr(agent.transport, "path_samples", None)
+        if path_samples is not None:
+            lines.append(path_samples().rstrip("\n"))
+
         def fam(name, kind, samples):
             lines.append(f"# TYPE {name} {kind}")
             lines.extend(samples)

@@ -169,6 +169,11 @@ _INDEX_DEFS: dict = {}
 _INDEX_DEFS_CAP = 4096
 
 
+#: id(conn) -> cached to_regclass probe connection (closed on release
+#: or when a recycled id re-registers)
+_PROBES: dict = {}
+
+
 def _defs_for(conn: sqlite3.Connection) -> dict:
     key = id(conn)
     if key not in _INDEX_DEFS:
@@ -176,6 +181,32 @@ def _defs_for(conn: sqlite3.Connection) -> dict:
             _INDEX_DEFS.pop(next(iter(_INDEX_DEFS)))
         _INDEX_DEFS[key] = {}
     return _INDEX_DEFS[key]
+
+
+def _install_defs(conn: sqlite3.Connection) -> dict:
+    """ALWAYS install a fresh dict at id(conn) (ADVICE r3): a recycled
+    id from a dead connection must not hand the new connection's UDF
+    closures the dead conn's stale defs."""
+    key = id(conn)
+    _INDEX_DEFS.pop(key, None)
+    while len(_INDEX_DEFS) >= _INDEX_DEFS_CAP:
+        _INDEX_DEFS.pop(next(iter(_INDEX_DEFS)))
+    fresh: dict = {}
+    _INDEX_DEFS[key] = fresh
+    return fresh
+
+
+def release_functions(conn: sqlite3.Connection) -> None:
+    """Drop the defs entry and close the cached probe connection for a
+    connection that is going away (ADVICE r3: the probe conn was never
+    closed).  Safe to call for conns that were never registered."""
+    _INDEX_DEFS.pop(id(conn), None)
+    probe = _PROBES.pop(id(conn), None)
+    if probe is not None:
+        try:
+            probe.close()
+        except Exception:
+            pass
 
 
 def _affinity_oid(decl: str) -> int:
@@ -395,9 +426,17 @@ def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
     # an open/close per call on the event loop.  Created EAGERLY: the UDF
     # runs on varying to_thread executor workers, so lazy init would race
     # and leak the loser's connection.
+    old_probe = _PROBES.pop(id(conn), None)
+    if old_probe is not None:  # recycled id: the dead conn's probe leaked
+        try:
+            old_probe.close()
+        except Exception:
+            pass
     probe_box: list = [
         sqlite3.connect(db_file, check_same_thread=False) if db_file else None
     ]
+    if probe_box[0] is not None:
+        _PROBES[id(conn)] = probe_box[0]
 
     def _to_regclass(name):
         # a real existence probe (the standard PG idiom
@@ -452,7 +491,7 @@ def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
 
     conn.create_function("regexp", 2, _regexp, deterministic=True)
 
-    defs = _defs_for(conn)
+    defs = _install_defs(conn)
 
     def _indexdef(oid, *_a):
         entry = defs.get(oid)

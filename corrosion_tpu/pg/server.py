@@ -80,10 +80,14 @@ class PgServer:
         conn = agent.store.conn
         catalog.attach(conn, dbname)
         catalog.register_functions(conn, dbname)
+        # every conn we registered functions on, so stop() can release
+        # the catalog defs + cached probe connections (ADVICE r3)
+        self._catalog_conns = [conn]
 
         def _init_read(rc):
             catalog.attach(rc, dbname)
             catalog.register_functions(rc, dbname)
+            self._catalog_conns.append(rc)
 
         agent.store.add_read_conn_init(_init_read)
 
@@ -102,6 +106,9 @@ class PgServer:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+        for conn in self._catalog_conns:
+            catalog.release_functions(conn)
+        self._catalog_conns.clear()
 
     async def _on_conn(self, reader, writer):
         try:

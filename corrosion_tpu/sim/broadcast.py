@@ -108,22 +108,35 @@ def broadcast_step(
     inflight = inflight.at[flat_idx].max(sent)
     inflight = inflight.reshape(d_slots, n, p)
 
-    # transmission budget decays once per flush that actually sent
-    any_edge_ok = ok.reshape(n, f).any(axis=1)  # [N]
-    spent = sending & any_edge_ok[:, None]
+    # transmission budget decays once per flush that actually SENT —
+    # i.e. handed datagrams to the transport.  A sender cannot know the
+    # target is partitioned away or dead (that's what SWIM is for), so
+    # unreachable targets still spend budget (the reference's decay
+    # happens at send, broadcast/mod.rs:653-778; r4 ground-truth sweep:
+    # refund-on-partition made the sim recover unrealistically fast).
+    attempted = (targets >= 0) & (targets != jnp.arange(n)[:, None])
+    node_up = state.alive == ALIVE
+    any_attempt = attempted.any(axis=1) & node_up  # [N]
+    spent = sending & any_attempt[:, None]
     relay_left = state.relay_left - spent.astype(state.relay_left.dtype)
 
     return state._replace(inflight=inflight, relay_left=relay_left)
 
 
-def deliver_step(state: SimState, cfg: SimConfig) -> SimState:
-    """Pop this round's delay slot: newly received payloads become held and
-    start relaying with one transmission spent (rebroadcast semantics)."""
+def deliver_step(
+    state: SimState, cfg: SimConfig, sync_arrivals: jnp.ndarray
+) -> SimState:
+    """Pop this round's delay slot: newly BROADCAST-received payloads
+    become held and start relaying with one transmission spent
+    (rebroadcast semantics, handlers.rs:768-779).  ``sync_arrivals``
+    (the buffer sync filled LAST round) merges into ``have`` too but
+    does NOT re-arm the relay budget — sync-received changesets are
+    never rebroadcast in the reference."""
     d_slots = state.inflight.shape[0]
     slot = state.t % d_slots
     arriving = state.inflight[slot]  # [N, P]
     newly = (arriving > 0) & (state.have == 0)
-    have = jnp.maximum(state.have, arriving)
+    have = jnp.maximum(jnp.maximum(state.have, arriving), sync_arrivals)
     relay_init = max(cfg.max_transmissions - 1, 1)
     relay_left = jnp.where(
         newly, jnp.uint8(relay_init), state.relay_left

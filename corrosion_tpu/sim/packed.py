@@ -188,6 +188,9 @@ class PackedCarry(NamedTuple):
     have: jnp.ndarray  # u32[N, W]
     inflight: jnp.ndarray  # u8[D, N, P] — dense, see docstring
     relay: Planes  # 4 × u32[N, W]
+    # one-slot sync delivery buffer (SimState.sync_inflight) — stays
+    # PACKED: the sync fold produces words directly, no scatter
+    sync_buf: jnp.ndarray  # u32[N, W]
 
 
 def pack_state(state: SimState, cfg: SimConfig) -> PackedCarry:
@@ -199,6 +202,7 @@ def pack_state(state: SimState, cfg: SimConfig) -> PackedCarry:
         have=pack_bits(state.have),
         inflight=state.inflight,
         relay=planes,
+        sync_buf=pack_bits(state.sync_inflight),
     )
 
 
@@ -212,6 +216,7 @@ def unpack_into_state(carry: PackedCarry, state: SimState, cfg: SimConfig) -> Si
         have=unpack_bits(carry.have, p).astype(jnp.uint8),
         inflight=carry.inflight,
         relay_left=relay.astype(jnp.uint8),
+        sync_inflight=unpack_bits(carry.sync_buf, p).astype(jnp.uint8),
     )
 
 
@@ -245,7 +250,8 @@ def inject_packed(
     have = carry.have | own
     relay = planes_set(carry.relay, newly, cfg.max_transmissions)
     return (
-        PackedCarry(have=have, inflight=carry.inflight, relay=relay),
+        PackedCarry(have=have, inflight=carry.inflight, relay=relay,
+                    sync_buf=carry.sync_buf),
         injected_p | inj_words,
     )
 
@@ -313,10 +319,14 @@ def broadcast_packed(
     inflight = inflight.at[flat_idx].max(sent)
     inflight = inflight.reshape(d_slots, n, p)
 
-    any_edge_ok = ok.reshape(n, f).any(axis=1)
-    spent = eligible & jnp.where(any_edge_ok[:, None], ONES, U32(0))
+    # budget spends on the ATTEMPT (see broadcast.broadcast_step): a
+    # sender can't observe partitions or dead targets
+    attempted = (targets >= 0) & (targets != jnp.arange(n)[:, None])
+    any_attempt = attempted.any(axis=1) & (state.alive == ALIVE)  # [N]
+    spent = eligible & jnp.where(any_attempt[:, None], ONES, U32(0))
     relay = planes_dec(carry.relay, spent)
-    return PackedCarry(have=carry.have, inflight=inflight, relay=relay)
+    return PackedCarry(have=carry.have, inflight=inflight, relay=relay,
+                       sync_buf=carry.sync_buf)
 
 
 def _fold_or_regular(words: jnp.ndarray, n: int, per: int) -> jnp.ndarray:
@@ -332,16 +342,23 @@ def _fold_or_regular(words: jnp.ndarray, n: int, per: int) -> jnp.ndarray:
 
 
 def deliver_packed(
-    carry: PackedCarry, t: jnp.ndarray, cfg: SimConfig
+    carry: PackedCarry,
+    pending_sync: jnp.ndarray,
+    t: jnp.ndarray,
+    cfg: SimConfig,
 ) -> PackedCarry:
+    """Broadcast arrivals re-arm the relay budget (rebroadcast path);
+    ``pending_sync`` (last round's sync grants, packed words) merges
+    into have WITHOUT re-arming — mirrors broadcast.deliver_step."""
     d_slots = carry.inflight.shape[0]
     slot = t % d_slots
     arriving = pack_bits(carry.inflight[slot])  # u8[N, P] → u32[N, W]
     newly = arriving & ~carry.have
-    have = carry.have | arriving
+    have = carry.have | arriving | pending_sync
     relay = planes_set(carry.relay, newly, max(cfg.max_transmissions - 1, 1))
     inflight = carry.inflight.at[slot].set(jnp.uint8(0))
-    return PackedCarry(have=have, inflight=inflight, relay=relay)
+    return PackedCarry(have=have, inflight=inflight, relay=relay,
+                       sync_buf=carry.sync_buf)
 
 
 def shrink_state(state: SimState) -> SimState:
@@ -358,6 +375,7 @@ def shrink_state(state: SimState) -> SimState:
         injected=jnp.zeros((0,), u8),
         relay_left=jnp.zeros((n, 0), u8),
         inflight=jnp.zeros((d, n, 0), u8),
+        sync_inflight=jnp.zeros((n, 0), u8),
     )
 
 
@@ -388,9 +406,11 @@ def packed_round_step(
     carry = broadcast_packed(
         carry, injected_p, state, cfg, topo, region, k_bcast
     )
-    carry, countdown = sync_packed(carry, state, cfg, topo, k_sync)
-    state = state._replace(sync_countdown=countdown)
-    carry = deliver_packed(carry, state.t, cfg)
+    # capture last round's sync grants before sync overwrites the buffer
+    pending_sync = carry.sync_buf
+    carry, countdown, backoff = sync_packed(carry, state, cfg, topo, k_sync)
+    state = state._replace(sync_countdown=countdown, sync_backoff=backoff)
+    carry = deliver_packed(carry, pending_sync, state.t, cfg)
 
     from .swim import swim_step
 
@@ -496,7 +516,7 @@ def sync_packed(
     cfg: SimConfig,
     topo: Topology,
     key: jax.Array,
-) -> Tuple[PackedCarry, jnp.ndarray]:
+) -> Tuple[PackedCarry, jnp.ndarray, jnp.ndarray]:
     """Anti-entropy on packed words: needs computed from the SAME
     advertised gap/head tensors as the dense path (state.heads/gap_lo/
     gap_hi), but factored into per-NODE group-uniform word masks first —
@@ -544,19 +564,25 @@ def sync_packed(
     # pulls land at the PULLER (src): exactly S edges per source in a
     # regular layout, so the OR-reduce is a packed fold — no scatter;
     # the dense u8 ring takes the pulls after one unpack
-    pulled = _fold_or_regular(need, n, s)  # [N, W]
-    pulled8 = unpack_bits(pulled, cfg.n_payloads).astype(carry.inflight.dtype)
-    d_slots = carry.inflight.shape[0]
-    slot = (state.t + 1) % d_slots
-    inflight = carry.inflight.at[slot].set(
-        jnp.maximum(carry.inflight[slot], pulled8)
-    )
+    pulled = _fold_or_regular(need, n, s)  # [N, W] — stays packed
 
-    rearm = jax.random.randint(
-        k_rearm, (n,), 1, cfg.sync_interval_rounds + 1, jnp.int32
+    # fruitfulness-adaptive backoff, bit-identical to sync.sync_step
+    fruitful = (pulled != U32(0)).any(axis=1)  # [N]
+    cap = cfg.sync_backoff_cap()
+    backoff = jnp.where(
+        due & fruitful,
+        jnp.int32(cfg.sync_interval_rounds),
+        jnp.where(
+            due,
+            jnp.minimum(state.sync_backoff * 2, cap),
+            state.sync_backoff,
+        ),
     )
+    rearm = jax.random.randint(k_rearm, (n,), 1, backoff + 1, jnp.int32)
     countdown = jnp.where(due, rearm, state.sync_countdown - 1)
     return (
-        PackedCarry(have=carry.have, inflight=inflight, relay=carry.relay),
+        PackedCarry(have=carry.have, inflight=carry.inflight,
+                    relay=carry.relay, sync_buf=pulled),
         countdown,
+        backoff,
     )

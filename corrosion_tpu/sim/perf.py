@@ -43,23 +43,37 @@ from .topology import Topology, regions
 HBM_BYTES_PER_S_CEILING = 4e12
 
 
-def carry_write_bytes(cfg: SimConfig) -> int:
-    """Bytes the round kernel must WRITE per round: the dense u8 carry
-    tensors are rewritten every round (scatter-max into `inflight`,
-    delivery merge into `have`, relay decay into `relay_left`).  This is
-    a deliberate under-count — reads, the [E, P] sync/broadcast masks,
-    and the bookkeeping refresh are ignored — so the derived minimum
-    round time is a true lower bound."""
+def carry_write_bytes(cfg: SimConfig, packed: bool = False) -> int:
+    """Bytes the round kernel must WRITE per round: the carry tensors
+    are rewritten every round (scatter-max into `inflight`, delivery
+    merge into `have`, relay decay into `relay_left`).  This is a
+    deliberate under-count — reads, the [E, P] sync/broadcast masks, and
+    the bookkeeping refresh are ignored — so the derived minimum round
+    time is a true lower bound.  ``packed`` sizes the bitpacked carry
+    (u32 have words + 4 bitsliced relay planes + the dense u8 ring —
+    sim/packed.py's hybrid layout) so the bound stays a LOWER bound on
+    whichever path actually dispatched."""
     n, p, d = cfg.n_nodes, cfg.n_payloads, cfg.n_delay_slots
-    have = n * p  # u8
-    relay = n * p  # u8
-    inflight = d * n * p  # u8
+    inflight = d * n * p  # u8 ring in both layouts
+    if packed:
+        have = n * (p // 8)  # u32[N, P/32]
+        relay = n * (p // 2)  # 4 × u32[N, P/32] planes
+    else:
+        have = n * p  # u8
+        relay = n * p  # u8
     return have + relay + inflight
 
 
-def analytic_min_round_s(cfg: SimConfig) -> float:
-    """Physical lower bound on one round's wall-clock (see module doc)."""
-    return carry_write_bytes(cfg) / HBM_BYTES_PER_S_CEILING
+def analytic_min_round_s(
+    cfg: SimConfig, n_devices: int = 1, packed: bool = False
+) -> float:
+    """Physical lower bound on one round's wall-clock (see module doc).
+    An n-chip mesh shards the node axis, so aggregate write bandwidth
+    scales with device count (ADVICE r3: an 8×v5e slice legitimately
+    sustains ~8× the single-chip ceiling)."""
+    return carry_write_bytes(cfg, packed) / (
+        HBM_BYTES_PER_S_CEILING * max(1, n_devices)
+    )
 
 
 def measure_per_round(
@@ -79,6 +93,15 @@ def measure_per_round(
     the strongest completion barrier available — it cannot return until
     the device actually produced the data, unlike an async-ready signal
     a tunnel plugin might fake."""
+    from .packed import (
+        pack_bits,
+        pack_state,
+        packed_round_step,
+        packed_supported,
+        shrink_state,
+        unpack_into_state,
+    )
+
     region = regions(cfg.n_nodes, topo.n_regions)
     state = new_sim(cfg, seed)
     metrics = new_metrics(cfg)
@@ -88,8 +111,28 @@ def measure_per_round(
         state = shard_state(state, mesh)
         meta = replicate_meta(meta, mesh)
 
+    # microbench the SAME path run_to_convergence dispatches, else the
+    # ×3 consistency check compares apples to oranges
+    use_packed = packed_supported(cfg, topo)
+
     @jax.jit
     def k_rounds_fn(state, metrics):
+        if use_packed:
+            carry0 = pack_state(state, cfg)
+            inj0 = pack_bits(state.injected)
+            slim = shrink_state(state)
+
+            def body(_, c):
+                s, carry, inj, m = c
+                return packed_round_step(
+                    s, carry, inj, m, meta, cfg, topo, region
+                )
+
+            slim, carry, inj, m = jax.lax.fori_loop(
+                0, k_rounds, body, (slim, carry0, inj0, metrics)
+            )
+            return unpack_into_state(carry, slim, cfg), m
+
         def body(_, carry):
             s, m = carry
             return round_step(s, m, meta, cfg, topo, region)
@@ -116,6 +159,8 @@ def verify_wall(
     rounds: int,
     per_round_s: float,
     cfg: SimConfig,
+    n_devices: int = 1,
+    packed: bool = False,
 ) -> Tuple[float, Dict[str, object]]:
     """Cross-check a full-run wall and return (defensible_wall, report).
 
@@ -127,12 +172,14 @@ def verify_wall(
     - If full_wall is >3× above, the run carried overhead (compile,
       tunnel stall); full_wall stands (conservative) but is flagged.
     """
-    min_round = analytic_min_round_s(cfg)
+    min_round = analytic_min_round_s(cfg, n_devices, packed)
     expected = rounds * per_round_s
     report: Dict[str, object] = {
         "per_round_ms": round(per_round_s * 1e3, 3),
         "analytic_min_round_ms": round(min_round * 1e3, 4),
-        "carry_write_mb": round(carry_write_bytes(cfg) / 1e6, 1),
+        "carry_write_mb": round(carry_write_bytes(cfg, packed) / 1e6, 1),
+        "n_devices": n_devices,
+        "carry_layout": "packed" if packed else "dense",
         "rounds_x_per_round_s": round(expected, 4),
         "full_run_wall_s": round(full_wall_s, 4),
     }

@@ -82,8 +82,11 @@ def round_step(
 
     state = inject_step(state, meta, cfg)
     state = broadcast_step(state, meta, cfg, topo, region, k_bcast)
+    # sync pulls granted LAST round deliver this round (bi-stream RTT);
+    # capture the buffer before sync_step overwrites it with new pulls
+    pending_sync = state.sync_inflight
     state = sync_step(state, meta, cfg, topo, k_sync)
-    state = deliver_step(state, cfg)
+    state = deliver_step(state, cfg, pending_sync)
     state = swim_step(state, cfg, topo, k_swim)
 
     # refresh the advertised bookkeeping tensors from this round's chunk

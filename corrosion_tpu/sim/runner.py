@@ -513,7 +513,13 @@ def config_write_storm_verified(
     run_scenario(cfg, meta, seed=seed, max_rounds=3000, compile_only=True,
                  mesh=mesh)
     m = run_scenario(cfg, meta, seed=seed, max_rounds=3000, mesh=mesh)
-    wall, report = verify_wall(m["wall_clock_s"], m["rounds"], per_round_s, cfg)
+    from .packed import packed_supported
+
+    wall, report = verify_wall(
+        m["wall_clock_s"], m["rounds"], per_round_s, cfg,
+        n_devices=len(mesh.devices.flat) if mesh is not None else 1,
+        packed=packed_supported(cfg, Topology()),
+    )
     m["wall_clock_s"] = wall
     m["rounds_per_sec"] = m["rounds"] / wall if wall > 0 else 0.0
     m["node_rounds_per_sec"] = (

@@ -75,6 +75,15 @@ class SimConfig:
     rate_limit_bytes_round: Optional[int] = 5 * 1024 * 1024  # 10 MiB/s * 0.5 s tick
     # sync (L7) — cadence in rounds: backoff 1-15 s ≈ 2-30 rounds
     sync_interval_rounds: int = 8
+    # fruitless syncs DOUBLE the re-arm window up to this cap, fruitful
+    # syncs reset it to sync_interval_rounds — the host tier's
+    # decorrelated backoff with reset-on-ingest (agent.py _sync_loop,
+    # util.rs:347-393).  0 = default 4× the base interval (host
+    # max/min backoff ratio is 6×; the uniform re-arm draw halves the
+    # mean, so 4× lands the same effective cadence).  Ground-truth
+    # fidelity: without growth the sim recovered from partitions
+    # unrealistically fast (r4 calibration sweep).
+    sync_backoff_max_rounds: int = 0
     sync_peers: int = 3  # (n/100).clamp(3,10)
     sync_budget_bytes: Optional[int] = 4 * 1024 * 1024
     # SWIM (L5)
@@ -154,6 +163,9 @@ class SimConfig:
     @property
     def n_versions(self) -> int:
         return self.n_payloads // (self.n_writers * self.chunks_per_version)
+
+    def sync_backoff_cap(self) -> int:
+        return self.sync_backoff_max_rounds or 4 * self.sync_interval_rounds
 
     def sync_peers_clamped(self) -> int:
         return max(3, min(10, self.n_nodes // 100 or 3))
@@ -249,7 +261,18 @@ class SimState(NamedTuple):
     injected: jnp.ndarray  # u8[P] payload entered the system (origin was up)
     relay_left: jnp.ndarray  # u8[N, P]
     inflight: jnp.ndarray  # u8[D, N, P]
+    # sync pulls granted last round, delivered this round (one-slot
+    # buffer = the bi-stream RTT).  Kept SEPARATE from the broadcast
+    # ring because sync-received changesets carry no retransmission
+    # budget in the reference (only the rebroadcast path re-arms,
+    # handlers.rs:768-779) — r4 ground-truth: conflating them let one
+    # early post-heal sync flood the cluster via rebroadcast, several×
+    # faster than the host tier recovers
+    sync_inflight: jnp.ndarray  # u8[N, P]
     sync_countdown: jnp.ndarray  # i32[N]
+    # per-node re-arm window: grows ×2 on fruitless due syncs up to
+    # cfg.sync_backoff_cap(), resets to sync_interval_rounds on ingest
+    sync_backoff: jnp.ndarray  # i32[N]
     alive: jnp.ndarray  # u8[N] ground truth (0 = up!  uses ALIVE/DOWN consts)
     incarnation: jnp.ndarray  # u32[N]
     group: jnp.ndarray  # i32[N] partition group
@@ -300,9 +323,11 @@ def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
         injected=jnp.zeros((p,), jnp.uint8),
         relay_left=jnp.zeros((n, p), jnp.uint8),
         inflight=jnp.zeros((cfg.n_delay_slots, n, p), jnp.uint8),
+        sync_inflight=jnp.zeros((n, p), jnp.uint8),
         sync_countdown=jax.random.randint(
             sub, (n,), 0, cfg.sync_interval_rounds, jnp.int32
         ),
+        sync_backoff=jnp.full((n,), cfg.sync_interval_rounds, jnp.int32),
         alive=jnp.zeros((n,), jnp.uint8),
         incarnation=jnp.zeros((n,), jnp.uint32),
         group=jnp.zeros((n,), jnp.int32),

@@ -127,18 +127,41 @@ def sync_step(
     # (version, actor) request order — no per-round permutation needed
     granted = budget_prefix_mask(need, cfg.sync_budget_bytes, meta.nbytes)
 
-    # deliver next round via the delay ring (bi-stream round trip)
-    d_slots = state.inflight.shape[0]
-    slot = (state.t + 1) % d_slots
-    flat_idx = slot * n + src  # pulls arrive at the puller
-    inflight = state.inflight.reshape(d_slots * n, p)
-    inflight = inflight.at[flat_idx].max(granted.astype(state.have.dtype))
-    inflight = inflight.reshape(d_slots, n, p)
+    # pulls land in the one-slot sync buffer, delivered NEXT round (the
+    # bi-stream round trip) — separate from the broadcast ring because
+    # sync-received changesets carry no retransmission budget (see
+    # SimState.sync_inflight).  Fold the s edges per puller first: the
+    # regular layout makes this a reshape-reduce, no scatter.
+    pulled = (
+        granted.reshape(n, s, p).max(axis=1).astype(state.have.dtype)
+    )  # [N, P]
+    # OVERWRITE, not merge: round_step captured the previous round's
+    # buffer before calling sync and hands it to deliver_step this round
+    sync_inflight = pulled
 
-    # re-arm countdowns: due nodes pick a fresh uniform backoff
-    rearm = jax.random.randint(
-        k_rearm, (n,), 1, cfg.sync_interval_rounds + 1, jnp.int32
+    # fruitfulness-adaptive backoff (host _sync_loop: decorrelated
+    # backoff, reset when a sync ingested changes): a due sync that
+    # granted nothing DOUBLES the node's re-arm window up to the cap; a
+    # fruitful one resets it to the base interval.  Ground-truth
+    # calibration r4: without growth the sim recovered from partitions
+    # several× faster than the host tier.
+    fruitful = granted.reshape(n, s, p).any(axis=(1, 2))  # [N] puller got data
+    cap = cfg.sync_backoff_cap()
+    backoff = jnp.where(
+        due & fruitful,
+        jnp.int32(cfg.sync_interval_rounds),
+        jnp.where(
+            due,
+            jnp.minimum(state.sync_backoff * 2, cap),
+            state.sync_backoff,
+        ),
     )
+    # re-arm countdowns: due nodes draw uniform over their window
+    rearm = jax.random.randint(k_rearm, (n,), 1, backoff + 1, jnp.int32)
     countdown = jnp.where(due, rearm, state.sync_countdown - 1)
 
-    return state._replace(inflight=inflight, sync_countdown=countdown)
+    return state._replace(
+        sync_inflight=sync_inflight,
+        sync_countdown=countdown,
+        sync_backoff=backoff,
+    )

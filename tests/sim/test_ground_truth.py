@@ -94,11 +94,14 @@ def test_convergence_distribution_matches_host():
         f"scenario lost its dynamic range: sim p99 = "
         f"{np.percentile(sim, 99):.1f} rounds"
     )
-    for q, slack in ((50, 2), (99, 2)):
+    # ×1.5 + 1 round (VERDICT r3 item 4 tightened the old ×2+2; the r4
+    # kernel-fidelity fixes — adaptive sync backoff, no sync rebroadcast,
+    # spend-on-attempt — carry the band, see test_ground_truth_sweep.py)
+    for q, slack in ((50, 1), (90, 1), (99, 1)):
         h = float(np.percentile(host, q))
         s = float(np.percentile(sim, q))
-        assert s <= h * 2 + slack, f"p{q}: sim={s:.1f} vs host={h:.1f} ticks"
-        assert h <= s * 2 + slack, f"p{q}: host={h:.1f} ticks vs sim={s:.1f}"
+        assert s <= h * 1.5 + slack, f"p{q}: sim={s:.1f} vs host={h:.1f} ticks"
+        assert h <= s * 1.5 + slack, f"p{q}: host={h:.1f} ticks vs sim={s:.1f}"
     print(
         f"calibration: host p50/p99 = {np.percentile(host, 50):.1f}/"
         f"{np.percentile(host, 99):.1f} ticks, sim = "
@@ -215,11 +218,24 @@ def sim_swim_detection_probe_periods(seed: int) -> float:
 
 
 def test_swim_detection_latency_matches_host():
+    from corrosion_tpu.sim.calibration import SWIM_HOST_PERIODS_PER_SIM_PERIOD
+
     host = host_swim_detection_probe_periods()
     sims = [sim_swim_detection_probe_periods(s) for s in range(5)]
     sim = float(np.median(sims))
     # the 10-period suspicion window guarantees real dynamic range
     assert sim > 5, f"sim detection collapsed to {sim:.1f} probe periods"
-    assert sim <= host * 2 + 2, f"sim={sim:.1f} vs host={host:.1f} probe periods"
-    assert host <= sim * 2 + 2, f"host={host:.1f} vs sim={sim:.1f} probe periods"
-    print(f"swim detection: host={host:.1f}, sim median={sim:.1f} probe periods")
+    # ×1.5 band AFTER the documented Δt calibration (VERDICT r3 item 4:
+    # the residual host-side excess — serialized failed-ack awaits +
+    # gossip fan-in tails — is a measured constant, not slack)
+    cal = sim * SWIM_HOST_PERIODS_PER_SIM_PERIOD
+    assert cal <= host * 1.5 + 1, (
+        f"calibrated sim={cal:.1f} vs host={host:.1f} probe periods"
+    )
+    assert host <= cal * 1.5 + 1, (
+        f"host={host:.1f} vs calibrated sim={cal:.1f} probe periods"
+    )
+    print(
+        f"swim detection: host={host:.1f}, sim median={sim:.1f} "
+        f"(calibrated {cal:.1f}) probe periods"
+    )

@@ -87,6 +87,7 @@ def _compare_round(t, sd, md, sp, carry, inj, mp, cfg):
     _assert_equal(f"have@r{t}", sd.have, full.have)
     _assert_equal(f"relay_left@r{t}", sd.relay_left, full.relay_left)
     _assert_equal(f"inflight@r{t}", sd.inflight, full.inflight)
+    _assert_equal(f"sync_inflight@r{t}", sd.sync_inflight, full.sync_inflight)
     _assert_equal(
         f"injected@r{t}",
         sd.injected,
@@ -96,6 +97,7 @@ def _compare_round(t, sd, md, sp, carry, inj, mp, cfg):
     _assert_equal(f"gap_lo@r{t}", sd.gap_lo, sp.gap_lo)
     _assert_equal(f"gap_hi@r{t}", sd.gap_hi, sp.gap_hi)
     _assert_equal(f"sync_countdown@r{t}", sd.sync_countdown, sp.sync_countdown)
+    _assert_equal(f"sync_backoff@r{t}", sd.sync_backoff, sp.sync_backoff)
     _assert_equal(f"key@r{t}", sd.key, sp.key)
     _assert_equal(f"view@r{t}", sd.view, sp.view)
     _assert_equal(f"vinc@r{t}", sd.vinc, sp.vinc)
