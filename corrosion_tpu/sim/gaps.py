@@ -54,6 +54,8 @@ def extract_gaps(
     """
     n, a, v = touched.shape
     k = cfg.gap_slots
+    if v <= 32:
+        return _extract_gaps_words(touched, heads, cfg)
     v_idx = jnp.arange(1, v + 1, dtype=jnp.int32)  # 1-based versions
 
     missing = (~touched) & (v_idx[None, None, :] <= heads[:, :, None])
@@ -64,21 +66,88 @@ def extract_gaps(
     # run index (1-based) at every position of its run
     rank = jnp.cumsum(start, axis=2, dtype=jnp.int32)
 
-    # scatter run boundaries into K slots (runs beyond K contribute 0)
-    rows = jnp.arange(n * a, dtype=jnp.int32)[:, None]  # [N*A, 1]
-    slot = jnp.clip(rank - 1, 0, k - 1).reshape(n * a, v)
-    keep = (rank <= k).reshape(n * a, v)
-    lo_vals = jnp.where(start.reshape(n * a, v) & keep, v_idx[None, :], 0)
-    hi_vals = jnp.where(end.reshape(n * a, v) & keep, v_idx[None, :], 0)
-    lo = jnp.zeros((n * a, k), jnp.int32).at[rows, slot].max(lo_vals)
-    hi = jnp.zeros((n * a, k), jnp.int32).at[rows, slot].max(hi_vals)
-    lo = lo.reshape(n, a, k)
-    hi = hi.reshape(n, a, k)
+    # select run boundaries into K slots with K static masked reductions
+    # (runs beyond K contribute 0).  A scatter into [N*A, K] did this
+    # job before, but a 12.8M-element random scatter cost ~300 ms/round
+    # on CPU at the 100k storm shape and scatters are the weakest op on
+    # TPU too — K is small and static, so K fused compare+select+reduce
+    # passes over the V axis beat it on both platforms (r4 profile:
+    # 343 ms → see BENCH_DIAG), with identical results: each (row, slot)
+    # receives AT MOST one boundary, so a masked max ≡ the scatter.
+    los = []
+    his = []
+    for slot_k in range(k):
+        in_slot = rank == slot_k + 1
+        los.append(
+            jnp.where(start & in_slot, v_idx[None, None, :], 0).max(axis=2)
+        )
+        his.append(
+            jnp.where(end & in_slot, v_idx[None, None, :], 0).max(axis=2)
+        )
+    lo = jnp.stack(los, axis=-1)  # [N, A, K]
+    hi = jnp.stack(his, axis=-1)
 
     # overflow clamp: merge runs K.. into slot K-1 by extending its hi to
     # the last missing version (over-covers; see module docstring)
     overflow = rank[:, :, -1] > k
     last_missing = (missing * v_idx[None, None, :]).max(axis=2)  # [N, A]
+    hi = hi.at[:, :, k - 1].set(
+        jnp.where(overflow, last_missing, hi[:, :, k - 1])
+    )
+    return GapTensors(lo=lo, hi=hi, overflow=overflow)
+
+
+def _extract_gaps_words(
+    touched: jnp.ndarray, heads: jnp.ndarray, cfg: SimConfig
+) -> GapTensors:
+    """V ≤ 32 fast path: the whole version axis packs into ONE u32 word
+    per (node, actor), so run extraction is bitwise on [N, A] words —
+    32× less data than the [N, A, V] formulation (the r4 profile put
+    the grid version at ~350 ms/round at the 100k storm shape; this is
+    a few ms).  Semantics identical: K 1-based inclusive ranges,
+    overflow clamp extends slot K-1 to the last missing version."""
+    import jax.lax as lax
+
+    n, a, v = touched.shape
+    k = cfg.gap_slots
+    u32 = jnp.uint32
+    one = u32(1)
+
+    shifts = jnp.arange(v, dtype=u32)
+    tv = (touched.astype(u32) << shifts[None, None, :]).sum(
+        axis=2, dtype=u32
+    )  # [N, A] version-bit words (bit i = version i+1 touched)
+    h = heads.astype(u32)
+    below = jnp.where(
+        h >= 32, u32(0xFFFFFFFF), (one << h) - one
+    )  # bits [0, head)
+    missing = ~tv & below  # [N, A]
+
+    start = missing & ~(missing << one)
+    end = missing & ~(missing >> one)
+
+    def nth_positions(bits: jnp.ndarray, count: int) -> jnp.ndarray:
+        """1-based position of the j-th set bit for j < count (0 when
+        absent), via iterated lowest-set-bit extraction."""
+        out = []
+        s = bits
+        for _ in range(count):
+            low = s & (~s + one)  # lowest set bit (two's complement)
+            pos = lax.population_count(low - one) + 1  # 1-based
+            out.append(jnp.where(s != 0, pos, u32(0)).astype(jnp.int32))
+            s &= s - one
+        return jnp.stack(out, axis=-1)  # [N, A, count]
+
+    lo = nth_positions(start, k)
+    hi = nth_positions(end, k)
+
+    n_runs = lax.population_count(start).astype(jnp.int32)  # [N, A]
+    overflow = n_runs > k
+    # last missing version: smear below the MSB, popcount = position
+    sm = missing
+    for sh in (1, 2, 4, 8, 16):
+        sm = sm | (sm >> u32(sh))
+    last_missing = lax.population_count(sm).astype(jnp.int32)  # [N, A]
     hi = hi.at[:, :, k - 1].set(
         jnp.where(overflow, last_missing, hi[:, :, k - 1])
     )

@@ -307,10 +307,16 @@ def broadcast_packed(
 
     # the ring is dense u8 (PackedCarry docstring): unpack the eligible
     # words once, then the fan-out scatter is the dense path's plain
-    # at[].max — the only correct-and-fast OR scatter XLA offers
+    # at[].max — the only correct-and-fast OR scatter XLA offers.
+    # `elig8[src]` is a regular f-fold repeat, written as a broadcast so
+    # XLA doesn't emit a 150 MB random gather for it.
     p = cfg.n_payloads
     elig8 = unpack_bits(eligible, p).astype(carry.inflight.dtype)  # [N, P]
-    sent = jnp.where(ok[:, None], elig8[src], jnp.uint8(0))  # [E, P]
+    sent = jnp.where(
+        ok.reshape(n, f)[:, :, None],
+        elig8[:, None, :],
+        jnp.uint8(0),
+    ).reshape(n * f, p)  # [E, P]
 
     d_slots = carry.inflight.shape[0]
     slot = (state.t + delay) % d_slots
@@ -553,13 +559,31 @@ def sync_packed(
     haves_w = below_w & ~miss_w & comp_w
     partial_w = below_w & ~miss_w & ~comp_w
 
+    # src-side masks ride BROADCASTS ([N, 1, W] against the s-axis): the
+    # src index is `repeat(arange, s)` — regular, not a random gather.
+    # Only the four dst-side masks pay a real gather (r4 profile: this
+    # halves sync's HBM traffic at the 100k storm shape).
+    w = miss_w.shape[1]
+    # one fused random gather for the four dst-side masks (contiguous
+    # 4×W-word rows gather better than four separate W-word lookups)
+    dmasks = jnp.stack(
+        [haves_w, partial_w, below_w, carry.have], axis=1
+    )  # [N, 4, W]
+    dd = dmasks[dst].reshape(n, s, 4, w)
+    haves_d = dd[:, :, 0]
+    partial_d = dd[:, :, 1]
+    below_d = dd[:, :, 2]
+    have_d = dd[:, :, 3]
     wanted = (
-        (miss_w[src] & haves_w[dst])  # full needs
-        | (partial_w[src] & (haves_w[dst] | partial_w[dst]))  # partial
-        | (~below_w[src] & below_w[dst])  # head catch-up
-    )  # [E, W]
-    need = wanted & carry.have[dst] & ~carry.have[src]
-    need &= jnp.where(ok[:, None], ONES, U32(0))
+        (miss_w[:, None, :] & haves_d)  # full needs
+        | (partial_w[:, None, :] & (haves_d | partial_d))  # partial
+        | (~below_w[:, None, :] & below_d)  # head catch-up
+    )  # [N, S, W]
+    need = wanted & have_d & ~carry.have[:, None, :]
+    need &= jnp.where(
+        ok.reshape(n, s)[:, :, None], ONES, U32(0)
+    )
+    need = need.reshape(n * s, w)  # [E, W] for the fold below
 
     # pulls land at the PULLER (src): exactly S edges per source in a
     # regular layout, so the OR-reduce is a packed fold — no scatter;

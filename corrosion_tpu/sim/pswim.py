@@ -89,9 +89,11 @@ def _merge_entries(
     n, m = pid.shape
     old_pkey = pkey
     bucket = jnp.where(e_id >= 0, e_id % m, 0)
-    cur_id = pid[e_dst, bucket]
-    cur_key = pkey[e_dst, bucket]
-    cur_since = psince[e_dst, bucket]
+    # ONE fused random gather for the three table reads: the per-entry
+    # (dst, bucket) accesses are the step's cache-miss hot spot
+    tbl = jnp.stack([pid, pkey, psince], axis=-1)  # [N, M, 3]
+    cur = tbl[e_dst, bucket]  # [E, 3]
+    cur_id, cur_key, cur_since = cur[:, 0], cur[:, 1], cur[:, 2]
 
     # 1. matching id → belief precedence merge
     match = e_ok & (cur_id == e_id)
@@ -213,10 +215,23 @@ def pswim_step(
     # append the sender's own claim as entry k
     ent_id = jnp.concatenate([sel_id, me[:, None]], axis=1)  # [N, k+1]
     ent_key = jnp.concatenate([sel_key, self_claim[:, None]], axis=1)
-    e_dst = jnp.repeat(gdst, k + 1)
-    e_id = ent_id[gsrc].reshape(-1)
-    e_key = ent_key[gsrc].reshape(-1)
-    e_ok = jnp.repeat(g_ok, k + 1) & (e_id >= 0) & (e_key >= 0)
+    # regular-index expansion as broadcasts, not gathers: gsrc repeats
+    # each row f times and every entry repeats per target — a random
+    # gather for these cost ~1/3 of the 100k-node step (r4 profile)
+    e_dst = jnp.broadcast_to(gdst.reshape(n, f, 1), (n, f, k + 1)).reshape(-1)
+    e_id = jnp.broadcast_to(
+        ent_id[:, None, :], (n, f, k + 1)
+    ).reshape(-1)
+    e_key = jnp.broadcast_to(
+        ent_key[:, None, :], (n, f, k + 1)
+    ).reshape(-1)
+    e_ok = (
+        jnp.broadcast_to(
+            g_ok.reshape(n, f, 1), (n, f, k + 1)
+        ).reshape(-1)
+        & (e_id >= 0)
+        & (e_key >= 0)
+    )
     # an entry about the RECEIVER is a refutation trigger, not a table
     # merge: SWIM nodes learn of their own suspicion from piggybacked
     # gossip and bump their incarnation (the full-view view[me,me] path)
