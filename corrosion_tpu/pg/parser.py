@@ -285,13 +285,21 @@ class Statement:
 
 _CLAUSE_STOP = ()  # item loop stops only on ) , ; EOF at depth 0
 
-_TX_WORDS = {"BEGIN", "COMMIT", "END", "ROLLBACK", "ABORT", "START"}
+_TX_WORDS = {
+    "BEGIN", "COMMIT", "END", "ROLLBACK", "ABORT", "START",
+    # savepoints are tx-machine statements: the server routes them onto
+    # the open interactive tx's connection (SQLite savepoints natively)
+    "SAVEPOINT", "RELEASE",
+}
 _SESSION_WORDS = {
     "SET", "SHOW", "DEALLOCATE", "DISCARD", "RESET", "LISTEN", "UNLISTEN",
     "NOTIFY",
 }
 _READ_VERBS = {"SELECT", "VALUES", "TABLE", "EXPLAIN"}
 _WRITE_VERBS = {"INSERT", "UPDATE", "DELETE", "REPLACE"}
+# SQL-level prepared statements (PREPARE name AS .. / EXECUTE name(..))
+# share the wire-protocol statement namespace in the server
+_PREPARE_WORDS = {"PREPARE", "EXECUTE"}
 _DDL_VERBS = {"CREATE", "DROP", "ALTER", "TRUNCATE"}
 
 
@@ -496,6 +504,12 @@ class Parser:
                 return self.parse_plain(word, "session")
             if word == "PRAGMA":
                 return self.parse_plain("PRAGMA", "pragma")
+            if word in _PREPARE_WORDS:
+                return self.parse_plain(word, word.lower())
+            if word == "COMMENT":
+                # COMMENT ON .. IS ..: no SQLite analog; parsed so the
+                # server can no-op it with the right command tag
+                return self.parse_plain("COMMENT", "comment")
             if word in _READ_VERBS:
                 # verb keeps the original word (TABLE needs a rewrite in
                 # translate); the command tag maps to SELECT later

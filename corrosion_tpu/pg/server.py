@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import re
+import sqlite3
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -322,6 +324,86 @@ class _Session:
             sql=msg.sql, translated=t, param_oids=oids
         )
 
+    _PREPARE_SQL_RE = re.compile(
+        r"^\s*PREPARE\s+(\"(?:[^\"]|\"\")+\"|\w+)\s*(?:\([^)]*\))?\s+AS\s+(.+)$",
+        re.I | re.S,
+    )
+    _EXECUTE_SQL_RE = re.compile(
+        r"^\s*EXECUTE\s+(\"(?:[^\"]|\"\")+\"|\w+)\s*(?:\((.*)\))?\s*$",
+        re.I | re.S,
+    )
+
+    @staticmethod
+    def _stmt_name(raw: str) -> str:
+        if raw.startswith('"'):
+            return raw[1:-1].replace('""', '"')
+        return raw.lower()  # unquoted identifiers fold to lowercase
+
+    def _prepare_sql(self, sql: str) -> None:
+        """SQL-level PREPARE name [(types)] AS stmt — shares the wire
+        protocol's statement namespace, exactly like PG."""
+        m = self._PREPARE_SQL_RE.match(sql)
+        if not m:
+            raise PgError(sql_state.SYNTAX_ERROR, "malformed PREPARE")
+        name = self._stmt_name(m.group(1))
+        if name in self.prepared:
+            raise PgError(
+                sql_state.DUPLICATE_PREPARED_STATEMENT,
+                f'prepared statement "{name}" already exists',
+            )
+        t = tr.translate(m.group(2), self._constraint_resolver)
+        self.prepared[name] = Prepared(
+            sql=m.group(2),
+            translated=t,
+            param_oids=tuple([p.OID_TEXT] * t.n_params),
+        )
+
+    #: scratch connection for evaluating EXECUTE argument expressions —
+    #: PG evaluates them as expressions at execute time; routing the
+    #: whole list through translate + one SELECT gives exact literal
+    #: semantics (E-strings, X'' blobs, casts, negation) with zero
+    #: hand-rolled decoding.  No DB context: table references error.
+    _scratch_conn = None
+
+    @classmethod
+    def _literal_args(cls, arglist: str) -> tuple:
+        if not arglist or not arglist.strip():
+            return ()
+        t = tr.translate(f"SELECT {arglist}")
+        if t.n_params:
+            raise PgError(
+                sql_state.SYNTAX_ERROR,
+                "EXECUTE arguments cannot reference parameters",
+            )
+        if cls._scratch_conn is None:
+            cls._scratch_conn = sqlite3.connect(
+                ":memory:", check_same_thread=False
+            )
+        try:
+            row = cls._scratch_conn.execute(t.sql).fetchone()
+        except sqlite3.Error as e:
+            raise PgError(
+                sql_state.SYNTAX_ERROR,
+                f"could not evaluate EXECUTE arguments: {e}",
+            )
+        return tuple(row)
+
+    async def _execute_sql(self, sql: str, result_formats, describe_rows):
+        m = self._EXECUTE_SQL_RE.match(sql)
+        if not m:
+            raise PgError(sql_state.SYNTAX_ERROR, "malformed EXECUTE")
+        prep = self._get_prepared(self._stmt_name(m.group(1)))
+        args = self._literal_args(m.group(2) or "")
+        if len(args) != prep.translated.n_params:
+            raise PgError(
+                sql_state.SYNTAX_ERROR,
+                f"wrong number of parameters for prepared statement: want "
+                f"{prep.translated.n_params}, got {len(args)}",
+            )
+        await self._run_statement(
+            prep.translated, args, result_formats, describe_rows
+        )
+
     def _get_prepared(self, name: str) -> Prepared:
         try:
             return self.prepared[name]
@@ -380,6 +462,19 @@ class _Session:
         if t.kind != "read":
             if t.kind == "session" and t.tag == "SHOW":
                 return [p.FieldDesc(name="setting")]
+            if t.kind == "execute":
+                # Describe on an EXECUTE resolves the underlying
+                # prepared statement's row shape — without this, an
+                # extended-protocol EXECUTE would send NoData and then
+                # stream DataRows (protocol violation)
+                m = self._EXECUTE_SQL_RE.match(t.sql)
+                if m:
+                    prep = self.prepared.get(self._stmt_name(m.group(1)))
+                    if prep is not None and prep.translated.kind == "read":
+                        args = self._literal_args(m.group(2) or "")
+                        return await self._describe_fields(
+                            prep.translated, args, result_formats
+                        )
             return None
         pad = tuple(params) + (None,) * 16  # unbound params describe as NULL
         bound = pad[: max(t.n_params, len(params))]
@@ -470,10 +565,41 @@ class _Session:
                 "end of transaction block",
             )
         if t.kind == "tx":
-            tag = await self._tx_statement(t.tag)
+            tag = await self._tx_statement(t.tag, t.sql)
             w.write(p.command_complete(tag))
             return
+        if t.kind == "comment":
+            # COMMENT ON has no SQLite analog: accepted as a no-op with
+            # PG's command tag (comments don't persist)
+            w.write(p.command_complete("COMMENT"))
+            return
+        if t.kind == "prepare":
+            self._prepare_sql(t.sql)
+            w.write(p.command_complete("PREPARE"))
+            return
+        if t.kind == "execute":
+            await self._execute_sql(t.sql, result_formats, describe_rows)
+            return
         if t.kind == "session":
+            if t.tag == "DEALLOCATE":
+                # DEALLOCATE name | ALL: drops SQL- or wire-prepared
+                # statements (shared namespace)
+                rest = t.sql.split(None, 1)
+                arg = rest[1].strip() if len(rest) > 1 else "ALL"
+                if arg.upper() in ("ALL", "PREPARE ALL"):
+                    self.prepared.clear()
+                else:
+                    if arg.upper().startswith("PREPARE "):
+                        arg = arg.split(None, 1)[1]
+                    name = self._stmt_name(arg.strip())
+                    if name not in self.prepared:
+                        raise PgError(
+                            sql_state.INVALID_SQL_STATEMENT_NAME,
+                            f'prepared statement "{name}" does not exist',
+                        )
+                    del self.prepared[name]
+                w.write(p.command_complete("DEALLOCATE"))
+                return
             tag, row = tr.session_statement(t.sql, self.gucs)
             if row is not None:
                 name, val = row
@@ -494,7 +620,99 @@ class _Session:
             return
         await self._run_write(t, params)
 
-    async def _tx_statement(self, tag: str) -> str:
+    _SAVEPOINT_RE = re.compile(
+        r"^\s*SAVEPOINT\s+(.+?)\s*$", re.I
+    )
+    _RELEASE_RE = re.compile(
+        r"^\s*RELEASE\s+(?:SAVEPOINT\s+)?(.+?)\s*$", re.I
+    )
+    _ROLLBACK_TO_RE = re.compile(
+        r"^\s*ROLLBACK\s+(?:WORK\s+|TRANSACTION\s+)?TO\s+"
+        r"(?:SAVEPOINT\s+)?(.+?)\s*$",
+        re.I,
+    )
+
+    @staticmethod
+    def _savepoint_ident(raw: str) -> str:
+        name = raw.strip()
+        if name.startswith('"') and name.endswith('"') and len(name) >= 2:
+            name = name[1:-1].replace('""', '"')
+        return '"' + name.replace('"', '""') + '"'
+
+    async def _tx_statement(self, tag: str, sql: str = "") -> str:
+        if tag == "SAVEPOINT":
+            # PG: only valid inside a transaction block; errors 25P02 in
+            # an aborted tx (savepoints don't bypass the failed gate)
+            if self.tx_failed:
+                raise PgError(
+                    sql_state.IN_FAILED_SQL_TRANSACTION,
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block",
+                )
+            if self.tx is None:
+                raise PgError(
+                    sql_state.NO_ACTIVE_SQL_TRANSACTION,
+                    "SAVEPOINT can only be used in transaction blocks",
+                )
+            m = self._SAVEPOINT_RE.match(sql)
+            if not m:
+                raise PgError(sql_state.SYNTAX_ERROR, "malformed SAVEPOINT")
+            self.tx.execute(f"SAVEPOINT {self._savepoint_ident(m.group(1))}")
+            return "SAVEPOINT"
+        if tag == "RELEASE":
+            if self.tx_failed:
+                raise PgError(
+                    sql_state.IN_FAILED_SQL_TRANSACTION,
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block",
+                )
+            if self.tx is None:
+                raise PgError(
+                    sql_state.NO_ACTIVE_SQL_TRANSACTION,
+                    "RELEASE SAVEPOINT can only be used in transaction "
+                    "blocks",
+                )
+            m = self._RELEASE_RE.match(sql)
+            if not m:
+                raise PgError(sql_state.SYNTAX_ERROR, "malformed RELEASE")
+            try:
+                self.tx.execute(
+                    f"RELEASE SAVEPOINT {self._savepoint_ident(m.group(1))}"
+                )
+            except sqlite3.OperationalError as e:
+                if "no such savepoint" not in str(e).lower():
+                    raise
+                raise PgError(
+                    sql_state.S_E_INVALID_SPECIFICATION,
+                    f"savepoint {m.group(1).strip()!r} does not exist",
+                ) from None
+            return "RELEASE"
+        rb_to = self._ROLLBACK_TO_RE.match(sql) if tag == "ROLLBACK" else None
+        if rb_to is not None:
+            # partial rollback: recovers an ABORTED tx back to the
+            # savepoint (psycopg's nested-transaction pattern) — the one
+            # tx statement that clears the failed flag without ending
+            # the block
+            if self.tx is None:
+                raise PgError(
+                    sql_state.NO_ACTIVE_SQL_TRANSACTION,
+                    "ROLLBACK TO SAVEPOINT can only be used in "
+                    "transaction blocks",
+                )
+            try:
+                self.tx.execute(
+                    f"ROLLBACK TO SAVEPOINT "
+                    f"{self._savepoint_ident(rb_to.group(1))}"
+                )
+            except sqlite3.OperationalError as e:
+                if "no such savepoint" not in str(e).lower():
+                    raise
+                raise PgError(
+                    sql_state.S_E_INVALID_SPECIFICATION,
+                    f"savepoint {rb_to.group(1).strip()!r} does not exist",
+                ) from None
+            self.tx_failed = False
+            return "ROLLBACK"
         if tag == "BEGIN":
             if self.tx is not None:
                 return tag  # PG warns "already a transaction in progress"

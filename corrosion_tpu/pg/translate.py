@@ -173,7 +173,7 @@ def translate(
     SQLSTATE 42704 when absent)."""
     st = parse(sql)
     tag, kind = _tag_kind(st, sql)
-    if kind in ("empty", "tx", "session"):
+    if kind in ("empty", "tx", "session", "prepare", "execute", "comment"):
         return Translated(sql=sql.strip().rstrip(";"), tag=tag, kind=kind)
     body = emit(st, constraint_resolver=constraint_resolver)
     if kind == "read" and st.verb == "TABLE":

@@ -1,0 +1,145 @@
+"""SAVEPOINT / RELEASE / ROLLBACK TO + SQL-level PREPARE/EXECUTE/
+DEALLOCATE + COMMENT ON (round-4 grammar depth; corro-pg parses these
+through sqlparser, lib.rs:546-1906)."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.pg import sql_state
+from corrosion_tpu.pg.client import PgClientError
+
+from .test_pg import _with_pg
+
+
+def test_savepoint_nested_rollback():
+    """psycopg's nested-transaction pattern: an error inside a savepoint
+    rolls back to it and the OUTER tx keeps going and commits."""
+
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query(
+            "CREATE TABLE sp (id INTEGER PRIMARY KEY, v TEXT) WITHOUT ROWID"
+        )
+        await c.query("BEGIN")
+        await c.query("INSERT INTO sp VALUES (1, 'outer')")
+        await c.query("SAVEPOINT nest")
+        await c.query("INSERT INTO sp VALUES (2, 'inner')")
+        # dup pk -> tx enters failed state
+        with pytest.raises(PgClientError) as ei:
+            await c.query("INSERT INTO sp VALUES (1, 'dup')")
+        assert ei.value.code == sql_state.UNIQUE_VIOLATION
+        # ordinary statements are refused while aborted
+        with pytest.raises(PgClientError) as ei2:
+            await c.query("SELECT 1")
+        assert ei2.value.code == sql_state.IN_FAILED_SQL_TRANSACTION
+        # ROLLBACK TO recovers the tx (clears the failed state)
+        await c.query("ROLLBACK TO SAVEPOINT nest")
+        r = await c.query("SELECT count(*) FROM sp")
+        assert r[0].rows[0][0] == "1"  # inner insert rolled back too
+        await c.query("INSERT INTO sp VALUES (3, 'after')")
+        await c.query("COMMIT")
+        r = await c.query("SELECT id FROM sp ORDER BY id")
+        assert [row[0] for row in r[0].rows] == ["1", "3"]
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_savepoint_release_and_partial_keep():
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query(
+            "CREATE TABLE sp2 (id INTEGER PRIMARY KEY, v TEXT) WITHOUT ROWID"
+        )
+        await c.query("BEGIN")
+        await c.query("INSERT INTO sp2 VALUES (1, 'a')")
+        await c.query("SAVEPOINT s1")
+        await c.query("INSERT INTO sp2 VALUES (2, 'b')")
+        await c.query("RELEASE SAVEPOINT s1")  # merges into outer tx
+        # releasing again: gone
+        with pytest.raises(PgClientError):
+            await c.query("RELEASE SAVEPOINT s1")
+        await c.query("ROLLBACK")  # failed tx -> whole tx rolls back
+        r = await c.query("SELECT count(*) FROM sp2")
+        assert r[0].rows[0][0] == "0"
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_savepoint_outside_tx_errors():
+    async def body(cluster, clients):
+        c = clients[0]
+        with pytest.raises(PgClientError) as ei:
+            await c.query("SAVEPOINT lonely")
+        assert ei.value.code == sql_state.NO_ACTIVE_SQL_TRANSACTION
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_prepare_execute_deallocate():
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query(
+            "CREATE TABLE pe (id INTEGER PRIMARY KEY, v TEXT) WITHOUT ROWID"
+        )
+        await c.query("PREPARE ins (int, text) AS INSERT INTO pe VALUES ($1, $2)")
+        await c.query("EXECUTE ins(1, 'one')")
+        await c.query("EXECUTE ins(2, 'two')")
+        r = await c.query("PREPARE q AS SELECT v FROM pe WHERE id = $1")
+        r = await c.query("EXECUTE q(2)")
+        assert r[0].rows == [("two",)]
+        # duplicate name -> 42P05
+        with pytest.raises(PgClientError) as ei:
+            await c.query("PREPARE q AS SELECT 1")
+        assert ei.value.code == sql_state.DUPLICATE_PREPARED_STATEMENT
+        # wrong arity
+        with pytest.raises(PgClientError):
+            await c.query("EXECUTE q(1, 2)")
+        await c.query("DEALLOCATE q")
+        with pytest.raises(PgClientError) as ei2:
+            await c.query("EXECUTE q(1)")
+        assert ei2.value.code == sql_state.INVALID_SQL_STATEMENT_NAME
+        # DEALLOCATE ALL clears the namespace
+        await c.query("DEALLOCATE ALL")
+        with pytest.raises(PgClientError):
+            await c.query("EXECUTE ins(3, 'x')")
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_comment_on_noop():
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query(
+            "CREATE TABLE cm (id INTEGER PRIMARY KEY) WITHOUT ROWID"
+        )
+        r = await c.query("COMMENT ON TABLE cm IS 'service registry'")
+        assert r[0].tag == "COMMENT"
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_execute_extended_protocol_describe():
+    """Extended-protocol EXECUTE of a SQL-prepared SELECT must carry a
+    RowDescription (review r4 finding: NoData + DataRow is a protocol
+    violation), and expression arguments evaluate (E-strings, casts,
+    negatives)."""
+
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query(
+            "CREATE TABLE px (id INTEGER PRIMARY KEY, v TEXT) WITHOUT ROWID"
+        )
+        await c.query("INSERT INTO px VALUES (-3, E'caf\\u00e9')")
+        await c.query("PREPARE gx AS SELECT v FROM px WHERE id = $1")
+        # extended protocol (Parse/Bind/Describe/Execute) of the EXECUTE
+        r = await c.execute("EXECUTE gx(-3)")
+        assert r.columns and r.columns[0][0] == "v"
+        assert r.rows == [("café",)]
+        # expression args: E-string + cast + arithmetic
+        await c.query("PREPARE ins2 AS INSERT INTO px VALUES ($1, $2)")
+        await c.query("EXECUTE ins2(1 + 1, E'a\\nb')")
+        r2 = await c.query("SELECT v FROM px WHERE id = 2")
+        assert r2[0].rows == [("a\nb",)]
+
+    asyncio.run(_with_pg(1, body))
