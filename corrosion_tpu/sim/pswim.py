@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from .state import ALIVE, DOWN, SUSPECT, SimConfig, SimState
-from .swim import _reachable  # shared ground-truth reachability model
+from .swim import _dup_before, _reachable  # shared sampling/reachability
 from .topology import Topology
 
 ID_BITS = 17
@@ -59,6 +59,7 @@ def psample_member_targets(
     cand = jnp.take_along_axis(state.pid, slots, axis=1)  # [N, over]
     ckey = jnp.take_along_axis(state.pkey, slots, axis=1)
     valid = (cand >= 0) & (cand != me) & (ckey % 4 != DOWN) & (ckey >= 0)
+    valid &= ~_dup_before(cand, valid)  # distinct targets (choose_multiple)
     rank = jnp.cumsum(valid, axis=1)
     keep = valid & (rank <= count)
     slot = jnp.clip(rank - 1, 0, count - 1)

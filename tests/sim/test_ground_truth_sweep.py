@@ -102,7 +102,7 @@ def _sim_burst_rounds(seed: int, loss: float, chunks: int = 1) -> float:
 
 @pytest.mark.parametrize("loss", [0.2, 0.7])
 def test_loss_sweep_distribution(loss):
-    seeds = range(8)
+    seeds = range(12)
     host = [_host_burst_rounds(s, loss) for s in seeds]
     sim = [_sim_burst_rounds(s, loss) for s in seeds]
     _assert_quantiles(host, sim, f"loss={loss}")
@@ -198,9 +198,12 @@ def test_partition_heal_distribution():
 
 CHUNK_VERSIONS = 8
 ROW_BYTES = 20_000  # ~3 chunks per version at the 8 KiB cap
+# loss 0.55: at 0.4 both tiers converge in ~3 rounds and the host's
+# ±1-2 ticks of event-loop jitter dwarfs the multiplicative band;
+# higher loss restores dynamic range (5-9 rounds) where x1.5 dominates
 
 
-def _host_chunked_rounds(seed: int, loss: float = 0.4) -> float:
+def _host_chunked_rounds(seed: int, loss: float = 0.55) -> float:
     async def body():
         cluster = Cluster(3, link=LinkModel(loss=loss, seed=seed), use_swim=False)
         await cluster.start()
@@ -238,7 +241,7 @@ def test_chunked_writes_distribution():
     _assert_quantiles(host, sim, "chunked-writes")
 
 
-def _sim_burst_chunked(seed: int, loss: float = 0.4) -> float:
+def _sim_burst_chunked(seed: int, loss: float = 0.55) -> float:
     cfg = SimConfig(
         n_nodes=3, n_payloads=CHUNK_VERSIONS * 3, chunks_per_version=3,
         fanout=2, sync_interval_rounds=4,

@@ -310,3 +310,17 @@ def test_envelope_gate():
     # threshold (packing only pays at HBM scale — CPU A/B r4)
     small = dataclasses.replace(ok, packed_min_cells=1 << 24)
     assert not packed_supported(small, Topology())
+
+
+def test_headline_storm_dispatches_packed():
+    """The official 100k bench shape must ride the packed path: guards
+    the envelope gate constants (payload multiple-of-32, power-of-two
+    chunking, optimize_budgets stripping, the size threshold) against
+    silent drift."""
+    from corrosion_tpu.sim.runner import _write_storm
+
+    cfg, _meta = _write_storm(100_000, 512)
+    assert packed_supported(cfg, Topology())
+    # and the CPU-tier ladder rungs below the crossover stay dense
+    cfg4k, _ = _write_storm(4_000, 512)
+    assert not packed_supported(cfg4k, Topology())
