@@ -127,9 +127,11 @@ class SimConfig:
     allow_packed: bool = True
     # minimum n_nodes*n_payloads before the packed round dispatches: the
     # pack/unpack boundary has per-round fixed cost, so packing only wins
-    # once the payload tensors are HBM-sized (measured CPU A/B r4:
-    # 0.79x at 8k×512=4M cells, 1.20x at 100k×512=51M); tests force 0
-    packed_min_cells: int = 1 << 24
+    # once the payload tensors are HBM-sized (measured CPU A/B r4 after
+    # the kernel optimizations: 0.97x at 8k×512=4.2M cells, 1.15x at
+    # 25k×512=12.8M, 1.24x at 100k×512=51M — crossover ≈ 10M);
+    # tests force 0
+    packed_min_cells: int = 10 * 1024 * 1024
     # payload byte size assumed when metadata gives none
     default_payload_bytes: int = 8 * 1024
 

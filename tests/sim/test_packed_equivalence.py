@@ -306,9 +306,9 @@ def test_envelope_gate():
                       chunks_per_version=2, rate_limit_bytes_round=None,
                       sync_budget_bytes=None, packed_min_cells=0)
     assert not packed_supported(bad_p, Topology())
-    # the size gate: small scenarios stay dense under the default
-    # threshold (packing only pays at HBM scale — CPU A/B r4)
-    small = dataclasses.replace(ok, packed_min_cells=1 << 24)
+    # the size gate: a tiny scenario under the shipped default threshold
+    # stays dense (packing only pays at HBM scale — CPU A/B r4)
+    small = dataclasses.replace(ok, packed_min_cells=SimConfig.packed_min_cells)
     assert not packed_supported(small, Topology())
 
 
@@ -321,6 +321,9 @@ def test_headline_storm_dispatches_packed():
 
     cfg, _meta = _write_storm(100_000, 512)
     assert packed_supported(cfg, Topology())
-    # and the CPU-tier ladder rungs below the crossover stay dense
+    # the measured crossover (~10M cells): 25k×512 = 12.8M rides packed,
+    # 4k×512 = 2.0M stays dense
+    cfg25k, _ = _write_storm(25_000, 512)
+    assert packed_supported(cfg25k, Topology())
     cfg4k, _ = _write_storm(4_000, 512)
     assert not packed_supported(cfg4k, Topology())
