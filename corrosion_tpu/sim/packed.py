@@ -401,7 +401,7 @@ def packed_round_step(
     test_packed_equivalence.py holds the two bit-for-bit equal."""
     from .gaps import extract_gaps
     from .round import RunMetrics
-    from .state import grid_to_payload, version_heads
+    from .state import version_heads
 
     key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
     state = state._replace(key=key)
@@ -430,15 +430,27 @@ def packed_round_step(
         metrics.overflow_frac, gaps.overflow.mean(dtype=jnp.float32)
     )
 
+    # convergence record on WORDS: comp/act are group-uniform (every
+    # chunk bit of a version carries the version's value), so the grid
+    # reductions collapse to bitwise folds — version_done = AND over up
+    # nodes of comp words, node_done = "every payload bit satisfied".
+    # Exactly the dense formulas per bit; the equivalence suite compares
+    # the resulting metrics every round.
     up = state.alive == ALIVE
-    comp = group_grid(carry.have, cfg, "all")  # [N, A, V]
-    act = group_grid(injected_p, cfg, "any")  # [A, V]
-    version_done = jnp.all(comp | ~up[:, None, None], axis=0) & act
-    payload_done = grid_to_payload(version_done, cfg)
+    c = cfg.chunks_per_version
+    comp_w = all_chunks_words(carry.have, cfg)  # [N, W]
+    act_w = _smear_groups(
+        _fold_any(injected_p, c) & _group_low_bits_mask(c), c
+    )  # [W]
+    masked = jnp.where(up[:, None], comp_w, ONES)
+    version_done_w = (
+        jax.lax.reduce(masked, ONES, jax.lax.bitwise_and, (0,)) & act_w
+    )  # [W]
+    payload_done = unpack_bits(version_done_w, cfg.n_payloads)
     coverage_at = jnp.where(
         (metrics.coverage_at < 0) & payload_done, state.t, metrics.coverage_at
     )
-    node_done = jnp.all(comp | ~act[None], axis=(1, 2)) & up
+    node_done = ((comp_w | ~act_w[None, :]) == ONES).all(axis=1) & up
     all_injected = jnp.all(meta.round <= state.t)
     converged_at = jnp.where(
         (metrics.converged_at < 0) & node_done & all_injected,
