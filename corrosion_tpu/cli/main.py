@@ -358,10 +358,15 @@ def cmd_sim(args) -> int:
         "write-storm-100k": runner.config_write_storm_100k,
         "gapstress": runner.config_write_storm_gapstress,
         "gapstress-distortion": runner.config_gapstress_distortion,
+        # packed-vs-dense A/B on the storm shape (results must be
+        # identical; reports the realized speedup)
+        "storm-ab": runner.config_storm_ab,
     }
     fn = fns[args.scenario]
     kwargs = {}
-    scalable = ("write-storm-100k", "gapstress", "gapstress-distortion")
+    scalable = (
+        "write-storm-100k", "gapstress", "gapstress-distortion", "storm-ab",
+    )
     if args.scenario in scalable and args.nodes:
         kwargs["n_nodes"] = args.nodes
     if args.seeds <= 1:
@@ -526,7 +531,7 @@ def build_parser() -> argparse.ArgumentParser:
             "ground-truth-3node", "swim-churn-64",
             "swim-churn-partial-4k", "broadcast-1k",
             "partition-heal-10k", "write-storm-100k",
-            "gapstress", "gapstress-distortion",
+            "gapstress", "gapstress-distortion", "storm-ab",
         ],
     )
     sm.add_argument("--seed", type=int, default=0)
