@@ -96,9 +96,13 @@ def broadcast_step(
     # frame on the wire (see edge_payload_drop)
     drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
     payload = state.have.dtype
+    # `sending[src]` is a regular f-fold repeat (src = repeat(arange, f))
+    # — a broadcast, not a 100M-cell random gather at the gapstress shape
     sent = jnp.where(
-        ok[:, None] & ~drop, sending[src], 0
-    ).astype(payload)  # [E, P]
+        ok.reshape(n, f, 1) & ~drop.reshape(n, f, p),
+        sending[:, None, :],
+        False,
+    ).astype(payload).reshape(n * f, p)  # [E, P]
 
     # scatter into the delay ring: slot (t + delay) mod D per edge
     d_slots = state.inflight.shape[0]

@@ -28,6 +28,8 @@ RTT).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -66,17 +68,42 @@ def node_sync_masks(state: SimState, cfg: SimConfig):
 
 
 def edge_needs(
-    state: SimState, cfg: SimConfig, src: jnp.ndarray, dst: jnp.ndarray
+    state: SimState,
+    cfg: SimConfig,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    regular_fanout: Optional[int] = None,
 ) -> jnp.ndarray:
     """bool[E, P] — chunks ``dst`` (server) can supply to ``src`` (puller),
     per the three need classes of `compute_available_needs`
     (sync.rs:127-249) evaluated on the advertised interval state.  Shared
-    by the sync kernel and the kernel-vs-scalar-spec property test."""
+    by the sync kernel and the kernel-vs-scalar-spec property test.
+
+    ``regular_fanout=s`` declares the kernel's regular edge layout
+    (src = repeat(arange(n), s)): src-side tensors then ride broadcasts
+    instead of random gathers — at the gapstress shape those gathers
+    were 100M cells each.  Callers with irregular edge lists (the
+    property test) omit it and get plain indexing."""
     miss_full, partial, haves = node_sync_masks(state, cfg)
     v_idx = jnp.arange(1, cfg.n_versions + 1, dtype=jnp.int32)[None, None, :]
-    full_need = miss_full[src] & haves[dst]  # [E, A, V]
-    partial_need = partial[src] & (haves[dst] | partial[dst])
-    catchup = (v_idx > state.heads[src][:, :, None]) & (
+    n = state.have.shape[0]
+    e = src.shape[0]
+    if regular_fanout is not None:
+        s = regular_fanout
+        assert e == n * s, "regular_fanout does not match the edge count"
+
+        def at_src(x):  # [N, ...] -> [E, ...] via broadcast
+            return jnp.broadcast_to(
+                x[:, None], (n, s) + x.shape[1:]
+            ).reshape((e,) + x.shape[1:])
+    else:
+
+        def at_src(x):
+            return x[src]
+
+    full_need = at_src(miss_full) & haves[dst]  # [E, A, V]
+    partial_need = at_src(partial) & (haves[dst] | partial[dst])
+    catchup = (v_idx > at_src(state.heads)[:, :, None]) & (
         v_idx <= state.heads[dst][:, :, None]
     )
     wanted = full_need | partial_need | catchup
@@ -87,7 +114,7 @@ def edge_needs(
     return (
         grid_to_payload(wanted, cfg)
         & (state.have[dst] > 0)
-        & (state.have[src] == 0)
+        & (at_src(state.have) == 0)
     )  # [E, P]
 
 
@@ -120,7 +147,7 @@ def sync_step(
     ok &= due[src]
     ok &= dst != src
 
-    need = edge_needs(state, cfg, src, dst) & ok[:, None]  # [E, P]
+    need = edge_needs(state, cfg, src, dst, regular_fanout=s) & ok[:, None]  # [E, P]
 
     # oldest-first budget: the payload axis is version-major BY
     # CONSTRUCTION (uniform_payloads), so index order is already global
