@@ -386,6 +386,7 @@ def main() -> int:
         aux = {}
         for fn in (
             "config_swim_churn_64",
+            "config_swim_churn_partial",  # #2 at the partial-view tier
             "config_broadcast_1k",
             "config_partition_heal_10k",
             "config_gapstress_distortion",  # #5b: V≫K overflow + control
