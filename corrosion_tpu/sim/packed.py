@@ -443,10 +443,16 @@ def packed_round_step(
         _fold_any(injected_p, c) & _group_low_bits_mask(c), c
     )  # [W]
     masked = jnp.where(up[:, None], comp_w, ONES)
-    version_done_w = (
-        jax.lax.reduce(masked, ONES, jax.lax.bitwise_and, (0,)) & act_w
-    )  # [W]
-    payload_done = unpack_bits(version_done_w, cfg.n_payloads)
+    # AND-fold over the NODE axis — the mesh-sharded axis.  A bitwise
+    # u32 reduction is a custom GSPMD reduction computation XLA:CPU
+    # rejects (UNIMPLEMENTED), so go through the PRED plane: unpack to
+    # bool, jnp.all over nodes (a supported reduce_and collective),
+    # re-pack.  Bit-identical to lax.reduce(bitwise_and); [N,P] bool is
+    # the same footprint the dense path's comp grid already pays.
+    payload_done = (
+        jnp.all(unpack_bits(masked, cfg.n_payloads), axis=0)
+        & unpack_bits(act_w, cfg.n_payloads)
+    )  # [P]
     coverage_at = jnp.where(
         (metrics.coverage_at < 0) & payload_done, state.t, metrics.coverage_at
     )

@@ -7,6 +7,7 @@ primitive spike's wins, so the phase breakdown decides where packing
 pays and where it costs.
 
 Run: JAX_PLATFORMS=cpu python doc/experiments/round_phase_profile.py [n_nodes]
+     PROFILE_PLATFORM=default python ... [n_nodes]   # real device (tpu)
 """
 
 import os
@@ -17,7 +18,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("PROFILE_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
 
@@ -84,7 +86,11 @@ def main():
     d["sync"] = timeit(
         "sync", jax.jit(lambda s, k: sync_step(s, meta, cfg, topo, k)), state, key
     )
-    d["deliver"] = timeit("deliver", jax.jit(lambda s: deliver_step(s, cfg)), state)
+    d["deliver"] = timeit(
+        "deliver",
+        jax.jit(lambda s: deliver_step(s, cfg, s.sync_inflight)),
+        state,
+    )
     d["swim"] = timeit(
         "swim", jax.jit(lambda s, k: swim_step(s, cfg, topo, k)), state, key
     )
@@ -120,7 +126,7 @@ def main():
     )
     q["deliver"] = timeit(
         "deliver",
-        jax.jit(lambda c, s: pk.deliver_packed(c, s.t, cfg)),
+        jax.jit(lambda c, s: pk.deliver_packed(c, c.sync_buf, s.t, cfg)),
         carry, slim,
     )
     q["swim"] = timeit(

@@ -59,6 +59,9 @@ N_VERSIONS = 20
 
 
 def _host_burst_rounds(seed: int, loss: float) -> float:
+    """Returns max apply-tick delta, or NaN when the event loop was too
+    starved for the tick clock to mean anything (see _skip_if_loaded)."""
+
     async def body():
         cluster = Cluster(3, link=LinkModel(loss=loss, seed=seed), use_swim=False)
         await cluster.start()
@@ -66,11 +69,13 @@ def _host_burst_rounds(seed: int, loss: float) -> float:
             writer = cluster.agents[0]
             receivers = cluster.agents[1:]
             t0 = {id(a): a.flush_tick for a in receivers}
+            wall0 = asyncio.get_event_loop().time()
             for i in range(N_VERSIONS):
                 writer.exec_transaction(
                     [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"v{i}"))]
                 )
             assert await cluster.wait_converged(60)
+            wall = asyncio.get_event_loop().time() - wall0
             rounds = 0.0
             for a in receivers:
                 ticks = [
@@ -79,6 +84,17 @@ def _host_burst_rounds(seed: int, loss: float) -> float:
                 ]
                 assert len(ticks) == N_VERSIONS
                 rounds = max(rounds, float(max(ticks) - t0[id(a)]))
+            # load guard: the tick clock is only load-invariant while
+            # the loop keeps its 0.02 s flush cadence.  If wall time per
+            # elapsed tick ran >2.5x nominal, a co-tenant (bench run,
+            # parallel suite) starved the loop and the host measurement
+            # is noise, not calibration signal.
+            elapsed_ticks = max(
+                float(max(a.flush_tick for a in receivers)
+                      - min(t0.values())), 1.0
+            )
+            if wall / elapsed_ticks > 2.5 * 0.02:
+                return float("nan")
             return rounds
         finally:
             await cluster.stop()
@@ -104,6 +120,13 @@ def _sim_burst_rounds(seed: int, loss: float, chunks: int = 1) -> float:
 def test_loss_sweep_distribution(loss):
     seeds = range(12)
     host = [_host_burst_rounds(s, loss) for s in seeds]
+    starved = sum(1 for h in host if h != h)  # NaN check
+    if starved > len(host) // 3:
+        pytest.skip(
+            f"event loop starved in {starved}/{len(host)} host runs "
+            "(co-tenant load); calibration needs a quiet machine"
+        )
+    host = [h for h in host if h == h]
     sim = [_sim_burst_rounds(s, loss) for s in seeds]
     _assert_quantiles(host, sim, f"loss={loss}")
 
