@@ -391,6 +391,9 @@ def constraint_columns(
 
 def register_functions(conn: sqlite3.Connection, dbname: str) -> None:
     """Session functions PG clients call during introspection."""
+    from . import runtime
+
+    runtime.register(conn)  # the PG scalar/aggregate function pack
     conn.create_function("version", 0, lambda: "PostgreSQL 14.0 (corrosion-tpu)")
     conn.create_function("current_schema", 0, lambda: "public")
     conn.create_function("current_database", 0, lambda: dbname)

@@ -44,6 +44,11 @@ class UnknownConstraint(ValueError):
     """ON CONSTRAINT name not found (SQLSTATE 42704)."""
 
 
+class UnsupportedConstruct(ValueError):
+    """Parsed fine, but has no SQLite execution strategy (SQLSTATE
+    0A000 via translate.UnsupportedStatement)."""
+
+
 # ---------------------------------------------------------------------------
 # lexer
 
@@ -822,6 +827,126 @@ _TYPE_MAP = {
 _OPERATOR_MAP = {"~": "REGEXP", "~~": "LIKE", "=": "=", "<>": "<>",
                  "!=": "!=", "~*": "REGEXP"}
 
+# function renames applied at call sites (PG name → SQLite/UDF name;
+# UDFs live in runtime.py and are registered on every PG-serving conn)
+_CALL_RENAMES = {
+    # UDFs, not SQLite MAX/MIN: PG's greatest/least IGNORE NULLs
+    # (greatest(1, NULL, 3) = 3) where SQLite's scalar MAX returns NULL
+    "greatest": "pg_greatest", "least": "pg_least",
+    "string_agg": "group_concat",
+    "array_agg": "json_group_array",
+    "json_agg": "json_group_array", "jsonb_agg": "json_group_array",
+    "json_object_agg": "json_group_object",
+    "jsonb_object_agg": "json_group_object",
+    "json_build_object": "json_object", "jsonb_build_object": "json_object",
+    "json_build_array": "json_array", "jsonb_build_array": "json_array",
+    "to_json": "pg_to_json", "to_jsonb": "pg_to_json",
+    "left": "pg_left", "right": "pg_right",  # SQLite JOIN keywords
+    "random": "pg_random",  # PG: float in [0,1); SQLite: int64
+    "now": "pg_now", "transaction_timestamp": "pg_now",
+    "statement_timestamp": "pg_now", "clock_timestamp": "pg_now",
+    "char_length": "length", "character_length": "length",
+    "strpos": "instr",
+    "uuid_generate_v4": "gen_random_uuid",
+}
+
+# keyword Names that terminate a value expression (used to decide
+# whether an item can be the LHS of an infix rewrite)
+_CLAUSE_KWS = (
+    "SELECT", "FROM", "WHERE", "AND", "OR", "NOT", "BY", "GROUP",
+    "ORDER", "HAVING", "LIMIT", "OFFSET", "SET", "VALUES", "ON", "AS",
+    "IN", "IS", "LIKE", "ILIKE", "BETWEEN", "CASE", "WHEN", "THEN",
+    "ELSE", "END", "RETURNING", "UNION", "INTERSECT", "EXCEPT", "ALL",
+    "DISTINCT", "JOIN", "LEFT", "RIGHT", "INNER", "OUTER", "CROSS",
+    "FULL", "USING", "INTO", "INSERT", "UPDATE", "DELETE", "INTERVAL",
+    "ASC", "DESC", "NULLS", "FIRST", "LAST", "OVER", "PARTITION",
+    "FILTER", "EXISTS", "ANY", "SOME", "ARRAY", "ESCAPE", "COLLATE",
+    "WITH",
+)
+
+
+def _is_valueish(it: "Item") -> bool:
+    """Could `it` be a complete value expression (LHS of an infix
+    operator rewrite)?"""
+    if isinstance(it, (Call, Group, Cast)):
+        return True
+    if isinstance(it, Name):
+        return not (
+            len(it.parts) == 1
+            and not it.parts[0].quoted
+            and it.parts[0].value.upper() in _CLAUSE_KWS
+        )
+    if isinstance(it, Token):
+        return it.kind in (STRING, NUMBER, PARAM)
+    return False
+
+
+def _split_args(args: Sequence["Item"]) -> List[List["Item"]]:
+    """Split a Call's flat arg items on top-level ',' tokens."""
+    out: List[List[Item]] = [[]]
+    for a in args:
+        if isinstance(a, Token) and a.kind == PUNCT and a.value == ",":
+            out.append([])
+        else:
+            out[-1].append(a)
+    if out == [[]]:
+        return []
+    return out
+
+
+def _strip_order_by(args: Sequence["Item"]) -> Sequence["Item"]:
+    """Drop a trailing ``ORDER BY ...`` from aggregate arguments:
+    SQLite < 3.44 rejects it inside group_concat, and the SQLite
+    aggregates have no ordered form anyway (the multiset is identical;
+    the concatenation order deviation is documented in doc/api/pg.md)."""
+    for k, a in enumerate(args):
+        if (
+            item_is_kw(a, "ORDER")
+            and k + 1 < len(args)
+            and item_is_kw(args[k + 1], "BY")
+        ):
+            return args[:k]
+    return args
+
+
+def _strip_quotes(tok: "Token") -> str:
+    """STRING token → inner text ('' unescaped; E-string prefix shed)."""
+    v = tok.value
+    if v and v[0] in "eE":
+        v = v[1:]
+    if len(v) >= 2 and v[0] == "'" and v[-1] == "'":
+        v = v[1:-1]
+    return v.replace("''", "'")
+
+
+def _literal_number(items: Sequence["Item"]):
+    """[Token(2)] or [-, Token(2)] → float, else None."""
+    sign = 1.0
+    toks = list(items)
+    if (
+        len(toks) == 2
+        and isinstance(toks[0], Token)
+        and toks[0].kind == OP
+        and toks[0].value in "+-"
+    ):
+        sign = -1.0 if toks[0].value == "-" else 1.0
+        toks = toks[1:]
+    if len(toks) == 1 and isinstance(toks[0], Token) and toks[0].kind == NUMBER:
+        try:
+            return sign * float(toks[0].value)
+        except ValueError:
+            return None
+    return None
+
+
+# Name-position keyword spellings PG accepts bare (emit_name)
+_NAME_RENAMES = {
+    "localtimestamp": "CURRENT_TIMESTAMP",
+    "localtime": "CURRENT_TIME",
+    "current_user": "'postgres'", "session_user": "'postgres'",
+    "current_role": "'postgres'",
+}
+
 _E_ESCAPES = {
     "n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
     "\\": "\\", "'": "'", '"': '"', "0": "\0",
@@ -913,8 +1038,16 @@ class Emitter:
 
     def emit_items(self, items: Sequence[Item]) -> None:
         idx = 0
+        clause = None  # last clause keyword seen at THIS nesting level
         while idx < len(items):
             it = items[idx]
+            if isinstance(it, Name) and len(it.parts) == 1 and not it.parts[0].quoted:
+                up = it.parts[0].value.upper()
+                if up in ("FROM", "JOIN"):
+                    clause = "FROM"
+                elif up in ("SELECT", "WHERE", "GROUP", "ORDER", "HAVING",
+                            "SET", "VALUES", "RETURNING", "LIMIT", "ON"):
+                    clause = up
             # COLLATE pg_catalog.default / COLLATE "default" → dropped
             if (
                 item_is_kw(it, "COLLATE")
@@ -936,8 +1069,432 @@ class Emitter:
             if rewritten:
                 idx += rewritten
                 continue
+            rewritten = self._try_interval_arith(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            rewritten = self._try_interval_literal(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            # any_all first: `~ ANY(...)` must hit the quantified-form
+            # rejection, not the regex rewrite
+            rewritten = self._try_any_all(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            rewritten = self._try_regex_op(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            rewritten = self._try_array_literal(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            # left(x, n) / right(x, n): JOIN keywords, so the parser
+            # leaves them as Name + Group instead of a Call
+            if (
+                item_is_kw(it, "LEFT", "RIGHT")
+                and idx + 1 < len(items)
+                and isinstance(items[idx + 1], Group)
+                and not items[idx + 1].is_select
+            ):
+                self._emit("pg_" + it.parts[0].value.lower())
+                self.out.append("(")
+                self.emit_items(items[idx + 1].items)
+                self._emit(")")
+                idx += 2
+                continue
+            rewritten = self._try_for_lock(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            if clause == "FROM":
+                rewritten = self._try_srf(items, idx)
+                if rewritten:
+                    idx += rewritten
+                    continue
             self.emit_item(it)
             idx += 1
+
+    # -- PG-idiom pattern rewrites (each returns items consumed, 0 = no
+    # match; execution-level fidelity the reference gets from PG itself
+    # and we must synthesize over SQLite) ----------------------------------
+
+    def _try_interval_arith(self, items: Sequence[Item], idx: int) -> int:
+        """``<ts> ± interval '...' [± interval '...']...`` →
+        nested ``pg_ts_offset(<ts>, '...', ±1)`` — a UDF rather than
+        SQLite datetime() modifiers because PG clamps month overflow
+        ('2026-01-31' + 1 mon = Feb 28) where datetime() normalizes it
+        into March."""
+        from .runtime import interval_to_seconds
+
+        def match(k: int):
+            """± interval-literal at k → (string-token, items-consumed);
+            both the keyword form (``- interval '1 h'``) and the cast
+            form (``- '1 h'::interval``) count — the cast form would
+            otherwise fold to float seconds and silently coerce the
+            text timestamp to numeric garbage."""
+            if not (
+                k + 1 < len(items)
+                and isinstance(items[k], Token)
+                and items[k].kind == OP
+                and items[k].value in "+-"
+            ):
+                return None
+            nxt = items[k + 1]
+            if (
+                item_is_kw(nxt, "INTERVAL")
+                and k + 2 < len(items)
+                and isinstance(items[k + 2], Token)
+                and items[k + 2].kind == STRING
+            ):
+                return items[k + 2], 3
+            if (
+                isinstance(nxt, Cast)
+                and nxt.pg_type == "interval"
+                and isinstance(nxt.operand, Token)
+                and nxt.operand.kind == STRING
+            ):
+                return nxt.operand, 2
+            return None
+
+        if not _is_valueish(items[idx]) or match(idx + 1) is None:
+            return 0
+        steps: List[tuple] = []  # (interval-text, sign)
+        j = idx + 1
+        while True:
+            got = match(j)
+            if got is None:
+                break
+            tok, width = got
+            text = _strip_quotes(tok)
+            try:
+                interval_to_seconds(text)  # validate at emit time
+            except ValueError:
+                return 0  # unparseable interval: emit raw, fail at exec
+            steps.append((text, -1 if items[j].value == "-" else 1))
+            j += width
+        for _ in steps:
+            self._emit("pg_ts_offset")
+            self.out.append("(")
+        self.emit_item(items[idx])
+        for text, sign in steps:
+            self._emit(",")
+            self._emit("'" + text.replace("'", "''") + "'")
+            self._emit(",")
+            self._emit(str(sign))
+            self._emit(")")
+        return j - idx
+
+    def _try_interval_literal(self, items: Sequence[Item], idx: int) -> int:
+        """Standalone ``interval '...'`` → seconds as a float literal
+        (the EXTRACT(EPOCH...) view; doc/pg.md#intervals)."""
+        from .runtime import interval_to_seconds
+
+        if not (
+            item_is_kw(items[idx], "INTERVAL")
+            and idx + 1 < len(items)
+            and isinstance(items[idx + 1], Token)
+            and items[idx + 1].kind == STRING
+        ):
+            return 0
+        try:
+            secs = interval_to_seconds(_strip_quotes(items[idx + 1]))
+        except ValueError:
+            return 0
+        self._emit(repr(secs))
+        return 2
+
+    def _try_regex_op(self, items: Sequence[Item], idx: int) -> int:
+        """Infix ``~  ~*  !~  !~*`` → [NOT] REGEXP (the regexp(p, s) UDF
+        is registered by runtime.py); ``*`` variants prepend ``(?i)`` —
+        SQLite's ``||`` binds tighter than REGEXP, so no parens needed."""
+        it = items[idx]
+        if not (
+            isinstance(it, Token)
+            and it.kind == OP
+            and it.value in ("~", "~*", "!~", "!~*")
+            and idx > 0
+            and _is_valueish(items[idx - 1])
+            and idx + 1 < len(items)
+        ):
+            return 0
+        if it.value.startswith("!"):
+            self._emit("NOT")
+        self._emit("REGEXP")
+        if it.value.endswith("*"):
+            self._emit("'(?i)'")
+            self._emit("||")
+        return 1
+
+    def _try_any_all(self, items: Sequence[Item], idx: int) -> int:
+        """``= ANY(x)`` → ``IN (SELECT value FROM json_each(pg_array_json(x)))``
+        and ``<> ALL(x)`` → ``NOT IN (...)`` — the psycopg list-parameter
+        idioms; arrays are JSON/PG-literal text (runtime.pg_array_json)."""
+        it = items[idx]
+        is_op = (
+            isinstance(it, Token)
+            and it.kind == OP
+            and it.value in ("=", "<>", "!=", "<", ">", "<=", ">=", "~", "~*")
+        )
+        is_like = item_is_kw(it, "LIKE", "ILIKE")
+        if not ((is_op or is_like) and idx + 1 < len(items)):
+            return 0
+        # ANY/SOME parse as a Call; ALL is a reserved word, so
+        # ``ALL('{..}')`` parses as Name + Group
+        nxt = items[idx + 1]
+        consumed = 2
+        if isinstance(nxt, Call) and len(nxt.name.parts) == 1:
+            fname = nxt.name.parts[0].value.lower()
+            arg_items: Sequence[Item] = nxt.args
+        elif (
+            item_is_kw(nxt, "ANY", "SOME", "ALL")
+            and idx + 2 < len(items)
+            and isinstance(items[idx + 2], Group)
+        ):
+            fname = nxt.parts[0].value.lower()
+            arg_items = items[idx + 2].items
+            consumed = 3
+        else:
+            return 0
+        if fname not in ("any", "some", "all"):
+            return 0
+        op_text = it.value if is_op else it.parts[0].value.upper()
+        if op_text == "=" and fname in ("any", "some"):
+            negate = False
+        elif op_text in ("<>", "!=") and fname == "all":
+            negate = True
+        else:
+            # <> ANY, = ALL, ordered comparisons: quantified forms with
+            # no direct SQLite strategy — reject cleanly (emitting the
+            # raw call would die later with "no such function: ANY")
+            raise UnsupportedConstruct(
+                f"{op_text} {fname.upper()}(...) quantified comparison "
+                "is not supported; use = ANY / <> ALL or rewrite with "
+                "EXISTS"
+            )
+        # a cast on the array argument ($1::int[]) would destroy the
+        # array text before pg_array_json parses it — strip it
+        if (
+            len(arg_items) == 1
+            and isinstance(arg_items[0], Cast)
+        ):
+            arg_items = [arg_items[0].operand]
+        if negate:
+            self._emit("NOT")
+        self._emit("IN")
+        self._emit("(")
+        if arg_items and item_is_kw(
+            arg_items[0], "SELECT", "VALUES", "WITH", "TABLE"
+        ):
+            # = ANY(subquery) ≡ IN (subquery) — no array wrapper
+            self.emit_items(arg_items)
+        else:
+            self._emit("SELECT value FROM json_each")
+            self.out.append("(")
+            self._emit("pg_array_json")
+            self.out.append("(")
+            self.emit_items(arg_items)
+            self._emit(")")
+            self._emit(")")
+        self._emit(")")
+        return consumed
+
+    def _try_array_literal(self, items: Sequence[Item], idx: int) -> int:
+        """``ARRAY[a, b, ...]`` → ``json_array(a, b, ...)`` (arrays are
+        JSON text everywhere in this dialect)."""
+        if not (
+            item_is_kw(items[idx], "ARRAY")
+            and idx + 1 < len(items)
+            and isinstance(items[idx + 1], Token)
+            and items[idx + 1].value == "["
+        ):
+            return 0
+        depth = 0
+        close = -1
+        for k in range(idx + 1, len(items)):
+            t = items[k]
+            if isinstance(t, Token):
+                if t.value == "[":
+                    depth += 1
+                elif t.value == "]":
+                    depth -= 1
+                    if depth == 0:
+                        close = k
+                        break
+        if close < 0:
+            return 0
+        self._emit("json_array")
+        self.out.append("(")
+        self.emit_items(items[idx + 2: close])
+        self._emit(")")
+        return close - idx + 1
+
+    def _try_for_lock(self, items: Sequence[Item], idx: int) -> int:
+        """``FOR UPDATE / FOR [NO KEY] SHARE/UPDATE [OF t, ...]
+        [NOWAIT | SKIP LOCKED]`` → dropped: the store's single-writer
+        lane serializes writes, so PG row-lock hints have no analog."""
+        if not (
+            item_is_kw(items[idx], "FOR")
+            and idx + 1 < len(items)
+            and item_is_kw(items[idx + 1], "UPDATE", "SHARE", "NO", "KEY")
+        ):
+            return 0
+        j = idx + 1
+        while j < len(items) and item_is_kw(items[j], "NO", "KEY", "UPDATE", "SHARE"):
+            j += 1
+        if j < len(items) and item_is_kw(items[j], "OF"):
+            j += 1
+            while j < len(items):
+                if isinstance(items[j], Name) and not item_is_kw(
+                    items[j], "NOWAIT", "SKIP"
+                ):
+                    j += 1
+                    if (
+                        j < len(items)
+                        and isinstance(items[j], Token)
+                        and items[j].value == ","
+                    ):
+                        j += 1
+                        continue
+                break
+        if j < len(items) and item_is_kw(items[j], "NOWAIT"):
+            j += 1
+        elif (
+            j + 1 < len(items)
+            and item_is_kw(items[j], "SKIP")
+            and item_is_kw(items[j + 1], "LOCKED")
+        ):
+            j += 2
+        return j - idx
+
+    def _try_srf(self, items: Sequence[Item], idx: int) -> int:
+        """Set-returning functions in FROM position:
+        ``generate_series(a, b[, step])`` → recursive-CTE subquery;
+        ``unnest(arr)`` → ``json_each`` over the JSON-text array.  The
+        PG aliasing rule (a bare alias names the single output column)
+        is reproduced."""
+        it = items[idx]
+        if not (isinstance(it, Call) and len(it.name.parts) == 1):
+            return 0
+        fname = it.name.parts[0].value.lower()
+        if fname not in ("generate_series", "unnest"):
+            return 0
+
+        # alias lookahead (same shapes as _try_values_alias)
+        j = idx + 1
+        if j < len(items) and item_is_kw(items[j], "AS"):
+            j += 1
+        alias: Optional[str] = None
+        col: Optional[str] = None
+        if (
+            j + 1 < len(items)
+            and item_is_kw(items[j], "WITH")
+            and item_is_kw(items[j + 1], "ORDINALITY")
+        ):
+            raise UnsupportedConstruct(
+                "WITH ORDINALITY is not supported; join against "
+                "generate_series or use row_number()"
+            )
+        if j < len(items) and isinstance(items[j], Call) and len(
+            items[j].name.parts
+        ) == 1:
+            alias = items[j].name.parts[0].value
+            cargs = _split_args(items[j].args)
+            if len(cargs) == 1 and len(cargs[0]) == 1 and isinstance(
+                cargs[0][0], Name
+            ):
+                col = cargs[0][0].parts[0].value
+            j += 1
+        elif (
+            j < len(items)
+            and isinstance(items[j], Name)
+            and len(items[j].parts) == 1
+            and _is_valueish(items[j])
+        ):
+            alias = items[j].parts[0].value
+            j += 1
+            if j < len(items) and isinstance(items[j], Group):
+                sub = _split_args(items[j].items)
+                if len(sub) == 1 and len(sub[0]) == 1 and isinstance(
+                    sub[0][0], Name
+                ):
+                    col = sub[0][0].parts[0].value
+                    j += 1
+        table = alias or fname
+        colname = col or alias or fname
+
+        if fname == "unnest":
+            self._emit("(")
+            self._emit("SELECT value AS")
+            self._emit(f'"{colname}"')
+            self._emit("FROM json_each")
+            self.out.append("(")
+            self._emit("pg_array_json")
+            self.out.append("(")
+            self.emit_items(it.args)
+            self._emit(")")
+            self._emit(")")
+            self._emit(")")
+        else:
+            arglists = _split_args(it.args)
+            if len(arglists) not in (2, 3):
+                raise UnsupportedConstruct(
+                    "generate_series over timestamps or with missing "
+                    "bounds is not supported"
+                )
+            step = 1.0
+            if len(arglists) == 3:
+                lit = _literal_number(arglists[2])
+                if lit is None:
+                    raise UnsupportedConstruct(
+                        "generate_series step must be a literal number"
+                    )
+                if lit == 0:
+                    # PG: "step size cannot equal zero"; emitting it
+                    # would make the recursive CTE spin forever
+                    raise UnsupportedConstruct(
+                        "generate_series step cannot be zero"
+                    )
+                step = lit
+            cmp_op = "<=" if step >= 0 else ">="
+            # integral steps emit as INTEGER so the series keeps PG's
+            # int type (value + 2.0 would promote every row to REAL)
+            step_text = (
+                str(int(step)) if float(step).is_integer() else repr(step)
+            )
+
+            def emit_arg(arg_items):
+                self.emit_items(arg_items)
+
+            self._emit("(")
+            self._emit('WITH RECURSIVE "__corro_gs"')
+            self._emit("(")
+            self._emit("value")
+            self._emit(")")
+            self._emit("AS")
+            self._emit("(")
+            self._emit("SELECT")
+            emit_arg(arglists[0])
+            self._emit("WHERE")
+            emit_arg(arglists[0])
+            self._emit(cmp_op)
+            emit_arg(arglists[1])
+            self._emit("UNION ALL SELECT value +")
+            self._emit(step_text)
+            self._emit('FROM "__corro_gs" WHERE value +')
+            self._emit(step_text)
+            self._emit(cmp_op)
+            emit_arg(arglists[1])
+            self._emit(")")
+            self._emit("SELECT value AS")
+            self._emit(f'"{colname}"')
+            self._emit('FROM "__corro_gs"')
+            self._emit(")")
+        self._emit("AS")
+        self._emit(f'"{table}"')
+        return j - idx
 
     def _try_values_alias(self, items: Sequence[Item], idx: int) -> int:
         """Detect ``Group(VALUES …) [AS] alias (col, …)`` starting at idx;
@@ -1059,6 +1616,24 @@ class Emitter:
             self.emit_call(it)
             return
         if isinstance(it, Cast):
+            if it.pg_type == "interval":
+                # '1 hour'::interval → seconds (the standalone-interval
+                # model); literal folds at emit time, else UDF
+                if isinstance(it.operand, Token) and it.operand.kind == STRING:
+                    from .runtime import interval_to_seconds
+
+                    try:
+                        self._emit(repr(
+                            interval_to_seconds(_strip_quotes(it.operand))
+                        ))
+                        return
+                    except ValueError:
+                        pass
+                self._emit("pg_interval_seconds")
+                self.out.append("(")
+                self.emit_item(it.operand)
+                self._emit(")")
+                return
             self._emit("CAST")
             self._emit("(")
             self.emit_item(it.operand)
@@ -1079,6 +1654,11 @@ class Emitter:
         schema = name.schema()
         if schema in ("public", "main") and len(parts) >= 2:
             parts = parts[-1:]
+        if len(parts) == 1 and not parts[0].quoted:
+            mapped = _NAME_RENAMES.get(parts[0].value.lower())
+            if mapped is not None:
+                self._emit(mapped)
+                return
         self._emit(
             ".".join(
                 p.value if p.kind != OP else "*"  # tbl.*
@@ -1108,10 +1688,166 @@ class Emitter:
         parts = name.parts
         if name.schema() in ("pg_catalog", "public", "information_schema"):
             parts = parts[-1:]  # UDFs have no schema in SQLite
+        if len(parts) == 1 and not parts[0].quoted:
+            fname = parts[0].value.lower()
+            if self._try_kw_arg_call(fname, call):
+                return
+            if fname == "string_agg" and call.args and item_is_kw(
+                call.args[0], "DISTINCT"
+            ):
+                # SQLite DISTINCT aggregates take exactly one argument;
+                # only PG's default-comma separator maps cleanly
+                groups = _split_args(_strip_order_by(call.args))
+                is_comma = (
+                    len(groups) == 2
+                    and len(groups[1]) == 1
+                    and isinstance(groups[1][0], Token)
+                    and groups[1][0].kind == STRING
+                    and _strip_quotes(groups[1][0]) == ","
+                )
+                if not is_comma:
+                    raise UnsupportedConstruct(
+                        "string_agg(DISTINCT ...) only supports the ',' "
+                        "separator (SQLite DISTINCT aggregates are "
+                        "single-argument)"
+                    )
+                self._emit("group_concat")
+                self.out.append("(")
+                self.emit_items(groups[0])  # includes the DISTINCT kw
+                self._emit(")")
+                return
+            mapped = _CALL_RENAMES.get(fname)
+            if mapped is not None:
+                args = call.args
+                if mapped in (
+                    "group_concat", "json_group_array", "json_group_object"
+                ):
+                    args = _strip_order_by(args)
+                self._emit(mapped)
+                self.out.append("(")
+                self.emit_items(args)
+                self._emit(")")
+                return
         self._emit(".".join(p.value for p in parts))
         self.out.append("(")  # no space: f(x)
         self.emit_items(call.args)
         self._emit(")")
+
+    def _try_kw_arg_call(self, fname: str, call: Call) -> bool:
+        """The SQL-standard keyword-argument call forms PG clients send:
+        position(x IN y), substring(s FROM a FOR b), trim(BOTH c FROM s),
+        extract(F FROM ts), overlay(s PLACING r FROM p FOR n)."""
+        args = call.args
+
+        def kw_index(*words: str) -> int:
+            for k, a in enumerate(args):
+                if item_is_kw(a, *words):
+                    return k
+            return -1
+
+        def emit_fn(fn: str, *arg_groups) -> None:
+            self._emit(fn)
+            self.out.append("(")
+            for k, grp in enumerate(arg_groups):
+                if k:
+                    self._emit(",")
+                if isinstance(grp, str):
+                    self._emit(grp)
+                else:
+                    self.emit_items(grp)
+            self._emit(")")
+
+        if fname == "position":
+            k = kw_index("IN")
+            if k < 0:
+                return False
+            emit_fn("instr", args[k + 1:], args[:k])
+            return True
+
+        if fname == "substring":
+            k = kw_index("FROM")
+            if k < 0:
+                kf = kw_index("FOR")
+                if kf >= 0:
+                    # substring(s FOR n) ≡ substr(s, 1, n)
+                    emit_fn("substr", args[:kf], "1", args[kf + 1:])
+                    return True
+                return False  # comma form: SQLite substring() is native
+            kf = kw_index("FOR")
+            if kf > k:
+                emit_fn("substr", args[:k], args[k + 1: kf], args[kf + 1:])
+            else:
+                start = args[k + 1:]
+                if (
+                    len(start) == 1
+                    and isinstance(start[0], Token)
+                    and start[0].kind == STRING
+                ):
+                    # substring(s FROM 'regex') — the SIMILAR-free form
+                    emit_fn("pg_substring_re", args[:k], start)
+                else:
+                    emit_fn("substr", args[:k], start)
+            return True
+
+        if fname == "trim":
+            k = kw_index("FROM")
+            direction = "BOTH"
+            rest = args
+            if rest and item_is_kw(rest[0], "BOTH", "LEADING", "TRAILING"):
+                direction = rest[0].last.upper() if isinstance(
+                    rest[0], Name
+                ) else "BOTH"
+                rest = rest[1:]
+                k -= 1
+            if k < 0:
+                return False  # plain trim(s) / trim(s, c): native
+            chars = rest[:k]
+            subject = rest[k + 1:]
+            fn = {"BOTH": "trim", "LEADING": "ltrim", "TRAILING": "rtrim"}[
+                direction
+            ]
+            if chars:
+                emit_fn(fn, subject, chars)
+            else:
+                emit_fn(fn, subject)
+            return True
+
+        if fname == "extract":
+            k = kw_index("FROM")
+            if k < 0:
+                return False
+            field = args[:k]
+            ftext = ""
+            if len(field) == 1:
+                if isinstance(field[0], Name):
+                    ftext = field[0].last.lower()
+                elif isinstance(field[0], Token) and field[0].kind == STRING:
+                    ftext = _strip_quotes(field[0]).lower()
+            if not ftext:
+                return False
+            emit_fn("pg_date_part", f"'{ftext}'", args[k + 1:])
+            return True
+
+        if fname == "overlay":
+            kp = kw_index("PLACING")
+            kf = kw_index("FROM")
+            if kp < 0 or kf < kp:
+                return False
+            kn = kw_index("FOR")
+            if kn > kf:
+                emit_fn(
+                    "pg_overlay",
+                    args[:kp], args[kp + 1: kf], args[kf + 1: kn],
+                    args[kn + 1:],
+                )
+            else:
+                emit_fn(
+                    "pg_overlay",
+                    args[:kp], args[kp + 1: kf], args[kf + 1:], "NULL",
+                )
+            return True
+
+        return False
 
     def _emit_cast_args(self, args: Sequence[Item]) -> None:
         # ... AS <type words>: everything before AS emits normally.  Bare
@@ -1207,5 +1943,97 @@ def emit(
     # DDL type mapping happens structurally in TableBody/ColumnDef
     # emission; everything else re-emits with the standard rewrites
     # (SQLite's affinity rules understand unmapped PG type names anyway)
+    if (
+        len(st.items) >= 3
+        and item_is_kw(st.items[0], "SELECT")
+        and item_is_kw(st.items[1], "DISTINCT")
+        and item_is_kw(st.items[2], "ON")
+    ):
+        raise UnsupportedConstruct(
+            "SELECT DISTINCT ON is not supported; rewrite with GROUP BY "
+            "or a row_number() window"
+        )
+    if st.verb == "DELETE" and _emit_delete_using(em, st):
+        return em.text()
     em.emit_items(st.items)
     return em.text()
+
+
+def _emit_delete_using(em: Emitter, st: Statement) -> bool:
+    """``DELETE FROM t [AS a] USING u, ... WHERE cond [RETURNING ...]``
+    → ``DELETE FROM t WHERE rowid IN (SELECT a.rowid FROM t AS a, u, ...
+    WHERE cond) [RETURNING ...]`` (PG's delete-join; SQLite has no
+    USING on DELETE)."""
+    items = st.items
+    i_using = -1
+    for k, it in enumerate(items):
+        if item_is_kw(it, "USING"):
+            i_using = k
+            break
+    if i_using < 0:
+        return False
+    # shape: DELETE FROM [ONLY] name [AS alias | alias] USING ...
+    k = 1
+    if k < len(items) and item_is_kw(items[k], "FROM"):
+        k += 1
+    if k < len(items) and item_is_kw(items[k], "ONLY"):
+        k += 1
+    if k >= len(items) or not isinstance(items[k], Name):
+        return False
+    tname = items[k]
+    k += 1
+    alias: Optional[Name] = None
+    if k < i_using and item_is_kw(items[k], "AS"):
+        k += 1
+    if k < i_using and isinstance(items[k], Name) and _is_valueish(items[k]):
+        alias = items[k]
+        k += 1
+    if k != i_using:
+        return False
+    i_where = -1
+    i_ret = -1
+    for k in range(i_using + 1, len(items)):
+        if item_is_kw(items[k], "WHERE") and i_where < 0:
+            i_where = k
+        if item_is_kw(items[k], "RETURNING") and i_ret < 0:
+            i_ret = k
+    end = i_ret if i_ret >= 0 else len(items)
+    using_items = items[i_using + 1: i_where if i_where >= 0 else end]
+    cond_items = items[i_where + 1: end] if i_where >= 0 else []
+
+    em._emit("DELETE FROM")
+    em.emit_name(tname)
+    if alias is not None:  # RETURNING may reference the alias
+        em._emit("AS")
+        em.emit_name(alias)
+    em._emit("WHERE rowid IN")
+    em._emit("(")
+    em._emit("SELECT")
+    em.emit_name(alias or tname)
+    em.out.append(".rowid")
+    em._emit("FROM")
+    em.emit_name(tname)
+    if alias is not None:
+        em._emit("AS")
+        em.emit_name(alias)
+    em._emit(",")
+    em.emit_items(using_items)
+    if cond_items:
+        em._emit("WHERE")
+        em.emit_items(cond_items)
+    em._emit(")")
+    if i_ret >= 0:
+        # SQLite RETURNING forbids table/alias qualifiers — strip them
+        qualifiers = {tname.last.lower()}
+        if alias is not None:
+            qualifiers.add(alias.last.lower())
+        for it in items[i_ret:]:
+            if (
+                isinstance(it, Name)
+                and len(it.parts) >= 2
+                and it.parts[0].value.lower().strip('"') in qualifiers
+            ):
+                em.emit_name(Name(parts=it.parts[1:]))
+            else:
+                em.emit_item(it)
+    return True

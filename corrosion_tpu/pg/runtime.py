@@ -1,0 +1,852 @@
+"""PG runtime function pack for the SQLite execution engine.
+
+The reference's PG layer translates statements between two ASTs and then
+executes on SQLite, where the *function* vocabulary is SQLite's — a PG
+client calling ``date_trunc`` or ``split_part`` gets "no such function"
+(corro-pg/src/lib.rs:546-1906 maps syntax, not the function library).
+This module closes that execution-level gap for the rebuild: the PG
+scalar/aggregate functions clients actually call are registered as UDFs
+on every connection the PG front-end executes on (the store's writer
+conn and each read conn — server.py registers via
+``catalog.register_functions``).
+
+Semantics model (documented deviations from PG, chosen for SQLite
+affinity):
+
+- **timestamps** are tz-naive UTC ISO text ``YYYY-MM-DD HH:MM:SS[.ffffff]``
+  — the same family SQLite's ``CURRENT_TIMESTAMP`` / ``datetime()``
+  produce, so comparisons and ordering work across the whole surface.
+- **intervals** standing alone evaluate to SECONDS as a float (PG's
+  ``EXTRACT(EPOCH FROM interval)`` view of the value); ``ts ± interval``
+  is rewritten by the emitter to ``pg_ts_offset(ts, text, sign)`` so
+  month/year arithmetic stays calendar-aware WITH PG's overflow clamp
+  (SQLite's own ``datetime(+N months)`` normalizes Jan 31 + 1 mon into
+  March, which is why the UDF exists).
+- **arrays** are JSON array text; PG array literals (``{a,b}``) are
+  accepted anywhere an array parameter lands (``pg_array_json``).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import json
+import math
+import re
+import sqlite3
+import time
+import uuid
+from typing import Optional
+
+__all__ = ["register", "interval_to_seconds"]
+
+
+# --------------------------------------------------------------------------
+# interval parsing (shared with the emitter's ``ts ± interval`` rewrite)
+
+_UNIT_SECONDS = {
+    "microsecond": 1e-6, "us": 1e-6,
+    "millisecond": 1e-3, "ms": 1e-3,
+    "second": 1.0, "sec": 1.0, "s": 1.0,
+    "minute": 60.0, "min": 60.0, "m": 60.0,
+    "hour": 3600.0, "hr": 3600.0, "h": 3600.0,
+    "day": 86400.0, "d": 86400.0,
+    "week": 604800.0, "w": 604800.0,
+    # PG: EXTRACT(EPOCH FROM '1 mon') = 30 days, '1 year' = 365.25 days
+    "month": 2592000.0, "mon": 2592000.0,
+    "year": 31557600.0, "yr": 31557600.0, "y": 31557600.0,
+    "decade": 315576000.0,
+    "century": 3155760000.0,
+}
+
+_INTERVAL_ITEM = re.compile(
+    r"([+-]?\d+(?:\.\d+)?)\s*([a-zA-Z]+)|(?<![\d.])([+-]?)(\d+):(\d\d)(?::(\d\d(?:\.\d+)?))?"
+)
+
+
+def _unit_key(word: str) -> Optional[str]:
+    w = word.lower()
+    if w in _UNIT_SECONDS:
+        return w
+    if w.endswith("s") and w[:-1] in _UNIT_SECONDS:
+        return w[:-1]
+    return None
+
+
+def _parse_interval(text: str):
+    """-> list of (kind, value): kind in _UNIT_SECONDS keys | 'clock'."""
+    out = []
+    matched = False
+    sign = 1.0
+    for m in _INTERVAL_ITEM.finditer(text):
+        matched = True
+        if m.group(1) is not None:
+            key = _unit_key(m.group(2))
+            if key is None:
+                if m.group(2).lower() == "ago":  # '1 day ago'
+                    sign = -1.0
+                    continue
+                raise ValueError(f"unknown interval unit {m.group(2)!r}")
+            out.append((key, float(m.group(1))))
+        else:
+            s = -1.0 if m.group(3) == "-" else 1.0
+            secs = int(m.group(4)) * 3600 + int(m.group(5)) * 60
+            if m.group(6):
+                secs += float(m.group(6))
+            out.append(("second", s * secs))
+    if not matched:
+        raise ValueError(f"cannot parse interval {text!r}")
+    return [(k, sign * v) for k, v in out]
+
+
+def interval_to_seconds(text: str) -> float:
+    """'1 hour 30 min' -> 5400.0 (PG EXTRACT(EPOCH ...) convention)."""
+    return sum(_UNIT_SECONDS[k] * v for k, v in _parse_interval(text))
+
+
+# --------------------------------------------------------------------------
+# timestamp helpers
+
+def _parse_ts(val):
+    """ISO text (space or T separator, optional subsec/offset) or epoch
+    number -> aware-naive UTC datetime."""
+    if val is None:
+        return None
+    if isinstance(val, (int, float)):
+        return _dt.datetime.fromtimestamp(float(val), _dt.timezone.utc).replace(
+            tzinfo=None
+        )
+    text = str(val).strip()
+    try:
+        d = _dt.datetime.fromisoformat(text.replace(" ", "T", 1))
+    except ValueError:
+        d = _dt.datetime.fromisoformat(text)
+    if d.tzinfo is not None:
+        d = d.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+    return d
+
+
+def _fmt_ts(d: _dt.datetime) -> str:
+    if d.microsecond:
+        return d.strftime("%Y-%m-%d %H:%M:%S.%f")
+    return d.strftime("%Y-%m-%d %H:%M:%S")
+
+
+def _pg_now() -> str:
+    return _fmt_ts(_dt.datetime.now(_dt.timezone.utc).replace(tzinfo=None))
+
+
+def _add_months(d: _dt.datetime, months: float) -> _dt.datetime:
+    """PG month arithmetic: clamp to the last day of the target month
+    ('2026-01-31' + 1 mon = '2026-02-28'), never normalize-overflow the
+    way SQLite's datetime(+N months) does."""
+    whole = int(months)
+    frac_days = (months - whole) * 30.0  # PG: fractional month = 30 days
+    y = d.year + (d.month - 1 + whole) // 12
+    m = (d.month - 1 + whole) % 12 + 1
+    if m == 12:
+        last = 31
+    else:
+        last = (_dt.datetime(y, m + 1, 1) - _dt.timedelta(days=1)).day
+    d = d.replace(year=y, month=m, day=min(d.day, last))
+    if frac_days:
+        d += _dt.timedelta(days=frac_days)
+    return d
+
+
+def _pg_ts_offset(val, interval_text, sign=1):
+    """timestamp ± interval with PG calendar semantics; the emitter
+    rewrites ``ts ± interval '...'`` to this."""
+    if val is None or interval_text is None:
+        return None
+    d = _parse_ts(val)
+    months = 0.0
+    seconds = 0.0
+    for k, v in _parse_interval(str(interval_text)):
+        v *= sign
+        if k in ("month", "mon"):
+            months += v
+        elif k in ("year", "yr", "y"):
+            months += v * 12
+        elif k == "decade":
+            months += v * 120
+        elif k == "century":
+            months += v * 1200
+        else:
+            seconds += _UNIT_SECONDS[k] * v
+    if months:
+        d = _add_months(d, months)
+    if seconds:
+        d += _dt.timedelta(seconds=seconds)
+    return _fmt_ts(d)
+
+
+_TRUNC_FIELDS = (
+    "microseconds", "milliseconds", "second", "minute", "hour",
+    "day", "week", "month", "quarter", "year", "decade", "century",
+)
+
+
+def _date_trunc(field, val):
+    if val is None:
+        return None
+    d = _parse_ts(val)
+    f = str(field).lower()
+    if f in ("microseconds",):
+        pass
+    elif f in ("milliseconds",):
+        d = d.replace(microsecond=d.microsecond // 1000 * 1000)
+    elif f == "second":
+        d = d.replace(microsecond=0)
+    elif f == "minute":
+        d = d.replace(second=0, microsecond=0)
+    elif f == "hour":
+        d = d.replace(minute=0, second=0, microsecond=0)
+    elif f == "day":
+        d = d.replace(hour=0, minute=0, second=0, microsecond=0)
+    elif f == "week":
+        d = d.replace(hour=0, minute=0, second=0, microsecond=0)
+        d -= _dt.timedelta(days=d.weekday())
+    elif f == "month":
+        d = d.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    elif f == "quarter":
+        d = d.replace(
+            month=(d.month - 1) // 3 * 3 + 1,
+            day=1, hour=0, minute=0, second=0, microsecond=0,
+        )
+    elif f == "year":
+        d = d.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+    elif f == "decade":
+        d = d.replace(
+            year=d.year // 10 * 10,
+            month=1, day=1, hour=0, minute=0, second=0, microsecond=0,
+        )
+    elif f == "century":
+        d = d.replace(
+            year=(d.year - 1) // 100 * 100 + 1,
+            month=1, day=1, hour=0, minute=0, second=0, microsecond=0,
+        )
+    else:
+        raise ValueError(f"date_trunc: unknown field {field!r}")
+    return _fmt_ts(d)
+
+
+def _date_part(field, val):
+    if val is None:
+        return None
+    f = str(field).lower().strip("'\"")
+    if isinstance(val, (int, float)) and f == "epoch":
+        return float(val)  # EXTRACT(EPOCH FROM <interval-as-seconds>)
+    d = _parse_ts(val)
+    if f == "epoch":
+        return d.replace(tzinfo=_dt.timezone.utc).timestamp()
+    if f in ("year", "years"):
+        return float(d.year)
+    if f in ("month", "months", "mon"):
+        return float(d.month)
+    if f in ("day", "days"):
+        return float(d.day)
+    if f in ("hour", "hours"):
+        return float(d.hour)
+    if f in ("minute", "minutes", "min"):
+        return float(d.minute)
+    if f in ("second", "seconds", "sec"):
+        return d.second + d.microsecond / 1e6
+    if f in ("milliseconds", "ms"):
+        return d.second * 1000.0 + d.microsecond / 1e3
+    if f in ("microseconds", "us"):
+        return d.second * 1e6 + float(d.microsecond)
+    if f == "dow":
+        return float((d.weekday() + 1) % 7)  # PG: Sunday = 0
+    if f == "isodow":
+        return float(d.weekday() + 1)  # PG: Monday = 1
+    if f == "doy":
+        return float(d.timetuple().tm_yday)
+    if f == "quarter":
+        return float((d.month - 1) // 3 + 1)
+    if f == "week":
+        return float(d.isocalendar()[1])
+    if f == "isoyear":
+        return float(d.isocalendar()[0])
+    if f == "decade":
+        return float(d.year // 10)
+    if f == "century":
+        return float((d.year - 1) // 100 + 1)
+    if f in ("timezone", "timezone_hour", "timezone_minute"):
+        return 0.0  # model is tz-naive UTC
+    raise ValueError(f"date_part: unknown field {field!r}")
+
+
+# --------------------------------------------------------------------------
+# to_char (the subset of patterns observed in the wild: timestamps and
+# simple 9/0 numeric pictures)
+
+_TOCHAR_TOKENS = [
+    ("YYYY", lambda d: f"{d.year:04d}"),
+    ("YY", lambda d: f"{d.year % 100:02d}"),
+    ("Month", lambda d: d.strftime("%B").ljust(9)),
+    ("month", lambda d: d.strftime("%B").lower().ljust(9)),
+    ("MONTH", lambda d: d.strftime("%B").upper().ljust(9)),
+    ("Mon", lambda d: d.strftime("%b")),
+    ("mon", lambda d: d.strftime("%b").lower()),
+    ("MON", lambda d: d.strftime("%b").upper()),
+    ("MM", lambda d: f"{d.month:02d}"),
+    ("Day", lambda d: d.strftime("%A").ljust(9)),
+    ("day", lambda d: d.strftime("%A").lower().ljust(9)),
+    ("DAY", lambda d: d.strftime("%A").upper().ljust(9)),
+    ("Dy", lambda d: d.strftime("%a")),
+    ("dy", lambda d: d.strftime("%a").lower()),
+    ("DY", lambda d: d.strftime("%a").upper()),
+    ("DDD", lambda d: f"{d.timetuple().tm_yday:03d}"),
+    ("DD", lambda d: f"{d.day:02d}"),
+    ("HH24", lambda d: f"{d.hour:02d}"),
+    ("HH12", lambda d: f"{(d.hour % 12) or 12:02d}"),
+    ("HH", lambda d: f"{(d.hour % 12) or 12:02d}"),
+    ("MI", lambda d: f"{d.minute:02d}"),
+    ("SS", lambda d: f"{d.second:02d}"),
+    ("MS", lambda d: f"{d.microsecond // 1000:03d}"),
+    ("US", lambda d: f"{d.microsecond:06d}"),
+    ("AM", lambda d: "AM" if d.hour < 12 else "PM"),
+    ("PM", lambda d: "AM" if d.hour < 12 else "PM"),
+    ("am", lambda d: "am" if d.hour < 12 else "pm"),
+    ("pm", lambda d: "am" if d.hour < 12 else "pm"),
+    ("TZ", lambda d: ""),
+    ("Q", lambda d: str((d.month - 1) // 3 + 1)),
+    ("J", lambda d: str(d.toordinal() + 1721425)),
+]
+
+
+def _to_char_ts(d: _dt.datetime, fmt: str) -> str:
+    out = []
+    fm = False
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == '"':  # quoted literal
+            j = fmt.find('"', i + 1)
+            if j < 0:
+                out.append(fmt[i + 1:])
+                break
+            out.append(fmt[i + 1:j])
+            i = j + 1
+            continue
+        if fmt.startswith("FM", i):
+            fm = True
+            i += 2
+            continue
+        for tok, fn in _TOCHAR_TOKENS:
+            if fmt.startswith(tok, i):
+                text = fn(d)
+                if fm:
+                    text = text.strip().lstrip("0") or "0"
+                out.append(text)
+                i += len(tok)
+                break
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+def _to_char_num(val: float, fmt: str) -> str:
+    pic = fmt[2:] if fmt.upper().startswith("FM") else fmt
+    fm = fmt.upper().startswith("FM")
+    if "." in pic:
+        decimals = len([c for c in pic.split(".", 1)[1] if c in "09"])
+    else:
+        decimals = 0
+    grouped = "," in pic
+    text = f"{val:{',' if grouped else ''}.{decimals}f}"
+    if not fm:
+        width = len(pic) + 1  # PG reserves a sign column
+        text = text.rjust(width)
+    return text
+
+
+def _to_char(val, fmt):
+    if val is None or fmt is None:
+        return None
+    fmt = str(fmt)
+    if isinstance(val, (int, float)) and not any(
+        t in fmt for t in ("YYYY", "MM", "DD", "HH")
+    ):
+        return _to_char_num(float(val), fmt)
+    return _to_char_ts(_parse_ts(val), fmt)
+
+
+# --------------------------------------------------------------------------
+# arrays as JSON text
+
+def _pg_array_json(val):
+    """Accept a PG array literal ('{a,b}'), JSON array text, or a scalar;
+    return JSON array text."""
+    if val is None:
+        return None
+    if isinstance(val, (bytes, bytearray)):
+        val = val.decode("utf-8", "replace")
+    if not isinstance(val, str):
+        return json.dumps([val])
+    s = val.strip()
+    if s.startswith("["):
+        try:
+            parsed = json.loads(s)
+            if isinstance(parsed, list):
+                return json.dumps(parsed)
+        except json.JSONDecodeError:
+            pass
+    if s.startswith("{") and s.endswith("}"):
+        return json.dumps(_parse_pg_array(s))
+    return json.dumps([val])
+
+
+def _parse_pg_array(s: str) -> list:
+    """'{1,2,"a b",NULL}' -> [1, 2, 'a b', None] (one dimension; nested
+    braces recurse)."""
+    out = []
+    i = 1  # past '{'
+    buf = []
+    quoted_item = False
+
+    def flush():
+        nonlocal quoted_item
+        text = "".join(buf)
+        buf.clear()
+        if quoted_item:
+            out.append(text)
+        else:
+            t = text.strip()
+            if not t:
+                return
+            if t.upper() == "NULL":
+                out.append(None)
+            else:
+                try:
+                    out.append(int(t))
+                except ValueError:
+                    try:
+                        out.append(float(t))
+                    except ValueError:
+                        out.append(t)
+        quoted_item = False
+
+    while i < len(s) - 1:
+        c = s[i]
+        if c == '"':
+            quoted_item = True
+            i += 1
+            while i < len(s) - 1 and s[i] != '"':
+                if s[i] == "\\":
+                    i += 1
+                buf.append(s[i])
+                i += 1
+            i += 1
+            continue
+        if c == "{":  # nested array
+            depth = 1
+            j = i + 1
+            while j < len(s) and depth:
+                if s[j] == "{":
+                    depth += 1
+                elif s[j] == "}":
+                    depth -= 1
+                j += 1
+            out.append(_parse_pg_array(s[i:j]))
+            i = j
+            # skip to next comma
+            while i < len(s) - 1 and s[i] != ",":
+                i += 1
+            i += 1
+            continue
+        if c == ",":
+            flush()
+            i += 1
+            continue
+        buf.append(c)
+        i += 1
+    if buf or quoted_item:
+        flush()
+    return out
+
+
+def _array_length(arr, dim=1):
+    if arr is None:
+        return None
+    if int(dim) != 1:
+        return None
+    parsed = json.loads(_pg_array_json(arr))
+    return len(parsed) or None  # PG: empty array has no dimensions
+
+
+def _array_to_string(arr, delim, nullstr=None):
+    if arr is None or delim is None:
+        return None
+    parsed = json.loads(_pg_array_json(arr))
+    parts = []
+    for v in parsed:
+        if v is None:
+            if nullstr is not None:
+                parts.append(str(nullstr))
+        else:
+            parts.append(str(v))
+    return str(delim).join(parts)
+
+
+def _string_to_array(s, delim, nullstr=None):
+    if s is None:
+        return None
+    if delim is None:
+        return json.dumps(list(str(s)))
+    parts = str(s).split(str(delim)) if delim != "" else [str(s)]
+    if nullstr is not None:
+        parts = [None if pp == nullstr else pp for pp in parts]
+    return json.dumps(parts)
+
+
+# --------------------------------------------------------------------------
+# regex (cached compile; PG flavor is close enough to `re` for the
+# common operator usage)
+
+_RE_CACHE: dict = {}
+
+
+def _compiled(pattern: str):
+    r = _RE_CACHE.get(pattern)
+    if r is None:
+        if len(_RE_CACHE) > 256:
+            _RE_CACHE.clear()
+        r = _RE_CACHE[pattern] = re.compile(pattern)
+    return r
+
+
+def _regexp(pattern, value):
+    """SQLite's REGEXP operator calls regexp(pattern, string)."""
+    if pattern is None or value is None:
+        return None
+    return 1 if _compiled(str(pattern)).search(str(value)) else 0
+
+
+def _regexp_replace(src, pattern, repl, flags=""):
+    if src is None or pattern is None or repl is None:
+        return None
+    flags = flags or ""
+    pat = str(pattern)
+    if "i" in flags:
+        pat = "(?i)" + pat
+    count = 0 if "g" in flags else 1
+    # PG \1 backrefs -> re \1 works as-is
+    return _compiled(pat).sub(str(repl).replace("\\&", "\\g<0>"), str(src), count)
+
+
+def _substring_re(src, pattern):
+    if src is None or pattern is None:
+        return None
+    m = _compiled(str(pattern)).search(str(src))
+    if not m:
+        return None
+    return m.group(1) if m.groups() else m.group(0)
+
+
+# --------------------------------------------------------------------------
+# aggregates
+
+class _BoolAnd:
+    def __init__(self):
+        self.seen = False
+        self.val = True
+
+    def step(self, v):
+        if v is not None:
+            self.seen = True
+            self.val = self.val and bool(v)
+
+    def finalize(self):
+        return (1 if self.val else 0) if self.seen else None
+
+
+class _BoolOr:
+    def __init__(self):
+        self.seen = False
+        self.val = False
+
+    def step(self, v):
+        if v is not None:
+            self.seen = True
+            self.val = self.val or bool(v)
+
+    def finalize(self):
+        return (1 if self.val else 0) if self.seen else None
+
+
+class _Variance:
+    """Welford accumulator; subclasses pick pop/samp + sqrt."""
+
+    ddof = 1
+    sqrt = False
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def step(self, v):
+        if v is None:
+            return
+        self.n += 1
+        d = float(v) - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (float(v) - self.mean)
+
+    def finalize(self):
+        if self.n <= self.ddof:
+            return None
+        out = self.m2 / (self.n - self.ddof)
+        return math.sqrt(out) if self.sqrt else out
+
+
+class _VarPop(_Variance):
+    ddof = 0
+
+
+class _StddevSamp(_Variance):
+    sqrt = True
+
+
+class _StddevPop(_Variance):
+    ddof = 0
+    sqrt = True
+
+
+class _Corr:
+    def __init__(self):
+        self.n = 0
+        self.sx = self.sy = self.sxx = self.syy = self.sxy = 0.0
+
+    def step(self, y, x):
+        if x is None or y is None:
+            return
+        x, y = float(x), float(y)
+        self.n += 1
+        self.sx += x
+        self.sy += y
+        self.sxx += x * x
+        self.syy += y * y
+        self.sxy += x * y
+
+    def finalize(self):
+        if self.n < 2:
+            return None
+        vx = self.sxx - self.sx * self.sx / self.n
+        vy = self.syy - self.sy * self.sy / self.n
+        if vx <= 0 or vy <= 0:
+            return None
+        return (self.sxy - self.sx * self.sy / self.n) / math.sqrt(vx * vy)
+
+
+# --------------------------------------------------------------------------
+# string helpers
+
+def _initcap(s):
+    if s is None:
+        return None
+    return re.sub(
+        r"[a-zA-Z0-9]+",
+        lambda m: m.group(0)[0].upper() + m.group(0)[1:].lower(),
+        str(s),
+    )
+
+
+def _lr_pad(s, n, fill, left):
+    if s is None or n is None:
+        return None
+    s = str(s)
+    n = int(n)
+    if n <= len(s):
+        return s[:n]
+    fill = str(fill) if fill else " "
+    pad = (fill * ((n - len(s)) // len(fill) + 1))[: n - len(s)]
+    return pad + s if left else s + pad
+
+
+def _split_part(s, delim, n):
+    if s is None or delim is None or n is None:
+        return None
+    n = int(n)
+    parts = str(s).split(str(delim)) if delim != "" else [str(s)]
+    if n < 0:  # PG 14+: negative counts from the end
+        n = len(parts) + n + 1
+    if n < 1 or n > len(parts):
+        return ""
+    return parts[n - 1]
+
+
+def _pg_left(s, n):
+    if s is None or n is None:
+        return None
+    s, n = str(s), int(n)
+    return s[:n] if n >= 0 else s[: max(0, len(s) + n)]
+
+
+def _pg_right(s, n):
+    if s is None or n is None:
+        return None
+    s, n = str(s), int(n)
+    if n >= 0:
+        return s[len(s) - n:] if n else ""
+    return s[-n:]
+
+
+def _age(a, b=None):
+    """Seconds between timestamps (interval-as-seconds model).  One-arg
+    form is PG's midnight-anchored age(now::date, ts)."""
+    if a is None:
+        return None
+    if b is None:
+        today = _dt.datetime.now(_dt.timezone.utc).replace(
+            tzinfo=None, hour=0, minute=0, second=0, microsecond=0
+        )
+        return (today - _parse_ts(a)).total_seconds()
+    return (_parse_ts(a) - _parse_ts(b)).total_seconds()
+
+
+# --------------------------------------------------------------------------
+
+def register(conn: sqlite3.Connection) -> None:
+    """Install the PG runtime pack on one connection.  Idempotent."""
+    f = conn.create_function
+    det = {"deterministic": True}
+
+    f("pg_now", 0, _pg_now)
+    f("pg_ts_offset", 2, _pg_ts_offset, **det)
+    f("pg_ts_offset", 3, _pg_ts_offset, **det)
+    f("pg_sleep", 1, lambda s: time.sleep(min(float(s or 0), 30.0)))
+    f("timeofday", 0, lambda: _dt.datetime.now(_dt.timezone.utc).strftime(
+        "%a %b %d %H:%M:%S.%f %Y UTC"))
+
+    f("date_trunc", 2, _date_trunc, **det)
+    f("pg_date_part", 2, _date_part, **det)
+    f("date_part", 2, _date_part, **det)
+    f("extract", 2, _date_part, **det)
+    f("to_char", 2, _to_char, **det)
+    f("to_timestamp", 1, lambda v: None if v is None else _fmt_ts(
+        _dt.datetime.fromtimestamp(float(v), _dt.timezone.utc).replace(tzinfo=None)
+    ), **det)
+    f("to_date", 2, lambda v, fmt: None if v is None else
+      _to_char_ts_inverse(str(v), str(fmt)), **det)
+    f("age", 1, _age)
+    f("age", 2, _age, **det)
+    f("pg_interval_seconds", 1,
+      lambda t: None if t is None else interval_to_seconds(str(t)), **det)
+    f("justify_interval", 1, lambda t: t, **det)
+
+    f("pg_left", 2, _pg_left, **det)
+    f("pg_right", 2, _pg_right, **det)
+    f("split_part", 3, _split_part, **det)
+    f("starts_with", 2, lambda s, p: None if s is None or p is None
+      else int(str(s).startswith(str(p))), **det)
+    f("initcap", 1, _initcap, **det)
+    f("repeat", 2, lambda s, n: None if s is None or n is None
+      else str(s) * max(0, int(n)), **det)
+    f("lpad", 2, lambda s, n: _lr_pad(s, n, " ", True), **det)
+    f("lpad", 3, lambda s, n, fl: _lr_pad(s, n, fl, True), **det)
+    f("rpad", 2, lambda s, n: _lr_pad(s, n, " ", False), **det)
+    f("rpad", 3, lambda s, n, fl: _lr_pad(s, n, fl, False), **det)
+    f("reverse", 1, lambda s: None if s is None else str(s)[::-1], **det)
+    f("translate", 3, lambda s, a, b: None if s is None or a is None or b is None
+      else str(s).translate(str.maketrans(str(a)[:len(str(b))], str(b)[:len(str(a))],
+                                          str(a)[len(str(b)):])), **det)
+    f("ascii", 1, lambda s: None if not s else ord(str(s)[0]), **det)
+    f("chr", 1, lambda n: None if n is None else chr(int(n)), **det)
+    f("btrim", 1, lambda s: None if s is None else str(s).strip(), **det)
+    f("btrim", 2, lambda s, c: None if s is None or c is None
+      else str(s).strip(str(c)), **det)
+    f("md5", 1, lambda s: None if s is None else hashlib.md5(
+        s if isinstance(s, bytes) else str(s).encode()).hexdigest(), **det)
+    f("gen_random_uuid", 0, lambda: str(uuid.uuid4()))
+    f("quote_literal", 1, lambda s: None if s is None
+      else "'" + str(s).replace("'", "''") + "'", **det)
+    f("concat", -1, lambda *a: "".join(str(x) for x in a if x is not None), **det)
+    f("concat_ws", -1, lambda sep, *a: None if sep is None
+      else str(sep).join(str(x) for x in a if x is not None), **det)
+    f("pg_random", 0, __import__("random").random)
+    # PG semantics: NULLs are IGNORED (greatest(1, NULL, 3) = 3); the
+    # SQLite scalar MAX/MIN return NULL if ANY argument is NULL
+    f("pg_greatest", -1, lambda *a: max(
+        (x for x in a if x is not None), default=None), **det)
+    f("pg_least", -1, lambda *a: min(
+        (x for x in a if x is not None), default=None), **det)
+    # advisory locks: the single-writer lane already serializes writers,
+    # so these are true no-ops — but they must accept PG's arities
+    f("pg_advisory_lock", 1, lambda _k: None)
+    f("pg_advisory_lock", 2, lambda _a, _b: None)
+    f("pg_advisory_unlock", 1, lambda _k: 1)
+    f("pg_advisory_unlock", 2, lambda _a, _b: 1)
+    f("pg_try_advisory_lock", 1, lambda _k: 1)
+    f("pg_try_advisory_lock", 2, lambda _a, _b: 1)
+    # int() truncates toward zero like PG's div(); // would floor
+    f("div", 2, lambda a, b: None if a is None or b is None
+      else int(float(a) / float(b)) if float(b) != 0 else _div0(), **det)
+    f("pg_substring_re", 2, _substring_re, **det)
+    f("pg_overlay", 4, lambda s, r, p, n: None
+      if s is None or r is None or p is None
+      else str(s)[: int(p) - 1] + str(r)
+      + str(s)[int(p) - 1 + (int(n) if n is not None else len(str(r))):], **det)
+    f("pg_to_json", 1, lambda v: None if v is None else json.dumps(v), **det)
+
+    f("regexp", 2, _regexp, **det)
+    f("regexp_like", 2, lambda s, pp: _regexp(pp, s), **det)
+    f("regexp_replace", 3, _regexp_replace, **det)
+    f("regexp_replace", 4, _regexp_replace, **det)
+    f("regexp_count", 2, lambda s, pp: None if s is None or pp is None
+      else len(_compiled(str(pp)).findall(str(s))), **det)
+
+    f("pg_array_json", 1, _pg_array_json, **det)
+    f("array_length", 2, _array_length, **det)
+    f("cardinality", 1, lambda a: None if a is None
+      else len(json.loads(_pg_array_json(a))), **det)
+    f("array_to_string", 2, _array_to_string, **det)
+    f("array_to_string", 3, _array_to_string, **det)
+    f("string_to_array", 2, _string_to_array, **det)
+    f("string_to_array", 3, _string_to_array, **det)
+    f("array_position", 2, lambda a, v: _array_position(a, v), **det)
+
+    ca = conn.create_aggregate
+    ca("bool_and", 1, _BoolAnd)
+    ca("every", 1, _BoolAnd)
+    ca("bool_or", 1, _BoolOr)
+    ca("var_samp", 1, _Variance)
+    ca("variance", 1, _Variance)
+    ca("var_pop", 1, _VarPop)
+    ca("stddev_samp", 1, _StddevSamp)
+    ca("stddev", 1, _StddevSamp)
+    ca("stddev_pop", 1, _StddevPop)
+    ca("corr", 2, _Corr)
+
+
+def _div0():
+    raise ValueError("division by zero")
+
+
+def _array_position(arr, val):
+    if arr is None:
+        return None
+    parsed = json.loads(_pg_array_json(arr))
+    try:
+        return parsed.index(val) + 1
+    except ValueError:
+        return None
+
+
+# longest-first: sequential str.replace would corrupt 'Month' if 'Mon'
+# ran before it
+_TO_DATE_MAP = [
+    ("YYYY", "%Y"), ("YY", "%y"), ("Month", "%B"), ("Mon", "%b"),
+    ("HH24", "%H"), ("HH12", "%I"), ("MM", "%m"), ("DD", "%d"),
+    ("MI", "%M"), ("SS", "%S"),
+]
+
+
+def _to_char_ts_inverse(text: str, fmt: str) -> str:
+    strp = fmt
+    for tok, pct in _TO_DATE_MAP:
+        strp = strp.replace(tok, pct)
+    d = _dt.datetime.strptime(text, strp)
+    return d.strftime("%Y-%m-%d")

@@ -890,5 +890,8 @@ class _Session:
         n = len(rows) if rows else max(self.agent.store.last_dml_changes, 0)
         if t.tag == "INSERT":
             self.writer.write(p.command_complete(f"INSERT 0 {n}"))
+        elif t.tag == "TRUNCATE TABLE":
+            # PG's TRUNCATE tag carries no rowcount
+            self.writer.write(p.command_complete(t.tag))
         else:
             self.writer.write(p.command_complete(f"{t.tag} {n}"))

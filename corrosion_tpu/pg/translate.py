@@ -30,10 +30,13 @@ from .parser import (
     IDENT,
     PUNCT,
     ConstraintResolver,
+    Name,
     ParseError,
     Statement,
     UnknownConstraint,
+    UnsupportedConstruct,
     emit,
+    item_is_kw,
     parse,
     tokenize,
 )
@@ -175,11 +178,47 @@ def translate(
     tag, kind = _tag_kind(st, sql)
     if kind in ("empty", "tx", "session", "prepare", "execute", "comment"):
         return Translated(sql=sql.strip().rstrip(";"), tag=tag, kind=kind)
-    body = emit(st, constraint_resolver=constraint_resolver)
+    if st.verb.startswith("TRUNCATE"):
+        return _translate_truncate(st)
+    try:
+        body = emit(st, constraint_resolver=constraint_resolver)
+    except UnsupportedConstruct as e:
+        raise UnsupportedStatement(str(e)) from e
     if kind == "read" and st.verb == "TABLE":
         # PG `TABLE t` ≡ SELECT * FROM t (SQLite has no TABLE command)
         body = re.sub(r"^\s*TABLE\b", "SELECT * FROM", body, flags=re.I)
     return Translated(sql=body, tag=tag, kind=kind, n_params=st.n_params)
+
+
+def _translate_truncate(st: Statement) -> Translated:
+    """TRUNCATE [TABLE] [ONLY] t [RESTART|CONTINUE IDENTITY]
+    [CASCADE|RESTRICT] → ``DELETE FROM t`` as kind='write': the
+    delete-all must ride the CRDT change path so it replicates (a PG
+    TRUNCATE that silently skipped broadcast would diverge the
+    cluster).  RESTART IDENTITY is accepted and ignored (CRR tables use
+    explicit PKs, not sequences); multi-table TRUNCATE would need two
+    statements in one Translated, so it is rejected."""
+    tables = []
+    for it in st.items[1:]:
+        if item_is_kw(it, "TABLE", "ONLY"):
+            continue
+        if item_is_kw(
+            it, "RESTART", "CONTINUE", "IDENTITY", "CASCADE", "RESTRICT"
+        ):
+            break
+        if isinstance(it, Name):
+            tables.append(it)
+    if not tables:
+        raise UnsupportedStatement("TRUNCATE: no table name")
+    if len(tables) > 1:
+        raise UnsupportedStatement(
+            "multi-table TRUNCATE is not supported; issue one TRUNCATE "
+            "per table"
+        )
+    name = tables[0].last.replace('"', '""')
+    return Translated(
+        sql=f'DELETE FROM "{name}"', tag="TRUNCATE TABLE", kind="write"
+    )
 
 
 _SET_RE = re.compile(
