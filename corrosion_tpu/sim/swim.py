@@ -35,6 +35,21 @@ from .state import ALIVE, DOWN, SUSPECT, SimConfig, SimState
 from .topology import Topology
 
 
+def _compact_targets(
+    cand: jnp.ndarray, valid: jnp.ndarray, count: int
+) -> jnp.ndarray:
+    """Prefix-compact the valid candidates of each row into the first
+    ``count`` slots (-1 pads).  Masked reduce over the small oversample
+    axis instead of a scatter: the previous ``out.at[rows, slot].max``
+    cost ~40 ms PER CALL at 100k nodes on TPU (r4 micro-profile), and
+    the sampler runs four times per round."""
+    rank = jnp.cumsum(valid, axis=1)  # [N, over]
+    sel = valid[:, :, None] & (
+        rank[:, :, None] == jnp.arange(1, count + 1, dtype=rank.dtype)
+    )  # [N, over, count] — exactly one True per (row, slot) pair
+    return jnp.max(jnp.where(sel, cand[:, :, None], -1), axis=1)
+
+
 def sample_member_targets(
     state: SimState, cfg: SimConfig, key: jax.Array, count: int
 ) -> jnp.ndarray:
@@ -68,12 +83,7 @@ def sample_member_targets(
     if cfg.swim_full_view and cfg.couple_membership:
         valid &= state.view[me, cand] != DOWN
     valid &= ~_dup_before(cand, valid)
-    rank = jnp.cumsum(valid, axis=1)
-    keep = valid & (rank <= count)
-    slot = jnp.clip(rank - 1, 0, count - 1)
-    rows = jnp.broadcast_to(me, (n, over))
-    out = jnp.full((n, count), -1, jnp.int32)
-    return out.at[rows, slot].max(jnp.where(keep, cand, -1))
+    return _compact_targets(cand, valid, count)
 
 
 def _dup_before(cand: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
