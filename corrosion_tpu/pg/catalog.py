@@ -125,6 +125,74 @@ def attach(conn: sqlite3.Connection, dbname: str) -> None:
         CREATE TABLE IF NOT EXISTS pg_catalog.pg_collation (
             oid INTEGER PRIMARY KEY, collname TEXT
         );
+        CREATE TABLE IF NOT EXISTS pg_catalog.is_kcu_rows (
+            constraint_name TEXT, table_name TEXT, column_name TEXT,
+            ordinal_position INTEGER
+        );
+        """
+    )
+    # information_schema is served as views INSIDE pg_catalog (SQLite
+    # forbids cross-database views); the emitter maps
+    # ``information_schema.X`` -> ``pg_catalog.is_X`` (parser.emit_name).
+    # The view bodies read the same pg_class/pg_attribute rows psql's
+    # \d path uses, so refresh_pg_class keeps them current for free.
+    dbname = dbname.replace("'", "''")
+    conn.executescript(
+        f"""
+        CREATE VIEW IF NOT EXISTS pg_catalog.is_tables AS
+            SELECT '{dbname}' AS table_catalog, 'public' AS table_schema,
+                   relname AS table_name,
+                   CASE relkind WHEN 'v' THEN 'VIEW' ELSE 'BASE TABLE' END
+                       AS table_type
+            FROM pg_class WHERE relkind IN ('r', 'v');
+        CREATE VIEW IF NOT EXISTS pg_catalog.is_columns AS
+            SELECT '{dbname}' AS table_catalog, 'public' AS table_schema,
+                   c.relname AS table_name, a.attname AS column_name,
+                   a.attnum AS ordinal_position,
+                   (SELECT adbin FROM pg_attrdef d
+                     WHERE d.adrelid = a.attrelid AND d.adnum = a.attnum)
+                       AS column_default,
+                   CASE a.attnotnull WHEN 1 THEN 'NO' ELSE 'YES' END
+                       AS is_nullable,
+                   CASE t.typname
+                       WHEN 'int4' THEN 'integer'
+                       WHEN 'int8' THEN 'bigint'
+                       WHEN 'int2' THEN 'smallint'
+                       WHEN 'float8' THEN 'double precision'
+                       WHEN 'float4' THEN 'real'
+                       WHEN 'bool' THEN 'boolean'
+                       WHEN 'varchar' THEN 'character varying'
+                       WHEN 'timestamp' THEN 'timestamp without time zone'
+                       WHEN 'timestamptz' THEN 'timestamp with time zone'
+                       ELSE t.typname END AS data_type,
+                   t.typname AS udt_name
+            FROM pg_attribute a
+            JOIN pg_class c ON c.oid = a.attrelid
+            LEFT JOIN pg_type t ON t.oid = a.atttypid
+            WHERE c.relkind IN ('r', 'v') AND a.attisdropped = 0;
+        CREATE VIEW IF NOT EXISTS pg_catalog.is_table_constraints AS
+            SELECT '{dbname}' AS constraint_catalog,
+                   'public' AS constraint_schema, conname AS constraint_name,
+                   '{dbname}' AS table_catalog, 'public' AS table_schema,
+                   c.relname AS table_name,
+                   CASE n.contype WHEN 'p' THEN 'PRIMARY KEY'
+                                  WHEN 'u' THEN 'UNIQUE'
+                                  WHEN 'f' THEN 'FOREIGN KEY'
+                                  ELSE 'CHECK' END AS constraint_type
+            FROM pg_constraint n JOIN pg_class c ON c.oid = n.conrelid;
+        CREATE VIEW IF NOT EXISTS pg_catalog.is_key_column_usage AS
+            SELECT '{dbname}' AS constraint_catalog,
+                   'public' AS constraint_schema, constraint_name,
+                   '{dbname}' AS table_catalog, 'public' AS table_schema,
+                   table_name, column_name, ordinal_position
+            FROM is_kcu_rows;
+        CREATE VIEW IF NOT EXISTS pg_catalog.is_schemata AS
+            SELECT '{dbname}' AS catalog_name, nspname AS schema_name
+            FROM pg_namespace;
+        CREATE VIEW IF NOT EXISTS pg_catalog.is_views AS
+            SELECT '{dbname}' AS table_catalog, 'public' AS table_schema,
+                   relname AS table_name, NULL AS view_definition
+            FROM pg_class WHERE relkind = 'v';
         """
     )
     conn.execute(
@@ -224,7 +292,7 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
     constraints (PG default names: <table>_pkey), and pg_attrdef
     defaults — the tables psql's ``\\d`` sequence reads."""
     for t in ("pg_class", "pg_attribute", "pg_attrdef", "pg_index",
-              "pg_constraint"):
+              "pg_constraint", "is_kcu_rows"):
         conn.execute(f"DELETE FROM pg_catalog.{t}")
     defs = _defs_for(conn)
     defs.clear()
@@ -237,14 +305,19 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
     attrdef_rows = []
     index_rows = []
     con_rows = []
+    kcu_rows = []  # information_schema.key_column_usage
     next_oid = [200000]  # synthetic oids for implicit PK "indexes"
     name_to_oid = {name: 100000 + rid for rid, name, typ in rows}
     for rid, name, typ in rows:
         oid = 100000 + rid
-        cls_rows.append((oid, name, PUBLIC_NS_OID,
-                         "r" if typ == "table" else "i"))
-        if typ != "table":
+        cls_rows.append((
+            oid, name, PUBLIC_NS_OID,
+            {"table": "r", "view": "v"}.get(typ, "i"),
+        ))
+        if typ not in ("table", "view"):
             continue
+        # PRAGMA table_info works for views too — ORMs that reflect a
+        # VIEW row from is_tables expect its columns to resolve
         cols = conn.execute(f'PRAGMA table_info("{name}")').fetchall()
         pk_cols = [r for r in cols if r[5] > 0]
         for cid, cname, decl, notnull, dflt, pk in cols:
@@ -255,6 +328,8 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
             if dflt is not None:
                 attrdef_rows.append((next_oid[0], oid, cid + 1, str(dflt)))
                 next_oid[0] += 1
+        if typ != "table":
+            continue  # no constraint/index machinery for views
         # primary key → <table>_pkey constraint + synthetic index
         if pk_cols:
             idx_oid = next_oid[0]
@@ -264,6 +339,8 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
             cls_rows.append((idx_oid, pkname, PUBLIC_NS_OID, "i"))
             index_rows.append((idx_oid, oid, 1, 1, len(pk_cols)))
             con_rows.append((idx_oid, pkname, oid, idx_oid, "p"))
+            for pos, r in enumerate(sorted(pk_cols, key=lambda r: r[5])):
+                kcu_rows.append((pkname, name, r[1], pos + 1))
             defs[idx_oid] = (
                 f"CREATE UNIQUE INDEX {pkname} ON {name} ({collist})",
                 f"PRIMARY KEY ({collist})",
@@ -273,6 +350,29 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
             f'PRAGMA index_list("{name}")'
         ).fetchall():
             if iname.startswith("sqlite_autoindex"):
+                # a table-level UNIQUE(...) constraint: origin 'u', no
+                # visible index name.  Surface it as a PG unique
+                # constraint (PG naming: <table>_<firstcol>_key) so
+                # information_schema/psql introspection sees it.
+                if origin == "u":
+                    icols = [
+                        r[2]
+                        for r in conn.execute(
+                            f'PRAGMA index_info("{iname}")'
+                        )
+                        if r[2] is not None
+                    ]
+                    if icols:
+                        con_oid = next_oid[0]
+                        next_oid[0] += 1
+                        cname = f"{name}_{icols[0]}_key"
+                        con_rows.append((con_oid, cname, oid, con_oid, "u"))
+                        defs[con_oid] = (
+                            "",
+                            f"UNIQUE ({', '.join(icols)})",
+                        )
+                        for pos, col in enumerate(icols):
+                            kcu_rows.append((cname, name, col, pos + 1))
                 continue
             idx_oid = name_to_oid.get(iname)
             if idx_oid is None:
@@ -291,8 +391,9 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
                 f"ON {name} ({collist})",
                 f"UNIQUE ({collist})" if unique else "",
             )
-            if unique and origin == "u":
-                con_rows.append((idx_oid, iname, oid, idx_oid, "u"))
+            # (a named CREATE UNIQUE INDEX has origin 'c' and is NOT an
+            # information_schema constraint in PG — only table-level
+            # UNIQUE(...) autoindexes, handled above, surface there)
     conn.executemany(
         "INSERT OR IGNORE INTO pg_catalog.pg_class "
         "(oid, relname, relnamespace, relkind) VALUES (?, ?, ?, ?)",
@@ -319,6 +420,12 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
         "INSERT OR IGNORE INTO pg_catalog.pg_constraint "
         "(oid, conname, conrelid, conindid, contype) VALUES (?, ?, ?, ?, ?)",
         con_rows,
+    )
+    conn.executemany(
+        "INSERT INTO pg_catalog.is_kcu_rows "
+        "(constraint_name, table_name, column_name, ordinal_position) "
+        "VALUES (?, ?, ?, ?)",
+        kcu_rows,
     )
     conn.execute(
         "UPDATE pg_catalog.pg_class SET relhasindex = 1 WHERE oid IN "

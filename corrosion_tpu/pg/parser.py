@@ -1654,6 +1654,11 @@ class Emitter:
         schema = name.schema()
         if schema in ("public", "main") and len(parts) >= 2:
             parts = parts[-1:]
+        elif schema == "information_schema":
+            # served as is_* views INSIDE pg_catalog (SQLite forbids
+            # cross-database views; catalog.attach builds them)
+            self._emit(f"pg_catalog.is_{name.last.lower()}")
+            return
         if len(parts) == 1 and not parts[0].quoted:
             mapped = _NAME_RENAMES.get(parts[0].value.lower())
             if mapped is not None:
