@@ -157,7 +157,7 @@ class Agent:
         self.stats = {
             "changes_committed": 0, "changes_applied": 0, "changes_deduped": 0,
             "broadcasts_sent": 0, "broadcasts_recv": 0, "sync_rounds": 0,
-            "ingest_dropped": 0, "empties_recv": 0,
+            "ingest_dropped": 0, "empties_recv": 0, "changes_failed": 0,
         }
         # protocol-native clock for calibration (VERDICT r2 item 2): the
         # broadcast flush tick counter and per-version apply ticks.  A
@@ -525,7 +525,17 @@ class Agent:
             for actor_id, version in dict.fromkeys(partials):
                 partial = self.bookie.for_actor(actor_id).get_partial(version)
                 if partial is not None and partial.is_complete():
-                    self._apply_fully_buffered(actor_id, version)
+                    try:
+                        self._apply_fully_buffered(actor_id, version)
+                    except Exception:
+                        # same isolation as the complete path: a
+                        # malformed buffered version must not swallow
+                        # the batch's `matched` list (subscriptions for
+                        # already-committed changes) or kill the lane.
+                        # Its rows stay buffered; the reference's
+                        # apply_fully_buffered_changes_loop likewise
+                        # logs and retries later (util.rs:395-422)
+                        self.stats["changes_failed"] += 1
         # subscriptions match committed changes only (util.rs:1026-1030);
         # returned so the async lanes can match on the event loop
         return matched
@@ -547,7 +557,22 @@ class Agent:
                 # a partial-need reply's last_seq only spans the served range,
                 # and the authoritative last_seq lives in our existing partial
                 if cs.is_complete() and snap.partials.get(cs.version) is None:
-                    impacted = store.apply_changes(cs.changes, in_tx=True)
+                    # per-version failure isolation (process_single_version
+                    # runs in its own savepoint, util.rs:487-539 + the
+                    # process_failed_changes test): a malformed changeset —
+                    # e.g. a column the local schema lacks — must not
+                    # poison the other versions in this batch.  Nothing is
+                    # recorded for the failed version, so anti-entropy
+                    # re-requests it later (possibly repaired).
+                    store.conn.execute("SAVEPOINT corro_apply_cs")
+                    try:
+                        impacted = store.apply_changes(cs.changes, in_tx=True)
+                    except Exception:
+                        store.conn.execute("ROLLBACK TO corro_apply_cs")
+                        store.conn.execute("RELEASE corro_apply_cs")
+                        self.stats["changes_failed"] += 1
+                        continue
+                    store.conn.execute("RELEASE corro_apply_cs")
                     self.bookie.record_versions(
                         cs.actor_id, snap, RangeSet([(cs.version, cs.version)])
                     )

@@ -136,17 +136,17 @@ def attach(conn: sqlite3.Connection, dbname: str) -> None:
     # ``information_schema.X`` -> ``pg_catalog.is_X`` (parser.emit_name).
     # The view bodies read the same pg_class/pg_attribute rows psql's
     # \d path uses, so refresh_pg_class keeps them current for free.
-    dbname = dbname.replace("'", "''")
+    dbname_lit = dbname.replace("'", "''")  # for the view-body literals
     conn.executescript(
         f"""
         CREATE VIEW IF NOT EXISTS pg_catalog.is_tables AS
-            SELECT '{dbname}' AS table_catalog, 'public' AS table_schema,
+            SELECT '{dbname_lit}' AS table_catalog, 'public' AS table_schema,
                    relname AS table_name,
                    CASE relkind WHEN 'v' THEN 'VIEW' ELSE 'BASE TABLE' END
                        AS table_type
             FROM pg_class WHERE relkind IN ('r', 'v');
         CREATE VIEW IF NOT EXISTS pg_catalog.is_columns AS
-            SELECT '{dbname}' AS table_catalog, 'public' AS table_schema,
+            SELECT '{dbname_lit}' AS table_catalog, 'public' AS table_schema,
                    c.relname AS table_name, a.attname AS column_name,
                    a.attnum AS ordinal_position,
                    (SELECT adbin FROM pg_attrdef d
@@ -171,9 +171,9 @@ def attach(conn: sqlite3.Connection, dbname: str) -> None:
             LEFT JOIN pg_type t ON t.oid = a.atttypid
             WHERE c.relkind IN ('r', 'v') AND a.attisdropped = 0;
         CREATE VIEW IF NOT EXISTS pg_catalog.is_table_constraints AS
-            SELECT '{dbname}' AS constraint_catalog,
+            SELECT '{dbname_lit}' AS constraint_catalog,
                    'public' AS constraint_schema, conname AS constraint_name,
-                   '{dbname}' AS table_catalog, 'public' AS table_schema,
+                   '{dbname_lit}' AS table_catalog, 'public' AS table_schema,
                    c.relname AS table_name,
                    CASE n.contype WHEN 'p' THEN 'PRIMARY KEY'
                                   WHEN 'u' THEN 'UNIQUE'
@@ -181,16 +181,16 @@ def attach(conn: sqlite3.Connection, dbname: str) -> None:
                                   ELSE 'CHECK' END AS constraint_type
             FROM pg_constraint n JOIN pg_class c ON c.oid = n.conrelid;
         CREATE VIEW IF NOT EXISTS pg_catalog.is_key_column_usage AS
-            SELECT '{dbname}' AS constraint_catalog,
+            SELECT '{dbname_lit}' AS constraint_catalog,
                    'public' AS constraint_schema, constraint_name,
-                   '{dbname}' AS table_catalog, 'public' AS table_schema,
+                   '{dbname_lit}' AS table_catalog, 'public' AS table_schema,
                    table_name, column_name, ordinal_position
             FROM is_kcu_rows;
         CREATE VIEW IF NOT EXISTS pg_catalog.is_schemata AS
-            SELECT '{dbname}' AS catalog_name, nspname AS schema_name
+            SELECT '{dbname_lit}' AS catalog_name, nspname AS schema_name
             FROM pg_namespace;
         CREATE VIEW IF NOT EXISTS pg_catalog.is_views AS
-            SELECT '{dbname}' AS table_catalog, 'public' AS table_schema,
+            SELECT '{dbname_lit}' AS table_catalog, 'public' AS table_schema,
                    relname AS table_name, NULL AS view_definition
             FROM pg_class WHERE relkind = 'v';
         """
@@ -306,6 +306,7 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
     index_rows = []
     con_rows = []
     kcu_rows = []  # information_schema.key_column_usage
+    used_con_names: set = set()
     next_oid = [200000]  # synthetic oids for implicit PK "indexes"
     name_to_oid = {name: 100000 + rid for rid, name, typ in rows}
     for rid, name, typ in rows:
@@ -365,7 +366,15 @@ def refresh_pg_class(conn: sqlite3.Connection) -> None:
                     if icols:
                         con_oid = next_oid[0]
                         next_oid[0] += 1
-                        cname = f"{name}_{icols[0]}_key"
+                        # PG disambiguates colliding synthesized names
+                        # with a numeric suffix (t_a_key, t_a_key1, ...)
+                        base = f"{name}_{icols[0]}_key"
+                        cname = base
+                        n_dup = 0
+                        while cname in used_con_names:
+                            n_dup += 1
+                            cname = f"{base}{n_dup}"
+                        used_con_names.add(cname)
                         con_rows.append((con_oid, cname, oid, con_oid, "u"))
                         defs[con_oid] = (
                             "",

@@ -1,0 +1,247 @@
+"""Analogs of reference agent tests not yet mirrored (SURVEY §4.2):
+
+- ``process_failed_changes`` (agent/tests.rs:878-1000) — a malformed
+  changeset must not poison the rest of the apply batch;
+- ``test_sync_changes_order`` (api/peer/mod.rs:1678-1727) — sync serves
+  newest version FIRST;
+- ``test_clear_empty_versions`` (agent/tests.rs:778-876) — versions
+  emptied by overwrites sync as Cleared/EMPTY runs and the puller
+  converges through them.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent.agent import ChangeSource
+from corrosion_tpu.agent.codec import decode_message
+from corrosion_tpu.agent.store import CrrStore
+from corrosion_tpu.agent.transport import LinkModel
+from corrosion_tpu.core.bookkeeping import RangeSet
+from corrosion_tpu.core.types import ActorId, Change, Changeset, ChangesetPart
+from corrosion_tpu.testing import TEST_SCHEMA, Cluster
+
+
+def _writer_changes(n_rows: int):
+    """A scratch origin store: n single-row versions of the tests table."""
+    writer = CrrStore(":memory:", ActorId.random())
+    writer.execute_schema(TEST_SCHEMA)
+    versions = []
+    for i in range(1, n_rows + 1):
+        _, info = writer.transact(
+            [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"t{i}"))]
+        )
+        versions.append(info.db_version)
+    out = {
+        v: writer.changes_for_version(writer.site_id, v) for v in versions
+    }
+    actor = writer.site_id
+    writer.close()
+    return actor, out
+
+
+def test_process_failed_changes():
+    """Good versions around a malformed one (a column the schema lacks)
+    still apply; the bad version is skipped, never recorded, and the
+    agent keeps serving (per-version savepoint isolation)."""
+
+    async def body():
+        cluster = Cluster(1, use_swim=False)
+        await cluster.start()
+        try:
+            agent = cluster.agents[0]
+            actor, by_version = _writer_changes(5)
+
+            bad = Change(
+                table="tests", pk=by_version[6 - 5][0].pk,  # any valid pk blob
+                cid="nonexistent", val="six", col_version=1,
+                db_version=6, seq=0, site_id=actor, cl=1,
+            )
+            batch = []
+            for v, changes in by_version.items():
+                last_seq = max(ch.seq for ch in changes)
+                batch.append(Changeset(
+                    actor_id=actor, version=v, changes=tuple(changes),
+                    seqs=(0, last_seq), last_seq=last_seq,
+                    part=ChangesetPart.FULL,
+                ))
+            # malformed version 6, sandwiched into the same batch
+            batch.insert(2, Changeset(
+                actor_id=actor, version=6, changes=(bad,),
+                seqs=(0, 0), last_seq=0, part=ChangesetPart.FULL,
+            ))
+            for cs in batch:
+                await agent._enqueue_changeset(cs, ChangeSource.SYNC)
+
+            async def applied():
+                rows = agent.store.query("SELECT id FROM tests ORDER BY id")
+                return [r[0] for r in rows] == [1, 2, 3, 4, 5]
+
+            for _ in range(100):
+                if await applied():
+                    break
+                await asyncio.sleep(0.05)
+            assert await applied(), agent.store.query("SELECT id FROM tests")
+            assert agent.stats["changes_failed"] >= 1
+            # the failed version is NOT recorded as known — anti-entropy
+            # may re-request it later
+            booked = agent.bookie.for_actor(actor)
+            assert not booked.contains_all(
+                (6, 6), None
+            ), "failed version must stay unknown"
+            # ...and versions 1..5 are all known
+            assert booked.contains_all((1, 5), None)
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_failed_buffered_version_does_not_swallow_batch():
+    """A malformed version arriving CHUNKED (buffered, then applied at
+    completion) must not blow up the lane or suppress subscriptions for
+    the batch's healthy changes."""
+
+    async def body():
+        cluster = Cluster(1, use_swim=False)
+        await cluster.start()
+        try:
+            agent = cluster.agents[0]
+            actor, by_version = _writer_changes(2)
+
+            bad = Change(
+                table="tests", pk=by_version[1][0].pk, cid="nonexistent",
+                val="x", col_version=1, db_version=3, seq=0,
+                site_id=actor, cl=1,
+            )
+            bad2 = Change(
+                table="tests", pk=by_version[1][0].pk, cid="nonexistent",
+                val="y", col_version=1, db_version=3, seq=1,
+                site_id=actor, cl=1,
+            )
+            # two chunks of malformed version 3, then a good version
+            await agent._enqueue_changeset(Changeset(
+                actor_id=actor, version=3, changes=(bad,),
+                seqs=(0, 0), last_seq=1, part=ChangesetPart.FULL,
+            ), ChangeSource.SYNC)
+            await agent._enqueue_changeset(Changeset(
+                actor_id=actor, version=3, changes=(bad2,),
+                seqs=(1, 1), last_seq=1, part=ChangesetPart.FULL,
+            ), ChangeSource.SYNC)
+            for v, changes in by_version.items():
+                last_seq = max(ch.seq for ch in changes)
+                await agent._enqueue_changeset(Changeset(
+                    actor_id=actor, version=v, changes=tuple(changes),
+                    seqs=(0, last_seq), last_seq=last_seq,
+                    part=ChangesetPart.FULL,
+                ), ChangeSource.SYNC)
+
+            async def applied():
+                rows = agent.store.query("SELECT id FROM tests ORDER BY id")
+                return [r[0] for r in rows] == [1, 2]
+
+            for _ in range(100):
+                if await applied():
+                    break
+                await asyncio.sleep(0.05)
+            assert await applied()
+            assert agent.stats["changes_failed"] >= 1
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+class _CaptureSender:
+    """AdaptiveSender stand-in recording decoded changesets."""
+
+    def __init__(self):
+        self.chunk_size = 8 * 1024
+        self.messages = []
+
+    async def send(self, _bi, frame: bytes):
+        kind, payload, _ = decode_message(frame)
+        self.messages.append((kind, payload))
+
+
+def test_sync_changes_order_newest_first():
+    """The serve path must stream newest versions first
+    (test_sync_changes_order, peer/mod.rs:1678-1727): fresh state lands
+    before a cold peer's backfill."""
+
+    async def body():
+        cluster = Cluster(1, use_swim=False)
+        await cluster.start()
+        try:
+            agent = cluster.agents[0]
+            for i in range(1, 8):
+                agent.exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                      (i, f"v{i}"))]
+                )
+            from corrosion_tpu.agent.agent import SyncNeed
+
+            cap = _CaptureSender()
+            await agent._serve_need(
+                None, agent.actor_id,
+                SyncNeed(kind="full", versions=(1, 7)), sender=cap,
+            )
+            versions = [
+                p["v"] for k, p in cap.messages if k == "changeset"
+            ]
+            assert versions == sorted(versions, reverse=True), versions
+            assert len(versions) == 7
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_clear_empty_versions_sync_through_overwrites():
+    """Overwriting rows empties their earlier versions on the origin
+    (LWW clock rows move to the new db_version); a partitioned peer
+    healing back must converge THROUGH those versions via EMPTY/Cleared
+    runs (tests.rs:778-876 + serve-side cleared-run algebra)."""
+
+    async def body():
+        cluster = Cluster(2, link=LinkModel(), use_swim=False)
+        await cluster.start()
+        try:
+            a, b = cluster.agents
+            addrs = [ag.transport.addr for ag in cluster.agents]
+            cluster.net.partition(addrs[0], addrs[1])
+
+            for i in range(1, 21):
+                a.exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                      (i, f"orig{i}"))]
+                )
+            # overwrite scattered ranges — versions 1..20 now partly empty
+            for i in (1, 2, 3, 10, 17, 18, 19, 20):
+                a.exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?) "
+                      "ON CONFLICT (id) DO UPDATE SET text = excluded.text",
+                      (i, f"new{i}"))]
+                )
+            # let the broadcast retransmission budget decay INSIDE the
+            # partition, so recovery must go through anti-entropy sync
+            # (the path that serves Cleared/EMPTY runs) rather than
+            # queued broadcast retries delivering stale pre-overwrite
+            # rows after heal
+            await asyncio.sleep(1.5)
+            cluster.net.heal()
+            assert await cluster.wait_converged(60)
+
+            rows_a = a.store.query("SELECT id, text FROM tests ORDER BY id")
+            rows_b = b.store.query("SELECT id, text FROM tests ORDER BY id")
+            assert rows_a == rows_b
+            assert len(rows_b) == 20
+            assert b.stats["empties_recv"] > 0, (
+                "healing peer must have synced Cleared/EMPTY runs"
+            )
+            booked = b.bookie.for_actor(a.actor_id)
+            assert booked.contains_all((1, 28), None)
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())

@@ -123,6 +123,62 @@ def test_unique_constraint_surfaces_catalog_level():
         conn.close()
 
 
+def test_duplicate_unique_first_column_disambiguates():
+    """Two UNIQUE constraints sharing a first column must not merge into
+    one bogus key_column_usage constraint (PG appends a numeric
+    suffix)."""
+    import sqlite3
+
+    from corrosion_tpu.pg import catalog
+
+    conn = sqlite3.connect(":memory:")
+    conn.execute(
+        "CREATE TABLE t2 (a INTEGER, b INTEGER, c INTEGER, "
+        "UNIQUE (a, b), UNIQUE (a, c))"
+    )
+    catalog.attach(conn, "corrosion")
+    catalog.register_functions(conn, "corrosion")
+    try:
+        catalog.refresh_pg_class(conn)
+        names = [
+            r[0] for r in conn.execute(
+                "SELECT DISTINCT constraint_name "
+                "FROM pg_catalog.is_table_constraints "
+                "WHERE table_name = 't2' AND constraint_type = 'UNIQUE' "
+                "ORDER BY constraint_name"
+            )
+        ]
+        assert len(names) == 2 and len(set(names)) == 2, names
+        for cname in names:
+            cols = conn.execute(
+                "SELECT ordinal_position FROM pg_catalog.is_key_column_usage "
+                "WHERE constraint_name = ? ORDER BY ordinal_position",
+                (cname,),
+            ).fetchall()
+            assert [c[0] for c in cols] == [1, 2], (cname, cols)
+    finally:
+        catalog.release_functions(conn)
+        conn.close()
+
+
+def test_dbname_with_quote_stays_literal():
+    import sqlite3
+
+    from corrosion_tpu.pg import catalog
+
+    conn = sqlite3.connect(":memory:")
+    catalog.attach(conn, "o'brien")
+    try:
+        assert conn.execute(
+            "SELECT datname FROM pg_catalog.pg_database"
+        ).fetchone() == ("o'brien",)
+        assert conn.execute(
+            "SELECT DISTINCT catalog_name FROM pg_catalog.is_schemata"
+        ).fetchone() == ("o'brien",)
+    finally:
+        conn.close()
+
+
 def test_view_columns_resolve_catalog_level():
     """Views can't be created over the bridge (CRR-only migrations),
     but a store MAY carry them; the catalog must reflect their columns
