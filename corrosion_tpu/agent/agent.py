@@ -166,6 +166,10 @@ class Agent:
         # the ground-truth tests read these instead of the wall.
         self.flush_tick = 0
         self.apply_tick: Dict[Tuple[ActorId, int], int] = {}
+        # fully-buffered versions whose final apply failed: (actor,
+        # version) -> attempts.  Drained by _buffered_retry_loop (the
+        # reference's apply_fully_buffered_changes_loop, util.rs:395-422)
+        self._buffered_retry: Dict[Tuple[ActorId, int], int] = {}
 
     _APPLY_TICK_CAP = 65536  # calibration-only record; never unbounded
 
@@ -192,6 +196,15 @@ class Agent:
             if sql.strip():
                 self.store.execute_schema(sql)
         self.subs.restore()
+        # schedule applies for fully-buffered partials that survived a
+        # restart (run_root.rs:180-194): the wedged-version ledger is
+        # memory-only, but the partial records + buffered rows are
+        # durable — reseed the retry loop from them so a crash between
+        # buffering completion and apply cannot wedge a version forever
+        for actor_id, booked in self.bookie.by_actor.items():
+            for version, partial in booked.partials.items():
+                if partial.is_complete():
+                    self._buffered_retry[(actor_id, version)] = 0
         # [telemetry] OTLP pipeline (main.rs:57-150): spans leave the
         # process once an endpoint is configured; otherwise they stay in
         # the in-process ring only
@@ -245,6 +258,9 @@ class Agent:
         self._tasks.append(spawn_counted(self._ingest_loop(), "ingest"))
         self._tasks.append(spawn_counted(self._sync_loop(), "sync"))
         self._tasks.append(spawn_counted(self._lock_watchdog(), "lock-watchdog"))
+        self._tasks.append(
+            spawn_counted(self._buffered_retry_loop(), "buffered-retry")
+        )
         from .maintenance import db_maintenance_loop
 
         # (no-op for in-memory stores — the loop gates itself)
@@ -532,10 +548,19 @@ class Agent:
                         # malformed buffered version must not swallow
                         # the batch's `matched` list (subscriptions for
                         # already-committed changes) or kill the lane.
-                        # Its rows stay buffered; the reference's
-                        # apply_fully_buffered_changes_loop likewise
-                        # logs and retries later (util.rs:395-422)
+                        # Rows stay buffered and the version goes on the
+                        # retry ledger drained by _buffered_retry_loop
+                        # (the reference's apply_fully_buffered_changes
+                        # _loop, util.rs:395-422) — it is already
+                        # recorded as known, so sync will NOT
+                        # re-request it; without the retry it would
+                        # wedge unapplied forever
                         self.stats["changes_failed"] += 1
+                        self._buffered_retry[(actor_id, version)] = 0
+                        log.warning(
+                            "buffered apply failed for %s v%s; queued for "
+                            "retry", actor_id, version, exc_info=True,
+                        )
         # subscriptions match committed changes only (util.rs:1026-1030);
         # returned so the async lanes can match on the event loop
         return matched
@@ -571,6 +596,11 @@ class Agent:
                         store.conn.execute("ROLLBACK TO corro_apply_cs")
                         store.conn.execute("RELEASE corro_apply_cs")
                         self.stats["changes_failed"] += 1
+                        log.warning(
+                            "changeset apply failed for %s v%s; version "
+                            "left unknown for anti-entropy re-request",
+                            cs.actor_id, cs.version, exc_info=True,
+                        )
                         continue
                     store.conn.execute("RELEASE corro_apply_cs")
                     self.bookie.record_versions(
@@ -668,6 +698,45 @@ class Agent:
         self.stats["changes_applied"] += impacted
         self._record_apply_tick(actor_id, version)
         self._match_changes(changes)
+
+    async def _buffered_retry_loop(self):
+        """apply_fully_buffered_changes_loop (util.rs:395-422): retry
+        fully-buffered versions whose final apply failed.  Transient
+        errors (a busy writer, a schema later repaired by migration)
+        heal here; persistent ones keep logging at a decaying cadence so
+        the operator can see WHICH version is stuck — without this loop
+        a failed buffered version wedges forever, because it is already
+        recorded as known and sync never re-requests it."""
+        while not self._stopped.is_set():
+            await asyncio.sleep(1.0)
+            for key in list(self._buffered_retry):
+                actor_id, version = key
+                ticks = self._buffered_retry[key]
+                # decaying cadence: ticks 0,1,2, then powers of 2, CAPPED
+                # at one retry per 64 ticks so a repaired schema heals
+                # within ~a minute no matter how long the wedge lasted
+                if (
+                    ticks > 2
+                    and ticks & (ticks - 1)
+                    and ticks % 64
+                ):
+                    self._buffered_retry[key] = ticks + 1
+                    continue
+                try:
+                    async with self.write_sema:
+                        with self.store.write_session():
+                            self._apply_fully_buffered(actor_id, version)
+                    self._buffered_retry.pop(key, None)
+                    log.info(
+                        "buffered retry healed %s v%s on tick %d",
+                        actor_id, version, ticks,
+                    )
+                except Exception:
+                    self._buffered_retry[key] = ticks + 1
+                    log.warning(
+                        "buffered retry failed for %s v%s (tick %d)",
+                        actor_id, version, ticks, exc_info=True,
+                    )
 
     def _match_changes(self, changes: List[Change]):
         """Feed committed changes to subscriptions + updates notifiers

@@ -146,6 +146,30 @@ def test_failed_buffered_version_does_not_swallow_batch():
                 await asyncio.sleep(0.05)
             assert await applied()
             assert agent.stats["changes_failed"] >= 1
+            assert (actor, 3) in agent._buffered_retry
+
+            # live migration repairs the schema → the buffered-retry
+            # loop (apply_fully_buffered_changes_loop analog) heals the
+            # wedged version without any re-delivery
+            agent.store.execute_schema(
+                TEST_SCHEMA.replace(
+                    "text TEXT NOT NULL DEFAULT ''\n);",
+                    "text TEXT NOT NULL DEFAULT '',\n"
+                    "    nonexistent TEXT\n);",
+                    1,
+                )
+            )
+            for _ in range(80):
+                if (actor, 3) not in agent._buffered_retry:
+                    break
+                await asyncio.sleep(0.1)
+            assert (actor, 3) not in agent._buffered_retry, (
+                "retry loop never healed the repaired version"
+            )
+            row = agent.store.query(
+                "SELECT nonexistent FROM tests WHERE id = 1"
+            )
+            assert row and row[0][0] == "y"  # seq 1 won LWW over seq 0
         finally:
             await cluster.stop()
 
@@ -191,6 +215,167 @@ def test_sync_changes_order_newest_first():
             ]
             assert versions == sorted(versions, reverse=True), versions
             assert len(versions) == 7
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_wedged_buffered_version_heals_across_restart():
+    """A fully-buffered version whose apply fails, followed by a
+    RESTART: the retry ledger is memory-only but partial records +
+    buffered rows are durable, so start() must reseed the retry loop
+    from restored complete partials (run_root.rs:180-194) — otherwise
+    the version wedges forever (it is recorded known; sync never
+    re-requests)."""
+
+    async def body():
+        import tempfile
+
+        from corrosion_tpu.agent.agent import Agent
+        from corrosion_tpu.agent.config import Config
+        from corrosion_tpu.agent.transport import MemoryNetwork
+        from corrosion_tpu.testing import fast_perf
+
+        tmp = tempfile.TemporaryDirectory()
+        net = MemoryNetwork()
+        cfg = Config(
+            db_path=f"{tmp.name}/node.db", gossip_addr="node0",
+            bootstrap=[], use_swim=False, perf=fast_perf(),
+        )
+        agent = Agent(cfg, net.transport("node0"))
+        agent.store.execute_schema(TEST_SCHEMA)
+        await agent.start()
+        actor, by_version = _writer_changes(1)
+        bad = Change(
+            table="tests", pk=by_version[1][0].pk, cid="nonexistent",
+            val="x", col_version=1, db_version=2, seq=0, site_id=actor, cl=1,
+        )
+        bad2 = Change(
+            table="tests", pk=by_version[1][0].pk, cid="nonexistent",
+            val="y", col_version=1, db_version=2, seq=1, site_id=actor, cl=1,
+        )
+        try:
+            await agent._enqueue_changeset(Changeset(
+                actor_id=actor, version=2, changes=(bad,),
+                seqs=(0, 0), last_seq=1, part=ChangesetPart.FULL,
+            ), ChangeSource.SYNC)
+            await agent._enqueue_changeset(Changeset(
+                actor_id=actor, version=2, changes=(bad2,),
+                seqs=(1, 1), last_seq=1, part=ChangesetPart.FULL,
+            ), ChangeSource.SYNC)
+            for _ in range(60):
+                if (actor, 2) in agent._buffered_retry:
+                    break
+                await asyncio.sleep(0.05)
+            assert (actor, 2) in agent._buffered_retry
+        finally:
+            await agent.stop()
+
+        # restart on the same database; repair the schema; must heal
+        agent2 = Agent(cfg, net.transport("node0b"))
+        await agent2.start()
+        try:
+            assert (actor, 2) in agent2._buffered_retry, (
+                "restart must reseed the retry ledger from durable "
+                "complete partials"
+            )
+            agent2.store.execute_schema(
+                TEST_SCHEMA.replace(
+                    "text TEXT NOT NULL DEFAULT ''\n);",
+                    "text TEXT NOT NULL DEFAULT '',\n"
+                    "    nonexistent TEXT\n);",
+                    1,
+                )
+            )
+            for _ in range(80):
+                if (actor, 2) not in agent2._buffered_retry:
+                    break
+                await asyncio.sleep(0.1)
+            assert (actor, 2) not in agent2._buffered_retry
+            row = agent2.store.query(
+                "SELECT nonexistent FROM tests WHERE id = 1"
+            )
+            assert row and row[0][0] == "y"
+        finally:
+            await agent2.stop()
+            tmp.cleanup()
+
+    asyncio.run(body())
+
+
+def test_loadshed_ingest_overflow_drops_oldest():
+    """test_loadshed_handle_changes (handlers.rs:931-1015): with the
+    apply lane stalled (write semaphore held hostage) and a tiny queue,
+    incoming changesets displace the OLDEST queued ones; dropped
+    versions are never recorded, the agent stays live."""
+
+    async def body():
+        cluster = Cluster(1, use_swim=False)
+        await cluster.start()
+        try:
+            agent = cluster.agents[0]
+            agent.config.perf.changes_queue_cap = 3
+            actor, by_version = _writer_changes(10)
+
+            async with agent.write_sema:  # lane hostage
+                # give the ingest loop a chance to park on the semaphore
+                await asyncio.sleep(0.05)
+                for v in sorted(by_version, reverse=True):  # newest first
+                    changes = by_version[v]
+                    last_seq = max(ch.seq for ch in changes)
+                    await agent._enqueue_changeset(Changeset(
+                        actor_id=actor, version=v, changes=tuple(changes),
+                        seqs=(0, last_seq), last_seq=last_seq,
+                        part=ChangesetPart.FULL,
+                    ), ChangeSource.SYNC)
+                assert agent._ingest_q.qsize() <= 4  # cap + in-flight slack
+                dropped = agent.stats["ingest_dropped"]
+                assert dropped >= 5, f"expected overflow drops, got {dropped}"
+
+            # lane released: the survivors apply, the agent is healthy
+            await asyncio.sleep(0.3)
+            rows = agent.store.query("SELECT count(*) FROM tests")
+            assert 0 < rows[0][0] <= 10 - dropped + 1
+            booked = agent.bookie.for_actor(actor)
+            known = sum(
+                1 for v in by_version if booked.contains_all((v, v), None)
+            )
+            assert known <= 10 - dropped, (
+                "dropped versions must stay unknown (re-requestable)"
+            )
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_broadcast_order_preserved_lossless():
+    """test_broadcast_order (broadcast/mod.rs:1104-1199) analog: on a
+    lossless link, a burst of local commits reaches the peer in version
+    order (flush drains the queue front-first)."""
+
+    async def body():
+        cluster = Cluster(2, use_swim=False)
+        await cluster.start()
+        try:
+            a, b = cluster.agents
+            for i in range(1, 9):
+                a.exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                      (i, f"v{i}"))]
+                )
+            assert await cluster.wait_converged(30)
+            # apply_tick is insertion-ordered: its key order IS the
+            # application order, which detects intra-flush-tick reorders
+            # the tick VALUES cannot (they coincide within a batch)
+            applied_versions = [
+                v for (aid, v) in b.apply_tick if aid == a.actor_id
+            ]
+            assert applied_versions == sorted(applied_versions), (
+                f"versions applied out of order: {applied_versions}"
+            )
+            assert len(applied_versions) == 8
         finally:
             await cluster.stop()
 
