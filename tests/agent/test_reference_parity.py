@@ -22,15 +22,18 @@ from corrosion_tpu.core.types import ActorId, Change, Changeset, ChangesetPart
 from corrosion_tpu.testing import TEST_SCHEMA, Cluster
 
 
-def _writer_changes(n_rows: int):
-    """A scratch origin store: n single-row versions of the tests table."""
+def _writer_changes(n_versions: int, rows_per_version: int = 1):
+    """A scratch origin store: n versions of the tests table, each
+    committing ``rows_per_version`` rows (seqs 0..rows_per_version-1)."""
     writer = CrrStore(":memory:", ActorId.random())
     writer.execute_schema(TEST_SCHEMA)
     versions = []
-    for i in range(1, n_rows + 1):
-        _, info = writer.transact(
-            [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"t{i}"))]
-        )
+    for i in range(1, n_versions + 1):
+        _, info = writer.transact([
+            ("INSERT INTO tests (id, text) VALUES (?, ?)",
+             (i * rows_per_version + r, f"v{i}r{r}"))
+            for r in range(rows_per_version)
+        ])
         versions.append(info.db_version)
     out = {
         v: writer.changes_for_version(writer.site_id, v) for v in versions
@@ -38,6 +41,17 @@ def _writer_changes(n_rows: int):
     actor = writer.site_id
     writer.close()
     return actor, out
+
+
+async def _wait_until(cond, timeout_s: float = 5.0):
+    """Poll the ASSERTED condition (not a queue-size proxy) so tests
+    stay correct if the apply lane ever gains suspension points."""
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return cond()
 
 
 def test_process_failed_changes():
@@ -215,6 +229,99 @@ def test_sync_changes_order_newest_first():
             ]
             assert versions == sorted(versions, reverse=True), versions
             assert len(versions) == 7
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_process_multiple_changes_bookkeeping():
+    """test_process_multiple_changes (tests.rs:1002-1180): staged
+    out-of-order deliveries must leave EXACTLY the right bookkeeping —
+    known runs, needed gaps, partial seq coverage, and EMPTY-run
+    recording for non-contiguous cleared versions."""
+
+    async def body():
+        cluster = Cluster(1, use_swim=False)
+        await cluster.start()
+        try:
+            agent = cluster.agents[0]
+            # 20 versions, TWO changes each (seqs 0,1) so partials can
+            # split mid-version
+            actor, by_version = _writer_changes(20, rows_per_version=2)
+
+            def full_cs(v, seq_filter=None):
+                changes = by_version[v]
+                last_seq = max(ch.seq for ch in changes)
+                if seq_filter is not None:
+                    changes = [ch for ch in changes if ch.seq in seq_filter]
+                    seqs = (min(seq_filter), max(seq_filter))
+                else:
+                    seqs = (0, last_seq)
+                return Changeset(
+                    actor_id=actor, version=v, changes=tuple(changes),
+                    seqs=seqs, last_seq=last_seq, part=ChangesetPart.FULL,
+                )
+
+            async def deliver(*css):
+                for cs in css:
+                    await agent._enqueue_changeset(cs, ChangeSource.SYNC)
+
+            booked = agent.bookie.for_actor(actor)
+
+            # stage 1: versions 1-5 contiguous
+            await deliver(*[full_cs(v) for v in range(1, 6)])
+            assert await _wait_until(
+                lambda: booked.contains_all((1, 5), None)
+            )
+            assert list(booked.needed()) == []
+
+            # stage 2: versions 9-10 → gap 6-8
+            await deliver(full_cs(9), full_cs(10))
+            assert await _wait_until(
+                lambda: list(booked.needed()) == [(6, 8)]
+            ), list(booked.needed())
+
+            # stage 3: version 20 + partial 15-16 (seq 0 only)
+            await deliver(
+                full_cs(20), full_cs(15, {0}), full_cs(16, {0})
+            )
+            assert await _wait_until(
+                lambda: list(booked.needed())
+                == [(6, 8), (11, 14), (17, 19)]
+            ), list(booked.needed())
+            for v in (15, 16):
+                p = booked.partials.get(v)
+                assert p is not None and not p.is_complete(), (v, p)
+                assert list(p.seqs) == [(0, 0)]
+
+            # stage 4: EMPTY (cleared) runs arrive non-contiguously
+            await deliver(
+                Changeset(actor_id=actor, version=22, versions_hi=22,
+                          part=ChangesetPart.EMPTY),
+                Changeset(actor_id=actor, version=25, versions_hi=25,
+                          part=ChangesetPart.EMPTY),
+            )
+            assert await _wait_until(
+                lambda: booked.contains_all((22, 22), None)
+                and booked.contains_all((25, 25), None)
+            )
+            assert list(booked.needed()) == [
+                (6, 8), (11, 14), (17, 19), (21, 21), (23, 24)
+            ]
+
+            # completing the partials closes them out
+            await deliver(full_cs(15, {1}), full_cs(16, {1}))
+            assert await _wait_until(
+                lambda: booked.partials.get(15) is None
+                and booked.partials.get(16) is None
+            )
+            assert booked.contains_all((15, 16), None)
+            rows = agent.store.query(
+                "SELECT count(*) FROM tests WHERE id IN (30, 31, 32, 33)"
+            )
+            assert rows[0][0] == 4  # versions 15+16 fully applied
+
         finally:
             await cluster.stop()
 
