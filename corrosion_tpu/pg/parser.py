@@ -60,7 +60,7 @@ _OPERATOR_CHARS = set("+-*/<>=~!@#%^&|`?")
 # multi-char operators PG clients actually send (longest first)
 _MULTI_OPS = (
     "::", "<=", ">=", "<>", "!=", "||", "->>", "->", "#>>", "#>", "~*",
-    "!~*", "!~", "@>", "<@",
+    "!~*", "!~", "@>", "<@", "&&", "?|", "?&",
 )
 
 
@@ -1087,6 +1087,10 @@ class Emitter:
             if rewritten:
                 idx += rewritten
                 continue
+            rewritten = self._try_containment_op(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
             rewritten = self._try_array_literal(items, idx)
             if rewritten:
                 idx += rewritten
@@ -1228,6 +1232,186 @@ class Emitter:
             self._emit("||")
         return 1
 
+    _CONTAINMENT_FNS = {
+        "@>": "pg_jsonb_contains", "<@": "pg_jsonb_contained",
+        "&&": "pg_array_overlap",
+        "?": "pg_jsonb_exists", "?|": "pg_jsonb_exists_any",
+        "?&": "pg_jsonb_exists_all",
+    }
+
+    # operators that extend a value expression without ending it — the
+    # canonical idiom is `data -> 'tags' @> '[...]'`, where the @>'s
+    # LHS is the whole arrow chain (PG: equal precedence, left-assoc)
+    _CHAIN_OPS = ("->", "->>", "#>", "#>>", "||")
+
+    def _unit_end(self, items: Sequence[Item], idx: int) -> int:
+        """End (exclusive) of one chain unit: a valueish item or an
+        ``ARRAY[...]`` constructor; -1 = neither."""
+        if idx < len(items) and _is_valueish(items[idx]):
+            return idx + 1
+        if (
+            idx + 1 < len(items)
+            and item_is_kw(items[idx], "ARRAY")
+            and isinstance(items[idx + 1], Token)
+            and items[idx + 1].value == "["
+        ):
+            close = self._array_close(items, idx)
+            if close > 0:
+                return close + 1
+        return -1
+
+    def _chain_end(self, items: Sequence[Item], idx: int) -> int:
+        """items[idx] starts a unit; extend over [chain-op, unit] pairs
+        (units include ARRAY[...] constructors — `'{a}' || ARRAY['b']`
+        is one operand); returns the index AFTER the maximal chain."""
+        j = self._unit_end(items, idx)
+        while (
+            j + 1 < len(items)
+            and isinstance(items[j], Token)
+            and items[j].kind == OP
+            and items[j].value in self._CHAIN_OPS
+        ):
+            ue = self._unit_end(items, j + 1)
+            if ue < 0:
+                break
+            j = ue
+        return j
+
+    def _array_close(self, items: Sequence[Item], idx: int) -> int:
+        """items[idx] is kw ARRAY, items[idx+1] is '[' — index of the
+        matching ']', or -1."""
+        depth = 0
+        for k in range(idx + 1, len(items)):
+            t = items[k]
+            if isinstance(t, Token):
+                if t.value == "[":
+                    depth += 1
+                elif t.value == "]":
+                    depth -= 1
+                    if depth == 0:
+                        return k
+        return -1
+
+    def _operand_end(
+        self, items: Sequence[Item], idx: int, chain: bool = True
+    ) -> int:
+        """End index (exclusive) of a containment operand.
+        ``chain=True`` (LHS only) extends over arrow/concat pairs —
+        left-associativity pulls the whole chain into the LHS, but the
+        RHS of an equal-precedence operator is always a SINGLE operand
+        (``a ? 'x' || 'y'`` parses as ``(a ? 'x') || 'y'`` in PG)."""
+        if chain:
+            return self._chain_end(items, idx)
+        return self._unit_end(items, idx)
+
+    def _emit_operand(self, items: Sequence[Item], start: int, end: int):
+        if end - start == 1 and isinstance(items[start], Cast):
+            # a typed-array cast ($1::int[]) would emit CAST(? AS
+            # INTEGER) and destroy the array text before the UDF parses
+            # it — strip it, like _try_any_all does for = ANY($1::int[])
+            self.emit_item(items[start].operand)
+            return
+        if (
+            item_is_kw(items[start], "ARRAY")
+            and self._array_close(items, start) + 1 == end
+        ):
+            # a pure ARRAY[...] constructor
+            self._emit("json_array")
+            self.out.append("(")
+            self.emit_items(items[start + 2: end - 1])
+            self._emit(")")
+            return
+        # split the span into chain units; PG resolves each `||` link
+        # LEFT-TO-RIGHT by operand type: a link is ARRAY CONCATENATION
+        # once the accumulated value or its right unit is array-typed
+        # (an ARRAY constructor); earlier links between untyped
+        # literals stay SQLite string concat
+        units = []  # (unit_start, unit_end)
+        ops = []
+        j = start
+        ue = self._unit_end(items, j)
+        while ue > 0 and ue <= end:
+            units.append((j, ue))
+            if ue >= end:
+                break
+            ops.append(items[ue])
+            j = ue + 1
+            ue = self._unit_end(items, j)
+        covered = units and units[-1][1] == end and len(ops) == len(units) - 1
+        has_array = any(
+            item_is_kw(items[s], "ARRAY") for s, _ in units
+        )
+        all_concat = all(
+            isinstance(o, Token) and o.value == "||" for o in ops
+        )
+        if covered and has_array and ops and all_concat:
+            is_cat = []  # per link
+            acc_is_array = item_is_kw(items[units[0][0]], "ARRAY")
+            for s, _e in units[1:]:
+                cat = acc_is_array or item_is_kw(items[s], "ARRAY")
+                is_cat.append(cat)
+                acc_is_array = acc_is_array or cat
+
+            def emit_fold(k: int):
+                if k == 0:
+                    self._emit_operand(items, *units[0])
+                    return
+                if is_cat[k - 1]:
+                    self._emit("pg_array_cat")
+                    self.out.append("(")
+                    emit_fold(k - 1)
+                    self._emit(",")
+                    self._emit_operand(items, *units[k])
+                    self._emit(")")
+                else:
+                    emit_fold(k - 1)
+                    self._emit("||")
+                    self._emit_operand(items, *units[k])
+
+            emit_fold(len(units) - 1)
+            return
+        self.emit_items(items[start:end])
+
+    def _try_containment_op(self, items: Sequence[Item], idx: int) -> int:
+        """Infix jsonb/array operators with no SQLite spelling:
+        ``a @> b`` / ``a <@ b`` (jsonb containment; PG array literals
+        and ARRAY[...] constructors get PG array-type semantics),
+        ``a && b`` (array overlap), and the key-existence family
+        ``? ?| ?&`` — rewritten as UDF calls (runtime.py) via lhs
+        lookahead, like the interval rewrite.  Operands capture their
+        full arrow/concat chain or ARRAY constructor.
+
+        NOTE: bare ``?`` params never reach this path — PG clients send
+        ``$N``, and the tokenizer classifies ``?`` as an operator."""
+        lhs_end = self._operand_end(items, idx)
+        if lhs_end < 0 or lhs_end >= len(items):
+            return 0
+        op = items[lhs_end]
+        if not (isinstance(op, Token) and op.kind == OP):
+            return 0
+        fn = self._CONTAINMENT_FNS.get(op.value)
+        if fn is None or lhs_end + 1 >= len(items):
+            return 0
+        rhs_end = self._operand_end(items, lhs_end + 1, chain=False)
+        if rhs_end < 0:
+            return 0
+        # an ARRAY[...] constructor ANYWHERE in an operand (including a
+        # || concat chain) pins PG ARRAY-type semantics for @>/<@ —
+        # the same rule runtime.py applies to '{...}' literals
+        if fn in ("pg_jsonb_contains", "pg_jsonb_contained") and any(
+            item_is_kw(items[k], "ARRAY")
+            for k in list(range(idx, lhs_end))
+            + list(range(lhs_end + 1, rhs_end))
+        ):
+            fn += "_arr"
+        self._emit(fn)
+        self.out.append("(")
+        self._emit_operand(items, idx, lhs_end)
+        self._emit(",")
+        self._emit_operand(items, lhs_end + 1, rhs_end)
+        self._emit(")")
+        return rhs_end - idx
+
     def _try_any_all(self, items: Sequence[Item], idx: int) -> int:
         """``= ANY(x)`` → ``IN (SELECT value FROM json_each(pg_array_json(x)))``
         and ``<> ALL(x)`` → ``NOT IN (...)`` — the psycopg list-parameter
@@ -1311,24 +1495,10 @@ class Emitter:
             and items[idx + 1].value == "["
         ):
             return 0
-        depth = 0
-        close = -1
-        for k in range(idx + 1, len(items)):
-            t = items[k]
-            if isinstance(t, Token):
-                if t.value == "[":
-                    depth += 1
-                elif t.value == "]":
-                    depth -= 1
-                    if depth == 0:
-                        close = k
-                        break
+        close = self._array_close(items, idx)
         if close < 0:
             return 0
-        self._emit("json_array")
-        self.out.append("(")
-        self.emit_items(items[idx + 2: close])
-        self._emit(")")
+        self._emit_operand(items, idx, close + 1)
         return close - idx + 1
 
     def _try_for_lock(self, items: Sequence[Item], idx: int) -> int:

@@ -505,6 +505,212 @@ def _string_to_array(s, delim, nullstr=None):
 # regex (cached compile; PG flavor is close enough to `re` for the
 # common operator usage)
 
+# jsonb containment family (@>, <@, &&, ?, ?|, ?&)
+
+_JSONB_CACHE: dict = {}
+
+
+def _jsonb_parse(v):
+    """Text -> (parsed value, spelled-as-PG-array-literal?).  One parse,
+    cached by input text with single-entry eviction so a hot RHS filter
+    literal survives per-row LHS churn (same rationale as _RE_CACHE)."""
+    if not isinstance(v, str):
+        return v, False
+    hit = _JSONB_CACHE.get(v)
+    if hit is not None:
+        # LRU move-to-end so the hot RHS filter literal outlives
+        # per-row LHS churn at the eviction boundary.  Guarded pops:
+        # the read pool runs UDFs concurrently from to_thread workers,
+        # and losing a move-to-end race is just a cache miss
+        _JSONB_CACHE.pop(v, None)
+        _JSONB_CACHE[v] = hit
+        return hit
+    s = v.strip()
+    is_literal = False
+    try:
+        out = json.loads(s)
+    except json.JSONDecodeError:
+        if s.startswith("{") and s.endswith("}"):
+            out = _parse_pg_array(s)
+            is_literal = True
+        else:
+            out = v
+    if len(_JSONB_CACHE) > 256:
+        try:
+            _JSONB_CACHE.pop(next(iter(_JSONB_CACHE)), None)
+        except (StopIteration, RuntimeError):
+            pass  # concurrent mutation: skip this eviction
+    _JSONB_CACHE[v] = (out, is_literal)
+    return out, is_literal
+
+
+def _jsonb_value(v):
+    return _jsonb_parse(v)[0]
+
+
+def _jsonb_eq(a, b) -> bool:
+    """Deep equality with PG's cross-width numeric compare (1 == 1.0)
+    and bool kept distinct from numbers."""
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return float(a) == float(b)
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(
+            _jsonb_eq(a[k], b[k]) for k in a
+        )
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(
+            _jsonb_eq(x, y) for x, y in zip(a, b)
+        )
+    return type(a) is type(b) and a == b
+
+
+def _contains(a, b, top: bool = True) -> bool:
+    """PG jsonb containment: does ``a`` contain ``b``?"""
+    if isinstance(a, dict) and isinstance(b, dict):
+        return all(
+            k in a and _contains(a[k], bv, top=False)
+            for k, bv in b.items()
+        )
+    if isinstance(a, list):
+        if isinstance(b, list):
+            return all(
+                any(_contains(ea, eb, top=False) for ea in a) for eb in b
+            )
+        # an array may contain a bare primitive — TOP LEVEL ONLY
+        # ('[1,2]' @> '1' is true, but '[[1,2]]' @> '[1]' is false)
+        if top and not isinstance(b, dict):
+            return any(_jsonb_eq(ea, b) for ea in a)
+        return False
+    return _jsonb_eq(a, b)
+
+
+def _flatten(v):
+    """PG array ops 'consider only the elements, not dimensionality'."""
+    if isinstance(v, list):
+        for x in v:
+            yield from _flatten(x)
+    else:
+        yield v
+
+
+def _array_elem_eq(x, y) -> bool:
+    """ARRAY-type element equality: NULL never equals (unlike jsonb,
+    where null is an ordinary value)."""
+    if x is None or y is None:
+        return False
+    return _jsonb_eq(x, y)
+
+
+def _as_array_operand(v, is_literal):
+    """Coerce a parsed operand for the ARRAY-type branch.  The '{}'
+    spelling parses as an (ambiguous) empty JSON object; in array
+    context it means the empty array — contained in everything."""
+    if is_literal:
+        return v
+    if v == {}:
+        return []
+    return v
+
+
+def _contains_array_type(av, bv) -> bool:
+    """PG ARRAY @>: every base element of b equals some base element
+    of a (dimensionality ignored)."""
+    base_a = list(_flatten(av))
+    return all(
+        any(_array_elem_eq(x, y) for x in base_a) for y in _flatten(bv)
+    )
+
+
+def _array_operands(a, b):
+    """Shared preamble for the ARRAY-type branches: parse + coerce both
+    sides; None unless both land as lists."""
+    av = _as_array_operand(*_jsonb_parse(a))
+    bv = _as_array_operand(*_jsonb_parse(b))
+    if not isinstance(av, list) or not isinstance(bv, list):
+        return None
+    return av, bv
+
+
+def _jsonb_contains(a, b):
+    if a is None or b is None:
+        return None
+    av, lit_a = _jsonb_parse(a)
+    bv, lit_b = _jsonb_parse(b)
+    if lit_a or lit_b:
+        # ARRAY-type semantics (either side spelled as a PG literal)
+        return _jsonb_contains_arr(a, b)
+    return 1 if _contains(av, bv) else 0
+
+
+def _jsonb_contains_arr(a, b):
+    """@> with an ARRAY-typed operand: flatten, elements only."""
+    if a is None or b is None:
+        return None
+    ops = _array_operands(a, b)
+    if ops is None:
+        return 0
+    return 1 if _contains_array_type(*ops) else 0
+
+
+def _array_overlap(a, b):
+    """PG && — shared base ELEMENT; && is an ARRAY-only operator, so
+    dimensionality is always ignored, comparison is equality, and NULL
+    elements never match."""
+    if a is None or b is None:
+        return None
+    ops = _array_operands(a, b)
+    if ops is None:
+        return 0
+    av, bv = ops
+    base_a = list(_flatten(av))
+    return 1 if any(
+        any(_array_elem_eq(x, y) for x in base_a) for y in _flatten(bv)
+    ) else 0
+
+
+def _array_cat(a, b):
+    """PG array || array concatenation on the JSON-text model."""
+    if a is None or b is None:
+        return None
+    av = _as_array_operand(*_jsonb_parse(a))
+    bv = _as_array_operand(*_jsonb_parse(b))
+    if not isinstance(av, list):
+        av = [av]
+    if not isinstance(bv, list):
+        bv = [bv]
+    return json.dumps(av + bv)
+
+
+def _jsonb_keys(a):
+    v = _jsonb_value(a)
+    if isinstance(v, dict):
+        return set(v.keys())
+    if isinstance(v, list):
+        return {x for x in v if isinstance(x, str)}
+    if isinstance(v, str):
+        return {v}  # PG: '"foo"'::jsonb ? 'foo' is true
+    return set()
+
+
+def _key_list(ks) -> set:
+    v = _jsonb_value(ks)
+    return {str(x) for x in v} if isinstance(v, list) else set()
+
+
+def _jsonb_exists_any(a, ks):
+    if a is None or ks is None:
+        return None
+    return int(bool(_jsonb_keys(a) & _key_list(ks)))
+
+
+def _jsonb_exists_all(a, ks):
+    if a is None or ks is None:
+        return None
+    return int(_key_list(ks) <= _jsonb_keys(a))  # vacuous-true on empty
+
+
 _RE_CACHE: dict = {}
 
 
@@ -799,6 +1005,17 @@ def register(conn: sqlite3.Connection) -> None:
       else len(_compiled(str(pp)).findall(str(s))), **det)
 
     f("pg_array_json", 1, _pg_array_json, **det)
+    f("pg_jsonb_contains", 2, _jsonb_contains, **det)
+    f("pg_jsonb_contained", 2, lambda a, b: _jsonb_contains(b, a), **det)
+    f("pg_jsonb_contains_arr", 2, _jsonb_contains_arr, **det)
+    f("pg_jsonb_contained_arr", 2,
+      lambda a, b: _jsonb_contains_arr(b, a), **det)
+    f("pg_array_cat", 2, _array_cat, **det)
+    f("pg_array_overlap", 2, _array_overlap, **det)
+    f("pg_jsonb_exists", 2, lambda a, k: None if a is None or k is None
+      else int(str(k) in _jsonb_keys(a)), **det)
+    f("pg_jsonb_exists_any", 2, _jsonb_exists_any, **det)
+    f("pg_jsonb_exists_all", 2, _jsonb_exists_all, **det)
     f("array_length", 2, _array_length, **det)
     f("cardinality", 1, lambda a: None if a is None
       else len(json.loads(_pg_array_json(a))), **det)

@@ -395,6 +395,113 @@ def test_quote_literal(conn):
     assert q(conn, "SELECT quote_literal('it''s')") == [("'it''s'",)]
 
 
+def test_jsonb_containment_operators(conn):
+    # recursive jsonb containment
+    assert q(conn, """SELECT '{"a": 1, "b": {"c": 2}}' @> '{"b": {"c": 2}}'""") == [(1,)]
+    assert q(conn, """SELECT '{"a": 1}' @> '{"a": 2}'""") == [(0,)]
+    assert q(conn, "SELECT '[1, 2, 3]' @> '[1, 3]'") == [(1,)]
+    assert q(conn, "SELECT '[1, 2, 3]' @> '4'") == [(0,)]
+    assert q(conn, """SELECT '{"a": 1}' <@ '{"a": 1, "b": 2}'""") == [(1,)]
+    # PG array literals coerce through the array model
+    assert q(conn, "SELECT '{1,2,3}' @> '{1,3}'") == [(1,)]
+    assert q(conn, "SELECT '{1,2}' && '{2,9}'") == [(1,)]
+    assert q(conn, "SELECT '{1,2}' && '{8,9}'") == [(0,)]
+
+
+def test_jsonb_key_existence(conn):
+    assert q(conn, """SELECT '{"a": 1}' ? 'a', '{"a": 1}' ? 'z'""") == [(1, 0)]
+    assert q(conn, """SELECT '{"a": 1, "b": 2}' ?| '{z,b}'""") == [(1,)]
+    assert q(conn, """SELECT '{"a": 1, "b": 2}' ?& '{a,b}'""") == [(1,)]
+    assert q(conn, """SELECT '{"a": 1}' ?& '{a,b}'""") == [(0,)]
+    # filter usage against a column
+    conn.execute("UPDATE t SET b = '{\"tag\": 1}' WHERE a = 1")
+    assert q(conn, "SELECT a FROM t WHERE b @> '{\"tag\": 1}'") == [(1,)]
+
+
+def test_containment_lhs_arrow_chain(conn):
+    # THE canonical idiom: the @>'s LHS is the whole arrow chain
+    # (a jsonb column holds valid JSON in every row, as in PG)
+    conn.execute(
+        "UPDATE t SET b = '{\"meta\": {\"tags\": [\"x\", \"y\"]}}' WHERE a = 1"
+    )
+    conn.execute("UPDATE t SET b = '{\"meta\": {}}' WHERE a <> 1")
+    assert q(
+        conn,
+        "SELECT a FROM t WHERE b -> 'meta' -> 'tags' @> '[\"x\"]'",
+    ) == [(1,)]
+    assert q(
+        conn,
+        "SELECT a FROM t WHERE b -> 'meta' @> '{\"tags\": [\"y\"]}'",
+    ) == [(1,)]
+
+
+def test_containment_pg_edge_semantics(conn):
+    # jsonb: scalar-in-array exception is TOP LEVEL only
+    assert q(conn, "SELECT '[1, 2]' @> '1'") == [(1,)]
+    assert q(conn, "SELECT '[[1, 2]]' @> '[1]'") == [(0,)]
+    # jsonb nested array containment stays recursive (PG doc example)
+    assert q(conn, "SELECT '[[1, 2]]' @> '[[1, 2, 2]]'") == [(1,)]
+    # numeric cross-width equality; bools stay distinct from numbers
+    assert q(conn, "SELECT '[1]' @> '1.0', '[true]' @> '1'") == [(1, 0)]
+
+
+def test_array_type_semantics_ignore_dimensionality(conn):
+    # PG ARRAY operators consider only base elements, never dims:
+    # literals ('{..}') and ARRAY[...] constructors pin array semantics
+    assert q(conn, "SELECT '{{1,2},{3,4}}' && '{{1,9}}'") == [(1,)]
+    assert q(conn, "SELECT '{{1,2},{3,4}}' && '{{8,9}}'") == [(0,)]
+    assert q(conn, "SELECT '{{1,2},{3,4}}' @> '{{1,4}}'") == [(1,)]
+    assert q(conn, "SELECT ARRAY[1, 2] && ARRAY[2, 9]") == [(1,)]
+    assert q(conn, "SELECT ARRAY[1, 2] @> ARRAY[2]") == [(1,)]
+    assert q(conn, "SELECT '{a,b}' @> ARRAY['b']") == [(1,)]
+    assert q(conn, "SELECT ARRAY[1] <@ '{1,2}'") == [(1,)]
+
+
+def test_jsonb_scalar_key_existence(conn):
+    # PG: '"foo"'::jsonb ? 'foo' is true (string scalar equality)
+    assert q(conn, "SELECT '\"foo\"' ? 'foo', '\"foo\"' ? 'bar'") == [(1, 0)]
+
+
+def test_array_empty_and_null_semantics(conn):
+    # '{}' in array context is the empty array — contained in everything
+    assert q(conn, "SELECT '{1,2}' @> '{}'") == [(1,)]
+    assert q(conn, "SELECT ARRAY[1, 2] @> '{}'") == [(1,)]
+    # ARRAY-type equality: NULL never matches
+    assert q(conn, "SELECT '{1,NULL}' @> '{NULL}'") == [(0,)]
+    assert q(conn, "SELECT '{1,NULL}' && '{NULL}'") == [(0,)]
+    # jsonb null IS an ordinary value
+    assert q(conn, "SELECT '[null]' @> 'null'") == [(1,)]
+
+
+def test_array_concat_in_containment_chain(conn):
+    # `||` between array operands is ARRAY CONCAT, and the whole chain
+    # is the containment LHS (left-assoc)
+    assert q(conn, "SELECT '{a}' || ARRAY['b'] @> ARRAY['a','b']") == [(1,)]
+    assert q(conn, "SELECT ARRAY['a'] || '{b}' @> ARRAY['z']") == [(0,)]
+    assert q(conn, "SELECT ARRAY[1] || ARRAY[2] && '{2}'") == [(1,)]
+    # ...but links LEFT of the first array stay TEXT concat: PG types
+    # each || left-to-right ('{a}' || 'b' = text '{a}b')
+    assert q(conn, "SELECT '{a}' || 'b' || ARRAY['c'] @> ARRAY['b']") == [(0,)]
+    assert q(conn, "SELECT '{a}' || 'b' || ARRAY['c'] @> ARRAY['c']") == [(1,)]
+
+
+def test_typed_array_cast_in_containment(conn):
+    # $1::int[] must not emit CAST(? AS INTEGER) around the array text
+    assert conn.execute(
+        translate("SELECT $1::int[] @> $2::int[]").sql, ("{1,2}", "{3}")
+    ).fetchall() == [(0,)]
+    assert conn.execute(
+        translate("SELECT $1::int[] @> $2::int[]").sql, ("{1,2}", "{1}")
+    ).fetchall() == [(1,)]
+    assert q(conn, "SELECT '{1,2}'::int[] @> '{1}'") == [(1,)]
+
+
+def test_rhs_is_single_operand_left_assoc(conn):
+    # PG parses a ? 'x' || 'y' as (a ? 'x') || 'y' — equal precedence,
+    # left-associative; the RHS must not swallow the || chain
+    assert q(conn, "SELECT '{\"a\": 1}' ? 'a' || 'b'") == [("1b",)]
+
+
 def test_json_builders(conn):
     assert q(conn, "SELECT jsonb_build_object('k', 1)") == [('{"k":1}',)]
     assert q(conn, "SELECT json_build_array(1, 'a')") == [('[1,"a"]',)]
