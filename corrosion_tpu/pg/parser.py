@@ -939,6 +939,186 @@ def _literal_number(items: Sequence["Item"]):
     return None
 
 
+def _parse_srf_alias(items: Sequence["Item"], j: int):
+    """Parse the alias tail of a FROM-position SRF: ``[AS] a``,
+    ``[AS] a(c)`` — returns (alias, col, next_idx).  Raises on
+    WITH ORDINALITY (no SQLite strategy)."""
+    if j < len(items) and item_is_kw(items[j], "AS"):
+        j += 1
+    alias = None
+    col = None
+    if (
+        j + 1 < len(items)
+        and item_is_kw(items[j], "WITH")
+        and item_is_kw(items[j + 1], "ORDINALITY")
+    ):
+        raise UnsupportedConstruct(
+            "WITH ORDINALITY is not supported; join against "
+            "generate_series or use row_number()"
+        )
+    if j < len(items) and isinstance(items[j], Call) and len(
+        items[j].name.parts
+    ) == 1:
+        alias = items[j].name.parts[0].value
+        cargs = _split_args(items[j].args)
+        if len(cargs) == 1 and len(cargs[0]) == 1 and isinstance(
+            cargs[0][0], Name
+        ):
+            col = cargs[0][0].parts[0].value
+        j += 1
+    elif (
+        j < len(items)
+        and isinstance(items[j], Name)
+        and len(items[j].parts) == 1
+        and _is_valueish(items[j])
+    ):
+        alias = items[j].parts[0].value
+        j += 1
+        if j < len(items) and isinstance(items[j], Group):
+            sub = _split_args(items[j].items)
+            if len(sub) == 1 and len(sub[0]) == 1 and isinstance(
+                sub[0][0], Name
+            ):
+                col = sub[0][0].parts[0].value
+                j += 1
+    return alias, col, j
+
+
+def _srf_args_correlated(args: Sequence["Item"]) -> bool:
+    """Do the SRF arguments reference any column (a bare or qualified
+    Name)?  Decides the emission strategy: correlated args need the
+    bare table-valued json_each (SQLite's only lateral form, which
+    leaks json_each's own column names); literal/param args get a
+    clean renaming subquery."""
+    for a in args:
+        if isinstance(a, Name):
+            if not (
+                len(a.parts) == 1
+                and not a.parts[0].quoted
+                and a.parts[0].value.upper() in _CLAUSE_KWS
+            ):
+                return True
+        elif isinstance(a, Call):
+            if _srf_args_correlated(a.args):
+                return True
+        elif isinstance(a, Group):
+            if _srf_args_correlated(a.items):
+                return True
+        elif isinstance(a, Cast):
+            if _srf_args_correlated([a.operand]):
+                return True
+        elif isinstance(a, Case):
+            if _srf_args_correlated(a.items):
+                return True
+    return False
+
+
+# json_each-backed set-returning functions (FROM position)
+_SRF_JSON_FAMILY = frozenset((
+    "unnest",
+    "jsonb_array_elements", "json_array_elements",
+    "jsonb_array_elements_text", "json_array_elements_text",
+    "jsonb_object_keys", "json_object_keys",
+))
+
+
+def _srf_column_expr(fname: str, table: str) -> str:
+    """The expression a reference to the SRF's output column rewrites
+    to, qualified by the emitted json_each table alias."""
+    t = '"' + table.replace('"', '""') + '"'
+    if fname in ("jsonb_object_keys", "json_object_keys"):
+        return f"{t}.key"
+    if fname in ("jsonb_array_elements", "json_array_elements"):
+        # jsonb TEXT per element: containers pass through, booleans/
+        # null keep their JSON spelling, scalars re-quote
+        return (
+            f"CASE WHEN {t}.type IN ('true', 'false', 'null') "
+            f"THEN {t}.type "
+            f"WHEN {t}.type IN ('object', 'array') THEN {t}.value "
+            f"ELSE json_quote({t}.value) END"
+        )
+    if fname in ("jsonb_array_elements_text", "json_array_elements_text"):
+        return (
+            f"CASE WHEN {t}.type = 'null' THEN NULL "
+            f"WHEN {t}.type IN ('true', 'false') THEN {t}.type "
+            f"ELSE CAST({t}.value AS TEXT) END"
+        )
+    return f"{t}.value"  # unnest
+
+
+def _clause_step(clause, it: "Item"):
+    """Shared clause-keyword tracker for the emitter and the SRF
+    scanner — the two MUST agree on what counts as FROM position.  A
+    top-level comma while in the ON clause returns to the FROM list
+    (``FROM a JOIN b ON cond, srf(...)``)."""
+    if isinstance(it, Name) and len(it.parts) == 1 and not it.parts[0].quoted:
+        up = it.parts[0].value.upper()
+        if up in ("FROM", "JOIN"):
+            return "FROM"
+        if up in ("SELECT", "WHERE", "GROUP", "ORDER", "HAVING",
+                  "SET", "VALUES", "RETURNING", "LIMIT", "ON"):
+            return up
+    elif (
+        isinstance(it, Token) and it.kind == PUNCT and it.value == ","
+        and clause == "ON"
+    ):
+        return "FROM"
+    return clause
+
+
+def scan_srf_renames(items: Sequence["Item"]):
+    """Scan ONE scope (no Group recursion — the emitter re-scopes at
+    each select subquery) for json-family SRFs in FROM position.
+    Returns (renames, has_from): {referenced-column-name (lower) ->
+    replacement expression} — PG names the SRF's single OUTPUT COLUMN
+    after the alias, and the lateral-capable bare ``json_each(...)``
+    emission needs every reference rewritten to the table-qualified
+    expression — plus whether this scope has its own FROM clause
+    (scope-shadowing policy in Emitter.emit_item)."""
+    renames: dict = {}
+    clause = None
+    has_from = False
+    for k, it in enumerate(items):
+        if item_is_kw(it, "UNION", "INTERSECT", "EXCEPT"):
+            # each set-operation branch is its own scope; the emitter
+            # re-scans at the same boundary (Emitter._emit_items_inner)
+            break
+        clause = _clause_step(clause, it)
+        if clause == "FROM":
+            has_from = True
+        if (
+            clause == "FROM"
+            and isinstance(it, Call)
+            and len(it.name.parts) == 1
+            and it.name.parts[0].value.lower() in _SRF_JSON_FAMILY
+            # only the bare-TVF (correlated) emission needs renames;
+            # the uncorrelated subquery form names its column directly.
+            # MUST match _try_srf's choice of emission strategy.
+            and _srf_args_correlated(it.args)
+        ):
+            fname = it.name.parts[0].value.lower()
+            try:
+                alias, col, _j = _parse_srf_alias(items, k + 1)
+            except UnsupportedConstruct:
+                continue  # _try_srf raises with position context later
+            table = alias or fname
+            colname = (col or alias or _srf_default_col(fname)).lower()
+            renames[colname] = _srf_column_expr(fname, table)
+    return renames, has_from
+
+
+def _srf_default_col(fname: str) -> str:
+    """PG's default output column name: functions with a named OUT
+    parameter (the *_elements family: `value`) use it; the rest use
+    the function name."""
+    if fname in (
+        "jsonb_array_elements", "json_array_elements",
+        "jsonb_array_elements_text", "json_array_elements_text",
+    ):
+        return "value"
+    return fname
+
+
 # Name-position keyword spellings PG accepts bare (emit_name)
 _NAME_RENAMES = {
     "localtimestamp": "CURRENT_TIMESTAMP",
@@ -1012,9 +1192,23 @@ class Emitter:
     def __init__(
         self,
         constraint_resolver: Optional[ConstraintResolver] = None,
+        srf_renames: Optional[dict] = None,
     ):
         self.resolver = constraint_resolver
+        # SRF output-column name -> replacement expression (the
+        # lateral-capable json_each emission; scan_srf_renames)
+        self.srf_renames = srf_renames or {}
+        # reference-position state for the rename guard: renames apply
+        # only in value-reading clauses, never to name-DEFINING
+        # positions (SELECT ... AS e, INSERT column lists, SET targets)
+        self._clause = None
+        self._prev_sig: Optional[Item] = None
         self.out: List[str] = []
+
+    _SRF_VALUE_CLAUSES = (
+        "SELECT", "WHERE", "GROUP", "ORDER", "HAVING", "ON",
+        "RETURNING", "LIMIT",
+    )
 
     # one space between emitted atoms except after ( . and before ) , . (
     _NO_SPACE_BEFORE = {")", ",", ".", ";", "[", "]", "("}
@@ -1037,17 +1231,34 @@ class Emitter:
     # -- item dispatch -----------------------------------------------------
 
     def emit_items(self, items: Sequence[Item]) -> None:
+        # the clause state INHERITS into nested item lists (call args
+        # inside a select list are still in SELECT position) and is
+        # restored on exit
+        entry_clause = self._clause
+        entry_renames = self.srf_renames
+        try:
+            self._emit_items_inner(items, entry_clause)
+        finally:
+            self._clause = entry_clause
+            self.srf_renames = entry_renames
+
+    def _emit_items_inner(self, items: Sequence[Item], clause) -> None:
         idx = 0
-        clause = None  # last clause keyword seen at THIS nesting level
         while idx < len(items):
             it = items[idx]
-            if isinstance(it, Name) and len(it.parts) == 1 and not it.parts[0].quoted:
-                up = it.parts[0].value.upper()
-                if up in ("FROM", "JOIN"):
-                    clause = "FROM"
-                elif up in ("SELECT", "WHERE", "GROUP", "ORDER", "HAVING",
-                            "SET", "VALUES", "RETURNING", "LIMIT", "ON"):
-                    clause = up
+            if item_is_kw(it, "UNION", "INTERSECT", "EXCEPT"):
+                # new set-operation branch = new SRF-rename scope
+                # (scan_srf_renames stops at the same boundary; the
+                # caller's emit_items restores on exit)
+                self.srf_renames = scan_srf_renames(items[idx + 1:])[0]
+            clause = _clause_step(clause, it)
+            self._clause = clause
+            self._prev_sig = items[idx - 1] if idx > 0 else None
+            if clause == "FROM" and item_is_kw(it, "LATERAL"):
+                # PG's explicit LATERAL spelling: the bare json_each
+                # emission is already lateral; SQLite has no keyword
+                idx += 1
+                continue
             # COLLATE pg_catalog.default / COLLATE "default" → dropped
             if (
                 item_is_kw(it, "COLLATE")
@@ -1088,6 +1299,10 @@ class Emitter:
                 idx += rewritten
                 continue
             rewritten = self._try_containment_op(items, idx)
+            if rewritten:
+                idx += rewritten
+                continue
+            rewritten = self._try_concat_chain(items, idx)
             if rewritten:
                 idx += rewritten
                 continue
@@ -1263,8 +1478,13 @@ class Emitter:
     def _chain_end(self, items: Sequence[Item], idx: int) -> int:
         """items[idx] starts a unit; extend over [chain-op, unit] pairs
         (units include ARRAY[...] constructors — `'{a}' || ARRAY['b']`
-        is one operand); returns the index AFTER the maximal chain."""
+        is one operand); returns the index AFTER the maximal chain, or
+        -1 when items[idx] is not a unit (a negative j would index
+        items[-1] and walk a bogus chain from 0 — the emit loop then
+        never terminates on malformed input)."""
         j = self._unit_end(items, idx)
+        if j < 0:
+            return -1
         while (
             j + 1 < len(items)
             and isinstance(items[j], Token)
@@ -1322,55 +1542,102 @@ class Emitter:
             self._emit(")")
             return
         # split the span into chain units; PG resolves each `||` link
-        # LEFT-TO-RIGHT by operand type: a link is ARRAY CONCATENATION
-        # once the accumulated value or its right unit is array-typed
-        # (an ARRAY constructor); earlier links between untyped
-        # literals stay SQLite string concat
-        units = []  # (unit_start, unit_end)
-        ops = []
-        j = start
-        ue = self._unit_end(items, j)
-        while ue > 0 and ue <= end:
-            units.append((j, ue))
-            if ue >= end:
-                break
-            ops.append(items[ue])
-            j = ue + 1
-            ue = self._unit_end(items, j)
-        covered = units and units[-1][1] == end and len(ops) == len(units) - 1
-        has_array = any(
-            item_is_kw(items[s], "ARRAY") for s, _ in units
-        )
-        all_concat = all(
-            isinstance(o, Token) and o.value == "||" for o in ops
-        )
-        if covered and has_array and ops and all_concat:
-            is_cat = []  # per link
-            acc_is_array = item_is_kw(items[units[0][0]], "ARRAY")
-            for s, _e in units[1:]:
-                cat = acc_is_array or item_is_kw(items[s], "ARRAY")
-                is_cat.append(cat)
-                acc_is_array = acc_is_array or cat
-
-            def emit_fold(k: int):
-                if k == 0:
-                    self._emit_operand(items, *units[0])
-                    return
-                if is_cat[k - 1]:
-                    self._emit("pg_array_cat")
-                    self.out.append("(")
-                    emit_fold(k - 1)
-                    self._emit(",")
-                    self._emit_operand(items, *units[k])
-                    self._emit(")")
-                else:
-                    emit_fold(k - 1)
-                    self._emit("||")
-                    self._emit_operand(items, *units[k])
-
-            emit_fold(len(units) - 1)
-            return
+        # LEFT-TO-RIGHT by operand type — see _emit_concat_fold
+        fold = self._fold_span(items, start, end)
+        if fold is not None:
+            units, ops, fend = fold
+            if fend == end and self._fold_eligible(items, units, ops):
+                self._emit_concat_fold(items, units)
+                return
         self.emit_items(items[start:end])
+
+    def _try_concat_chain(self, items: Sequence[Item], idx: int) -> int:
+        """A bare ``... || ARRAY[...] || ...`` chain ANYWHERE (not just
+        as a containment operand) gets the PG type-resolved fold —
+        ``SELECT ARRAY[1] || ARRAY[2]`` is array concatenation, not
+        SQLite string concat of two json_array() texts."""
+        prev = items[idx - 1] if idx > 0 else None
+        if (
+            isinstance(prev, Token)
+            and prev.kind == OP
+            and prev.value in self._CHAIN_OPS
+        ):
+            # we are the RHS of an already-emitted chain operator
+            # (`data #>> '{a}' || ...`): starting a fold here would
+            # regroup PG's left-associative chain
+            return 0
+        fold = self._fold_span(items, idx, len(items))
+        if fold is None:
+            return 0
+        units, ops, end = fold
+        if not self._fold_eligible(items, units, ops):
+            return 0
+        self._emit_concat_fold(items, units)
+        return end - idx
+
+    def _fold_span(self, items: Sequence[Item], start: int, limit: int):
+        """Maximal [unit, (chain-op, unit)*] span from ``start`` bounded
+        by ``limit``; returns (units, ops, end) or None."""
+        ue = self._unit_end(items, start)
+        if ue < 0 or ue > limit:
+            return None
+        units = [(start, ue)]
+        ops: List[Token] = []
+        while ue < limit:
+            op = items[ue]
+            if not (
+                isinstance(op, Token)
+                and op.kind == OP
+                and op.value in self._CHAIN_OPS
+            ):
+                break
+            nxt = self._unit_end(items, ue + 1)
+            if nxt < 0 or nxt > limit:
+                break
+            ops.append(op)
+            units.append((ue + 1, nxt))
+            ue = nxt
+        return units, ops, ue
+
+    def _fold_eligible(self, items, units, ops) -> bool:
+        """The array-concat fold applies to all-``||`` chains that
+        involve at least one ARRAY constructor (PG types the links)."""
+        return (
+            bool(ops)
+            and all(o.value == "||" for o in ops)
+            and any(item_is_kw(items[s], "ARRAY") for s, _ in units)
+        )
+
+    def _emit_concat_fold(self, items: Sequence[Item], units) -> None:
+        """PG resolves each ``||`` link LEFT-TO-RIGHT by operand type:
+        a link is ARRAY CONCATENATION (pg_array_cat) once the
+        accumulated value or its right unit is array-typed (an ARRAY
+        constructor); earlier links between untyped literals stay
+        SQLite string concat."""
+        is_cat = []  # per link
+        acc_is_array = item_is_kw(items[units[0][0]], "ARRAY")
+        for s, _e in units[1:]:
+            cat = acc_is_array or item_is_kw(items[s], "ARRAY")
+            is_cat.append(cat)
+            acc_is_array = acc_is_array or cat
+
+        def emit_fold(k: int):
+            if k == 0:
+                self._emit_operand(items, *units[0])
+                return
+            if is_cat[k - 1]:
+                self._emit("pg_array_cat")
+                self.out.append("(")
+                emit_fold(k - 1)
+                self._emit(",")
+                self._emit_operand(items, *units[k])
+                self._emit(")")
+            else:
+                emit_fold(k - 1)
+                self._emit("||")
+                self._emit_operand(items, *units[k])
+
+        emit_fold(len(units) - 1)
 
     def _try_containment_op(self, items: Sequence[Item], idx: int) -> int:
         """Infix jsonb/array operators with no SQLite spelling:
@@ -1393,7 +1660,9 @@ class Emitter:
         if fn is None or lhs_end + 1 >= len(items):
             return 0
         rhs_end = self._operand_end(items, lhs_end + 1, chain=False)
-        if rhs_end < 0:
+        # validate BEFORE emitting anything: a non-positive consumed
+        # count would wedge the emit loop (idx += 0/negative forever)
+        if rhs_end < 0 or rhs_end <= idx:
             return 0
         # an ARRAY[...] constructor ANYWHERE in an operand (including a
         # || concat chain) pins PG ARRAY-type semantics for @>/<@ —
@@ -1542,71 +1811,105 @@ class Emitter:
     def _try_srf(self, items: Sequence[Item], idx: int) -> int:
         """Set-returning functions in FROM position:
         ``generate_series(a, b[, step])`` → recursive-CTE subquery;
-        ``unnest(arr)`` → ``json_each`` over the JSON-text array.  The
-        PG aliasing rule (a bare alias names the single output column)
-        is reproduced."""
+        ``unnest(arr)``, ``json[b]_array_elements[_text](j)``, and
+        ``json[b]_object_keys(j)`` → ``json_each`` projections (with a
+        json_type guard where PG would raise on the wrong container
+        kind — we yield zero rows instead).  The PG aliasing rule (a
+        bare alias names the single output column) is reproduced."""
         it = items[idx]
         if not (isinstance(it, Call) and len(it.name.parts) == 1):
             return 0
         fname = it.name.parts[0].value.lower()
-        if fname not in ("generate_series", "unnest"):
+        if fname not in (
+            "generate_series", "unnest",
+            "jsonb_array_elements", "json_array_elements",
+            "jsonb_array_elements_text", "json_array_elements_text",
+            "jsonb_object_keys", "json_object_keys",
+        ):
             return 0
 
-        # alias lookahead (same shapes as _try_values_alias)
-        j = idx + 1
-        if j < len(items) and item_is_kw(items[j], "AS"):
-            j += 1
-        alias: Optional[str] = None
-        col: Optional[str] = None
-        if (
-            j + 1 < len(items)
-            and item_is_kw(items[j], "WITH")
-            and item_is_kw(items[j + 1], "ORDINALITY")
-        ):
-            raise UnsupportedConstruct(
-                "WITH ORDINALITY is not supported; join against "
-                "generate_series or use row_number()"
-            )
-        if j < len(items) and isinstance(items[j], Call) and len(
-            items[j].name.parts
-        ) == 1:
-            alias = items[j].name.parts[0].value
-            cargs = _split_args(items[j].args)
-            if len(cargs) == 1 and len(cargs[0]) == 1 and isinstance(
-                cargs[0][0], Name
-            ):
-                col = cargs[0][0].parts[0].value
-            j += 1
-        elif (
-            j < len(items)
-            and isinstance(items[j], Name)
-            and len(items[j].parts) == 1
-            and _is_valueish(items[j])
-        ):
-            alias = items[j].parts[0].value
-            j += 1
-            if j < len(items) and isinstance(items[j], Group):
-                sub = _split_args(items[j].items)
-                if len(sub) == 1 and len(sub[0]) == 1 and isinstance(
-                    sub[0][0], Name
-                ):
-                    col = sub[0][0].parts[0].value
-                    j += 1
+        alias, col, j = _parse_srf_alias(items, idx + 1)
         table = alias or fname
-        colname = col or alias or fname
+        colname = col or alias or _srf_default_col(fname)
 
-        if fname == "unnest":
-            self._emit("(")
-            self._emit("SELECT value AS")
-            self._emit(f'"{colname}"')
-            self._emit("FROM json_each")
-            self.out.append("(")
-            self._emit("pg_array_json")
-            self.out.append("(")
-            self.emit_items(it.args)
-            self._emit(")")
-            self._emit(")")
-            self._emit(")")
+        if fname != "generate_series":
+            # Correlated args (the dominant PG shape — the lateral join
+            # `FROM t, jsonb_array_elements(t.data) AS e`) emit as a
+            # BARE table-valued json_each, the only SQLite form that
+            # can reference earlier FROM entries; the output column
+            # (PG names it after the alias) rewrites at reference
+            # sites via srf_renames.  The bare form leaks json_each's
+            # own column names (id/key/value/...), so literal/param
+            # args take a clean renaming subquery instead.  The
+            # correlation predicate MUST match scan_srf_renames.
+            correlated = _srf_args_correlated(it.args)
+            want_kind = None  # json_type the source must have
+            if fname in ("jsonb_object_keys", "json_object_keys"):
+                want_kind = "object"
+            elif fname != "unnest":
+                want_kind = "array"
+
+            def emit_src():
+                # SRF arguments are VALUE position even though the
+                # clause is FROM — a chained SRF's args may reference
+                # an earlier SRF's output column
+                saved_clause = self._clause
+                self._clause = "SELECT"
+                try:
+                    if fname == "unnest":
+                        self._emit("pg_array_json")
+                        self.out.append("(")
+                        self.emit_items(it.args)
+                        self._emit(")")
+                    else:
+                        self.emit_items(it.args)
+                finally:
+                    self._clause = saved_clause
+
+            def emit_each():
+                self._emit("json_each")
+                self.out.append("(")
+                if want_kind is not None:
+                    # PG raises on the wrong container kind; feeding
+                    # json_each an empty container yields zero rows.
+                    # The guard evaluates the source twice per outer
+                    # row — acceptable for the typical `t.col` /
+                    # `t.col -> 'k'` argument; SQLite has no lateral
+                    # derived table to bind it once
+                    empty = "'[]'" if want_kind == "array" else "'{}'"
+                    self._emit("iif")
+                    self.out.append("(")
+                    self._emit("json_type")
+                    self.out.append("(")
+                    emit_src()
+                    self._emit(")")
+                    self._emit(f"= '{want_kind}',")
+                    emit_src()
+                    self._emit(f", {empty})")
+                else:
+                    emit_src()
+                self._emit(")")
+
+            if correlated:
+                emit_each()
+                self._emit("AS")
+                self._emit(f'"{table}"')
+            else:
+                self._emit("(")
+                self._emit("SELECT")
+                self._emit(
+                    _srf_column_expr(fname, "json_each").replace(
+                        '"json_each".', ""
+                    )
+                )
+                self._emit("AS")
+                self._emit(f'"{colname}"')
+                self._emit("FROM")
+                emit_each()
+                self._emit(")")
+                self._emit("AS")
+                self._emit(f'"{table}"')
+            return j - idx
         else:
             arglists = _split_args(it.args)
             if len(arglists) not in (2, 3):
@@ -1778,9 +2081,25 @@ class Emitter:
             self.emit_name(it)
             return
         if isinstance(it, Group):
-            self._emit("(")
-            self.emit_items(it.items)
-            self._emit(")")
+            # a select subquery is its own SRF-rename SCOPE: it sees
+            # the outer scope's SRF columns (correlation) UNLESS it has
+            # its own FROM clause — then its names resolve against its
+            # own tables, which we cannot enumerate, so outer renames
+            # are dropped rather than hijacking same-named columns (a
+            # correlated ref to an outer SRF column from inside such a
+            # subquery errors instead of silently rewriting)
+            saved = self.srf_renames
+            if it.is_select:
+                sub, sub_has_from = scan_srf_renames(it.items)
+                self.srf_renames = (
+                    {**sub} if sub_has_from else {**saved, **sub}
+                )
+            try:
+                self._emit("(")
+                self.emit_items(it.items)
+                self._emit(")")
+            finally:
+                self.srf_renames = saved
             return
         if isinstance(it, Call):
             self.emit_call(it)
@@ -1830,6 +2149,19 @@ class Emitter:
             self._emit(f"pg_catalog.is_{name.last.lower()}")
             return
         if len(parts) == 1 and not parts[0].quoted:
+            srf = self.srf_renames.get(parts[0].value.lower())
+            if (
+                srf is not None
+                and self._clause in self._SRF_VALUE_CLAUSES
+                # name-DEFINING positions: `expr AS e` and the bare
+                # implicit alias `expr e` — a name directly after a
+                # complete value expression is an alias, not a ref
+                and not item_is_kw(self._prev_sig, "AS")
+                and not _is_valueish(self._prev_sig)
+                and not isinstance(self._prev_sig, Case)
+            ):
+                self._emit(srf)
+                return
             mapped = _NAME_RENAMES.get(parts[0].value.lower())
             if mapped is not None:
                 self._emit(mapped)
@@ -1863,6 +2195,23 @@ class Emitter:
         parts = name.parts
         if name.schema() in ("pg_catalog", "public", "information_schema"):
             parts = parts[-1:]  # UDFs have no schema in SQLite
+        if call.args and item_is_kw(
+            call.args[0], "SELECT", "VALUES", "WITH", "TABLE"
+        ):
+            # EXISTS(SELECT ...) / coalesce((SELECT ...)) parse their
+            # subquery items FLAT into call.args — re-scope SRF renames
+            # exactly like the Group subquery path
+            self._emit(".".join(p.value for p in parts))
+            self.out.append("(")
+            saved = self.srf_renames
+            sub, sub_has_from = scan_srf_renames(call.args)
+            self.srf_renames = {**sub} if sub_has_from else {**saved, **sub}
+            try:
+                self.emit_items(call.args)
+            finally:
+                self.srf_renames = saved
+            self._emit(")")
+            return
         if len(parts) == 1 and not parts[0].quoted:
             fname = parts[0].value.lower()
             if self._try_kw_arg_call(fname, call):
@@ -2098,7 +2447,10 @@ def emit(
     st: Statement,
     constraint_resolver: Optional[ConstraintResolver] = None,
 ) -> str:
-    em = Emitter(constraint_resolver=constraint_resolver)
+    em = Emitter(
+        constraint_resolver=constraint_resolver,
+        srf_renames=scan_srf_renames(st.items)[0],
+    )
     if st.ctes:
         em._emit("WITH")
         if st.recursive:

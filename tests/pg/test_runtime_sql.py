@@ -274,6 +274,91 @@ def test_unnest_in_from(conn):
 
 # -- set-returning generate_series -------------------------------------------
 
+def test_jsonb_srf_family(conn):
+    assert q(
+        conn,
+        "SELECT e FROM jsonb_array_elements('[1, 2]') AS e ORDER BY e",
+    ) == [("1",), ("2",)]
+    # nested containers round-trip as jsonb text; scalars re-quote
+    assert q(
+        conn,
+        "SELECT e FROM jsonb_array_elements('[\"a\", {\"k\": 1}]') AS e "
+        "ORDER BY e",
+    ) == [('"a"',), ('{"k":1}',)]
+    assert q(
+        conn,
+        "SELECT t FROM json_array_elements_text('[\"a\", \"b\"]') AS t "
+        "ORDER BY t",
+    ) == [("a",), ("b",)]
+    assert q(
+        conn,
+        "SELECT k FROM jsonb_object_keys('{\"x\": 1, \"y\": 2}') AS k "
+        "ORDER BY k",
+    ) == [("x",), ("y",)]
+    # the lateral-ish filter shape
+    assert q(
+        conn,
+        "SELECT count(*) FROM jsonb_array_elements('[1,2,3]') AS e "
+        "WHERE e > '1'",
+    ) == [(2,)]
+    # booleans/null keep their JSON spelling; _text maps null -> NULL
+    assert q(
+        conn,
+        "SELECT e FROM jsonb_array_elements('[true, false, null]') AS e",
+    ) == [("true",), ("false",), ("null",)]
+    assert q(
+        conn,
+        "SELECT t FROM jsonb_array_elements_text('[true, null, 1]') AS t",
+    ) == [("true",), (None,), ("1",)]
+    # wrong container kind yields zero rows (PG raises; we guard)
+    assert q(conn, "SELECT k FROM jsonb_object_keys('[5, 6]') AS k") == []
+    assert q(
+        conn, "SELECT e FROM jsonb_array_elements('{\"a\": 1}') AS e"
+    ) == []
+
+
+def test_jsonb_srf_lateral_correlated(conn):
+    """The dominant real-world shape: per-row expansion of a jsonb
+    column — `FROM t, jsonb_array_elements(t.col) AS e` — requires the
+    SRF to see earlier FROM entries (SQLite's bare json_each can)."""
+    conn.execute("CREATE TABLE docs (id INTEGER PRIMARY KEY, data TEXT)")
+    conn.executemany(
+        "INSERT INTO docs VALUES (?, ?)",
+        [
+            (1, '{"tags": ["a", "b"]}'),
+            (2, '{"tags": ["b"]}'),
+            (3, '{"tags": []}'),
+        ],
+    )
+    assert q(
+        conn,
+        "SELECT docs.id, e FROM docs, "
+        "jsonb_array_elements_text(docs.data -> 'tags') AS e "
+        "ORDER BY docs.id, e",
+    ) == [(1, "a"), (1, "b"), (2, "b")]
+    # aggregation over the expansion
+    assert q(
+        conn,
+        "SELECT e, count(*) FROM docs, "
+        "jsonb_array_elements_text(docs.data -> 'tags') AS e "
+        "GROUP BY e ORDER BY e",
+    ) == [("a", 1), ("b", 2)]
+    # unnest correlates too
+    conn.execute("CREATE TABLE lists (id INTEGER PRIMARY KEY, vals TEXT)")
+    conn.execute("INSERT INTO lists VALUES (1, '{10,20}')")
+    assert q(
+        conn,
+        "SELECT v FROM lists, unnest(lists.vals) AS v ORDER BY v",
+    ) == [(10,), (20,)]
+    # uncorrelated args take the renaming-subquery form, which leaks
+    # NO json_each columns — unqualified ORDER BY id stays unambiguous
+    assert q(
+        conn,
+        "SELECT id, e FROM docs, jsonb_array_elements_text('[\"q\"]') "
+        "AS e ORDER BY id",
+    ) == [(1, "q"), (2, "q"), (3, "q")]
+
+
 def test_generate_series(conn):
     assert q(conn, "SELECT * FROM generate_series(1, 5)") == [
         (1,), (2,), (3,), (4,), (5,)
@@ -494,6 +579,179 @@ def test_typed_array_cast_in_containment(conn):
         translate("SELECT $1::int[] @> $2::int[]").sql, ("{1,2}", "{1}")
     ).fetchall() == [(1,)]
     assert q(conn, "SELECT '{1,2}'::int[] @> '{1}'") == [(1,)]
+
+
+def test_srf_inside_exists_and_scalar_subquery(conn):
+    """SRF renames must apply inside Call-wrapped subqueries (EXISTS
+    parses its SELECT flat into call args) — the canonical jsonb filter
+    idiom."""
+    conn.execute("CREATE TABLE items (id INTEGER PRIMARY KEY, data TEXT)")
+    conn.executemany(
+        "INSERT INTO items VALUES (?, ?)",
+        [(1, '["a", "b"]'), (2, '["c"]')],
+    )
+    assert q(
+        conn,
+        "SELECT id FROM items WHERE EXISTS (SELECT 1 FROM "
+        "jsonb_array_elements_text(items.data) AS e WHERE e = 'a')",
+    ) == [(1,)]
+    assert q(
+        conn,
+        "SELECT coalesce((SELECT e FROM "
+        "jsonb_array_elements_text('[\"z\"]') AS e LIMIT 1), 'none')",
+    ) == [("z",)]
+
+
+def test_srf_rename_does_not_hijack_inner_scopes(conn):
+    """A subquery with its OWN FROM resolves its names against its own
+    tables — an outer SRF alias must not capture them."""
+    conn.execute("CREATE TABLE other (e TEXT)")
+    conn.execute("INSERT INTO other VALUES ('sub-col')")
+    conn.execute("CREATE TABLE items2 (id INTEGER PRIMARY KEY, data TEXT)")
+    conn.execute("INSERT INTO items2 VALUES (1, '[\"x\"]')")
+    assert q(
+        conn,
+        "SELECT (SELECT e FROM other) FROM items2, "
+        "jsonb_array_elements(items2.data) AS e",
+    ) == [("sub-col",)]
+
+
+def test_srf_after_join_on_comma(conn):
+    """``FROM a JOIN b ON cond, srf(...)`` — the comma ends the ON
+    clause and returns to the FROM list."""
+    conn.execute("CREATE TABLE ja (id INTEGER PRIMARY KEY, data TEXT)")
+    conn.execute("CREATE TABLE jb (id INTEGER PRIMARY KEY)")
+    conn.execute("INSERT INTO ja VALUES (1, '[\"k\"]')")
+    conn.execute("INSERT INTO jb VALUES (1)")
+    assert q(
+        conn,
+        "SELECT e FROM ja JOIN jb ON ja.id = jb.id, "
+        "jsonb_array_elements_text(ja.data) AS e",
+    ) == [("k",)]
+
+
+def _make_docs(conn):
+    conn.execute("CREATE TABLE docs (id INTEGER PRIMARY KEY, data TEXT)")
+    conn.executemany(
+        "INSERT INTO docs VALUES (?, ?)",
+        [
+            (1, '{"tags": ["a", "b"]}'),
+            (2, '{"tags": ["b"]}'),
+            (3, '{"tags": []}'),
+        ],
+    )
+
+
+def test_srf_rename_skips_defining_positions(conn):
+    """`SELECT id AS e`: the alias DEFINITION must not be rewritten to
+    the SRF column expression even when an SRF alias `e` exists."""
+    _make_docs(conn)
+    assert q(
+        conn,
+        "SELECT docs.id AS e FROM docs, "
+        "jsonb_array_elements(docs.data -> 'tags') AS e "
+        "WHERE docs.id = 2",
+    ) == [(2,)]
+
+
+def test_srf_correlated_arg_inside_case(conn):
+    _make_docs(conn)
+    assert q(
+        conn,
+        "SELECT e FROM docs, jsonb_array_elements_text("
+        "CASE WHEN docs.id = 1 THEN docs.data -> 'tags' ELSE '[]' END"
+        ") AS e ORDER BY e",
+    ) == [("a",), ("b",)]
+
+
+def test_srf_default_column_name_is_value(conn):
+    _make_docs(conn)
+    # PG: the *_elements family's OUT param names the column `value`
+    assert q(
+        conn,
+        "SELECT value FROM jsonb_array_elements_text('[\"v\"]')",
+    ) == [("v",)]
+    # correlated form: `value` rewrites to the jsonb-text expression,
+    # not json_each's raw column
+    assert q(
+        conn,
+        "SELECT value FROM docs, jsonb_array_elements(docs.data -> 'tags') "
+        "WHERE docs.id = 2",
+    ) == [('"b"',)]
+
+
+def test_srf_scope_edges(conn):
+    _make_docs(conn)
+    # explicit LATERAL spelling (the canonical PG form) is dropped
+    assert q(
+        conn,
+        "SELECT e FROM docs, LATERAL "
+        "jsonb_array_elements_text(docs.data -> 'tags') AS e "
+        "WHERE docs.id = 2",
+    ) == [("b",)]
+    # UNION branches are separate scopes: the second branch's `e` is a
+    # real column, not the first branch's SRF alias
+    conn.execute("CREATE TABLE uother (e TEXT)")
+    conn.execute("INSERT INTO uother VALUES ('plain')")
+    rows = q(
+        conn,
+        "SELECT e FROM docs, "
+        "jsonb_array_elements_text(docs.data -> 'tags') AS e "
+        "WHERE docs.id = 2 UNION ALL SELECT e FROM uother",
+    )
+    assert sorted(rows) == [("b",), ("plain",)]
+    # bare implicit alias (no AS) is a defining position
+    assert q(
+        conn,
+        "SELECT docs.id value FROM docs, "
+        "jsonb_array_elements(docs.data -> 'tags') WHERE docs.id = 2",
+    ) == [(2,)]
+    # chained SRFs: the second one's argument references the first's
+    # output column
+    conn.execute(
+        "INSERT INTO docs VALUES (4, '{\"m\": [[1, 2], [3]]}')"
+    )
+    assert q(
+        conn,
+        "SELECT x FROM docs, jsonb_array_elements(docs.data -> 'm') AS e, "
+        "jsonb_array_elements(e) AS x WHERE docs.id = 4 ORDER BY x",
+    ) == [("1",), ("2",), ("3",)]
+
+
+def test_fold_not_started_mid_chain(conn):
+    """A fold must never start at the RHS of an already-emitted chain
+    operator — `data #>> '{a}' || ARRAY['x']` would otherwise swallow
+    the path argument into pg_array_cat('{a}', ...). Mixed-op chains
+    fall back to the untyped emission (a documented deviation: PG's
+    static operand types are unknowable here), but the grouping must
+    stay left-associative."""
+    sql = translate(
+        "SELECT docs.data #>> '{tags}' || ARRAY['x'] FROM docs"
+    ).sql
+    assert "#>> pg_array_cat" not in sql
+    assert "#>> '{tags}'" in sql
+
+
+def test_array_concat_outside_containment(conn):
+    # the typing fold is not containment-context-only
+    assert q(conn, "SELECT ARRAY[1] || ARRAY[2]") == [("[1, 2]",)]
+    assert q(conn, "SELECT '{a}' || ARRAY['b']") == [('["a", "b"]',)]
+
+
+def test_malformed_chain_fragments_terminate(conn):
+    """A malformed operator fragment must fail cleanly (or pass through
+    to a SQLite error), never wedge the translator's emit loop — a
+    hung translate() on client-supplied SQL is a DoS."""
+    for sql in (
+        "SELECT (a @> b, c ||)",
+        "SELECT (a @> b ||)",
+        "SELECT a @>",
+        "SELECT || b",
+    ):
+        try:
+            translate(sql)  # must RETURN (any error is fine)
+        except Exception:
+            pass
 
 
 def test_rhs_is_single_operand_left_assoc(conn):
