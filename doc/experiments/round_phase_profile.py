@@ -2,9 +2,11 @@
 
 Times each phase of the dense and packed rounds separately (jitted,
 block_until_ready) to locate where the 100k-node round actually spends
-its wall — the end-to-end A/B showed packed 0.74x on CPU despite the
-primitive spike's wins, so the phase breakdown decides where packing
-pays and where it costs.
+its wall.  This is the tool that found the round-4 scatter hot spots
+(gaps_to_mask diff-array 301 ms on TPU, sampler compaction, the heard
+scatters — see TPU_BACKEND_NOTES.md "scatter purge"); post-purge TPU
+phases: sync 73 ms, swim 239 ms, broadcast 74 ms of a ~420-480 ms
+projected round (758 ms captured pre-purge).
 
 Run: JAX_PLATFORMS=cpu python doc/experiments/round_phase_profile.py [n_nodes]
      PROFILE_PLATFORM=default python ... [n_nodes]   # real device (tpu)
