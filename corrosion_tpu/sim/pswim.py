@@ -48,6 +48,11 @@ from .topology import Topology
 ID_BITS = 17
 ID_CAP = 1 << ID_BITS  # 131072
 INC_CLAMP = 4000
+# the merge gather packs (pkey+1) above (pid+1): the +1 offsets absorb
+# the -1 empty markers, so the pid field needs ID_BITS+1 bits.  Bounds:
+# (INC_CLAMP*4+3+1) << 18 | 2^17 < 2^32.
+PACK_SHIFT = ID_BITS + 1
+PACK_MASK = (1 << PACK_SHIFT) - 1
 
 
 def psample_member_targets(
@@ -90,10 +95,23 @@ def _merge_entries(
     old_pkey = pkey
     bucket = jnp.where(e_id >= 0, e_id % m, 0)
     # ONE fused random gather for the three table reads: the per-entry
-    # (dst, bucket) accesses are the step's cache-miss hot spot
-    tbl = jnp.stack([pid, pkey, psince], axis=-1)  # [N, M, 3]
-    cur = tbl[e_dst, bucket]  # [E, 3]
-    cur_id, cur_key, cur_since = cur[:, 0], cur[:, 1], cur[:, 2]
+    # (dst, bucket) accesses are the step's cache-miss hot spot.  pid
+    # (< 2^17) and pkey (≤ INC_CLAMP*4+3 < 2^14) pack into one u32
+    # word (+1 offsets absorb the -1 empty markers; 16004<<18 + 2^17
+    # < 2^32), shrinking the gather from 3×i32 to 2×u32 — a third of
+    # the merge's random-access traffic (r4 profile: 121 ms on CPU,
+    # 36 ms on TPU, at the 100k shape)
+    u32 = jnp.uint32
+    packed_tbl = (
+        (pkey + 1).astype(u32) << PACK_SHIFT
+    ) | (pid + 1).astype(u32)
+    tbl = jnp.stack(
+        [packed_tbl, (psince + 1).astype(u32)], axis=-1
+    )  # [N, M, 2] u32
+    cur = tbl[e_dst, bucket]  # [E, 2]
+    cur_id = (cur[:, 0] & u32(PACK_MASK)).astype(jnp.int32) - 1
+    cur_key = (cur[:, 0] >> PACK_SHIFT).astype(jnp.int32) - 1
+    cur_since = cur[:, 1].astype(jnp.int32) - 1
 
     # 1. matching id → belief precedence merge
     match = e_ok & (cur_id == e_id)
