@@ -133,3 +133,47 @@ def test_partial_churn_config_detects_all():
     m = config_swim_churn_partial(seed=1, n=512, max_rounds=800)
     assert m["converged"], m
     assert m["detected_fraction"] == 1.0
+
+
+def test_merge_gather_pack_boundary_values():
+    """The merge's 2xu32 packed gather must decode EXACTLY at the
+    envelope bounds: pid = ID_CAP-1, pkey = INC_CLAMP*4+3, and the -1
+    empty markers (the +1 offsets absorb them)."""
+    import jax
+
+    from corrosion_tpu.sim.pswim import ID_CAP, INC_CLAMP, _merge_entries
+    from corrosion_tpu.sim.state import SimConfig
+
+    cfg = SimConfig(
+        n_nodes=4, n_payloads=32, swim_partial_view=True, member_slots=4
+    )
+    max_key = INC_CLAMP * 4 + 3
+    pid = jnp.array(
+        [[ID_CAP - 1, -1, 2, 3]] * 4, jnp.int32
+    )
+    pkey = jnp.array([[max_key, -1, 0, 1]] * 4, jnp.int32)
+    psince = jnp.array([[-1, -1, 5, -1]] * 4, jnp.int32)
+
+    # entry about id = ID_CAP-1 (bucket (ID_CAP-1) % 4 = 3 ... pick a
+    # bucket-0 id: ID_CAP-1 % 4 == 3, so use dst bucket 3's occupant)
+    b = (ID_CAP - 1) % 4
+    assert b == 3
+    # matching-id merge at the boundary: higher key must win
+    e_dst = jnp.array([0], jnp.int32)
+    e_id = jnp.array([ID_CAP - 1], jnp.int32)
+    e_key = jnp.array([max_key], jnp.int32)
+    e_ok = jnp.ones((1,), bool)
+    # place the boundary occupant in bucket 3 with a LOWER key
+    pid = pid.at[0, 3].set(ID_CAP - 1)
+    pkey = pkey.at[0, 3].set(4)  # inc 1, ALIVE
+    out_pid, out_pkey, _ = jax.jit(
+        lambda p, k, s: _merge_entries(
+            p, k, s, e_dst, e_id, e_key, e_ok, jnp.int32(9), cfg
+        )
+    )(pid, pkey, psince)
+    # the match was detected (decode of pid at ID_CAP-1 was exact) and
+    # precedence took the higher boundary key
+    assert int(out_pid[0, 3]) == ID_CAP - 1
+    assert int(out_pkey[0, 3]) == max_key
+    # empty marker slots stayed empty (-1 decode exact)
+    assert int(out_pid[0, 1]) == -1
