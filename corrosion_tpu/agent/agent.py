@@ -158,6 +158,7 @@ class Agent:
             "changes_committed": 0, "changes_applied": 0, "changes_deduped": 0,
             "broadcasts_sent": 0, "broadcasts_recv": 0, "sync_rounds": 0,
             "ingest_dropped": 0, "empties_recv": 0, "changes_failed": 0,
+            "cluster_mismatch_dropped": 0, "sync_rejected_different_cluster": 0,
         }
         # protocol-native clock for calibration (VERDICT r2 item 2): the
         # broadcast flush tick counter and per-version apply ticks.  A
@@ -351,7 +352,8 @@ class Agent:
                 part=ChangesetPart.FULL,
             )
             frame = codec.encode_message(
-                "bcast", codec.encode_changeset(cs), ts=self.clock.now()
+                "bcast", codec.encode_changeset(cs), ts=self.clock.now(),
+                cid=self.config.cluster_id,
             )
             self._bcast_q.append(_PendingBroadcast(frame=frame, is_local=True))
         sometimes(True, "broadcasts-happen")
@@ -416,8 +418,14 @@ class Agent:
             await self.swim.handle_datagram(src, data)
 
     async def _on_uni(self, src: str, data: bytes):
-        kind, body, ts = codec.decode_message(data)
+        kind, body, ts, _tr, cid = codec.decode_message_full(data)
         if kind != "bcast":
+            return
+        if cid != self.config.cluster_id:
+            # cross-cluster broadcasts are dropped before any CRDT state is
+            # touched (uni.rs:73-75 checks the cluster id on every incoming
+            # BroadcastV1 frame)
+            self.stats["cluster_mismatch_dropped"] += 1
             return
         if ts is not None:
             try:
@@ -821,6 +829,7 @@ class Agent:
                     codec.encode_sync_state(ours),
                     ts=self.clock.now(),
                     trace={"traceparent": sp.context.traceparent()},
+                    cid=self.config.cluster_id,
                 )
             )
             frame = await bi.recv(timeout)
@@ -829,10 +838,25 @@ class Agent:
             # handshake round-trip = a fresh RTT sample for the peer's
             # ring bucket (the reference samples path RTT per exchange)
             self.members.record_rtt(addr, (time.monotonic() - _t0) * 1000.0)
-            kind, body, ts = codec.decode_message(frame)
+            kind, body, ts, _tr, cid = codec.decode_message_full(frame)
             if kind == "sync_reject":
+                if body == "different_cluster":
+                    self.stats["sync_rejected_different_cluster"] += 1
+                    # the peer told us it belongs to another cluster:
+                    # demote it so it leaves the sync rotation and the
+                    # broadcast fan-out instead of being retried forever
+                    aid = self.members.by_addr.get(addr)
+                    st = self.members.get(aid) if aid is not None else None
+                    if st is not None:
+                        self.members.remove_member(st.actor)
                 return 0
             if kind != "sync_state":
+                return 0
+            if cid != self.config.cluster_id:
+                # symmetric client-side guard: never ingest state served by
+                # a foreign cluster (the server normally rejects first —
+                # peer/mod.rs:1431 SyncRejectionV1::DifferentCluster)
+                self.stats["cluster_mismatch_dropped"] += 1
                 return 0
             if ts is not None:
                 try:
@@ -873,8 +897,14 @@ class Agent:
             frame = await bi.recv(30.0)
             if not frame:
                 return
-            kind, body, ts, tr = codec.decode_message_tr(frame)
+            kind, body, ts, tr, cid = codec.decode_message_full(frame)
             if kind != "sync_start":
+                return
+            if cid != self.config.cluster_id:
+                # typed rejection so the initiator can tell policy from
+                # failure (peer/mod.rs:1431 SyncRejectionV1::DifferentCluster)
+                self.stats["cluster_mismatch_dropped"] += 1
+                await bi.send(codec.encode_message("sync_reject", "different_cluster"))
                 return
             # continue the client's trace (serve_sync extraction,
             # peer/mod.rs:1415-1417)
@@ -905,6 +935,7 @@ class Agent:
                 "sync_state",
                 codec.encode_sync_state(self.sync_state()),
                 ts=self.clock.now(),
+                cid=self.config.cluster_id,
             )
         )
         frame = await bi.recv(30.0)

@@ -142,25 +142,32 @@ def encode_message(
     body: Any,
     ts: Optional[int] = None,
     trace: Optional[dict] = None,
+    cid: int = 0,
 ) -> bytes:
     """One framed gossip message: {"t": kind, "ts": clock, "b": body}.
     ``trace`` adds an optional "tr" carrier — the SyncTraceContextV1
     {traceparent, tracestate} riding the sync handshake
-    (corro-types/src/sync.rs:33-67)."""
+    (corro-types/src/sync.rs:33-67).  ``cid`` stamps the sender's cluster
+    id; a missing "cid" key decodes as cluster 0 (the reference carries
+    the cluster id on every BroadcastV1 frame and the sync handshake —
+    uni.rs:73-75, peer/mod.rs:1431)."""
     env = {"t": kind, "ts": ts, "b": body}
     if trace:
         env["tr"] = trace
+    if cid:
+        env["cid"] = cid
     return json.dumps(env, separators=(",", ":")).encode("utf-8")
 
 
 def decode_message(data: bytes) -> Tuple[str, Any, Optional[int]]:
-    return decode_message_tr(data)[:3]
+    return decode_message_full(data)[:3]
 
 
-def decode_message_tr(
+def decode_message_full(
     data: bytes,
-) -> Tuple[str, Any, Optional[int], Optional[dict]]:
+) -> Tuple[str, Any, Optional[int], Optional[dict], int]:
     """decode_message plus the optional trace carrier (serve_sync's
-    extraction side, peer/mod.rs:1415-1417)."""
+    extraction side, peer/mod.rs:1415-1417) plus the sender's cluster id
+    (0 when the frame predates / omits the stamp)."""
     d = json.loads(data)
-    return d["t"], d.get("b"), d.get("ts"), d.get("tr")
+    return d["t"], d.get("b"), d.get("ts"), d.get("tr"), d.get("cid", 0)

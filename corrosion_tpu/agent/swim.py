@@ -211,6 +211,12 @@ class SwimRuntime:
     # -- wire -------------------------------------------------------------
 
     async def _send(self, addr: str, msg: dict):
+        # every SWIM datagram carries the cluster id so foreign-cluster
+        # membership gossip can never merge (the reference's foca runtime
+        # is isolated the same way — uni.rs:73-75 gates broadcast frames,
+        # and membership rides the same identity envelope)
+        if self.agent.config.cluster_id:
+            msg["cid"] = self.agent.config.cluster_id
         msg["gossip"] = self._pick_gossip()
         data = json.dumps(msg, separators=(",", ":")).encode()
         # stay under the SWIM datagram budget by shedding gossip entries
@@ -247,6 +253,11 @@ class SwimRuntime:
         try:
             msg = json.loads(data)
         except json.JSONDecodeError:
+            return
+        if msg.get("cid", 0) != self.agent.config.cluster_id:
+            # drop foreign-cluster datagrams before merging any gossip —
+            # two clusters sharing a network must not exchange membership
+            self.agent.stats["cluster_mismatch_dropped"] += 1
             return
         kind = msg.get("k")
         for row in msg.get("gossip", []):

@@ -52,21 +52,28 @@ class Cluster:
         connectivity: Optional[int] = None,
         seed: int = 0,
         use_swim: bool = True,
+        cluster_id: int = 0,
+        net: Optional[MemoryNetwork] = None,
+        addr_prefix: str = "node",
     ):
         self.n = n
         self.schema = schema
-        self.net = MemoryNetwork(default_link=link or LinkModel())
+        # a shared ``net`` lets two Clusters with different cluster_ids sit
+        # on one network (the cross-cluster isolation tests)
+        self.net = net or MemoryNetwork(default_link=link or LinkModel())
         self.agents: List[Agent] = []
         self.tmp = tempfile.TemporaryDirectory()
         self.connectivity = connectivity
         self.seed = seed
         self.use_swim = use_swim
+        self.cluster_id = cluster_id
+        self.addr_prefix = addr_prefix
 
-    async def start(self):
+    async def start(self, extra_bootstrap: Optional[List[str]] = None):
         import random
 
         rng = random.Random(self.seed)
-        addrs = [f"node{i}" for i in range(self.n)]
+        addrs = [f"{self.addr_prefix}{i}" for i in range(self.n)]
         for i, addr in enumerate(addrs):
             if self.connectivity is None or self.connectivity >= self.n - 1:
                 bootstrap = [a for a in addrs if a != addr]
@@ -75,11 +82,14 @@ class Cluster:
                 bootstrap = rng.sample(
                     [a for a in addrs if a != addr], self.connectivity
                 )
+            if extra_bootstrap:
+                bootstrap = bootstrap + list(extra_bootstrap)
             cfg = Config(
                 db_path=f"{self.tmp.name}/node{i}.db",
                 gossip_addr=addr,
                 bootstrap=bootstrap,
                 use_swim=self.use_swim,
+                cluster_id=self.cluster_id,
                 perf=fast_perf(),
             )
             agent = Agent(cfg, self.net.transport(addr))
@@ -93,12 +103,13 @@ class Cluster:
         tests.rs:602-650): fresh empty DB, bootstrap = existing nodes, must
         catch up through anti-entropy sync."""
         i = len(self.agents)
-        addr = f"node{i}"
+        addr = f"{self.addr_prefix}{i}"
         cfg = Config(
             db_path=f"{self.tmp.name}/node{i}.db",
             gossip_addr=addr,
             bootstrap=[a.transport.addr for a in self.agents],
             use_swim=self.use_swim,
+            cluster_id=self.cluster_id,
             perf=fast_perf(),
         )
         agent = Agent(cfg, self.net.transport(addr))

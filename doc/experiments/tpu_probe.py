@@ -2,7 +2,7 @@
 """TPU health probe + retry log (VERDICT r03 item 1 evidence trail).
 
 Runs one bounded bench_child preflight against the default (TPU) platform
-and appends a timestamped JSON line to ``doc/experiments/TPU_RETRY_r04.jsonl``.
+and appends a timestamped JSON line to ``doc/experiments/TPU_RETRY_r05.jsonl``.
 The judge asked for either a healthy-chip capture or an auditable retry log
 with <=30 min cadence; this script is the logger for the latter and the
 trigger condition for the former (exit code 0 == chip healthy).
@@ -20,7 +20,7 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-LOG = os.path.join(REPO, "doc", "experiments", "TPU_RETRY_r04.jsonl")
+LOG = os.path.join(REPO, "doc", "experiments", "TPU_RETRY_r05.jsonl")
 
 
 def probe(timeout: float = 180.0) -> dict:

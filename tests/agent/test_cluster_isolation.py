@@ -1,0 +1,135 @@
+"""Cross-cluster isolation: two clusters sharing one network must never
+exchange CRDT state, membership, or sync payloads.
+
+The reference gates every receive path on the cluster id: incoming
+broadcast frames (corro-agent/src/agent/uni.rs:73-75) and the sync
+handshake, which answers a foreign cluster with a typed
+`SyncRejectionV1::DifferentCluster` (corro-agent/src/api/peer/mod.rs:1431).
+These tests put two full clusters on one MemoryNetwork, cross-wire their
+bootstrap lists so frames really flow across the boundary, and assert
+nothing leaks.
+"""
+
+import asyncio
+
+from corrosion_tpu.agent.transport import LinkModel, MemoryNetwork
+from corrosion_tpu.testing import Cluster
+
+
+async def _two_clusters(use_swim: bool):
+    net = MemoryNetwork(default_link=LinkModel())
+    ca = Cluster(2, cluster_id=1, net=net, addr_prefix="a", use_swim=use_swim)
+    cb = Cluster(2, cluster_id=2, net=net, addr_prefix="b", use_swim=use_swim)
+    # cross-wire: every node also bootstraps against the FOREIGN cluster,
+    # so broadcast/sync/SWIM traffic is actually attempted across clusters
+    await ca.start(extra_bootstrap=["b0", "b1"])
+    await cb.start(extra_bootstrap=["a0", "a1"])
+    return ca, cb
+
+
+async def _stop(ca, cb):
+    await ca.stop()
+    await cb.stop()
+
+
+def _total_stat(cluster: Cluster, key: str) -> int:
+    return sum(agent.stats[key] for agent in cluster.agents)
+
+
+def test_static_membership_no_leak_and_typed_sync_rejection():
+    """Static membership (no SWIM) forces frames onto the wire: foreign
+    members ARE in the broadcast fan-out and the sync peer set, so the
+    receive-path checks are what keeps the clusters apart."""
+
+    async def body():
+        ca, cb = await _two_clusters(use_swim=False)
+        try:
+            ca.agents[0].exec_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "alpha"))]
+            )
+            cb.agents[0].exec_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (2, "beta"))]
+            )
+            assert await ca.wait_converged(15)
+            assert await cb.wait_converged(15)
+            # give the cross-wired broadcast/sync lanes time to fire
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if (
+                    _total_stat(ca, "cluster_mismatch_dropped")
+                    + _total_stat(cb, "cluster_mismatch_dropped")
+                    > 0
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            # not a single row crossed the boundary
+            for i in range(2):
+                assert ca.rows(i, "SELECT id, text FROM tests") == [(1, "alpha")]
+                assert cb.rows(i, "SELECT id, text FROM tests") == [(2, "beta")]
+            # and the drop was an *explicit policy decision*, not silence
+            assert (
+                _total_stat(ca, "cluster_mismatch_dropped")
+                + _total_stat(cb, "cluster_mismatch_dropped")
+                > 0
+            )
+            # no foreign actor's CRDT state is booked anywhere
+            a_actors = {ag.actor_id for ag in ca.agents}
+            b_actors = {ag.actor_id for ag in cb.agents}
+            for ag in ca.agents:
+                assert not (set(ag.sync_state().heads) & b_actors)
+            for ag in cb.agents:
+                assert not (set(ag.sync_state().heads) & a_actors)
+        finally:
+            await _stop(ca, cb)
+
+    asyncio.run(body())
+
+
+def test_sync_handshake_rejected_with_typed_reason():
+    """A direct cross-cluster sync attempt gets the typed rejection
+    (peer/mod.rs:1431) and ingests nothing."""
+
+    async def body():
+        ca, cb = await _two_clusters(use_swim=False)
+        try:
+            cb.agents[0].exec_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (9, "secret"))]
+            )
+            got = await ca.agents[0]._sync_with("b0")
+            assert got == 0
+            assert (
+                ca.agents[0].stats["sync_rejected_different_cluster"] >= 1
+            )
+            assert cb.agents[0].stats["cluster_mismatch_dropped"] >= 1
+            assert ca.rows(0, "SELECT * FROM tests") == []
+        finally:
+            await _stop(ca, cb)
+
+    asyncio.run(body())
+
+
+def test_swim_membership_isolated():
+    """With SWIM on, foreign join/gossip datagrams are dropped before any
+    merge, so neither cluster ever learns a foreign member."""
+
+    async def body():
+        ca, cb = await _two_clusters(use_swim=True)
+        try:
+            ca.agents[0].exec_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "alpha"))]
+            )
+            assert await ca.wait_converged(15)
+            await asyncio.sleep(0.5)  # a few SWIM probe intervals
+            a_ids = {ag.actor_id for ag in ca.agents}
+            b_ids = {ag.actor_id for ag in cb.agents}
+            for ag in ca.agents:
+                member_ids = {st.actor.id for st in ag.members.up_members()}
+                assert not (member_ids & b_ids)
+            for ag in cb.agents:
+                member_ids = {st.actor.id for st in ag.members.up_members()}
+                assert not (member_ids & a_ids)
+                assert list(ag.store.query("SELECT * FROM tests")) == []
+        finally:
+            await _stop(ca, cb)
+
+    asyncio.run(body())
