@@ -268,7 +268,10 @@ def release_functions(conn: sqlite3.Connection) -> None:
     """Drop the defs entry and close the cached probe connection for a
     connection that is going away (ADVICE r3: the probe conn was never
     closed).  Safe to call for conns that were never registered."""
+    from . import runtime
+
     _INDEX_DEFS.pop(id(conn), None)
+    runtime.release_now(conn)  # its freeze cell must not survive id reuse
     probe = _PROBES.pop(id(conn), None)
     if probe is not None:
         try:

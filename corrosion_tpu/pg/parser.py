@@ -1394,6 +1394,13 @@ class Emitter:
                 return 0  # unparseable interval: emit raw, fail at exec
             steps.append((text, -1 if items[j].value == "-" else 1))
             j += width
+        # trailing +/- is fine (left-assoc: our fold IS PG's grouping);
+        # trailing * / % ^ binds the interval first in PG and would be
+        # regrouped — same for any arithmetic gluing to our left
+        self._guard_arith_regroup(
+            items, idx, j, "interval arithmetic",
+            trailing=frozenset({"*", "/", "%", "^"}),
+        )
         for _ in steps:
             self._emit("pg_ts_offset")
             self.out.append("(")
@@ -1639,6 +1646,47 @@ class Emitter:
 
         emit_fold(len(units) - 1)
 
+    _ARITH_OPS = frozenset({"+", "-", "*", "/", "%", "^"})
+
+    def _guard_arith_regroup(
+        self,
+        items: Sequence[Item],
+        idx: int,
+        end: int,
+        opname: str,
+        trailing: frozenset = _ARITH_OPS,
+    ) -> None:
+        """The lookahead rewrites (containment, interval arithmetic)
+        capture ONE operand on each side, so an adjacent arithmetic
+        operator that PG binds FIRST (``+`` binds tighter than ``@>``;
+        ``*`` tighter than ``± interval``) would be silently regrouped
+        — ``x + a @> b`` must mean ``(x + a) @> b``, not
+        ``x + (a @> b)``.  Refuse with a parenthesize hint instead of
+        emitting a wrong grouping (ADVICE r4, parser.py:1642)."""
+        prev = items[idx - 1] if idx > 0 else None
+        if (
+            isinstance(prev, Token)
+            and prev.kind == OP
+            and prev.value in self._ARITH_OPS
+            # a sign with nothing valueish before it is unary: no regroup
+            and not (
+                prev.value in "+-"
+                and (idx < 2 or not _is_valueish(items[idx - 2]))
+            )
+        ):
+            raise UnsupportedConstruct(
+                f"arithmetic adjacent to {opname} is ambiguous here "
+                "(PG binds the arithmetic first); parenthesize the "
+                "left operand"
+            )
+        nxt = items[end] if end < len(items) else None
+        if isinstance(nxt, Token) and nxt.kind == OP and nxt.value in trailing:
+            raise UnsupportedConstruct(
+                f"arithmetic adjacent to {opname} is ambiguous here "
+                "(PG binds the arithmetic first); parenthesize the "
+                "right operand"
+            )
+
     def _try_containment_op(self, items: Sequence[Item], idx: int) -> int:
         """Infix jsonb/array operators with no SQLite spelling:
         ``a @> b`` / ``a <@ b`` (jsonb containment; PG array literals
@@ -1664,6 +1712,7 @@ class Emitter:
         # count would wedge the emit loop (idx += 0/negative forever)
         if rhs_end < 0 or rhs_end <= idx:
             return 0
+        self._guard_arith_regroup(items, idx, rhs_end, op.value)
         # an ARRAY[...] constructor ANYWHERE in an operand (including a
         # || concat chain) pins PG ARRAY-type semantics for @>/<@ —
         # the same rule runtime.py applies to '{...}' literals
@@ -1911,6 +1960,16 @@ class Emitter:
                 self._emit(f'"{table}"')
             return j - idx
         else:
+            if _srf_args_correlated(it.args):
+                # the recursive-CTE derived table this emits cannot be
+                # correlated in SQLite — it would fail at execution with
+                # an opaque "no such column"; reject cleanly instead
+                # (same treatment as WITH ORDINALITY / dynamic step)
+                raise UnsupportedConstruct(
+                    "correlated generate_series (bounds referencing an "
+                    "earlier FROM entry) is not supported; precompute the "
+                    "bound or join against a literal series"
+                )
             arglists = _split_args(it.args)
             if len(arglists) not in (2, 3):
                 raise UnsupportedConstruct(

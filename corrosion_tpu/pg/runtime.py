@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import hashlib
+from contextlib import contextmanager
 import json
 import math
 import re
@@ -134,6 +135,90 @@ def _fmt_ts(d: _dt.datetime) -> str:
 
 def _pg_now() -> str:
     return _fmt_ts(_dt.datetime.now(_dt.timezone.utc).replace(tzinfo=None))
+
+
+# PG's now()/transaction_timestamp() is TRANSACTION-stable: every row of
+# every statement in one transaction sees the same timestamp.  A plain
+# non-deterministic UDF re-evaluates per row (ADVICE r4: a multi-row
+# predicate could compare different timestamps across rows of ONE
+# statement).  Each registered connection gets a freeze cell; the PG
+# front-end freezes it at BEGIN (thawing at COMMIT/ROLLBACK) and per
+# statement outside a block.  Cells are keyed by id(conn) — connections
+# here are the long-lived writer + fixed read pool, so the map stays
+# bounded.
+_now_cells: dict = {}
+
+
+def freeze_now(conn) -> bool:
+    """Freeze now() for the transaction block about to run on ``conn``.
+    Returns True when this caller took the freeze and owns the matching
+    :func:`thaw_now`.  The PG front-end calls this at BEGIN (write_sema
+    serializes blocks, so the cell is always free) and thaws at
+    COMMIT/ROLLBACK *and* on session abort."""
+    cell = _now_cells.get(id(conn))
+    if cell is None or cell[0] is not None:
+        return False
+    cell[0] = _pg_now()
+    return True
+
+
+def thaw_now(conn) -> None:
+    cell = _now_cells.get(id(conn))
+    if cell is not None:
+        cell[0] = None
+
+
+@contextmanager
+def statement_now(conn):
+    """Scope one AUTOCOMMIT statement: now() is pinned to a fresh
+    statement timestamp for its duration, then the cell is restored to
+    whatever it held before.  The restore (rather than clear) matters in
+    the shared-writer-conn fallback, where another session's open
+    transaction block may have the cell frozen: that session's later
+    statements must still see its BEGIN timestamp, while this statement
+    sees its own time (PG: statement_timestamp() per statement,
+    transaction_timestamp() per block)."""
+    cell = _now_cells.get(id(conn))
+    if cell is None:
+        yield
+        return
+    prev = cell[0]
+    cell[0] = _pg_now()
+    try:
+        yield
+    finally:
+        cell[0] = prev
+
+
+def release_now(conn) -> None:
+    """Drop the freeze cell for a connection that is going away — id()
+    values recycle, and a stale (possibly frozen) cell must never be
+    inherited by a future connection."""
+    _now_cells.pop(id(conn), None)
+
+
+def _div_exact(a, b):
+    """PG's div(): exact truncating division for integers of any width
+    (routing through float loses exactness past 2^53 — ADVICE r4:
+    div(9007199254740993, 1) came back one less)."""
+    if a is None or b is None:
+        return None
+
+    def num(v):
+        if isinstance(v, (int, float)):
+            return v
+        try:
+            return int(str(v))
+        except ValueError:
+            return float(str(v))
+
+    a2, b2 = num(a), num(b)
+    if b2 == 0:
+        _div0()
+    if isinstance(a2, int) and isinstance(b2, int):
+        q = abs(a2) // abs(b2)
+        return -q if (a2 < 0) != (b2 < 0) else q
+    return int(a2 / b2)
 
 
 def _add_months(d: _dt.datetime, months: float) -> _dt.datetime:
@@ -920,10 +1005,22 @@ def register(conn: sqlite3.Connection) -> None:
     f = conn.create_function
     det = {"deterministic": True}
 
-    f("pg_now", 0, _pg_now)
+    # now() reads the connection's freeze cell (set per statement /
+    # transaction by the PG front-end) so it is stable across the rows
+    # of one statement the way PG's transaction_timestamp() is.  A
+    # FRESH cell is installed on every register: id() values recycle,
+    # and inheriting a dead connection's (possibly frozen) cell would
+    # pin the new connection's clock forever (same hazard catalog.py
+    # guards against for its defs registry)
+    _now_cells[id(conn)] = cell = [None]
+    f("pg_now", 0, lambda: cell[0] if cell[0] is not None else _pg_now())
     f("pg_ts_offset", 2, _pg_ts_offset, **det)
     f("pg_ts_offset", 3, _pg_ts_offset, **det)
-    f("pg_sleep", 1, lambda s: time.sleep(min(float(s or 0), 30.0)))
+    # capped hard at 2 s: pg_sleep runs on whatever thread executes the
+    # statement — through a write statement that is the single-writer
+    # lane, where a 30 s nap would stall replication apply and every
+    # other client (ADVICE r4); doc/pg.md documents the deviation
+    f("pg_sleep", 1, lambda s: time.sleep(min(max(float(s or 0), 0.0), 2.0)))
     f("timeofday", 0, lambda: _dt.datetime.now(_dt.timezone.utc).strftime(
         "%a %b %d %H:%M:%S.%f %Y UTC"))
 
@@ -987,9 +1084,7 @@ def register(conn: sqlite3.Connection) -> None:
     f("pg_advisory_unlock", 2, lambda _a, _b: 1)
     f("pg_try_advisory_lock", 1, lambda _k: 1)
     f("pg_try_advisory_lock", 2, lambda _a, _b: 1)
-    # int() truncates toward zero like PG's div(); // would floor
-    f("div", 2, lambda a, b: None if a is None or b is None
-      else int(float(a) / float(b)) if float(b) != 0 else _div0(), **det)
+    f("div", 2, _div_exact, **det)
     f("pg_substring_re", 2, _substring_re, **det)
     f("pg_overlay", 4, lambda s, r, p, n: None
       if s is None or r is None or p is None

@@ -18,7 +18,7 @@ import sqlite3
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from . import catalog, protocol as p, sql_state, translate as tr
+from . import catalog, protocol as p, runtime, sql_state, translate as tr
 
 log = logging.getLogger("corrosion_tpu.pg")
 
@@ -236,6 +236,12 @@ class _Session:
         if self.tx is not None:
             self.tx.rollback()
             self.tx = None
+            # the BEGIN freeze must not outlive the session: a client
+            # dropping mid-transaction would otherwise pin now() on the
+            # shared writer conn forever
+            if getattr(self, "_tx_now_frozen", False):
+                runtime.thaw_now(self.agent.store.conn)
+                self._tx_now_frozen = False
             self.agent.write_sema.release()
 
     # -- dispatch --------------------------------------------------------
@@ -725,6 +731,9 @@ class _Session:
                 raise
             self.tx = tx
             self.tx_failed = False
+            # PG: now() is transaction-stable — freeze it for the whole
+            # block (thawed at COMMIT/ROLLBACK below)
+            self._tx_now_frozen = runtime.freeze_now(self.agent.store.conn)
             return tag
         # COMMIT / ROLLBACK
         if self.tx is None:
@@ -739,6 +748,9 @@ class _Session:
                 if tag == "COMMIT":
                     tag = "ROLLBACK"  # PG's tag when committing a failed tx
         finally:
+            if getattr(self, "_tx_now_frozen", False):
+                runtime.thaw_now(self.agent.store.conn)
+                self._tx_now_frozen = False
             self.agent.write_sema.release()
         return tag
 
@@ -747,7 +759,8 @@ class _Session:
     ):
         if self.tx is not None:
             # inside an explicit tx reads MUST see its uncommitted rows, so
-            # they stay on the write conn (held by this session anyway)
+            # they stay on the write conn (held by this session anyway);
+            # now() stays pinned to the BEGIN freeze — no statement scope
             conn = self.agent.store.conn
             if catalog.mentions_catalog(t.sql):
                 catalog.refresh_pg_class(conn)
@@ -761,9 +774,10 @@ class _Session:
             conn = self.agent.store.conn
             if catalog.mentions_catalog(t.sql):
                 catalog.refresh_pg_class(conn)
-            cur = conn.execute(t.sql, tuple(params))
-            desc = cur.description or []
-            rows = cur.fetchall()
+            with runtime.statement_now(conn):
+                cur = conn.execute(t.sql, tuple(params))
+                desc = cur.description or []
+                rows = cur.fetchall()
         else:
             # RO pool + watchdog + worker thread: one slow PG query must not
             # stall gossip/ingest/SWIM on the event loop (mirrors
@@ -779,8 +793,9 @@ class _Session:
                 ) as conn:
                     if catalog.mentions_catalog(t.sql):
                         catalog.refresh_pg_class(conn)
-                    cur = conn.execute(t.sql, tuple(params))
-                    return cur.description or [], cur.fetchall()
+                    with runtime.statement_now(conn):
+                        cur = conn.execute(t.sql, tuple(params))
+                        return cur.description or [], cur.fetchall()
 
             desc, rows = await asyncio.to_thread(blocking_read)
         fmt = result_formats[0] if len(result_formats) == 1 else 0
@@ -862,6 +877,7 @@ class _Session:
         rows = []
         desc = None
         if self.tx is not None:
+            # now() stays frozen at the BEGIN timestamp (transaction-stable)
             cur = self.tx.execute(t.sql, tuple(params))
             if cur is not None and cur.description:
                 desc = cur.description
@@ -870,15 +886,16 @@ class _Session:
             async with self.agent.write_sema:
                 tx = self.agent.interactive_tx()
                 tx.begin()
-                try:
-                    cur = tx.execute(t.sql, tuple(params))
-                    if cur is not None and cur.description:
-                        desc = cur.description
-                        rows = cur.fetchall()
-                    tx.commit()
-                except Exception:
-                    tx.rollback()
-                    raise
+                with runtime.statement_now(self.agent.store.conn):
+                    try:
+                        cur = tx.execute(t.sql, tuple(params))
+                        if cur is not None and cur.description:
+                            desc = cur.description
+                            rows = cur.fetchall()
+                        tx.commit()
+                    except Exception:
+                        tx.rollback()
+                        raise
         # emit the row set before CommandComplete (reference write path)
         if desc is not None:
             fields = [

@@ -1,0 +1,150 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4):
+operator-precedence guards on the lookahead rewrites, correlated
+generate_series rejection, exact div(), statement/transaction-stable
+now(), and the pg_sleep cap."""
+
+import sqlite3
+import time
+
+import pytest
+
+from corrosion_tpu.pg import runtime
+from corrosion_tpu.pg.translate import UnsupportedStatement, translate
+
+
+@pytest.fixture()
+def conn():
+    c = sqlite3.connect(":memory:")
+    runtime.register(c)
+    c.execute("CREATE TABLE t (a INTEGER, j TEXT)")
+    c.executemany("INSERT INTO t VALUES (?,?)", [(1, '{"k":1}'), (2, '{"k":2}')])
+    yield c
+    runtime.thaw_now(c)
+    c.close()
+
+
+def q(conn, sql, params=()):
+    return conn.execute(translate(sql).sql, params).fetchall()
+
+
+# -- parser precedence guards (ADVICE: parser.py:1642) -----------------------
+
+def test_arith_glued_to_containment_is_rejected():
+    # PG parses `x + a @> b` as `(x + a) @> b` (+ binds tighter); the
+    # single-operand lookahead would regroup it — must refuse, not emit
+    with pytest.raises(UnsupportedStatement, match="parenthesize"):
+        translate("SELECT x + a @> b FROM t")
+    with pytest.raises(UnsupportedStatement, match="parenthesize"):
+        translate("SELECT a @> b + x FROM t")
+
+
+def test_parenthesized_containment_still_translates(conn):
+    assert q(conn, "SELECT j @> '{\"k\":1}' FROM t ORDER BY a") == [(1,), (0,)]
+    # the guard only fires on glued arithmetic; parens disambiguate
+    t = translate("SELECT (a + a) @> b FROM t")
+    assert "pg_jsonb_contains" in t.sql
+
+
+def test_arith_glued_to_interval_chain_is_rejected():
+    with pytest.raises(UnsupportedStatement, match="parenthesize"):
+        translate("SELECT a - b - interval '1 hour' FROM t")
+    # trailing * binds the interval first in PG → regroup → refuse
+    with pytest.raises(UnsupportedStatement, match="parenthesize"):
+        translate("SELECT ts + interval '1 hour' * 2 FROM t")
+
+
+def test_interval_chain_plain_still_works(conn):
+    assert q(conn, "SELECT '2026-07-15 12:00:00' - interval '1 hour'") == [
+        ("2026-07-15 11:00:00",)
+    ]
+    # trailing +/- of a non-interval is left-assoc: grouping unchanged
+    t = translate("SELECT interval '1 hour' + 5")
+    assert t.sql
+
+
+# -- correlated generate_series (ADVICE: parser.py:1811) ---------------------
+
+def test_correlated_generate_series_rejected_cleanly():
+    with pytest.raises(UnsupportedStatement, match="correlated generate_series"):
+        translate("SELECT * FROM t, generate_series(1, t.a) AS g")
+
+
+def test_literal_generate_series_still_works(conn):
+    assert q(conn, "SELECT g FROM generate_series(1, 3) AS g") == [
+        (1,), (2,), (3,)
+    ]
+
+
+# -- div() exactness (ADVICE: runtime.py:991) --------------------------------
+
+def test_div_exact_beyond_double_precision(conn):
+    big = 9007199254740993  # 2^53 + 1: float division loses the low bit
+    assert q(conn, f"SELECT div({big}, 1)") == [(big,)]
+    assert q(conn, f"SELECT div({big * 3 + 2}, 3)") == [(big * 3 // 3,)]
+
+
+def test_div_truncates_toward_zero(conn):
+    assert q(conn, "SELECT div(7, 2), div(-7, 2), div(7, -2), div(-7, -2)") == [
+        (3, -3, -3, 3)
+    ]
+    # non-integer inputs fall back to float truncation (PG numeric trunc)
+    assert q(conn, "SELECT div(7.5, 2)") == [(3,)]
+
+
+def test_div_by_zero_raises(conn):
+    with pytest.raises(sqlite3.OperationalError):
+        q(conn, "SELECT div(1, 0)")
+
+
+# -- now() stability (ADVICE: runtime.py:923) --------------------------------
+
+def test_now_frozen_is_stable_across_rows_and_statements(conn):
+    assert runtime.freeze_now(conn) is True
+    # nested freeze does NOT re-freeze (transaction beats statement)
+    assert runtime.freeze_now(conn) is False
+    rows = q(conn, "SELECT now() FROM t")
+    assert rows[0] == rows[1]
+    time.sleep(0.002)
+    assert q(conn, "SELECT now()")[0] == rows[0]
+    frozen_val = rows[0][0]
+    runtime.thaw_now(conn)
+    time.sleep(0.002)
+    (live,) = q(conn, "SELECT now()")[0]
+    assert live != frozen_val  # thawed clock moves again
+
+
+# -- pg_sleep cap (ADVICE: runtime.py:926) -----------------------------------
+
+def test_pg_sleep_capped(conn):
+    t0 = time.monotonic()
+    q(conn, "SELECT pg_sleep(30)")
+    assert time.monotonic() - t0 < 3.0
+
+
+def test_statement_scope_overrides_foreign_freeze(conn):
+    """Shared-writer-conn fallback: a statement from session B must see
+    its OWN statement time while session A's transaction freeze stays
+    intact underneath (code-review r5 finding)."""
+    assert runtime.freeze_now(conn) is True
+    (frozen,) = q(conn, "SELECT now()")[0]
+    time.sleep(0.002)
+    with runtime.statement_now(conn):
+        (stmt,) = q(conn, "SELECT now()")[0]
+        assert stmt != frozen
+    # the foreign transaction's freeze is restored, not cleared
+    assert q(conn, "SELECT now()")[0] == (frozen,)
+
+
+def test_register_installs_fresh_cell(conn):
+    """id(conn) values recycle: re-registering must never inherit a
+    stale (possibly frozen) cell (code-review r5 finding)."""
+    assert runtime.freeze_now(conn) is True
+    runtime.register(conn)
+    assert runtime.freeze_now(conn) is True  # fresh cell, not frozen
+
+
+def test_release_now_prunes_cell(conn):
+    runtime.freeze_now(conn)
+    runtime.release_now(conn)
+    # no cell → freeze is a no-op and now() is live again
+    assert runtime.freeze_now(conn) is False

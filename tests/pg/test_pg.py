@@ -310,3 +310,47 @@ def test_extended_error_rfq_only_on_sync():
         assert res[0].rows == [("1",)]
 
     asyncio.run(_with_pg(1, body))
+
+
+def test_now_transaction_stable_over_wire():
+    """PG's now() is transaction-stable (ADVICE r4): every statement in
+    a BEGIN..COMMIT block sees the BEGIN timestamp; after COMMIT the
+    clock moves again."""
+
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query("BEGIN")
+        (first,) = (await c.query("SELECT now()"))[0].rows[0]
+        await asyncio.sleep(0.005)
+        (second,) = (await c.query("SELECT now()"))[0].rows[0]
+        assert first == second
+        await c.query("COMMIT")
+        await asyncio.sleep(0.005)
+        (after,) = (await c.query("SELECT now()"))[0].rows[0]
+        assert after != first
+
+    asyncio.run(_with_pg(1, body))
+
+
+def test_now_thawed_after_client_drops_mid_tx():
+    """A client dropping mid-BEGIN must not leave now() frozen on the
+    shared writer connection (code-review r5: _abort_open_tx leak)."""
+
+    async def body(cluster, clients):
+        c = clients[0]
+        await c.query("BEGIN")
+        (frozen,) = (await c.query("SELECT now()"))[0].rows[0]
+        await c.close()  # abrupt end: session abort path
+        c2 = PgClient(c.host, c.port)
+        await c2.connect()
+        try:
+            await asyncio.sleep(0.01)
+            (after,) = (await c2.query("SELECT now()"))[0].rows[0]
+            assert after != frozen
+            await asyncio.sleep(0.005)
+            (after2,) = (await c2.query("SELECT now()"))[0].rows[0]
+            assert after2 != after  # clock is genuinely live again
+        finally:
+            await c2.close()
+
+    asyncio.run(_with_pg(1, body))
