@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, TYPE_CHECKING
 
+from ..core import swim_tuning
 from ..core.types import Actor, ActorId
 from ..utils.backoff import Backoff
 
@@ -245,7 +246,7 @@ class SwimRuntime:
             0,
             _Update(
                 info=info,
-                sends_left=self.agent.config.perf.swim_max_transmissions,
+                sends_left=self.effective_max_transmissions(),
             ),
         )
 
@@ -383,7 +384,8 @@ class SwimRuntime:
     async def _probe_loop(self):
         perf = self.agent.config.perf
         while not self._stopped:
-            await asyncio.sleep(perf.swim_probe_interval_s)
+            # cadence re-derived each tick from live membership
+            await asyncio.sleep(self.effective_probe_interval_s())
             self.probe_tick += 1
             self._expire_suspects()
             candidates = [
@@ -425,29 +427,54 @@ class SwimRuntime:
                 target.suspect_tick = self.probe_tick
                 self._disseminate(target)
 
-    def _suspect_timeout_s(self) -> float:
-        """Cluster-size-adaptive suspicion window: the reference re-tunes
-        foca's WAN config as its cluster-size estimate moves
-        (broadcast/mod.rs:236-256, 951-960) — suspicion must outlast the
-        longer gossip paths of a bigger cluster, scaling ~log₂(N)."""
-        import math
+    # -- cluster-size feedback (broadcast/mod.rs:236-256, 951-960) --------
+    #
+    # Every read of these effective_* values re-derives the parameter
+    # from the LIVE membership count, which is the same feedback loop the
+    # reference runs through FocaInput::ClusterSize → make_foca_config →
+    # foca.set_config on every membership change — just without the
+    # config-object churn (the formulas live in core/swim_tuning.py,
+    # shared with the simulator's SimConfig.wan_tuned).
 
+    def live_count(self) -> int:
+        """LIVE cluster size (self + non-DOWN members): DOWN members
+        linger until their GC window and would otherwise inflate the
+        timing with all-time churn."""
+        return 1 + sum(1 for m in self.members.values() if m.status != DOWN)
+
+    def effective_probe_interval_s(self) -> float:
+        perf = self.agent.config.perf
+        if not perf.swim_adaptive_timing:
+            return perf.swim_probe_interval_s
+        return perf.swim_probe_interval_s * swim_tuning.probe_interval_factor(
+            self.live_count()
+        )
+
+    def effective_max_transmissions(self) -> int:
+        perf = self.agent.config.perf
+        if not perf.swim_adaptive_timing:
+            return perf.swim_max_transmissions
+        return swim_tuning.max_transmissions_for(
+            self.live_count(), perf.swim_max_transmissions
+        )
+
+    def _suspect_timeout_s(self) -> float:
+        """Cluster-size-adaptive suspicion window: suspicion must outlast
+        the longer gossip paths of a bigger cluster, scaling ~log₂(N)."""
         base = self.agent.config.perf.swim_suspect_timeout_s
         if not self.agent.config.perf.swim_adaptive_timing:
             return base
-        # LIVE cluster size: DOWN members linger until their GC window
-        # and would otherwise inflate the window with all-time churn
-        live = sum(1 for m in self.members.values() if m.status != DOWN)
-        n = max(2, live + 1)
         # normalized so a small test cluster keeps the configured window
-        return base * max(1.0, math.log2(n) / 3.0)
+        return base * swim_tuning.suspicion_factor(self.live_count() - 1)
 
     def _expired(self, m: MemberInfo, timeout_s: float, now: float) -> bool:
         """Suspicion expiry in probe PERIODS when the tick is known (the
         load-invariant clock); wall-clock fallback for entries whose
         suspicion predates this runtime (persisted/legacy)."""
         if m.suspect_tick >= 0:
-            interval = max(self.agent.config.perf.swim_probe_interval_s, 1e-6)
+            # ticks and timeout must use the SAME (effective) cadence or
+            # the window would shrink as the probe interval stretches
+            interval = max(self.effective_probe_interval_s(), 1e-6)
             return self.probe_tick - m.suspect_tick > timeout_s / interval
         return now - m.suspect_since > timeout_s
 

@@ -110,6 +110,58 @@ class LinkModel:
         return self.loss > 0 and self._rng.random() < self.loss
 
 
+@dataclass
+class FaultInjector(LinkModel):
+    """Injectable network faults for the REAL-socket transport — the
+    in-process analog of the network faults the reference's Antithesis
+    rig throws at real nodes (.antithesis/config/docker-compose.yaml:
+    1-45: partitions, crashes, degraded links).  Extends the in-memory
+    tier's :class:`LinkModel` (same seeded loss semantics — the two
+    tiers must not drift) with partitions, added delay, and a drop
+    counter.  Installed via ``UdpTcpTransport.install_faults``; applied
+    at the send boundary of every verb, so a partition behaves like an
+    egress firewall on this node (install on both sides for a symmetric
+    split, as the rig's network does).
+
+    - ``partition(addr...)``: block sends to those peers ("*" = all) —
+      ALSO severs this transport's established connections (a real
+      partition cuts in-flight TCP, not just new dials)
+    - ``loss``: drop probability for datagram/uni payloads (bi streams
+      stay reliable once open, like TCP under real packet loss)
+    - ``latency_s``: added delay before every send
+    """
+
+    blocked_peers: set = field(default_factory=set)
+    dropped: int = 0  # counter for test assertions
+    # wired by install_faults: severs the transport's established conns
+    # whenever the partition set grows
+    _sever_cb: Optional[Callable[[], None]] = None
+
+    def partition(self, *addrs: str) -> None:
+        self.blocked_peers.update(addrs or ("*",))
+        if self._sever_cb is not None:
+            self._sever_cb()
+
+    def heal(self) -> None:
+        self.blocked_peers.clear()
+
+    def blocks(self, addr: str) -> bool:
+        if "*" in self.blocked_peers or addr in self.blocked_peers:
+            self.dropped += 1
+            return True
+        return False
+
+    def drops(self) -> bool:
+        if self.drop():  # LinkModel's seeded loss
+            self.dropped += 1
+            return True
+        return False
+
+    async def apply_delay(self) -> None:
+        if self.latency_s > 0:
+            await asyncio.sleep(self.latency_s)
+
+
 class Transport:
     """Abstract transport verbs (reference transport.rs:79-162)."""
 
@@ -374,6 +426,14 @@ class UdpTcpTransport(Transport):
         # per-peer path statistics (bounded: one entry per peer addr,
         # evicted with the member; cap guards a churn pathology)
         self.path_stats: Dict[str, PathStats] = {}
+        # injectable network faults (None = zero overhead); the fault
+        # campaign installs a FaultInjector to partition/degrade REAL
+        # sockets the way the Antithesis rig does to the reference
+        self.faults: Optional[FaultInjector] = None
+        # client-opened bi writers, tracked so install_faults can sever
+        # in-flight sync sessions the way a real network partition cuts
+        # established TCP conns (not just new dials)
+        self._client_streams: set = set()
 
     _PATH_STATS_CAP = 4096
 
@@ -566,6 +626,11 @@ class UdpTcpTransport(Transport):
                     raise
 
     async def send_datagram(self, addr: str, data: bytes) -> None:
+        if self.faults is not None:
+            # UDP semantics: partitioned/lost datagrams vanish silently
+            if self.faults.blocks(addr) or self.faults.drops():
+                return
+            await self.faults.apply_delay()
         if self.tls:
             # SWIM rides the encrypted stream: plaintext UDP would leak
             # membership traffic QUIC encrypts in the reference.  The
@@ -605,14 +670,53 @@ class UdpTcpTransport(Transport):
         task.add_done_callback(self._tasks.discard)
 
     async def send_uni(self, addr: str, data: bytes) -> None:
+        if self.faults is not None:
+            if self.faults.blocks(addr):
+                raise ConnectionError(f"fault injection: {addr} partitioned")
+            if self.faults.drops():
+                return  # modeled payload loss: frame never delivered
+            await self.faults.apply_delay()
         await self._send_frame(addr, self.KIND_UNI, data)
 
     async def open_bi(self, addr: str) -> BiStream:
+        if self.faults is not None:
+            if self.faults.blocks(addr):
+                raise ConnectionError(f"fault injection: {addr} partitioned")
+            await self.faults.apply_delay()
         reader, writer = await self._connect(addr)
         writer.write(self.TAG_BI)
         await writer.drain()
         self._pstats(addr).bi_opened += 1
+        self._client_streams = {
+            w for w in self._client_streams if not w.is_closing()
+        }
+        self._client_streams.add(writer)
         return _TcpBiStream(reader, writer)
+
+    def install_faults(self, faults: Optional[FaultInjector]) -> None:
+        """Install (or clear, with None) a FaultInjector AND sever every
+        established connection — cached uni conns, server-accepted conns,
+        and in-flight client bi streams.  A real partition (the rig's
+        iptables-style fault) cuts established TCP flows, not just new
+        dials; without severing, a sync session opened pre-partition
+        would keep replicating straight across the 'partition'.  Later
+        ``partition()`` calls on the installed injector sever again via
+        the wired callback, so extending a split mid-test is also safe."""
+        self.faults = faults
+        if faults is None:
+            return
+        faults._sever_cb = self._sever_connections
+        self._sever_connections()
+
+    def _sever_connections(self) -> None:
+        for addr in list(self._conns):
+            self._evict(addr)
+        for writer in list(self._server_writers) + list(self._client_streams):
+            try:
+                writer.close()
+            except Exception:
+                pass
+        self._client_streams.clear()
 
     def path_samples(self) -> str:
         """Prometheus text families for the per-path stats (the

@@ -1,0 +1,45 @@
+"""Cluster-size → SWIM parameter formulas, shared by the host runtime
+(`agent/swim.py`) and the simulator (`sim/state.py` ``wan_tuned``).
+
+Rebuild of the reference's cluster-size feedback loop: every membership
+change re-derives the SWIM config from the live cluster-size estimate
+(`corro-agent/src/broadcast/mod.rs:236-256` FocaInput::ClusterSize →
+set_config) and the config constructor scales its timing with that size
+(`make_foca_config`, `broadcast/mod.rs:951-960`, built on foca's
+WAN-tuned constructor).  We keep the *feedback-loop shape* — live size
+in, timing out, re-evaluated on every membership change — with explicit,
+documented formulas instead of a third-party constructor:
+
+- **suspicion window** must outlast the longer gossip paths of a bigger
+  cluster: classic SWIM scales it with log(N) of the cluster size.
+- **probe cadence** stays at the configured base for small clusters and
+  stretches gently at storm sizes, bounding per-node probe/ack traffic.
+- **per-update transmission budget** (gossip retransmissions AND the
+  broadcast relay budget — the reference uses one knob for both) grows
+  log2 with size so updates still reach everyone as paths lengthen; the
+  configured base is treated as the right budget for a ~32-node cluster
+  and is never shrunk (small clusters keep their configured floor).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def suspicion_factor(n_live: int) -> float:
+    """Multiplier on the configured suspicion window: 1.0 for tiny
+    clusters, log2(N)/3 beyond ~8 live members."""
+    return max(1.0, math.log2(max(2, n_live + 1)) / 3.0)
+
+
+def probe_interval_factor(n_live: int) -> float:
+    """Multiplier on the configured probe period: 1.0 below ~64 live
+    members, log2(N)/6 beyond (2x at ~4k, 2.8x at ~100k)."""
+    return max(1.0, math.log2(max(2, n_live + 2)) / 6.0)
+
+
+def max_transmissions_for(n_live: int, base: int) -> int:
+    """Per-update transmission budget for a cluster with ``n_live``
+    members, where ``base`` is the configured budget (calibrated for
+    ~32 nodes).  Grows ~log2, never below ``base``."""
+    return max(base, round(base * math.log2(max(2, n_live + 2)) / 5.0))

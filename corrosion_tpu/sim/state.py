@@ -154,12 +154,23 @@ class SimConfig:
         """Cluster-size-adaptive SWIM timing — the analog of the reference
         re-tuning foca's WAN config as the cluster-size estimate moves
         (broadcast/mod.rs:236-256, 951-960): suspicion windows grow with
-        log₂(N) so detection stays accurate as gossip paths lengthen."""
+        log₂(N) so detection stays accurate as gossip paths lengthen, and
+        the per-payload transmission budget follows the SAME formula the
+        host runtime derives from live membership (core/swim_tuning.py),
+        capped at 15 — the packed path's 4-bit relay planes
+        (packed.py packed_supported).  A/B at 16k nodes: the derived
+        budget leaves storm convergence identical (26 rounds, same p99)."""
+        from ..core.swim_tuning import max_transmissions_for
+
         log = max(3, math.ceil(math.log2(n_nodes + 1)))
         kw.setdefault("probe_period_rounds", 2)
         kw.setdefault("suspect_timeout_rounds", log)
         kw.setdefault("indirect_probes", 3)
         kw.setdefault("announce_interval_rounds", max(4, log // 2))
+        base = cls.__dataclass_fields__["max_transmissions"].default
+        kw.setdefault(
+            "max_transmissions", min(15, max_transmissions_for(n_nodes, base))
+        )
         return cls(n_nodes=n_nodes, **kw)
 
     @property

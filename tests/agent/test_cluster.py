@@ -214,3 +214,37 @@ def test_large_tx_sync_cold_node_reference_envelope():
               f"cold catch-up {catchup_s:.1f}s")
 
     asyncio.run(_with_cluster(2, body, use_swim=False))
+
+
+def test_interactive_tx_requires_write_sema():
+    """VERDICT r4 weak #6: interactive_tx() must refuse callers that do
+    not hold the writer lane instead of trusting them."""
+
+    async def body(cluster: Cluster):
+        a = cluster.agents[0]
+        with pytest.raises(RuntimeError, match="write_sema"):
+            a.interactive_tx()
+        # ownership, not mere lockedness: ANOTHER task holding the lane
+        # (the ingest lane mid-apply) must not let this task through
+        entered = asyncio.Event()
+        release = asyncio.Event()
+
+        async def holder():
+            async with a.write_sema:
+                entered.set()
+                await release.wait()
+
+        task = asyncio.ensure_future(holder())
+        await entered.wait()
+        with pytest.raises(RuntimeError, match="write_sema"):
+            a.interactive_tx()
+        release.set()
+        await task
+        async with a.write_sema:
+            tx = a.interactive_tx()
+            tx.begin()
+            tx.execute("INSERT INTO tests (id, text) VALUES (1, 'guarded')")
+            tx.commit()
+        assert cluster.rows(0, "SELECT id, text FROM tests") == [(1, "guarded")]
+
+    asyncio.run(_with_cluster(1, body))

@@ -57,6 +57,27 @@ async def _stress(num_nodes: int, connectivity: int, input_count: int,
             for agent in cluster.agents
         ]
         assert len(set(heads)) == 1
+        # cluster-size feedback (broadcast/mod.rs:236-256): at reference
+        # scale the SWIM config must have TRANSITIONED off its
+        # single-node base — suspicion window stretched, and the
+        # transmission budget tracking the shared formula exactly
+        first = cluster.agents[0]
+        if first.swim is not None and num_nodes >= 30:
+            from corrosion_tpu.core.swim_tuning import max_transmissions_for
+
+            perf = first.config.perf
+            assert first.swim.live_count() >= num_nodes - 2
+            assert (
+                first.swim._suspect_timeout_s()
+                > perf.swim_suspect_timeout_s
+            )
+            eff = first.swim.effective_max_transmissions()
+            assert eff == max_transmissions_for(
+                first.swim.live_count(), perf.swim_max_transmissions
+            )
+            if num_nodes >= 45:
+                # 45 live members crosses the budget's first growth step
+                assert eff > perf.swim_max_transmissions
     finally:
         await cluster.stop()
 

@@ -182,3 +182,87 @@ def test_down_member_gc():
             cluster.tmp.cleanup()
 
     asyncio.run(body())
+
+
+def test_cluster_size_feedback_retunes_config():
+    """The reference re-derives the SWIM config from the live cluster
+    size on every membership change (broadcast/mod.rs:236-256,
+    make_foca_config :951-960).  Growing the membership must stretch
+    the suspicion window and the transmission budget; members going
+    DOWN must shrink them back (live size, not all-time size)."""
+    from corrosion_tpu.core import swim_tuning
+
+    async def body(cluster: Cluster):
+        agent = cluster.agents[0]
+        rt = agent.swim
+        perf = agent.config.perf
+
+        small_suspect = rt._suspect_timeout_s()
+        small_mt = rt.effective_max_transmissions()
+        small_probe = rt.effective_probe_interval_s()
+        assert small_probe == perf.swim_probe_interval_s  # tiny cluster: base
+
+        # synthesize a 100-member roster (feedback input is membership,
+        # not the wire) — the same merge path real gossip drives
+        from corrosion_tpu.agent.swim import MemberInfo
+        from corrosion_tpu.core.types import ActorId
+
+        fake = []
+        for i in range(100):
+            info = MemberInfo(
+                actor_id=ActorId(bytes([9] * 14) + bytes(divmod(i, 256))),
+                addr=f"fake{i}", incarnation=0, status=ALIVE, ts=0,
+            )
+            fake.append(info)
+            rt._merge(info)
+        assert rt.live_count() >= 100
+        assert rt._suspect_timeout_s() > small_suspect
+        assert rt.effective_max_transmissions() > small_mt
+        assert rt.effective_max_transmissions() == (
+            swim_tuning.max_transmissions_for(
+                rt.live_count(), perf.swim_max_transmissions
+            )
+        )
+        # the agent's broadcast lane consults the same live value
+        assert agent.effective_max_transmissions() == (
+            rt.effective_max_transmissions()
+        )
+
+        # members dying shrinks the LIVE size → config transitions back
+        for info in fake:
+            info.status = DOWN
+            info.incarnation += 1
+            rt._merge(MemberInfo(**{**info.__dict__}))
+        assert rt._suspect_timeout_s() == small_suspect
+        assert rt.effective_max_transmissions() == small_mt
+
+    async def run():
+        cluster = Cluster(2)
+        await cluster.start()
+        try:
+            await body(cluster)
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_swim_tuning_formulas_monotone():
+    """Shared-formula sanity: all three outputs are monotone in N and
+    floor at the configured base."""
+    from corrosion_tpu.core import swim_tuning as st
+
+    prev_s, prev_p, prev_m = 0.0, 0.0, 0
+    for n in (1, 2, 8, 32, 45, 128, 1024, 100_000):
+        s, p, m = (
+            st.suspicion_factor(n),
+            st.probe_interval_factor(n),
+            st.max_transmissions_for(n, 10),
+        )
+        assert s >= prev_s and p >= prev_p and m >= prev_m
+        prev_s, prev_p, prev_m = s, p, m
+    assert st.suspicion_factor(2) == 1.0
+    assert st.probe_interval_factor(8) == 1.0
+    assert st.max_transmissions_for(4, 10) == 10  # never below base
+    assert st.max_transmissions_for(45, 10) == 11  # first growth step
+    assert st.max_transmissions_for(100_000, 10) > 30

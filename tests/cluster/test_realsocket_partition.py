@@ -1,0 +1,170 @@
+"""Partition + heal on REAL sockets (VERDICT r4 missing #3).
+
+The reference's Antithesis rig partitions real containers and then
+asserts the bookkeeping property after heal
+(.antithesis/config/docker-compose.yaml:1-45,
+.antithesis/client/test-templates/check_bookkeeping.py:6-27: every
+node's generated sync shows need == 0 ∧ partial_need == 0 and all heads
+agree).  The sim tier already has partition-heal distribution checks;
+this is the REAL-socket tier: agents on loopback UDP/TCP with a
+FaultInjector (transport.faults) standing in for the rig's network
+faults — partitions block egress on both sides, loss degrades links,
+and after heal the campaign asserts check_bookkeeping verbatim.
+"""
+
+import asyncio
+import tempfile
+
+import pytest
+
+from corrosion_tpu.agent.agent import Agent
+from corrosion_tpu.agent.config import Config
+from corrosion_tpu.agent.transport import FaultInjector, UdpTcpTransport
+from corrosion_tpu.testing import TEST_SCHEMA, fast_perf
+
+
+async def _boot(n: int, tmp: str):
+    transports = [UdpTcpTransport() for _ in range(n)]
+    addrs = [await t.start() for t in transports]
+    agents = []
+    for i, t in enumerate(transports):
+        cfg = Config(
+            db_path=f"{tmp}/n{i}.db",
+            gossip_addr=addrs[i],
+            bootstrap=[a for a in addrs if a != addrs[i]],
+            perf=fast_perf(),
+        )
+        agent = Agent(cfg, t)
+        agent.store.execute_schema(TEST_SCHEMA)
+        agents.append(agent)
+    for a in agents:
+        await a.start()
+    return agents, addrs
+
+
+def _check_bookkeeping(agents) -> bool:
+    """check_bookkeeping.py:6-27 verbatim: all needs empty, no partials,
+    all heads equal, every node knows every writer's head."""
+    heads = {}
+    for agent in agents:
+        s = agent.sync_state()
+        if s.need or s.partial_need:
+            return False
+        for booked in agent.bookie.by_actor.values():
+            if booked.partials:
+                return False
+        for actor, head in s.heads.items():
+            if heads.setdefault(actor, head) != head:
+                return False
+    for agent in agents:
+        s = agent.sync_state()
+        for w, h in heads.items():
+            if w != agent.actor_id and s.heads.get(w) != h:
+                return False
+    return True
+
+
+async def _wait_bookkeeping(agents, timeout: float) -> bool:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if _check_bookkeeping(agents):
+            return True
+        await asyncio.sleep(0.1)
+    return _check_bookkeeping(agents)
+
+
+def test_partition_heal_on_real_sockets():
+    """Split 4 real-socket agents 2|2, write on BOTH sides of the split,
+    heal, and assert the check_bookkeeping property plus row equality."""
+
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            agents, addrs = await _boot(4, tmp)
+            try:
+                # pre-partition warmup write so the full mesh is live
+                agents[0].exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (0, 'warm')", ())]
+                )
+                assert await _wait_bookkeeping(agents, 15)
+
+                # partition {0,1} | {2,3}: egress blocked on BOTH sides,
+                # the way the rig firewalls each container
+                side_a, side_b = (0, 1), (2, 3)
+                for side, other in ((side_a, side_b), (side_b, side_a)):
+                    for i in side:
+                        fi = FaultInjector()
+                        fi.partition(*(addrs[j] for j in other))
+                        # install_faults also severs established conns —
+                        # a real partition cuts in-flight TCP, and a sync
+                        # session opened pre-partition would otherwise
+                        # keep replicating across the split
+                        agents[i].transport.install_faults(fi)
+
+                # writes land on BOTH sides during the split
+                for k in range(1, 11):
+                    agents[0].exec_transaction(
+                        [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                          (k, f"side-a-{k}"))]
+                    )
+                    agents[2].exec_transaction(
+                        [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                          (100 + k, f"side-b-{k}"))]
+                    )
+                await asyncio.sleep(1.0)
+                # the split is real: side B must not have seen side A's
+                # writes (and the injector actually dropped traffic)
+                b_rows = agents[2].store.query(
+                    "SELECT count(*) FROM tests WHERE id BETWEEN 1 AND 10"
+                )
+                assert b_rows[0][0] == 0
+                assert any(
+                    agents[i].transport.faults.dropped > 0 for i in range(4)
+                )
+                assert not _check_bookkeeping(agents)
+
+                # heal: drop the injectors entirely (rig removes the fault)
+                for a in agents:
+                    a.transport.install_faults(None)
+                assert await _wait_bookkeeping(agents, 30), (
+                    "bookkeeping did not re-converge after heal"
+                )
+                counts = {
+                    tuple(a.store.query("SELECT count(*) FROM tests")[0])
+                    for a in agents
+                }
+                assert counts == {(21,)}
+            finally:
+                for a in agents:
+                    await a.stop()
+
+    asyncio.run(body())
+
+
+def test_degraded_link_loss_converges_on_real_sockets():
+    """30% payload loss + 5ms delay on every node: broadcast alone can't
+    deliver everything, anti-entropy sync must fill the gaps — and the
+    campaign still ends with the bookkeeping property."""
+
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            agents, _addrs = await _boot(3, tmp)
+            try:
+                for i, a in enumerate(agents):
+                    a.transport.install_faults(
+                        FaultInjector(loss=0.3, latency_s=0.005, seed=i)
+                    )
+                for k in range(20):
+                    agents[k % 3].exec_transaction(
+                        [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                          (k, f"lossy-{k}"))]
+                    )
+                assert await _wait_bookkeeping(agents, 45)
+                assert any(a.transport.faults.dropped > 0 for a in agents)
+                for a in agents:
+                    (n,) = a.store.query("SELECT count(*) FROM tests")[0]
+                    assert n == 20
+            finally:
+                for a in agents:
+                    await a.stop()
+
+    asyncio.run(body())
