@@ -329,11 +329,21 @@ def main() -> int:
     # rung is the same machinery with every limiter engaged, reported as
     # the `secondary` record with its own budget-derived vs_baseline.
     global _secondary
-    # 4096 nodes: 145 s wall / 31 rounds / overflow 0.26 on CPU (r4
-    # calibration) — heavy enough to overflow K and meter mixed sizes,
-    # light enough to fit the bench budget alongside the primary ladder
-    gs_nodes = int(os.environ.get("BENCH_GAPSTRESS_NODES", "4096"))
-    gs_target = float(os.environ.get("BENCH_GAPSTRESS_TARGET_S", "240"))
+    # r5: the limiter class runs PACKED (sim/packed.py budget_prefix_words
+    # + per-edge loss words), so the adversarial rung scales with the
+    # platform — 25.6k nodes on a healthy chip, the r4-calibrated 4096 on
+    # CPU fallback (184.6 s packed vs 227 s dense, r5 measurement).  The
+    # target pro-rates the 4k/240 s budget linearly in nodes.
+    gs_nodes = int(
+        os.environ.get(
+            "BENCH_GAPSTRESS_NODES", "4096" if on_cpu else "25600"
+        )
+    )
+    gs_target = float(
+        os.environ.get(
+            "BENCH_GAPSTRESS_TARGET_S", str(240.0 * (gs_nodes / 4096.0))
+        )
+    )
     if _remaining() > 240:
         res = run_child(
             {

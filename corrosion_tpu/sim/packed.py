@@ -23,10 +23,24 @@ test_packed_equivalence.py compares round-by-round bit-for-bit):
   one word and "all chunks present" is a log2(C)-step bitwise fold.
 
 Supported scenario envelope (validated by ``packed_supported``):
-P % 32 == 0, chunks_per_version ∈ {1, 2, 4, 8, 16, 32}, statically
-unmetered budgets (optimize_budgets), zero payload loss, and
-max_transmissions < 16.  Everything outside stays on the dense path —
-same results, just slower.
+P % 32 == 0, chunks_per_version ∈ {1, 2, 4, 8, 16, 32}, and
+max_transmissions < 16.  Since r5 the LIMITERS run packed too — the
+reference never runs unmetered (its 10 MiB/s governor is always on,
+broadcast/mod.rs:460-463), so the adversarial envelope had to stop
+being a dense-path exile:
+
+- byte budgets (broadcast governor + sync budget) evaluate via
+  ``budget_prefix_words``: per-word masked byte totals, a word-level
+  prefix, and a 32-step in-word scan — bit-identical to the dense
+  ``budget_prefix_mask`` (including its exact two-lane i32 arithmetic
+  past 32767 payloads) at O(N·W) HBM instead of O(N·P);
+- payload loss draws the SAME per-(edge, payload) u8 threshold mask as
+  the dense kernel (same key, same shape → same bits); the [E, P]
+  tensor is dense, but so is the broadcast scatter's delay ring — the
+  packed win stays on have/relay/sync/bookkeeping.
+
+Everything outside the envelope stays on the dense path — same
+results, just slower.
 """
 
 from __future__ import annotations
@@ -38,7 +52,7 @@ import jax.numpy as jnp
 
 from .state import ALIVE, PayloadMeta, SimConfig, SimState
 from .swim import sample_member_targets
-from .topology import Topology, edge_alive, edge_delay
+from .topology import Topology, edge_alive, edge_delay, edge_payload_drop
 
 U32 = jnp.uint32
 ONES = jnp.uint32(0xFFFFFFFF)
@@ -51,11 +65,74 @@ def packed_supported(cfg: SimConfig, topo: Topology) -> bool:
         and cfg.n_nodes * cfg.n_payloads >= cfg.packed_min_cells
         and cfg.n_payloads % 32 == 0
         and c in (1, 2, 4, 8, 16, 32)
-        and cfg.rate_limit_bytes_round is None
-        and cfg.sync_budget_bytes is None
-        and topo.loss == 0.0
         and cfg.max_transmissions < 16
     )
+
+
+def budget_prefix_words(
+    elig_w: jnp.ndarray, budget_bytes, nbytes: jnp.ndarray
+) -> jnp.ndarray:
+    """Packed twin of ``state.budget_prefix_mask``: keep the
+    version-major prefix of set bits whose cumulative byte size fits
+    ``budget_bytes``, entirely in the word domain.  Three stages — (1)
+    per-word masked byte totals (32 fused elementwise steps over
+    [.., W]), (2) an exclusive word-level prefix sum, (3) a 32-step
+    in-word scan emitting the output bits — reproduce the dense mask's
+    inclusive-cumsum-vs-budget comparison EXACTLY, including the
+    two-lane (KiB + sub-KiB) exact i32 arithmetic the dense path uses
+    past 32767 payloads.  HBM cost is O(N·W) i32 instead of the dense
+    cumsum's O(N·P) — the budget was the single hottest dense-sync op
+    at bench shape and the reason limiters used to force the dense
+    path."""
+    if budget_bytes is None:
+        return elig_w
+    w = elig_w.shape[-1]
+    p = w * 32
+    if p >= 1 << 21:
+        # same loud refusal as the dense mask: a wrapped i32 cumsum
+        # would silently un-bound the governor
+        raise ValueError(
+            f"byte budget supports at most 2^21-1 payloads, got {p}"
+        )
+    nb = nbytes.astype(jnp.int32).reshape(w, 32)
+
+    def word_tot(lane_nb):
+        tot = jnp.zeros(elig_w.shape, jnp.int32)
+        for j in range(32):
+            bit = ((elig_w >> j) & U32(1)).astype(jnp.int32)
+            tot = tot + bit * lane_nb[:, j]
+        return tot
+
+    if p <= 32767:
+        tot = word_tot(nb)
+        run = jnp.cumsum(tot, axis=-1) - tot  # exclusive word prefix
+        out = jnp.zeros_like(elig_w)
+        for j in range(32):
+            bit = (elig_w >> j) & U32(1)
+            run = run + bit.astype(jnp.int32) * nb[:, j]
+            ok = (run <= budget_bytes) & (bit != U32(0))
+            out = out | (ok.astype(U32) << j)
+        return out
+
+    # two-lane exact arithmetic (dense budget_prefix_mask's large-P
+    # branch): KiB lane + sub-KiB remainder lane, carry-normalized
+    # lexicographic compare against the budget
+    nb_hi, nb_lo = nb >> 10, nb & 1023
+    tot_hi, tot_lo = word_tot(nb_hi), word_tot(nb_lo)
+    run_hi = jnp.cumsum(tot_hi, axis=-1) - tot_hi
+    run_lo = jnp.cumsum(tot_lo, axis=-1) - tot_lo
+    bhi, blo = budget_bytes >> 10, budget_bytes & 1023
+    out = jnp.zeros_like(elig_w)
+    for j in range(32):
+        bit = (elig_w >> j) & U32(1)
+        bi = bit.astype(jnp.int32)
+        run_hi = run_hi + bi * nb_hi[:, j]
+        run_lo = run_lo + bi * nb_lo[:, j]
+        nh = run_hi + (run_lo >> 10)
+        nl = run_lo & 1023
+        ok = ((nh < bhi) | ((nh == bhi) & (nl <= blo))) & (bit != U32(0))
+        out = out | (ok.astype(U32) << j)
+    return out
 
 
 def pack_bits(x: jnp.ndarray) -> jnp.ndarray:
@@ -264,12 +341,18 @@ def broadcast_packed(
     topo: Topology,
     region: jnp.ndarray,
     key: jax.Array,
+    meta: PayloadMeta,
 ) -> PackedCarry:
     n = cfg.n_nodes
     f = cfg.fanout
-    k_targets, _k_drop, k_ring0 = jax.random.split(key, 3)
+    k_targets, k_drop, k_ring0 = jax.random.split(key, 3)
 
     eligible = carry.have & carry.relay.nonzero & injected_p[None, :]  # [N, W]
+    # rate-limit governor, FIFO oldest-first within the per-round byte
+    # budget — word-domain twin of broadcast_step's budget_prefix_mask
+    sending = budget_prefix_words(
+        eligible, cfg.rate_limit_bytes_round, meta.nbytes
+    )
 
     targets = sample_member_targets(state, cfg, k_targets, f)  # [N, F]
     if cfg.ring0_first and topo.n_regions > 1:
@@ -305,15 +388,18 @@ def broadcast_packed(
     ok &= dst != src
     delay = edge_delay(topo, region, src, dst)
 
-    # the ring is dense u8 (PackedCarry docstring): unpack the eligible
+    # the ring is dense u8 (PackedCarry docstring): unpack the sending
     # words once, then the fan-out scatter is the dense path's plain
     # at[].max — the only correct-and-fast OR scatter XLA offers.
     # `elig8[src]` is a regular f-fold repeat, written as a broadcast so
-    # XLA doesn't emit a 150 MB random gather for it.
+    # XLA doesn't emit a 150 MB random gather for it.  Loss draws the
+    # SAME per-(edge, payload) mask as the dense kernel — same key, same
+    # shape, same bits (trace-time constant when loss == 0).
     p = cfg.n_payloads
-    elig8 = unpack_bits(eligible, p).astype(carry.inflight.dtype)  # [N, P]
+    drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
+    elig8 = unpack_bits(sending, p).astype(carry.inflight.dtype)  # [N, P]
     sent = jnp.where(
-        ok.reshape(n, f)[:, :, None],
+        ok.reshape(n, f, 1) & ~drop.reshape(n, f, p),
         elig8[:, None, :],
         jnp.uint8(0),
     ).reshape(n * f, p)  # [E, P]
@@ -326,10 +412,11 @@ def broadcast_packed(
     inflight = inflight.reshape(d_slots, n, p)
 
     # budget spends on the ATTEMPT (see broadcast.broadcast_step): a
-    # sender can't observe partitions or dead targets
+    # sender can't observe partitions, dead targets, or wire loss —
+    # only what the governor let through this round spends
     attempted = (targets >= 0) & (targets != jnp.arange(n)[:, None])
     any_attempt = attempted.any(axis=1) & (state.alive == ALIVE)  # [N]
-    spent = eligible & jnp.where(any_attempt[:, None], ONES, U32(0))
+    spent = sending & jnp.where(any_attempt[:, None], ONES, U32(0))
     relay = planes_dec(carry.relay, spent)
     return PackedCarry(have=carry.have, inflight=inflight, relay=relay,
                        sync_buf=carry.sync_buf)
@@ -410,11 +497,13 @@ def packed_round_step(
         carry, injected_p, state.t, meta, cfg, state.alive
     )
     carry = broadcast_packed(
-        carry, injected_p, state, cfg, topo, region, k_bcast
+        carry, injected_p, state, cfg, topo, region, k_bcast, meta
     )
     # capture last round's sync grants before sync overwrites the buffer
     pending_sync = carry.sync_buf
-    carry, countdown, backoff = sync_packed(carry, state, cfg, topo, k_sync)
+    carry, countdown, backoff = sync_packed(
+        carry, state, cfg, topo, k_sync, meta
+    )
     state = state._replace(sync_countdown=countdown, sync_backoff=backoff)
     carry = deliver_packed(carry, pending_sync, state.t, cfg)
 
@@ -540,6 +629,7 @@ def sync_packed(
     cfg: SimConfig,
     topo: Topology,
     key: jax.Array,
+    meta: PayloadMeta,
 ) -> Tuple[PackedCarry, jnp.ndarray, jnp.ndarray]:
     """Anti-entropy on packed words: needs computed from the SAME
     advertised gap/head tensors as the dense path (state.heads/gap_lo/
@@ -603,10 +693,14 @@ def sync_packed(
     )
     need = need.reshape(n * s, w)  # [E, W] for the fold below
 
+    # per-sync byte budget, oldest-version-first (sync_step's
+    # budget_prefix_mask) — evaluated per edge row in the word domain
+    granted = budget_prefix_words(need, cfg.sync_budget_bytes, meta.nbytes)
+
     # pulls land at the PULLER (src): exactly S edges per source in a
     # regular layout, so the OR-reduce is a packed fold — no scatter;
     # the dense u8 ring takes the pulls after one unpack
-    pulled = _fold_or_regular(need, n, s)  # [N, W] — stays packed
+    pulled = _fold_or_regular(granted, n, s)  # [N, W] — stays packed
 
     # fruitfulness-adaptive backoff, bit-identical to sync.sync_step
     fruitful = (pulled != U32(0)).any(axis=1)  # [N]

@@ -118,12 +118,12 @@ def main():
     )
     q["broadcast"] = timeit(
         "broadcast",
-        jax.jit(lambda c, i, s, k: pk.broadcast_packed(c, i, s, cfg, topo, region, k)),
+        jax.jit(lambda c, i, s, k: pk.broadcast_packed(c, i, s, cfg, topo, region, k, meta)),
         carry, injected_p, slim, key,
     )
     q["sync"] = timeit(
         "sync",
-        jax.jit(lambda c, s, k: pk.sync_packed(c, s, cfg, topo, k)),
+        jax.jit(lambda c, s, k: pk.sync_packed(c, s, cfg, topo, k, meta)),
         carry, slim, key,
     )
     q["deliver"] = timeit(

@@ -284,7 +284,9 @@ def test_run_to_convergence_dispatches_packed():
 
 
 def test_envelope_gate():
-    """packed_supported must reject every envelope violation."""
+    """packed_supported must reject every envelope violation — and,
+    since r5, ACCEPT the limiter class (loss + budgets run packed: the
+    reference's governor is always on, broadcast/mod.rs:460-463)."""
     base = dict(
         n_payloads=64, n_writers=2, chunks_per_version=2,
         rate_limit_bytes_round=None, sync_budget_bytes=None,
@@ -292,11 +294,11 @@ def test_envelope_gate():
     )
     ok = SimConfig(n_nodes=8, **base)
     assert packed_supported(ok, Topology())
-    assert not packed_supported(ok, Topology(loss=0.1))
-    assert not packed_supported(
+    assert packed_supported(ok, Topology(loss=0.1))
+    assert packed_supported(
         dataclasses.replace(ok, rate_limit_bytes_round=1024), Topology()
     )
-    assert not packed_supported(
+    assert packed_supported(
         dataclasses.replace(ok, sync_budget_bytes=1024), Topology()
     )
     assert not packed_supported(
@@ -327,3 +329,64 @@ def test_headline_storm_dispatches_packed():
     assert packed_supported(cfg25k, Topology())
     cfg4k, _ = _write_storm(4_000, 512)
     assert not packed_supported(cfg4k, Topology())
+
+
+def test_metered_lossy_gapstress_class():
+    """The r5 envelope extension: ALL limiters engaged at once — 30%
+    payload loss, a binding broadcast governor, a binding sync byte
+    budget, mixed 1 B-8 KiB payload sizes, burst injection over K=4 gap
+    slots — must stay bit-for-bit equal to the dense round.  This is
+    the gapstress scenario class (runner.config_write_storm_gapstress)
+    at lockstep-testable scale."""
+    from corrosion_tpu.sim.runner import gapstress_payload_sizes
+
+    cfg = SimConfig.wan_tuned(
+        24,
+        n_payloads=256,  # 16 versions x 4 writers x 4 chunks
+        n_writers=4,
+        chunks_per_version=4,
+        gap_slots=4,
+        fanout=2,
+        sync_interval_rounds=3,
+        swim_partial_view=True,
+        member_slots=8,
+        # binding budgets: 256 mixed payloads sum to ~590 KiB, so a
+        # 32 KiB broadcast tick and a 24 KiB sync grant both clamp
+        rate_limit_bytes_round=32 * 1024,
+        sync_budget_bytes=24 * 1024,
+        packed_min_cells=0,
+        n_delay_slots=2,
+    )
+    meta = uniform_payloads(
+        cfg, inject_every=0,
+        payload_bytes=gapstress_payload_sizes(cfg.n_payloads),
+    )
+    topo = Topology(loss=0.3)
+    assert packed_supported(cfg, topo)
+    _run_lockstep(cfg, topo, meta, rounds=40, seed=29)
+
+
+def test_budget_prefix_words_matches_dense_mask():
+    """Property check of the word-domain budget kernel against the dense
+    budget_prefix_mask over random masks, mixed sizes, and budgets —
+    including the two-lane large-P arithmetic."""
+    from corrosion_tpu.sim.packed import budget_prefix_words
+    from corrosion_tpu.sim.state import budget_prefix_mask
+
+    rng = np.random.default_rng(7)
+    for p, budget in ((256, 17_000), (256, 1), (256, None), (1024, 300_000),
+                      (65536, 9_000_000)):  # 65536 > 32767: two-lane path
+        sizes = rng.choice([1, 64, 512, 1024, 4096, 8192], size=p)
+        mask = rng.random((8, p)) < 0.6
+        dense = budget_prefix_mask(
+            jnp.asarray(mask), budget, jnp.asarray(sizes, jnp.int32)
+        )
+        words = budget_prefix_words(
+            pack_bits(jnp.asarray(mask)), budget,
+            jnp.asarray(sizes, jnp.int32),
+        )
+        _assert_equal(
+            f"budget p={p} b={budget}",
+            np.asarray(dense),
+            np.asarray(unpack_bits(words, p)),
+        )
