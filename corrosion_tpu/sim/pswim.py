@@ -81,12 +81,14 @@ def psample_member_targets(
     of swim.sample_member_targets."""
     n, m = state.pid.shape
     over = 4 * count
-    slots = jax.random.randint(key, (n, over), 0, m, jnp.int32)
-    me = jnp.arange(n, dtype=jnp.int32)[:, None]
-    # one packed gather for the (pid, pkey) pair per sampled bucket
-    cand, ckey = _unpack_word(
-        jnp.take_along_axis(_pack_tables(state.pid, state.pkey), slots, axis=1)
-    )  # [N, over]
+    # transposed [over, N] layout (see swim._compact_targets) + one
+    # packed gather for the (pid, pkey) pair per sampled bucket
+    slots = jax.random.randint(key, (over, n), 0, m, jnp.int32)
+    me = jnp.arange(n, dtype=jnp.int32)[None, :]
+    # static trace-time guard: the flat index me*m+slots rides i32
+    assert n * m < 2**31, "flat gather index would overflow int32"
+    flat = _pack_tables(state.pid, state.pkey).reshape(-1)
+    cand, ckey = _unpack_word(flat[me * m + slots])  # [over, N]
     valid = (cand >= 0) & (cand != me) & (ckey % 4 != DOWN) & (ckey >= 0)
     valid &= ~_dup_before(cand, valid)  # distinct targets (choose_multiple)
     return _compact_targets(cand, valid, count)

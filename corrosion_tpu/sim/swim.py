@@ -38,16 +38,21 @@ from .topology import Topology
 def _compact_targets(
     cand: jnp.ndarray, valid: jnp.ndarray, count: int
 ) -> jnp.ndarray:
-    """Prefix-compact the valid candidates of each row into the first
-    ``count`` slots (-1 pads).  Masked reduce over the small oversample
-    axis instead of a scatter: the previous ``out.at[rows, slot].max``
-    cost ~40 ms PER CALL at 100k nodes on TPU (r4 micro-profile), and
-    the sampler runs four times per round."""
-    rank = jnp.cumsum(valid, axis=1)  # [N, over]
-    sel = valid[:, :, None] & (
-        rank[:, :, None] == jnp.arange(1, count + 1, dtype=rank.dtype)
-    )  # [N, over, count] — exactly one True per (row, slot) pair
-    return jnp.max(jnp.where(sel, cand[:, :, None], -1), axis=1)
+    """Prefix-compact the valid candidates of each node into the first
+    ``count`` slots (-1 pads); inputs are TRANSPOSED [over, N] (r5: N in
+    the minor axis keeps the VPU's 128 lanes full — over is 4-12, so
+    the [N, over] layout ran every elementwise sampler op at <10% lane
+    utilization; fused 4-call block at 100k: 163 ms → 105 ms even on
+    CPU).  Masked reduce over the small oversample axis instead of a
+    scatter: the pre-r4 ``out.at[rows, slot].max`` cost ~40 ms PER CALL
+    at 100k nodes on TPU, and the sampler runs four times per round.
+    Returns [N, count]."""
+    rank = jnp.cumsum(valid, axis=0)  # [over, N]
+    sel = valid[:, None, :] & (
+        rank[:, None, :]
+        == jnp.arange(1, count + 1, dtype=rank.dtype)[None, :, None]
+    )  # [over, count, N] — exactly one True per (slot, node) pair
+    return jnp.max(jnp.where(sel, cand[:, None, :], -1), axis=0).T
 
 
 def sample_member_targets(
@@ -77,8 +82,8 @@ def sample_member_targets(
     # runs don't starve fanout beyond what the reference's pick-from-list
     # sampling would (it only falls short when the live list itself is)
     over = 4 * count
-    cand = jax.random.randint(key, (n, over), 0, n, jnp.int32)
-    me = jnp.arange(n, dtype=jnp.int32)[:, None]
+    cand = jax.random.randint(key, (over, n), 0, n, jnp.int32)
+    me = jnp.arange(n, dtype=jnp.int32)[None, :]
     valid = cand != me
     if cfg.swim_full_view and cfg.couple_membership:
         valid &= state.view[me, cand] != DOWN
@@ -87,16 +92,17 @@ def sample_member_targets(
 
 
 def _dup_before(cand: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
-    """bool[N, over]: candidate j repeats an EARLIER valid candidate.
-    The reference samples targets with `choose_multiple` — DISTINCT
-    members — and the host tier uses rng.sample; drawing with
-    replacement made the sim's effective fan-out ~25% smaller in tiny
-    clusters (r4 calibration: 3-node loss-0.7 recovery ran ~1.4× slow).
-    ``over`` is small and static, so the pairwise compare is cheap."""
-    over = cand.shape[1]
-    eq = cand[:, None, :] == cand[:, :, None]  # [N, j, i]
+    """bool[over, N]: candidate j repeats an EARLIER valid candidate
+    (transposed layout — see _compact_targets).  The reference samples
+    targets with `choose_multiple` — DISTINCT members — and the host
+    tier uses rng.sample; drawing with replacement made the sim's
+    effective fan-out ~25% smaller in tiny clusters (r4 calibration:
+    3-node loss-0.7 recovery ran ~1.4× slow).  ``over`` is small and
+    static, so the pairwise compare is cheap."""
+    over = cand.shape[0]
+    eq = cand[None, :, :] == cand[:, None, :]  # [j, i, N]
     earlier = jnp.tril(jnp.ones((over, over), bool), k=-1)  # i < j
-    return (eq & earlier[None, :, :] & valid[:, None, :]).any(axis=2)
+    return (eq & earlier[:, :, None] & valid[None, :, :]).any(axis=1)
 
 
 def _reachable(

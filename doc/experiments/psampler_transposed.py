@@ -1,0 +1,109 @@
+"""Sampler layout A/B: shipped transposed [over, N] vs legacy [N, over].
+
+Round-5 history: the samplers originally kept candidate tensors as
+[N, over] with over ∈ {4..12} — a minor axis far below the TPU VPU's
+128-lane tile, so every elementwise op over the oversample axis ran at
+poor lane utilization.  The fused 4-call block at 100k nodes measured
+162.65 ms ([N, over]) vs 104.60 ms ([over, N]) on CPU, so the
+transposed layout SHIPPED (swim._compact_targets/_dup_before,
+pswim.psample_member_targets).  This script keeps the legacy layout
+alive for the on-chip confirmation run (r4 discipline: fused-block
+timings on a healthy chip are the ground truth; run it when the tunnel
+heals):
+
+    JAX_PLATFORMS=cpu python doc/experiments/psampler_transposed.py 100000
+    PROFILE_PLATFORM=default python ... 100000     # real chip
+
+The two layouts draw randint with transposed shapes, so they produce
+different (equally distributed) samples — the r5 switch re-rolled the
+sim's PRNG streams, which the statistical calibration bands absorb.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+if os.environ.get("PROFILE_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from corrosion_tpu.sim.pswim import (  # noqa: E402
+    _pack_tables,
+    _unpack_word,
+    psample_member_targets,
+)
+from corrosion_tpu.sim.round import new_metrics, new_sim, round_step  # noqa: E402
+from corrosion_tpu.sim.runner import _write_storm  # noqa: E402
+from corrosion_tpu.sim.state import DOWN  # noqa: E402
+from corrosion_tpu.sim.topology import Topology, regions  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+REPS = 10
+
+
+def psample_legacy(state, cfg, key, count):
+    """The pre-r5 [N, over] layout (with the packed-pair gather)."""
+    n, m = state.pid.shape
+    over = 4 * count
+    slots = jax.random.randint(key, (n, over), 0, m, jnp.int32)
+    me = jnp.arange(n, dtype=jnp.int32)[:, None]
+    cand, ckey = _unpack_word(
+        jnp.take_along_axis(_pack_tables(state.pid, state.pkey), slots, axis=1)
+    )  # [N, over]
+    valid = (cand >= 0) & (cand != me) & (ckey % 4 != DOWN) & (ckey >= 0)
+    eq = cand[:, None, :] == cand[:, :, None]  # [N, j, i]
+    earlier = jnp.tril(jnp.ones((over, over), bool), k=-1)
+    valid &= ~(eq & earlier[None, :, :] & valid[:, None, :]).any(axis=2)
+    rank = jnp.cumsum(valid, axis=1)
+    sel = valid[:, :, None] & (
+        rank[:, :, None] == jnp.arange(1, count + 1, dtype=rank.dtype)
+    )
+    return jnp.max(jnp.where(sel, cand[:, :, None], -1), axis=1)
+
+
+def fused_block(sampler):
+    def block(state, key):
+        ks = jax.random.split(key, 4)
+        a = sampler(state, cfg, ks[0], 1)
+        b = sampler(state, cfg, ks[1], 3)
+        c = sampler(state, cfg, ks[2], 3)
+        d = sampler(state, cfg, ks[3], 3)
+        return a.sum() + b.sum() + c.sum() + d.sum()
+
+    return jax.jit(block)
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    print(f"{name:32s} {(time.monotonic() - t0) / REPS * 1e3:8.2f} ms")
+
+
+cfg, meta = _write_storm(N, 512)
+topo = Topology()
+region = regions(cfg.n_nodes, topo.n_regions)
+state = new_sim(cfg, 0)
+
+warm = jax.jit(lambda s, m: round_step(s, m, meta, cfg, topo, region))
+for _ in range(2):
+    state, _m = warm(state, new_metrics(cfg))
+jax.block_until_ready(state.t)
+
+key = jax.random.PRNGKey(7)
+for sampler in (psample_member_targets, psample_legacy):
+    t = jax.device_get(sampler(state, cfg, key, 3))
+    assert t.shape == (N, 3)
+    row0 = [x for x in t[0] if x >= 0]
+    assert len(set(row0)) == len(row0)
+
+timeit("sampler [over, N] (shipped r5)", fused_block(psample_member_targets), state, key)
+timeit("sampler [N, over] (legacy)", fused_block(psample_legacy), state, key)
