@@ -28,8 +28,8 @@ reference:
 
 Belief precedence rides one scatter word: ``pkey = inc*4 + state`` (max =
 higher incarnation wins, then worse state), and bucket replacement packs
-``(pkey << 17) | id`` into an i32 — hence the 2^17 node cap and the
-incarnation clamp at 4000.
+``pkey * ID_CAP + id`` into an i32 — hence the 2^18 node cap and the
+incarnation clamp at 2046 (see the bound asserts below).
 """
 
 from __future__ import annotations
@@ -45,14 +45,20 @@ from .swim import (  # shared sampling/reachability
 )
 from .topology import Topology
 
-ID_BITS = 17
-ID_CAP = 1 << ID_BITS  # 131072
-INC_CLAMP = 4000
+ID_BITS = 18  # r5: widened 17→18 for the 250k north-star headroom tier
+ID_CAP = 1 << ID_BITS  # 262144
+# incarnation clamp sized to the pack bounds below (r5: 4000→2046 to
+# buy the extra id bit; foca's incarnation is a u16 and refutation
+# episodes per member stay far below 2k in any scenario tier)
+INC_CLAMP = 2046
 # the merge gather packs (pkey+1) above (pid+1): the +1 offsets absorb
 # the -1 empty markers, so the pid field needs ID_BITS+1 bits.  Bounds:
-# (INC_CLAMP*4+3+1) << 18 | 2^17 < 2^32.
+# u32 gather word  (INC_CLAMP*4+3+1) << 19 | 2^18        < 2^32
+# i32 scatter word (INC_CLAMP*4+3) * ID_CAP + (ID_CAP-1) < 2^31
 PACK_SHIFT = ID_BITS + 1
 PACK_MASK = (1 << PACK_SHIFT) - 1
+assert (INC_CLAMP * 4 + 4) << PACK_SHIFT | ID_CAP < 1 << 32
+assert (INC_CLAMP * 4 + 3) * ID_CAP + ID_CAP - 1 < 1 << 31
 
 
 def _pack_tables(pid: jnp.ndarray, pkey: jnp.ndarray) -> jnp.ndarray:
@@ -118,9 +124,10 @@ def _merge_entries(
     bucket = jnp.where(e_id >= 0, e_id % m, 0)
     # ONE fused random gather for the three table reads: the per-entry
     # (dst, bucket) accesses are the step's cache-miss hot spot.  pid
-    # (< 2^17) and pkey (≤ INC_CLAMP*4+3 < 2^14) pack into one u32
-    # word (+1 offsets absorb the -1 empty markers; 16004<<18 + 2^17
-    # < 2^32), shrinking the gather from 3×i32 to 2×u32 — a third of
+    # (< 2^18) and pkey (≤ INC_CLAMP*4+3 < 2^13) pack into one u32
+    # word (+1 offsets absorb the -1 empty markers; bounds statically
+    # asserted at module level), shrinking the gather from 3×i32 to
+    # 2×u32 — a third of
     # the merge's random-access traffic (r4 profile: 121 ms on CPU,
     # 36 ms on TPU, at the 100k shape)
     u32 = jnp.uint32

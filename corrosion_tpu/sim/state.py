@@ -144,10 +144,10 @@ class SimConfig:
             )
         if self.swim_full_view and self.swim_partial_view:
             raise ValueError("pick ONE of swim_full_view / swim_partial_view")
-        if self.swim_partial_view and self.n_nodes > 131072:
+        if self.swim_partial_view and self.n_nodes > 262144:
             # pswim packs (belief_key, id) into one i32 scatter word:
-            # id needs 17 bits (see pswim.py)
-            raise ValueError("partial-view SWIM supports at most 2^17 nodes")
+            # id needs 18 bits (see pswim.py pack-bound asserts)
+            raise ValueError("partial-view SWIM supports at most 2^18 nodes")
 
     @classmethod
     def wan_tuned(cls, n_nodes: int, **kw) -> "SimConfig":
