@@ -95,19 +95,54 @@ class BiStream:
 
 @dataclass
 class LinkModel:
-    """Deterministic latency/loss injection for in-memory clusters (stands in
-    for the WAN conditions Antithesis injects around the reference)."""
+    """Deterministic latency/loss/jitter/duplication injection for
+    in-memory clusters (stands in for the WAN conditions Antithesis
+    injects around the reference) — the host-tier compile target of the
+    FaultPlan seam (`corrosion_tpu.faults`).
+
+    Every stochastic decision (drop, duplicate, jitter draw) comes from
+    ONE per-instance ``random.Random(seed)`` stream, so a replay with
+    the same seed reproduces the exact decision sequence.  **Seed
+    derivation**: links must never share a stream — `MemoryNetwork`
+    derives each edge's instance via :meth:`derive`, which folds the
+    directed ``(src, dst)`` pair into the base seed with
+    ``faults.derive_seed(seed, "link", src, dst)`` (a blake2b fold;
+    process-stable, unlike salted ``hash()``).  Two links configured
+    from the same base LinkModel therefore draw INDEPENDENT sequences,
+    and the k-th decision on a given link is a pure function of
+    (base seed, src, dst, k)."""
 
     latency_s: float = 0.0
     loss: float = 0.0  # datagram/uni loss probability; bi streams are reliable
     seed: int = 0
+    # per-message extra delay uniform in [0, jitter_s): messages overtake
+    # each other — this is the REORDERING fault on the host tier
+    jitter_s: float = 0.0
+    duplicate: float = 0.0  # probability a delivered payload arrives twice
     _rng: random.Random = field(init=False)
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
 
+    def derive(self, src: str, dst: str) -> "LinkModel":
+        """Same parameters, per-edge independent seed-derived stream."""
+        from ..faults import derive_seed
+
+        return dataclasses.replace(
+            self, seed=derive_seed(self.seed, "link", src, dst)
+        )
+
     def drop(self) -> bool:
         return self.loss > 0 and self._rng.random() < self.loss
+
+    def dup(self) -> bool:
+        return self.duplicate > 0 and self._rng.random() < self.duplicate
+
+    def delay_s(self) -> float:
+        """Per-message delivery delay: fixed latency + jitter draw."""
+        if self.jitter_s > 0:
+            return self.latency_s + self._rng.random() * self.jitter_s
+        return self.latency_s
 
 
 @dataclass
@@ -210,7 +245,17 @@ class MemoryNetwork:
         return t
 
     def link(self, src: str, dst: str) -> LinkModel:
-        return self.links.get((src, dst), self.default_link)
+        """The directed edge's link model.  Edges without an explicit
+        entry get a lazily-created PER-EDGE instance derived from
+        ``default_link`` (`LinkModel.derive`: same parameters, seed
+        folded with the edge) — a single shared instance would make
+        every link consume ONE RNG stream, so link A's traffic would
+        perturb link B's drop sequence and no per-link schedule could
+        ever replay."""
+        lm = self.links.get((src, dst))
+        if lm is None:
+            lm = self.links[(src, dst)] = self.default_link.derive(src, dst)
+        return lm
 
     def partition(self, a: str, b: str, bidirectional: bool = True):
         self.partitioned.add((a, b))
@@ -245,15 +290,23 @@ class MemoryTransport(Transport):
         if kind in ("datagram", "uni") and link.drop():
             return False
         dst = self.net.nodes[addr]
+        # every stochastic decision is drawn HERE, at send time, in send
+        # order — drawing inside the spawned delivery task would make the
+        # stream's consumption order depend on scheduler interleaving and
+        # break seed replay.  Jitter gives each message its own delay, so
+        # later sends can overtake earlier ones: the reorder fault.
+        copies = 2 if kind in ("datagram", "uni") and link.dup() else 1
+        delays = [link.delay_s() for _ in range(copies)]
 
-        async def run():
-            if link.latency_s:
-                await asyncio.sleep(link.latency_s)
+        async def run(delay: float):
+            if delay > 0:
+                await asyncio.sleep(delay)
             handler = getattr(dst, f"on_{kind}")
             if handler is not None:
                 await handler(self.addr, payload)
 
-        self._spawn(run())
+        for d in delays:
+            self._spawn(run(d))
         return True
 
     async def send_datagram(self, addr: str, data: bytes) -> None:

@@ -354,8 +354,15 @@ class PooledSubscriptionStream:
     async def _iter(self):
         from ..utils.backoff import Backoff
 
-        backoff = Backoff(0.05, 2.0)
-        barren = 0  # consecutive failovers with zero events delivered
+        # the retry CAP rides the backoff itself (Backoff.max_retries):
+        # `reset()` on every delivered event restores the budget, so the
+        # cap bounds CONSECUTIVE barren failovers — a stream that dies
+        # before delivering anything is not a node-failure pattern worth
+        # spinning on forever; once the budget is spent the backoff
+        # gives up and the root cause surfaces
+        backoff = Backoff(
+            0.05, 2.0, max_retries=self.MAX_CONSECUTIVE_FAILOVERS
+        )
         while True:
             if self._stream is None:
                 await self._connect()
@@ -364,7 +371,6 @@ class PooledSubscriptionStream:
             try:
                 async for event in self._stream:
                     got_any = True
-                    barren = 0
                     backoff.reset()
                     yield event
                 # subscriptions are infinite: a "clean" EOF means the
@@ -375,21 +381,32 @@ class PooledSubscriptionStream:
             self.failovers += 1
             self.pool.rotate()
             self._stream = None
-            if not got_any:
-                # a stream that dies before delivering ANYTHING is not a
-                # node failure pattern worth spinning on: back off, and
-                # surface the root cause once the budget is spent
-                barren += 1
-                if barren >= self.MAX_CONSECUTIVE_FAILOVERS:
-                    raise err if err is not None else RuntimeError(
-                        "subscription failed on every address"
-                    )
+            if got_any:
+                # fruitful connection: restore the interval AND budget
+                backoff.reset()
+            try:
+                delay = next(backoff)
+            except StopIteration:  # pragma: no cover — gave_up raises below
+                delay = 0.0
+            if got_any:
+                # the post-fruitful draw sets the sleep (ADVICE r2: back
+                # off on EVERY failover) but must not spend barren
+                # budget — only consecutive barren failovers count, so
+                # reset again to refund the draw just taken
+                backoff.reset()
+            elif backoff.gave_up:
+                # the budget (16 consecutive barren failovers, exactly as
+                # the old counter bounded it) is spent: surface the root
+                # cause instead of sleeping once more
+                raise err if err is not None else RuntimeError(
+                    "subscription failed on every address"
+                )
             # ADVICE r2 (low): back off on EVERY failover, not only barren
             # ones — a flapping node that delivers a few events per
             # connection would otherwise drive a zero-delay resubscribe
             # loop hammering the cluster.  The backoff resets on delivery,
             # so a healthy failover still reconnects in ~50 ms.
-            await asyncio.sleep(next(backoff))
+            await asyncio.sleep(delay)
 
     def close(self):
         if self._stream is not None:

@@ -343,6 +343,9 @@ def cmd_consul(args) -> int:
 #: imports jax (the sim stack loads only when `sim` actually runs).
 _SIM_SCENARIOS = {
     "ground-truth-3node": "config_ground_truth_3node",
+    # FaultPlan demo campaign (doc/faults.md): one seeded fault schedule,
+    # also replayable against the in-process host tier
+    "fault-campaign-3node": "config_fault_campaign_3node",
     "swim-churn-64": "config_swim_churn_64",
     "swim-churn-partial-4k": "config_swim_churn_partial",
     "broadcast-1k": "config_broadcast_1k",

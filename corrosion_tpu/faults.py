@@ -1,0 +1,460 @@
+"""Unified deterministic FaultPlan: ONE seeded fault schedule driving
+both tiers of the transport seam.
+
+The reference bakes Antithesis-style fault campaigns into its test rig
+(.antithesis/config/docker-compose.yaml: partitions, crashes, degraded
+links) and always/sometimes assertions into production code
+(`corrosion_tpu.invariants`).  Before this module each tier had its own
+ad-hoc fault knobs — `LinkModel(loss, latency_s)` on the in-memory
+cluster, a hard-coded WAN partition in sim config #4, kill -9 in the
+process campaign — so the *same* adversarial schedule could never be
+replayed against both tiers and compared.  A FaultPlan is the single
+source of truth:
+
+- a **schedule** of timed :class:`FaultEvent`\\ s — per-link loss /
+  latency / jitter (jitter also produces message REORDERING on both
+  tiers: each message draws its own extra delay), message duplication,
+  asymmetric partitions (A hears B but not vice versa), node
+  crash+restart with or without state wipe, and HLC clock skew;
+- ``plan.schedule()`` expands events into a canonical per-round table —
+  a pure function of the plan, so both compilers consume identical
+  per-round fault decisions;
+- :class:`HostFaultDriver` replays the schedule against an in-process
+  cluster (`corrosion_tpu.testing.Cluster` on a `MemoryNetwork`),
+  installing seed-derived :class:`~corrosion_tpu.agent.transport.LinkModel`
+  instances, directed partition edges, crash/restart/wipe, and HLC skew;
+- `corrosion_tpu.sim.faults.compile_plan` lowers the SAME schedule into
+  per-round mask/delay tensors threaded through the sim kernels.
+
+Seed derivation (the PeerSwap randomness-reproducibility discipline,
+arxiv 2408.03829): every stochastic stream is derived from the ONE plan
+seed via :func:`derive_seed` — a blake2b fold over ``(seed, *tokens)``
+— so two links never share an RNG stream and a replay with the same
+seed reproduces the exact per-draw decisions on each tier.
+
+Time base: a plan is denominated in ROUNDS (one sim round ≈ one
+broadcast flush tick).  The host driver converts rounds to wall-clock
+via ``plan.round_s``; the sim indexes its schedule tensors by ``state.t``
+directly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple, Union
+
+from .invariants import CATALOG, Catalog, sometimes
+
+#: event kinds a plan may schedule (doc/faults.md documents each)
+KINDS = (
+    "loss",        # per-link Bernoulli drop of fire-and-forget payloads
+    "delay",       # fixed added latency, in rounds
+    "jitter",      # per-message uniform extra delay 0..delay_rounds (reorders)
+    "duplicate",   # per-link Bernoulli duplication of delivered payloads
+    "partition",   # directed (or symmetric) edge cut
+    "crash",       # node down [start, end); restarts at `end`, optionally wiped
+    "clock_skew",  # HLC physical-clock offset on one node
+)
+
+NodeSel = Union[int, str]  # node index or "*"
+
+
+def derive_seed(seed: int, *tokens) -> int:
+    """Stable 63-bit child seed from the plan seed and a token path.
+
+    blake2b over the repr of ``(seed, *tokens)`` — byte-stable across
+    processes and Python hash randomization (``hash()`` is salted per
+    process; it would break replay).  This is THE seed-derivation rule
+    for every FaultPlan stream: per-link loss streams use
+    ``derive_seed(seed, "link", src, dst, epoch)``, so two links with
+    the same base seed never share an RNG stream, and the epoch (index
+    of the link's parameter change in the schedule) restarts the stream
+    deterministically whenever a link's fault parameters change.
+    """
+    h = hashlib.blake2b(
+        repr((int(seed),) + tokens).encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big") & (2**63 - 1)
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One timed fault.  Active over rounds ``[start, end)`` (for
+    ``crash``, the node is down over [start, end) and restarts at round
+    ``end``; ``wipe=True`` loses its durable state at restart)."""
+
+    kind: str
+    start: int
+    end: int
+    src: NodeSel = "*"   # link faults: sending side ("*" = every node)
+    dst: NodeSel = "*"   # link faults: receiving side
+    node: Optional[int] = None  # crash / clock_skew target
+    p: float = 0.0       # loss / duplicate probability
+    delay_rounds: int = 0  # delay magnitude (fixed for `delay`, max for `jitter`)
+    wipe: bool = False   # crash: lose durable state at restart
+    skew_ns: int = 0     # clock_skew offset (may be negative)
+    symmetric: bool = False  # partition: cut both directions
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (use one of {KINDS})")
+        if self.end <= self.start:
+            raise ValueError(f"{self.kind}: end {self.end} must be > start {self.start}")
+        if self.kind in ("crash", "clock_skew") and self.node is None:
+            raise ValueError(f"{self.kind} needs node=")
+        if self.kind in ("loss", "duplicate") and not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"{self.kind}: p={self.p} outside [0, 1]")
+
+
+@dataclass(frozen=True)
+class LinkFault:
+    """Resolved per-(directed-link, round) fault parameters."""
+
+    loss: float = 0.0
+    delay_rounds: int = 0
+    jitter_rounds: int = 0
+    duplicate: float = 0.0
+    blocked: bool = False
+
+    def merge(self, other: "LinkFault") -> "LinkFault":
+        """Overlapping events compose: losses combine as independent
+        drops, delays add, jitter/duplicate take the max, block ORs."""
+        return LinkFault(
+            loss=1.0 - (1.0 - self.loss) * (1.0 - other.loss),
+            delay_rounds=self.delay_rounds + other.delay_rounds,
+            jitter_rounds=max(self.jitter_rounds, other.jitter_rounds),
+            duplicate=max(self.duplicate, other.duplicate),
+            blocked=self.blocked or other.blocked,
+        )
+
+
+CLEAR = LinkFault()
+
+
+@dataclass(frozen=True)
+class RoundSchedule:
+    """Canonical fault state of ONE round — what both compilers consume."""
+
+    links: Dict[Tuple[int, int], LinkFault]  # directed (src, dst) -> fault
+    down: FrozenSet[int]        # nodes down this round
+    restart: FrozenSet[int]     # nodes restarting this round (were down)
+    wipe: FrozenSet[int]        # restarting nodes that lost durable state
+    skews: Dict[int, int]       # node -> HLC offset (ns) active this round
+
+    def active_kinds(self) -> List[str]:
+        """Fault kinds in effect this round — the single source for
+        coverage-marker firing on BOTH tiers (`fault-<kind>-active`), so
+        the drivers can't drift from `FaultPlan.coverage_markers`."""
+        kinds = set()
+        for f in self.links.values():
+            if f.blocked:
+                kinds.add("partition")
+            if f.loss > 0:
+                kinds.add("loss")
+            if f.delay_rounds > 0:
+                kinds.add("delay")
+            if f.jitter_rounds > 0:
+                kinds.add("jitter")
+            if f.duplicate > 0:
+                kinds.add("duplicate")
+        if self.down:
+            kinds.add("crash")
+        if self.skews:
+            kinds.add("clock_skew")
+        return sorted(kinds)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A seeded, declarative fault schedule for an ``n_nodes`` cluster."""
+
+    n_nodes: int
+    seed: int
+    events: Tuple[FaultEvent, ...]
+    round_s: float = 0.05  # host wall-clock per round (≈ fast_perf flush tick)
+
+    def __post_init__(self):
+        object.__setattr__(self, "events", tuple(self.events))
+        for ev in self.events:
+            for sel in (ev.src, ev.dst):
+                if sel != "*" and not 0 <= int(sel) < self.n_nodes:
+                    raise ValueError(f"node selector {sel} outside 0..{self.n_nodes - 1}")
+            if ev.node is not None and not 0 <= ev.node < self.n_nodes:
+                raise ValueError(f"node {ev.node} outside 0..{self.n_nodes - 1}")
+
+    # -- schedule expansion (pure; shared by both compilers) ---------------
+
+    @property
+    def horizon(self) -> int:
+        """First round with no scheduled fault activity left (restart
+        rounds included, so a crash's rejoin is inside the horizon)."""
+        return max((ev.end for ev in self.events), default=0) + 1
+
+    def _pairs(self, ev: FaultEvent):
+        srcs = range(self.n_nodes) if ev.src == "*" else (int(ev.src),)
+        dsts = range(self.n_nodes) if ev.dst == "*" else (int(ev.dst),)
+        for s in srcs:
+            for d in dsts:
+                if s != d:
+                    yield (s, d)
+                    if ev.kind == "partition" and ev.symmetric:
+                        yield (d, s)
+
+    def schedule_at(self, r: int) -> RoundSchedule:
+        """The resolved fault state of round ``r`` — a pure function of
+        the plan, so the host driver and the sim compiler can never
+        disagree on what round r looks like."""
+        links: Dict[Tuple[int, int], LinkFault] = {}
+        down, restart, wipe = set(), set(), set()
+        skews: Dict[int, int] = {}
+        for ev in self.events:
+            if ev.kind == "crash":
+                if ev.start <= r < ev.end:
+                    down.add(ev.node)
+                elif r == ev.end:
+                    restart.add(ev.node)
+                    if ev.wipe:
+                        wipe.add(ev.node)
+                continue
+            if not ev.start <= r < ev.end:
+                continue
+            if ev.kind == "clock_skew":
+                skews[ev.node] = skews.get(ev.node, 0) + ev.skew_ns
+                continue
+            if ev.kind == "loss":
+                f = LinkFault(loss=ev.p)
+            elif ev.kind == "delay":
+                f = LinkFault(delay_rounds=ev.delay_rounds)
+            elif ev.kind == "jitter":
+                f = LinkFault(jitter_rounds=ev.delay_rounds)
+            elif ev.kind == "duplicate":
+                f = LinkFault(duplicate=ev.p)
+            else:  # partition
+                f = LinkFault(blocked=True)
+            for pair in self._pairs(ev):
+                links[pair] = links.get(pair, CLEAR).merge(f)
+        return RoundSchedule(
+            links=links, down=frozenset(down), restart=frozenset(restart),
+            wipe=frozenset(wipe), skews=skews,
+        )
+
+    def schedule(self) -> List[RoundSchedule]:
+        """Every round of the plan, rounds ``0..horizon`` inclusive (the
+        final entry is all-clear by construction — the steady state both
+        tiers converge under)."""
+        return [self.schedule_at(r) for r in range(self.horizon + 1)]
+
+    def link_epochs(self) -> Dict[Tuple[int, int], List[Tuple[int, LinkFault]]]:
+        """Per-link parameter-change points: ``(src, dst) -> [(round,
+        params), ...]``.  The index of a change is that link's RNG
+        **epoch** — `HostFaultDriver` re-seeds the link's LinkModel at
+        every epoch with ``derive_seed(seed, "link", src, dst, epoch)``,
+        which is what makes a replay reproduce the exact drop/dup/jitter
+        draw sequence regardless of wall-clock timing."""
+        epochs: Dict[Tuple[int, int], List[Tuple[int, LinkFault]]] = {}
+        prev: Dict[Tuple[int, int], LinkFault] = {}
+        for r, sched in enumerate(self.schedule()):
+            for pair in set(prev) | set(sched.links):
+                cur = sched.links.get(pair, CLEAR)
+                if prev.get(pair, CLEAR) != cur:
+                    epochs.setdefault(pair, []).append((r, cur))
+                    prev[pair] = cur
+        return epochs
+
+    def coverage_markers(self) -> List[str]:
+        """`sometimes` markers this plan is expected to fire — one per
+        fault kind present (the Antithesis coverage property: a campaign
+        that never exercised a declared fault is a broken campaign)."""
+        return sorted({f"fault-{ev.kind}-active" for ev in self.events})
+
+
+def demo_plan(n_nodes: int = 3, seed: int = 0, rounds: int = 36) -> FaultPlan:
+    """The canonical example campaign (doc/faults.md; the CLI's
+    `sim fault-campaign-3node` scenario): a loss burst over everything,
+    a mid-run asymmetric partition, delay+jitter on one link, and a
+    crash-with-wipe of the last node in the final third."""
+    third = rounds // 3
+    return FaultPlan(
+        n_nodes=n_nodes, seed=seed,
+        events=(
+            FaultEvent("loss", 0, rounds, p=0.4),
+            FaultEvent("partition", third // 2, third, src=n_nodes - 1, dst=0),
+            FaultEvent("delay", 2, 2 * third, src=0, dst=1, delay_rounds=1),
+            FaultEvent("jitter", 2, 2 * third, src=0, dst=1, delay_rounds=1),
+            FaultEvent(
+                "crash", 2 * third, rounds - 2, node=n_nodes - 1, wipe=True
+            ),
+        ),
+    )
+
+
+class CampaignCoverage:
+    """Scoped `sometimes` coverage over one campaign: snapshot the pass
+    counters at entry, and :meth:`assert_covered` demands every expected
+    marker fired SINCE then (the reference's "did every sometimes fire"
+    stress-test property, scoped so earlier tests can't donate passes)."""
+
+    def __init__(self, expected: Sequence[str], catalog: Catalog = CATALOG):
+        self.expected = sorted(set(expected))
+        self.catalog = catalog
+        self._at_entry: Dict[str, int] = {}
+
+    def __enter__(self):
+        self.catalog.expect_sometimes(*self.expected)
+        report = self.catalog.report()
+        self._at_entry = {
+            name: report.get(name, {}).get("passes", 0) for name in self.expected
+        }
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def unfired(self) -> List[str]:
+        report = self.catalog.report()
+        return [
+            name
+            for name in self.expected
+            if report.get(name, {}).get("passes", 0) <= self._at_entry[name]
+        ]
+
+    def coverage(self) -> float:
+        if not self.expected:
+            return 1.0
+        return 1.0 - len(self.unfired()) / len(self.expected)
+
+    def assert_covered(self):
+        missing = self.unfired()
+        assert not missing, (
+            f"campaign sometimes-coverage {self.coverage():.0%}: "
+            f"never fired {missing}"
+        )
+
+
+class HostFaultDriver:
+    """Replay a FaultPlan against an in-process cluster.
+
+    One driver round ≈ one sim round: every ``plan.round_s`` of
+    wall-clock the driver advances its round counter and installs that
+    round's :class:`RoundSchedule` — per-link LinkModels (seed-derived,
+    epoch-reset; see :meth:`FaultPlan.link_epochs`), directed partition
+    edges on the `MemoryNetwork`, crash/restart/wipe through the
+    Cluster, and HLC skew on the target agent's clock.  After the final
+    scheduled round everything is healed/cleared, so the cluster can
+    converge in the all-clear steady state (the campaign's eventual
+    checker runs after :meth:`run` returns).
+    """
+
+    def __init__(self, plan: FaultPlan, cluster, catalog: Catalog = CATALOG):
+        from .testing import Cluster  # local import: avoid test-dep at import
+
+        assert isinstance(cluster, Cluster)
+        if cluster.n != plan.n_nodes:
+            raise ValueError(
+                f"plan is for {plan.n_nodes} nodes, cluster has {cluster.n}"
+            )
+        self.plan = plan
+        self.cluster = cluster
+        self.catalog = catalog
+        self.round = -1
+        self._epochs = plan.link_epochs()
+        self._epoch_idx: Dict[Tuple[int, int], int] = {}
+        self._skewed: Dict[int, object] = {}  # node -> original _now_ns
+        self._skew_offset: Dict[int, int] = {}  # node -> installed offset
+        self.log: List[Tuple[int, str, object]] = []  # (round, action, detail)
+
+    def _addr(self, i: int) -> str:
+        return f"{self.cluster.addr_prefix}{i}"
+
+    def _mark(self, kind: str):
+        self.catalog.sometimes(True, f"fault-{kind}-active")
+
+    async def apply_round(self, r: int) -> None:
+        """Install round ``r``'s schedule (idempotent per round)."""
+        from .agent.transport import LinkModel
+
+        plan, net = self.plan, self.cluster.net
+        sched = plan.schedule_at(r)
+
+        # -- link faults: (re)install LinkModels at epoch boundaries
+        for pair, changes in self._epochs.items():
+            idx = self._epoch_idx.get(pair, 0)
+            while idx < len(changes) and changes[idx][0] <= r:
+                _, params = changes[idx]
+                src, dst = pair
+                edge = (self._addr(src), self._addr(dst))
+                if params == CLEAR:
+                    # back to the network's own (per-link derived) model
+                    net.links.pop(edge, None)
+                else:
+                    base = net.default_link
+                    net.links[edge] = LinkModel(
+                        latency_s=base.latency_s
+                        + params.delay_rounds * plan.round_s,
+                        loss=1.0 - (1.0 - base.loss) * (1.0 - params.loss),
+                        jitter_s=params.jitter_rounds * plan.round_s,
+                        duplicate=params.duplicate,
+                        seed=derive_seed(plan.seed, "link", src, dst, idx),
+                    )
+                self.log.append((r, "link", (pair, idx, params)))
+                idx += 1
+                self._epoch_idx[pair] = idx
+
+        # -- coverage markers for whatever is active this round
+        for kind in sched.active_kinds():
+            self._mark(kind)
+
+        # -- partitions: the driver owns the directed blocked-edge set
+        net.partitioned = {
+            (self._addr(s), self._addr(d))
+            for (s, d), f in sched.links.items()
+            if f.blocked
+        }
+
+        # -- crash / restart / wipe
+        for i in sorted(sched.down):
+            if i not in self.cluster.down:
+                self.log.append((r, "crash", i))
+                # the crashed agent's clock dies with it: a skew spanning
+                # the crash re-installs cleanly on the restarted agent
+                self._skewed.pop(i, None)
+                self._skew_offset.pop(i, None)
+                await self.cluster.crash_node(i)
+        for i in sorted(sched.restart):
+            if i in self.cluster.down:
+                self.log.append((r, "restart", (i, i in sched.wipe)))
+                await self.cluster.restart_node(i, wipe=i in sched.wipe)
+
+        # -- HLC clock skew (host tier only: the sim has no clock; see
+        # doc/faults.md "tier coverage").  Re-installed whenever the
+        # SCHEDULED offset moves (overlapping skew events sum, so the
+        # offset can change mid-plan) — install-once would freeze the
+        # first round's value
+        for i, offset in sched.skews.items():
+            if i in self.cluster.down or self._skew_offset.get(i) == offset:
+                continue
+            clock = self.cluster.agents[i].clock
+            if i not in self._skewed:
+                self._skewed[i] = clock._now_ns
+            base = self._skewed[i]
+            clock._now_ns = lambda base=base, off=offset: base() + off
+            self._skew_offset[i] = offset
+            self.log.append((r, "clock_skew", (i, offset)))
+        for i in list(self._skewed):
+            if i not in sched.skews:
+                self.cluster.agents[i].clock._now_ns = self._skewed.pop(i)
+                self._skew_offset.pop(i, None)
+                self.log.append((r, "clock_skew_clear", i))
+
+    async def run(self) -> None:
+        """Drive the whole schedule in real time, one round per
+        ``plan.round_s``; returns with every fault healed."""
+        import asyncio
+
+        for r in range(self.plan.horizon + 1):
+            self.round = r
+            await self.apply_round(r)
+            if r < self.plan.horizon:
+                await asyncio.sleep(self.plan.round_s)
+        sometimes(True, "fault-campaign-completed")

@@ -30,6 +30,7 @@ def broadcast_step(
     topo: Topology,
     region: jnp.ndarray,
     key: jax.Array,
+    faults=None,
 ) -> SimState:
     n, p = state.have.shape
     f = cfg.fanout
@@ -95,6 +96,28 @@ def broadcast_step(
     # loss is drawn per (edge, payload): each changeset is its own uni
     # frame on the wire (see edge_payload_drop)
     drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
+
+    if faults is not None:
+        # FaultPlan seam (sim/faults.py): directed cuts, extra per-link
+        # loss, fixed delay + jitter drawn per (edge, flush) — the
+        # round's batch shares one draw (coarser than the host tier's
+        # per-message jitter; doc/faults.md pins it).  Keys are fold_in-
+        # derived (never split from the phase keys) so the faults=None
+        # path stays byte-identical, and fold the PLAN seed so the fault
+        # decisions are plan-seeded, as on the host tier.
+        k_fault = jax.random.fold_in(key, faults.seed)
+        k_floss = jax.random.fold_in(k_fault, 101)
+        k_fjit = jax.random.fold_in(k_fault, 102)
+        ok &= ~faults.block[src, dst]
+        thr = faults.loss[src, dst]  # u8[E]
+        fbits = jax.random.bits(k_floss, (src.shape[0], p), dtype=jnp.uint8)
+        drop = drop | (fbits < thr[:, None])
+        delay = delay + faults.delay[src, dst].astype(jnp.int32)
+        jit = faults.jitter[src, dst].astype(jnp.int32)  # [E]
+        draw = jax.random.randint(
+            k_fjit, (src.shape[0],), 0, jnp.iinfo(jnp.int32).max
+        )
+        delay = delay + jnp.where(jit > 0, draw % (jit + 1), 0)
     payload = state.have.dtype
     # `sending[src]` is a regular f-fold repeat (src = repeat(arange, f))
     # — a broadcast, not a 100M-cell random gather at the gapstress shape

@@ -1,0 +1,283 @@
+"""FaultPlan → sim tensors: the device backend of the unified fault seam.
+
+`corrosion_tpu.faults.FaultPlan.schedule()` is the single source of
+truth; this module lowers that per-round table into stacked mask/delay
+tensors indexed by ``state.t`` and threads them through the round
+kernels (broadcast / sync / SWIM reachability), extending the existing
+DOWN/latency-class machinery:
+
+- ``block[R+1, N, N] bool`` — directed edge cut (asymmetric partitions:
+  block[r, a, b] stops a→b while b→a still flows);
+- ``loss[R+1, N, N] u8``   — extra per-link drop threshold (p·256, the
+  same 8-bit quantization as `topology.edge_payload_drop`); a loss of
+  ~1.0 compiles into ``block`` instead (a u8 threshold cannot express
+  certainty);
+- ``delay/jitter[R+1, N, N] u8`` — extra delivery delay in rounds:
+  fixed + uniform 0..jitter drawn per (edge, flush) — a round's whole
+  batch on one edge shares the draw, so jitter reorders traffic across
+  ROUNDS and EDGES, a coarser grain than the host tier's true
+  per-message draw (doc/faults.md "tier coverage" pins this);
+- ``alive[R+1, N] i8``     — scheduled alive override (-1 = leave to
+  the scenario; ALIVE/DOWN during crash windows and at restart);
+- ``wipe[R+1, N] bool``    — the restart round of a crash with
+  ``wipe=True``: the node's ``have``/relay/inflight/bookkeeping rows
+  are zeroed, so it rejoins empty and must recover via anti-entropy.
+
+Row ``R`` (one past the last scheduled round) is all-clear by
+construction, and `round_faults` clamps its index there — after the
+horizon the sim runs fault-free, the steady state convergence is
+measured in.
+
+Tier coverage caveats (doc/faults.md): ``duplicate`` compiles to a
+no-op here — sim delivery is an idempotent scatter-max, so a duplicated
+payload is indistinguishable from the original (the host tier delivers
+it twice and the dedup cache absorbs it); ``clock_skew`` is host-only —
+the sim carries no HLC.  Both still count toward schedule coverage via
+the plan's markers, fired by `run_fault_plan_checked`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..faults import CLEAR, FaultPlan
+from .round import RunMetrics, new_metrics, round_step
+from .state import (
+    ALIVE,
+    DOWN,
+    PayloadMeta,
+    SimConfig,
+    SimState,
+    complete_versions,
+    version_active,
+)
+from .topology import Topology, regions
+
+
+class SimFaultPlan(NamedTuple):
+    """Stacked per-round fault tensors (device); index with `round_faults`."""
+
+    block: jnp.ndarray   # bool[R+1, N, N] directed src→dst cut
+    loss: jnp.ndarray    # u8[R+1, N, N] extra drop threshold (p·256)
+    delay: jnp.ndarray   # u8[R+1, N, N] fixed extra delay, rounds
+    jitter: jnp.ndarray  # u8[R+1, N, N] max per-message extra delay, rounds
+    alive: jnp.ndarray   # i8[R+1, N] override: -1 none, else ALIVE/DOWN
+    wipe: jnp.ndarray    # bool[R+1, N] zero the node's state this round
+    # plan-seed fold (derive_seed(seed, "sim")): every stochastic fault
+    # draw folds this in, so the PLAN seed — not just the scenario's
+    # PRNG key — determines the per-round fault decisions, mirroring the
+    # host tier where the plan seed derives every LinkModel stream
+    seed: jnp.ndarray    # i32 scalar
+
+
+class RoundFaults(NamedTuple):
+    """One round's slice of a SimFaultPlan, consumed by the kernels."""
+
+    block: jnp.ndarray   # bool[N, N]
+    loss: jnp.ndarray    # u8[N, N]
+    delay: jnp.ndarray   # u8[N, N]
+    jitter: jnp.ndarray  # u8[N, N]
+    alive: jnp.ndarray   # i8[N]
+    wipe: jnp.ndarray    # bool[N]
+    seed: jnp.ndarray    # i32 scalar (see SimFaultPlan.seed)
+
+
+def compile_plan(
+    plan: FaultPlan, cfg: SimConfig, topo: Topology = Topology()
+) -> SimFaultPlan:
+    """Lower ``plan.schedule()`` into device tensors.
+
+    Validates the delay-ring envelope at compile time: the ring must be
+    able to represent every (topology + fault) delay, or a wrapped slot
+    would deliver EARLY, silently (`round.validate`'s contract)."""
+    if plan.n_nodes != cfg.n_nodes:
+        raise ValueError(
+            f"plan is for {plan.n_nodes} nodes, SimConfig has {cfg.n_nodes}"
+        )
+    if cfg.swim_partial_view:
+        # pswim_step does not consume RoundFaults yet (ROADMAP open
+        # item): probes would sail through partitions while broadcast/
+        # sync honor them — silently wrong campaign results.  Refuse
+        # loudly until the partial-view kernel carries the seam.
+        raise ValueError(
+            "FaultPlan does not yet thread faults through partial-view "
+            "SWIM (sim/pswim.py); use swim_full_view or oracle membership"
+        )
+    n, rounds = plan.n_nodes, plan.horizon
+    shape = (rounds + 1, n, n)
+    block = np.zeros(shape, np.bool_)
+    loss = np.zeros(shape, np.uint8)
+    delay = np.zeros(shape, np.uint8)
+    jitter = np.zeros(shape, np.uint8)
+    alive = np.full((rounds + 1, n), -1, np.int8)
+    wipe = np.zeros((rounds + 1, n), np.bool_)
+
+    max_extra = 0
+    for r, sched in enumerate(plan.schedule()):
+        for (s, d), f in sched.links.items():
+            if f is CLEAR:
+                continue
+            thr = int(round(f.loss * 256.0))
+            if f.blocked or thr >= 256:
+                # certainty can't ride the u8 threshold: sever the edge
+                block[r, s, d] = True
+            elif thr > 0:
+                loss[r, s, d] = thr
+            delay[r, s, d] = min(f.delay_rounds, 255)
+            jitter[r, s, d] = min(f.jitter_rounds, 255)
+            max_extra = max(max_extra, f.delay_rounds + f.jitter_rounds)
+        for i in sched.down:
+            alive[r, i] = DOWN
+        for i in sched.restart:
+            alive[r, i] = ALIVE
+        for i in sched.wipe:
+            wipe[r, i] = True
+
+    base = max(topo.intra_delay, topo.inter_delay, 1)
+    if base + max_extra >= cfg.n_delay_slots:
+        raise ValueError(
+            f"max edge delay {base + max_extra} rounds (topology {base} + "
+            f"fault {max_extra}) needs n_delay_slots > {base + max_extra}, "
+            f"got {cfg.n_delay_slots}"
+        )
+    from ..faults import derive_seed
+
+    return SimFaultPlan(
+        block=jnp.asarray(block), loss=jnp.asarray(loss),
+        delay=jnp.asarray(delay), jitter=jnp.asarray(jitter),
+        alive=jnp.asarray(alive), wipe=jnp.asarray(wipe),
+        seed=jnp.int32(derive_seed(plan.seed, "sim") & 0x7FFFFFFF),
+    )
+
+
+def round_faults(fplan: SimFaultPlan, t: jnp.ndarray) -> RoundFaults:
+    """Slice round ``t``'s fault state; past the horizon every round
+    reads the final all-clear row (index clamp, not wraparound)."""
+    i = jnp.minimum(t, fplan.block.shape[0] - 1)
+    return RoundFaults(
+        block=fplan.block[i], loss=fplan.loss[i], delay=fplan.delay[i],
+        jitter=fplan.jitter[i], alive=fplan.alive[i], wipe=fplan.wipe[i],
+        seed=fplan.seed,
+    )
+
+
+def apply_node_faults(state: SimState, rf: RoundFaults) -> SimState:
+    """Crash/restart/wipe, applied BEFORE the round's phases: the alive
+    override makes `edge_alive` mask the node's edges this very round,
+    and a wipe zeroes everything the node 'knew' — chunk bits, relay
+    budgets, in-flight deliveries addressed to it, and the advertised
+    bookkeeping tensors (heads/gaps), so the node rejoins as a cold
+    joiner and must recover purely via anti-entropy (the
+    crash-with-state-wipe shape of the reference's restore campaign)."""
+    alive = jnp.where(
+        rf.alive >= 0, rf.alive.astype(state.alive.dtype), state.alive
+    )
+    w = rf.wipe
+    wn = w[:, None]
+    return state._replace(
+        alive=alive,
+        have=jnp.where(wn, 0, state.have),
+        relay_left=jnp.where(wn, 0, state.relay_left),
+        sync_inflight=jnp.where(wn, 0, state.sync_inflight),
+        inflight=jnp.where(w[None, :, None], 0, state.inflight),
+        heads=jnp.where(wn, 0, state.heads),
+        gap_lo=jnp.where(w[:, None, None], 0, state.gap_lo),
+        gap_hi=jnp.where(w[:, None, None], 0, state.gap_hi),
+    )
+
+
+def _all_have(state: SimState, meta: PayloadMeta, cfg: SimConfig) -> jnp.ndarray:
+    """bool: every up node holds every injected version completely (the
+    check_bookkeeping property, computed FRESH — `metrics.converged_at`
+    is sticky and a post-convergence wipe must un-converge the node)."""
+    up = state.alive == ALIVE
+    comp = complete_versions(state.have, cfg)
+    act = version_active(state.injected, cfg)
+    node_done = jnp.all(comp | ~act[None], axis=(1, 2)) | ~up
+    return jnp.all(meta.round <= state.t) & jnp.all(node_done)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "topo", "max_rounds"))
+def run_fault_plan(
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    fplan: SimFaultPlan,
+    max_rounds: int = 1000,
+) -> Tuple[SimState, RunMetrics]:
+    """Advance rounds under the fault schedule until the cluster holds
+    every payload AND the schedule is exhausted (a plan may crash a node
+    after convergence — early exit would miss the rejoin), or
+    ``max_rounds``.  Always the DENSE round path: the packed kernels
+    don't carry the fault seam (doc/faults.md)."""
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+    horizon = fplan.block.shape[0] - 1  # static
+
+    def cond(carry):
+        state, metrics = carry
+        done = (state.t >= horizon) & _all_have(state, meta, cfg)
+        return (state.t < max_rounds) & ~done
+
+    def body(carry):
+        state, metrics = carry
+        rf = round_faults(fplan, state.t)
+        state = apply_node_faults(state, rf)
+        return round_step(state, metrics, meta, cfg, topo, region, faults=rf)
+
+    return jax.lax.while_loop(cond, body, (state, metrics))
+
+
+def run_fault_plan_checked(
+    plan: FaultPlan,
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology = Topology(),
+    max_rounds: int = 1000,
+    check_every: int = 1,
+    catalog=None,
+) -> Tuple[SimState, RunMetrics, list]:
+    """The test-tier driver: same schedule, Python round loop, with the
+    sim invariant catalog (`sim.invariants.check_state`) asserted every
+    ``check_every`` rounds and the plan's `sometimes` coverage markers
+    fired as scheduled faults take effect.  Returns (state, metrics,
+    digests) where ``digests`` is a per-round fingerprint of the fault
+    decisions + resulting state — two runs from the same seed must
+    produce identical digest sequences (the replay-determinism
+    contract)."""
+    import hashlib
+
+    from ..faults import CATALOG
+    from .invariants import check_state
+
+    catalog = catalog or CATALOG
+    fplan = compile_plan(plan, cfg, topo)
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+    digests = []
+    for r in range(max_rounds):
+        rf = round_faults(fplan, state.t)
+        state = apply_node_faults(state, rf)
+        sched = plan.schedule_at(min(r, plan.horizon))
+        for kind in sched.active_kinds():
+            catalog.sometimes(True, f"fault-{kind}-active")
+        state, metrics = round_step(
+            state, metrics, meta, cfg, topo, region, faults=rf
+        )
+        h = hashlib.blake2b(digest_size=8)
+        h.update(np.asarray(state.have).tobytes())
+        h.update(np.asarray(state.alive).tobytes())
+        h.update(np.asarray(state.heads).tobytes())
+        digests.append(h.hexdigest())
+        if r % check_every == 0:
+            check_state(state, cfg)
+        if r >= plan.horizon and bool(_all_have(state, meta, cfg)):
+            break
+    return state, metrics, digests

@@ -75,19 +75,27 @@ def round_step(
     cfg: SimConfig,
     topo: Topology,
     region: jnp.ndarray,
+    faults=None,
 ) -> Tuple[SimState, RunMetrics]:
+    """``faults`` (a `sim.faults.RoundFaults` slice, or None) threads
+    the FaultPlan seam through every phase: directed edge cuts, extra
+    per-link loss, delay/jitter on the fire-and-forget paths, and SWIM
+    probe reachability.  The None path is byte-identical to the
+    pre-fault kernels — fault keys are `fold_in`-derived inside the
+    ``faults is not None`` trace branch, never split from the phase
+    keys, so existing seeded runs replay unchanged."""
     validate(cfg, topo)
     key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
     state = state._replace(key=key)
 
     state = inject_step(state, meta, cfg)
-    state = broadcast_step(state, meta, cfg, topo, region, k_bcast)
+    state = broadcast_step(state, meta, cfg, topo, region, k_bcast, faults)
     # sync pulls granted LAST round deliver this round (bi-stream RTT);
     # capture the buffer before sync_step overwrites it with new pulls
     pending_sync = state.sync_inflight
-    state = sync_step(state, meta, cfg, topo, k_sync)
+    state = sync_step(state, meta, cfg, topo, k_sync, faults)
     state = deliver_step(state, cfg, pending_sync)
-    state = swim_step(state, cfg, topo, k_swim)
+    state = swim_step(state, cfg, topo, k_swim, faults)
 
     # refresh the advertised bookkeeping tensors from this round's chunk
     # arrivals (generate_sync's snapshot; next round's sync reads them)

@@ -125,6 +125,43 @@ def config_ground_truth_3node(seed: int = 0) -> Dict[str, float]:
     return run_scenario(cfg, meta, seed=seed)
 
 
+def config_fault_campaign_3node(seed: int = 0) -> Dict[str, float]:
+    """The FaultPlan demo campaign (doc/faults.md) on the sim tier: loss
+    burst + asymmetric partition + delay/jitter + crash-with-wipe, all
+    from ONE plan seed; the identical schedule replays against the
+    in-process host cluster via `faults.HostFaultDriver`."""
+    from ..faults import demo_plan
+    from .faults import compile_plan, run_fault_plan
+
+    plan = demo_plan(seed=seed)
+    cfg = SimConfig(
+        n_nodes=plan.n_nodes, n_payloads=16, fanout=2,
+        sync_interval_rounds=4, n_delay_slots=4,
+    )
+    meta = uniform_payloads(cfg, inject_every=1)
+    topo = Topology()
+    fplan = compile_plan(plan, cfg, topo)
+    state = new_sim(cfg, seed)
+    t0 = time.monotonic()
+    final, metrics = run_fault_plan(state, meta, cfg, topo, fplan, 1000)
+    jax.block_until_ready((final, metrics))
+    wall = time.monotonic() - t0
+    node_conv = np.asarray(metrics.converged_at)
+    alive = np.asarray(final.alive)
+    unconverged = int(((node_conv < 0) & (alive == ALIVE)).sum())
+    heads = np.asarray(final.heads)
+    return {
+        "n_nodes": cfg.n_nodes,
+        "plan_seed": plan.seed,
+        "plan_horizon": plan.horizon,
+        "rounds": int(final.t),
+        "wall_clock_s": wall,
+        "converged": unconverged == 0 and bool((heads[:, 0] == cfg.n_versions).all()),
+        "unconverged_nodes": unconverged,
+        "p99_node_convergence_round": _percentile(node_conv, 99),
+    }
+
+
 def config_swim_churn_64(
     seed: int = 0, max_rounds: int = 400, n: int = 64
 ) -> Dict[str, float]:

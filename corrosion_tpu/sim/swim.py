@@ -106,9 +106,17 @@ def _dup_before(cand: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
 
 
 def _reachable(
-    state: SimState, topo: Topology, key: jax.Array, src: jnp.ndarray, dst: jnp.ndarray
+    state: SimState,
+    topo: Topology,
+    key: jax.Array,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    faults=None,
 ) -> jnp.ndarray:
-    """Ground-truth reachability of a probe message src→dst."""
+    """Ground-truth reachability of a probe message src→dst.  ``faults``
+    (sim/faults.py RoundFaults) adds directed FaultPlan cuts and extra
+    per-link loss; its key is fold_in-derived so the faults=None path
+    consumes RNG byte-identically to the pre-fault kernel."""
     ok = (
         (state.group[src] == state.group[dst])
         & (state.alive[src] == ALIVE)
@@ -116,11 +124,20 @@ def _reachable(
     )
     if topo.loss > 0:
         ok &= ~jax.random.bernoulli(key, topo.loss, src.shape)
+    if faults is not None:
+        ok &= ~faults.block[src, dst]
+        thr = faults.loss[src, dst]
+        bits = jax.random.bits(
+            jax.random.fold_in(jax.random.fold_in(key, faults.seed), 103),
+            src.shape, dtype=jnp.uint8,
+        )
+        ok &= ~(bits < thr)
     return ok
 
 
 def swim_step(
-    state: SimState, cfg: SimConfig, topo: Topology, key: jax.Array
+    state: SimState, cfg: SimConfig, topo: Topology, key: jax.Array,
+    faults=None,
 ) -> SimState:
     if cfg.swim_partial_view:
         from .pswim import pswim_step
@@ -143,7 +160,7 @@ def swim_step(
     target = sample_member_targets(state, cfg, k_probe, 1)[:, 0]
     do_probe = up & (state.t % cfg.probe_period_rounds == 0) & (target >= 0)
     target = jnp.maximum(target, 0)
-    direct = _reachable(state, topo, k_ploss, me, target)
+    direct = _reachable(state, topo, k_ploss, me, target, faults)
     # indirect probes through sampled believed-member relays (ping-req)
     relays = sample_member_targets(state, cfg, k_relay, cfg.indirect_probes)
     relay_ok = relays >= 0
@@ -151,11 +168,11 @@ def swim_step(
     hop_keys = jax.random.split(k_rloss, 2)
     leg1 = _reachable(
         state, topo, hop_keys[0],
-        jnp.repeat(me, cfg.indirect_probes), relays.reshape(-1),
+        jnp.repeat(me, cfg.indirect_probes), relays.reshape(-1), faults,
     ).reshape(n, cfg.indirect_probes)
     leg2 = _reachable(
         state, topo, hop_keys[1],
-        relays.reshape(-1), jnp.repeat(target, cfg.indirect_probes),
+        relays.reshape(-1), jnp.repeat(target, cfg.indirect_probes), faults,
     ).reshape(n, cfg.indirect_probes)
     indirect = (leg1 & leg2 & relay_ok).any(axis=1)
     acked = direct | indirect
@@ -191,7 +208,7 @@ def swim_step(
     gdst = g_targets.reshape(-1)
     g_valid = gdst >= 0
     gdst = jnp.maximum(gdst, 0)
-    g_ok = _reachable(state, topo, k_gloss, gsrc, gdst) & g_valid
+    g_ok = _reachable(state, topo, k_gloss, gsrc, gdst, faults) & g_valid
     g_ok &= view[gdst, gsrc] != DOWN  # receiver-side down filter
 
     belief_key = vinc.astype(jnp.int32) * 4 + view.astype(jnp.int32)  # [N, N]
@@ -212,7 +229,7 @@ def swim_step(
         stagger
         & up
         & (ann_target != me)
-        & _reachable(state, topo, k_aloss, me, ann_target)
+        & _reachable(state, topo, k_aloss, me, ann_target, faults)
     )
     self_claim = state.incarnation.astype(jnp.int32) * 4 + ALIVE
     merged = merged.at[ann_target, me].max(

@@ -124,6 +124,7 @@ def sync_step(
     cfg: SimConfig,
     topo: Topology,
     key: jax.Array,
+    faults=None,
 ) -> SimState:
     n, p = state.have.shape
     s = cfg.sync_peers
@@ -146,6 +147,17 @@ def sync_step(
     # paths (LinkModel marks bi streams reliable on the host tier too)
     ok &= due[src]
     ok &= dst != src
+    if faults is not None:
+        # a sync session is a BIDIRECTIONAL stream: an asymmetric cut in
+        # either direction refuses the session here, while one-way
+        # broadcast still flows in the hearing direction.  (The host
+        # tier is slightly more permissive: a bi stream OPENED from the
+        # unblocked side keeps flowing, like established TCP across a
+        # young one-way partition — doc/faults.md "tier coverage" pins
+        # the divergence; it only lets the host converge faster.)  Fault
+        # loss/delay don't bite here for the same reliable-bi reason as
+        # topology loss above.
+        ok &= ~faults.block[src, dst] & ~faults.block[dst, src]
 
     need = edge_needs(state, cfg, src, dst, regular_fanout=s) & ok[:, None]  # [E, P]
 

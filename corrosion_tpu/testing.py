@@ -68,6 +68,10 @@ class Cluster:
         self.use_swim = use_swim
         self.cluster_id = cluster_id
         self.addr_prefix = addr_prefix
+        # crashed node indices (FaultPlan campaigns): excluded from
+        # convergence checks until restarted
+        self.down: set = set()
+        self.configs: List[Config] = []
 
     async def start(self, extra_bootstrap: Optional[List[str]] = None):
         import random
@@ -95,6 +99,7 @@ class Cluster:
             agent = Agent(cfg, self.net.transport(addr))
             agent.store.execute_schema(self.schema)
             self.agents.append(agent)
+            self.configs.append(cfg)
         for agent in self.agents:
             await agent.start()
 
@@ -115,13 +120,50 @@ class Cluster:
         agent = Agent(cfg, self.net.transport(addr))
         agent.store.execute_schema(self.schema)
         self.agents.append(agent)
+        self.configs.append(cfg)
         self.n += 1
         await agent.start()
         return agent
 
+    async def crash_node(self, i: int) -> None:
+        """Take node i down hard (the kill -9 analog of the process
+        campaign): its transport leaves the network registry, so every
+        send to it fails, and `converged()` excludes it until restart."""
+        self.down.add(i)
+        await self.agents[i].stop()
+
+    async def restart_node(self, i: int, wipe: bool = False) -> Agent:
+        """Restart a crashed node on its original state dir.  With
+        ``wipe=True`` the durable state is deleted first, so the node
+        rejoins as a cold joiner with a FRESH actor identity (site_id
+        lives in the db) and must recover purely via anti-entropy —
+        the restore-onto-empty shape of the reference's backup
+        campaign."""
+        import glob
+        import os
+
+        assert i in self.down, f"node {i} is not down"
+        cfg = self.configs[i]
+        if wipe:
+            for path in glob.glob(cfg.db_path + "*"):
+                if os.path.isdir(path):
+                    import shutil
+
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.unlink(path)
+        addr = f"{self.addr_prefix}{i}"
+        agent = Agent(cfg, self.net.transport(addr))
+        agent.store.execute_schema(self.schema)
+        self.agents[i] = agent
+        await agent.start()
+        self.down.discard(i)
+        return agent
+
     async def stop(self):
-        for agent in self.agents:
-            await agent.stop()
+        for i, agent in enumerate(self.agents):
+            if i not in self.down:
+                await agent.stop()
         self.tmp.cleanup()
 
     def converged(self) -> bool:
@@ -130,8 +172,9 @@ class Cluster:
         plus NO partials at all: a complete-but-not-yet-applied partial
         is invisible to generate_sync (it advertises no gaps) but its
         data has not landed in the tables yet."""
+        live = [a for i, a in enumerate(self.agents) if i not in self.down]
         heads = {}
-        for agent in self.agents:
+        for agent in live:
             s = agent.sync_state()
             if s.need or s.partial_need:
                 return False
@@ -143,7 +186,7 @@ class Cluster:
                     return False
         # every node must know every writer's head
         writers = {a for a in heads}
-        for agent in self.agents:
+        for agent in live:
             s = agent.sync_state()
             for w in writers:
                 if w != agent.actor_id and s.heads.get(w) != heads[w]:

@@ -12,7 +12,16 @@ from typing import Optional
 class Backoff:
     """Iterator of sleep durations: decorrelated jitter between min and max.
 
-    next = min(max_s, uniform(min_s, prev * 3)), starting at min_s."""
+    next = min(max_s, uniform(min_s, prev * 3)), starting at min_s.
+
+    ``max_retries`` caps the number of draws: once spent, ``__next__``
+    raises StopIteration and :attr:`gave_up` turns True — the give-up
+    signal reconnect loops need to surface a terminal error instead of
+    iterating forever (a ``for`` over the backoff simply ends).
+    ``reset()`` — called when a connection/sync succeeds — restores both
+    the interval and the retry budget, so the cap bounds CONSECUTIVE
+    failures, not lifetime ones.  Draws come from the injected ``rng``
+    only, so a seeded ``random.Random`` replays the exact schedule."""
 
     def __init__(
         self,
@@ -20,20 +29,32 @@ class Backoff:
         max_s: float,
         factor: float = 3.0,
         rng: Optional[random.Random] = None,
+        max_retries: Optional[int] = None,
     ):
         self.min_s = min_s
         self.max_s = max_s
         self.factor = factor
         self._rng = rng or random.Random()
         self._prev = min_s
+        self.max_retries = max_retries
+        self.attempts = 0
+
+    @property
+    def gave_up(self) -> bool:
+        """True once the retry budget is spent (always False uncapped)."""
+        return self.max_retries is not None and self.attempts >= self.max_retries
 
     def reset(self):
         self._prev = self.min_s
+        self.attempts = 0
 
     def __iter__(self):
         return self
 
     def __next__(self) -> float:
+        if self.gave_up:
+            raise StopIteration
+        self.attempts += 1
         nxt = min(self.max_s, self._rng.uniform(self.min_s, self._prev * self.factor))
         self._prev = nxt
         return nxt

@@ -21,8 +21,10 @@ whole campaign is CI-sized (3 nodes, ~100 writes) but every process,
 socket, and CLI invocation is real.
 """
 
+import contextlib
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -34,6 +36,27 @@ import urllib.request
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The campaign seed threads through the environment so a failing
+# kill/restore schedule can be replayed exactly:
+#   CORRO_CAMPAIGN_SEED=1234 pytest tests/cluster/test_fault_campaign.py
+# The seed drives every schedule decision (victim choice, inter-phase
+# delays) via one random.Random — the FaultPlan reproducibility
+# discipline applied to the real-process tier.
+CAMPAIGN_SEED = int(os.environ.get("CORRO_CAMPAIGN_SEED", "0"))
+
+
+@contextlib.contextmanager
+def _phase(name: str, budget_s: float):
+    """Per-phase wall-clock guard: a hung node fails THIS phase fast
+    with a named error instead of eating the suite-wide watchdog."""
+    t0 = time.monotonic()
+    yield
+    elapsed = time.monotonic() - t0
+    assert elapsed < budget_s, (
+        f"campaign phase {name!r} took {elapsed:.1f}s (budget {budget_s}s) "
+        f"— seed {CAMPAIGN_SEED}"
+    )
 SCHEMA = """CREATE TABLE tests (
     id INTEGER PRIMARY KEY NOT NULL,
     text TEXT NOT NULL DEFAULT ''
@@ -139,11 +162,15 @@ def _wait(pred, timeout, what):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+@pytest.mark.chaos
 def test_fault_campaign_kill_restart_backup_restore():
-    # no pytest-timeout in this image; the conftest faulthandler watchdog
-    # (300 s dump-and-exit) bounds a wedged campaign
+    # no pytest-timeout in this image; per-phase _phase() guards fail a
+    # hung node fast, and the conftest faulthandler watchdog (300 s
+    # dump-and-exit) remains the backstop
     from corrosion_tpu.devcluster import DevCluster, Topology
 
+    rng = random.Random(CAMPAIGN_SEED)
+    print(f"campaign seed {CAMPAIGN_SEED} (set CORRO_CAMPAIGN_SEED to replay)")
     tmp = tempfile.TemporaryDirectory()
     schema_dir = os.path.join(tmp.name, "schema")
     os.makedirs(schema_dir)
@@ -159,54 +186,63 @@ def test_fault_campaign_kill_restart_backup_restore():
     }
     dc.start()
     try:
-        dc.wait_ready(45)
-        load = LoadGen(dc.nodes["A"].api_addr)
-        load.start()
+        with _phase("boot + initial load", 80):
+            dc.wait_ready(45)
+            load = LoadGen(dc.nodes["A"].api_addr)
+            load.start()
         try:
-            _wait(lambda: load.committed > 20, 30, "initial write load")
+            with _phase("initial write load", 35):
+                _wait(lambda: load.committed > 20, 30, "initial write load")
 
-            # -- phase 1: kill -9 B mid-storm, restart on same state dir
-            b = dc.nodes["B"]
-            b.proc.send_signal(signal.SIGKILL)
-            b.proc.wait(timeout=10)
-            time.sleep(1.5)  # writes continue against the degraded cluster
-            with open(os.path.join(b.state_dir, "node.log"), "a") as log:
-                b.proc = subprocess.Popen(
-                    [sys.executable, "-m", "corrosion_tpu.cli.main",
-                     "-c", cfg["B"], "agent"],
-                    stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+            # -- phase 1: kill -9 a seed-chosen victim mid-storm, restart
+            # on the same state dir.  A writes the load, so the victim is
+            # drawn from {B, C}; the restore phase targets the other.
+            kill_name, restore_name = rng.sample(["B", "C"], 2)
+            degraded_s = 0.5 + rng.random() * 1.5  # schedule jitter, seeded
+            with _phase(f"kill -9 {kill_name} + restart", 60):
+                b = dc.nodes[kill_name]
+                b.proc.send_signal(signal.SIGKILL)
+                b.proc.wait(timeout=10)
+                time.sleep(degraded_s)  # writes continue against the degraded cluster
+                with open(os.path.join(b.state_dir, "node.log"), "a") as log:
+                    b.proc = subprocess.Popen(
+                        [sys.executable, "-m", "corrosion_tpu.cli.main",
+                         "-c", cfg[kill_name], "agent"],
+                        stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+                    )
+                _wait(
+                    lambda: b.proc.poll() is None and load.committed > 40,
+                    30, f"restarted {kill_name} + more load",
                 )
-            _wait(
-                lambda: b.proc.poll() is None and load.committed > 40,
-                30, "restarted B + more load",
-            )
 
-            # -- phase 2: backup A under load, restore onto stopped C
-            backup_path = os.path.join(tmp.name, "a.backup.db")
-            _cli(cfg["A"], "backup", backup_path)
-            c = dc.nodes["C"]
-            c.proc.send_signal(signal.SIGTERM)
-            c.proc.wait(timeout=15)
-            _cli(cfg["C"], "restore", backup_path)
-            with open(os.path.join(c.state_dir, "node.log"), "a") as log:
-                c.proc = subprocess.Popen(
-                    [sys.executable, "-m", "corrosion_tpu.cli.main",
-                     "-c", cfg["C"], "agent"],
-                    stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+            # -- phase 2: backup A under load, restore onto the other node
+            with _phase(f"backup A → restore {restore_name}", 90):
+                backup_path = os.path.join(tmp.name, "a.backup.db")
+                _cli(cfg["A"], "backup", backup_path)
+                c = dc.nodes[restore_name]
+                c.proc.send_signal(signal.SIGTERM)
+                c.proc.wait(timeout=15)
+                _cli(cfg[restore_name], "restore", backup_path)
+                with open(os.path.join(c.state_dir, "node.log"), "a") as log:
+                    c.proc = subprocess.Popen(
+                        [sys.executable, "-m", "corrosion_tpu.cli.main",
+                         "-c", cfg[restore_name], "agent"],
+                        stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+                    )
+                _wait(
+                    lambda: c.proc.poll() is None and load.committed > 60,
+                    30, f"restored {restore_name} + more load",
                 )
-            _wait(
-                lambda: c.proc.poll() is None and load.committed > 60,
-                30, "restored C + more load",
-            )
         finally:
             load.stop()
 
         assert load.committed > 60, (load.committed, load.errors)
         # -- eventual checker: the check_bookkeeping property
-        _wait(
-            lambda: _cluster_converged(list(cfg.values())),
-            90, "cluster-wide need==0 ∧ equal heads",
-        )
+        with _phase("eventual convergence checker", 95):
+            _wait(
+                lambda: _cluster_converged(list(cfg.values())),
+                90, "cluster-wide need==0 ∧ equal heads",
+            )
         # eventually_check_db analog: every node holds every write
         counts = {n: _query_count(cfg[n]) for n in cfg}
         assert len(set(counts.values())) == 1, counts

@@ -1,0 +1,182 @@
+"""Ground truth under faults (ISSUE 2 tentpole cap): ONE FaultPlan —
+one seed, one schedule — runs against BOTH backends of the transport
+seam:
+
+- a 3-node in-process host cluster (`testing.Cluster` on a
+  `MemoryNetwork`), driven by `HostFaultDriver`;
+- the 3-node tpu-sim, via `sim.faults.compile_plan` + the checked
+  driver (sim invariant catalog asserted every round).
+
+Both must converge, the eventual heads must match (every node's head
+for the writer equals the number of versions written — the ground
+truth a dropped write or phantom would break), the invariant catalog
+runs in strict mode throughout (conftest turns it on), and every
+`sometimes` marker the campaign declares must fire — 100% coverage,
+scoped to the campaign window.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.faults import (
+    CampaignCoverage,
+    FaultEvent,
+    FaultPlan,
+    HostFaultDriver,
+)
+from corrosion_tpu.invariants import CATALOG
+from corrosion_tpu.testing import Cluster
+
+N_VERSIONS = 12
+ROUND_S = 0.05
+
+
+def parity_plan(seed: int = 7) -> FaultPlan:
+    """The shared adversarial schedule.  Node 0 is the writer, so the
+    crash victim is node 2 (a reader): its identity can change at wipe
+    without perturbing the writer-head ground truth."""
+    return FaultPlan(
+        n_nodes=3, seed=seed, round_s=ROUND_S,
+        events=(
+            FaultEvent("loss", 0, 36, p=0.4),
+            # asymmetric partition: 2 still hears 0, but 2→0 is cut
+            FaultEvent("partition", 6, 18, src=2, dst=0),
+            FaultEvent("delay", 4, 24, src=0, dst=1, delay_rounds=1),
+            FaultEvent("jitter", 4, 24, src=0, dst=1, delay_rounds=1),
+            FaultEvent("duplicate", 0, 24, src=1, dst=2, p=0.3),
+            FaultEvent("crash", 24, 34, node=2, wipe=True),
+            # +100 ms skew: inside the HLC 300 ms drift ceiling, so
+            # convergence must survive it (host tier only; sim has no clock)
+            FaultEvent("clock_skew", 0, 36, node=1, skew_ns=100_000_000),
+        ),
+    )
+
+
+def run_host_campaign(plan: FaultPlan) -> dict:
+    """Host tier: write N_VERSIONS on node 0 while the driver replays
+    the schedule; after the horizon, wait for check_bookkeeping
+    convergence and return the eventual writer heads."""
+
+    async def body():
+        cluster = Cluster(plan.n_nodes, use_swim=False)
+        await cluster.start()
+        try:
+            driver = HostFaultDriver(plan, cluster)
+            drive = asyncio.ensure_future(driver.run())
+            writer = cluster.agents[0]
+            writer_id = writer.actor_id
+            for i in range(N_VERSIONS):
+                writer.exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"v{i}"))]
+                )
+                await asyncio.sleep(plan.round_s)
+            await drive
+            assert not cluster.down  # every crash was restarted
+            assert await cluster.wait_converged(60), "host tier never converged"
+            heads = [
+                a.sync_state().heads.get(writer_id, 0) for a in cluster.agents
+            ]
+            rows = [
+                cluster.rows(i, "SELECT count(*) FROM tests")[0][0]
+                for i in range(plan.n_nodes)
+            ]
+            return {"heads": heads, "rows": rows, "log": list(driver.log)}
+        finally:
+            await cluster.stop()
+
+    return asyncio.run(body())
+
+
+def run_sim_campaign(plan: FaultPlan) -> dict:
+    """Sim tier via the jitted driver (one compile; the replay run hits
+    the jit cache, so determinism costs ~nothing).  The final state
+    passes the sim invariant catalog; the per-ROUND invariant sweep
+    under faults is pinned by tests/sim/test_fault_plan.py's
+    crash-rejoin test, which drives the same seam eagerly."""
+    from corrosion_tpu.sim.faults import compile_plan, run_fault_plan
+    from corrosion_tpu.sim.invariants import check_state
+    from corrosion_tpu.sim.round import new_sim
+    from corrosion_tpu.sim.state import ALIVE, SimConfig, uniform_payloads
+    from corrosion_tpu.sim.topology import Topology
+
+    cfg = SimConfig(
+        n_nodes=plan.n_nodes, n_payloads=N_VERSIONS, fanout=2,
+        sync_interval_rounds=4, n_delay_slots=4,
+    )
+    meta = uniform_payloads(cfg, inject_every=1)  # writer is node 0
+    fplan = compile_plan(plan, cfg, Topology())
+    final, metrics = run_fault_plan(
+        new_sim(cfg, seed=plan.seed), meta, cfg, Topology(), fplan, 400
+    )
+    check_state(final, cfg)
+    assert (np.asarray(final.alive) == ALIVE).all()
+    assert (np.asarray(final.have) > 0).all(), "sim tier never converged"
+    return {
+        "heads": [int(h) for h in np.asarray(final.heads)[:, 0]],
+        "have": np.asarray(final.have).copy(),
+        "rounds": int(final.t),
+    }
+
+
+@pytest.mark.chaos
+def test_fault_plan_parity_host_vs_sim():
+    plan = parity_plan()
+    expected = plan.coverage_markers() + ["broadcasts-happen", "sync-happens"]
+    assert CATALOG.strict  # the campaign must run with teeth
+    with CampaignCoverage(expected) as cov:
+        host = run_host_campaign(plan)
+        sim = run_sim_campaign(plan)
+        # replay: the SAME plan seed reproduces identical per-round sim
+        # fault decisions — the second run rides the jit cache, and any
+        # divergent decision anywhere in the run would change the final
+        # chunk bitmap (the host tier's per-draw replay is pinned by
+        # tests/agent/test_link_determinism.py — wall-clock timing makes
+        # whole-campaign bit-replay meaningless for real agents)
+        sim2 = run_sim_campaign(plan)
+
+    # -- eventual heads match: every node, both tiers, one ground truth
+    assert host["heads"] == [N_VERSIONS] * plan.n_nodes, host
+    assert sim["heads"] == [N_VERSIONS] * plan.n_nodes, sim
+    assert set(host["rows"]) == {N_VERSIONS}, host
+    assert (sim2["have"] == sim["have"]).all() and sim2["rounds"] == sim["rounds"]
+
+    # -- 100% sometimes coverage over the campaign, reported
+    cov.assert_covered()
+    print(
+        f"fault parity: heads={N_VERSIONS} on both tiers, sim rounds="
+        f"{sim['rounds']}, sometimes coverage {cov.coverage():.0%} "
+        f"({len(cov.expected)} markers)"
+    )
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_host_tier():
+    """Tier-1-sized host smoke (3 nodes, ≤5 s): a loss burst + short
+    asymmetric partition, then convergence — the in-default-selection
+    FaultPlan regression canary."""
+    plan = FaultPlan(
+        n_nodes=3, seed=1, round_s=0.04,
+        events=(
+            FaultEvent("loss", 0, 10, p=0.3),
+            FaultEvent("partition", 2, 8, src=1, dst=0),
+        ),
+    )
+
+    async def body():
+        cluster = Cluster(3, use_swim=False)
+        await cluster.start()
+        try:
+            driver = HostFaultDriver(plan, cluster)
+            drive = asyncio.ensure_future(driver.run())
+            for i in range(5):
+                cluster.agents[0].exec_transaction(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, "x"))]
+                )
+            await drive
+            assert await cluster.wait_converged(10)
+        finally:
+            await cluster.stop()
+
+    asyncio.run(body())

@@ -1,0 +1,165 @@
+"""FaultPlan on the sim tier: schedule compilation, replay determinism,
+crash-with-state-wipe rejoin under the sim invariant catalog, and the
+tier-1-sized chaos smoke (ISSUE 2)."""
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.faults import FaultEvent, FaultPlan, derive_seed
+from corrosion_tpu.sim.faults import (
+    compile_plan,
+    run_fault_plan,
+    run_fault_plan_checked,
+)
+from corrosion_tpu.sim.round import new_sim
+from corrosion_tpu.sim.state import ALIVE, DOWN, SimConfig, uniform_payloads
+from corrosion_tpu.sim.topology import Topology
+
+
+def _cfg(n_payloads=8, **kw):
+    kw.setdefault("n_delay_slots", 4)
+    return SimConfig(n_nodes=3, n_payloads=n_payloads, fanout=2,
+                     sync_interval_rounds=4, **kw)
+
+
+def _plan(seed=3):
+    return FaultPlan(
+        n_nodes=3, seed=seed,
+        events=(
+            FaultEvent("loss", 0, 30, p=0.4),
+            # asymmetric: node 2 still HEARS node 0, but 2→0 is cut
+            FaultEvent("partition", 5, 20, src=2, dst=0),
+            FaultEvent("delay", 4, 24, src=0, dst=1, delay_rounds=1),
+            FaultEvent("jitter", 4, 24, src=0, dst=1, delay_rounds=1),
+            FaultEvent("duplicate", 0, 20, src=1, dst=2, p=0.3),
+            FaultEvent("crash", 22, 30, node=2, wipe=True),
+            FaultEvent("clock_skew", 0, 30, node=1, skew_ns=100_000_000),
+        ),
+    )
+
+
+def test_schedule_is_pure_and_deterministic():
+    """plan.schedule() is the single source of truth both compilers
+    consume: two expansions are equal, and derive_seed is process-stable
+    (a salted hash() here would break cross-run replay)."""
+    p = _plan()
+    assert p.schedule() == p.schedule()
+    assert p.horizon == 31
+    # blake2b derivation: fixed value, distinct per token path
+    assert derive_seed(3, "link", 0, 1) == derive_seed(3, "link", 0, 1)
+    assert derive_seed(3, "link", 0, 1) != derive_seed(3, "link", 1, 0)
+    assert derive_seed(3, "link", 0, 1) != derive_seed(4, "link", 0, 1)
+    # epoch table: the asymmetric partition appears only in the 2→0 slot
+    epochs = p.link_epochs()
+    assert any(f.blocked for _, f in epochs[(2, 0)])
+    assert not any(f.blocked for _, f in epochs.get((0, 2), []))
+
+
+def test_compile_plan_lowers_schedule_to_tensors():
+    cfg = _cfg()
+    fp = compile_plan(_plan(), cfg)
+    assert fp.block.shape == (32, 3, 3)
+    blk = np.asarray(fp.block)
+    assert blk[10, 2, 0] and not blk[10, 0, 2]  # asymmetric
+    assert not blk[25].any()  # partition healed
+    loss = np.asarray(fp.loss)
+    assert loss[0, 0, 1] == round(0.4 * 256)
+    assert np.asarray(fp.delay)[10, 0, 1] == 1
+    assert np.asarray(fp.jitter)[10, 0, 1] == 1
+    alive = np.asarray(fp.alive)
+    assert alive[22, 2] == DOWN and alive[29, 2] == DOWN
+    assert alive[30, 2] == ALIVE  # restart round
+    assert np.asarray(fp.wipe)[30, 2]
+    assert not np.asarray(fp.block)[31].any()  # final row: all clear
+    # near-certain loss cannot ride the u8 threshold: compiles to a cut
+    hard = FaultPlan(3, 1, (FaultEvent("loss", 0, 4, src=0, dst=1, p=1.0),))
+    assert np.asarray(compile_plan(hard, cfg).block)[1, 0, 1]
+
+
+def test_compile_rejects_delay_overflowing_the_ring():
+    """A fault delay the inflight ring can't represent would deliver
+    EARLY, silently — compile must refuse (round.validate's contract)."""
+    plan = FaultPlan(
+        3, 0, (FaultEvent("delay", 0, 4, delay_rounds=6),)
+    )
+    with pytest.raises(ValueError, match="n_delay_slots"):
+        compile_plan(plan, _cfg(), Topology())
+    # partial-view SWIM doesn't carry the fault seam yet: a campaign
+    # whose probes ignore partitions would report silently-wrong
+    # convergence, so compile refuses (ROADMAP open item)
+    ok_plan = FaultPlan(3, 0, (FaultEvent("loss", 0, 4, p=0.1),))
+    with pytest.raises(ValueError, match="partial-view"):
+        compile_plan(ok_plan, _cfg(swim_partial_view=True), Topology())
+
+
+def test_fault_run_replays_identical_per_round_decisions():
+    """The replay-determinism acceptance: same seed → identical
+    per-round fault decisions and state evolution, different seed →
+    different trajectory."""
+    cfg = _cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    # 40 rounds cover every scheduled fault; digests don't need
+    # convergence, and capping keeps the eager loop tier-1-cheap
+    runs = []
+    for _ in range(2):
+        state = new_sim(cfg, seed=11)
+        _, _, digests = run_fault_plan_checked(
+            _plan(), state, meta, cfg, max_rounds=40, check_every=8
+        )
+        runs.append(digests)
+    assert runs[0] == runs[1]
+    other = run_fault_plan_checked(
+        _plan(seed=99), new_sim(cfg, seed=11), meta, cfg, max_rounds=40,
+        check_every=8,
+    )[2]
+    assert other != runs[0]
+
+
+def test_crash_with_state_wipe_rejoins_via_anti_entropy():
+    """ISSUE 2 satellite: a node goes DOWN mid-storm, loses its `have`
+    rows at restart, and recovers purely through anti-entropy sync —
+    with the sim invariant catalog (no-phantom-data, bookkeeping-heads,
+    bookkeeping-gaps, relay-budget) asserted EVERY round by the checked
+    driver."""
+    cfg = _cfg(n_payloads=12)
+    meta = uniform_payloads(cfg, inject_every=1)  # writer is node 0
+    plan = FaultPlan(
+        n_nodes=3, seed=5,
+        events=(FaultEvent("crash", 8, 20, node=2, wipe=True),),
+    )
+    state = new_sim(cfg, seed=2)
+    final, metrics, _ = run_fault_plan_checked(
+        plan, state, meta, cfg, max_rounds=300, check_every=1
+    )
+    have = np.asarray(final.have)
+    heads = np.asarray(final.heads)
+    assert (np.asarray(final.alive) == ALIVE).all()
+    # the wiped node holds EVERY version again, purely via sync (its
+    # relay budgets were zeroed, so rebroadcast can't have self-served it
+    # — sync-received payloads carry no budget)
+    assert (have[2] > 0).all()
+    assert (heads[:, 0] == cfg.n_versions).all()
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_sim_tier():
+    """Tier-1-sized FaultPlan smoke (3 nodes, well under 5 s): converge
+    under a loss burst + short asymmetric partition.  Eager driver — the
+    jitted `run_fault_plan` is exercised by the parity campaign
+    (tests/cluster/test_fault_parity.py); paying a second XLA compile
+    here would bust the smoke's 5 s budget for no extra coverage."""
+    cfg = _cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    plan = FaultPlan(
+        n_nodes=3, seed=1,
+        events=(
+            FaultEvent("loss", 0, 10, p=0.3),
+            FaultEvent("partition", 2, 8, src=1, dst=0),
+        ),
+    )
+    final, _, _ = run_fault_plan_checked(
+        plan, new_sim(cfg, seed=0), meta, cfg, max_rounds=120, check_every=8
+    )
+    assert int(final.t) >= plan.horizon  # no early exit inside the schedule
+    assert (np.asarray(final.have) > 0).all()
+    assert (np.asarray(final.heads)[:, 0] == cfg.n_versions).all()
