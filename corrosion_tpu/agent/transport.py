@@ -164,10 +164,20 @@ class FaultInjector(LinkModel):
     - ``loss``: drop probability for datagram/uni payloads (bi streams
       stay reliable once open, like TCP under real packet loss)
     - ``latency_s``: added delay before every send
+    - ``links``: per-DESTINATION LinkModel overrides — the compile
+      target of `faults.RealSocketFaultDriver`, which installs one
+      seed-derived stream per directed edge (``derive_seed(seed,
+      "link", src, dst, epoch)`` — the SAME derivation the host tier's
+      `MemoryNetwork` and the sim compiler use), so a FaultPlan replays
+      the exact per-draw decisions on real sockets too.  The injector's
+      own loss/latency fields stay the default for unlisted peers.
     """
 
     blocked_peers: set = field(default_factory=set)
     dropped: int = 0  # counter for test assertions
+    # per-destination LinkModel streams (addr -> model); each carries
+    # its OWN seeded RNG so edges never share a stream
+    links: Dict[str, LinkModel] = field(default_factory=dict)
     # wired by install_faults: severs the transport's established conns
     # whenever the partition set grows
     _sever_cb: Optional[Callable[[], None]] = None
@@ -177,8 +187,25 @@ class FaultInjector(LinkModel):
         if self._sever_cb is not None:
             self._sever_cb()
 
+    def set_partition(self, addrs) -> None:
+        """Replace the blocked-peer set wholesale (the per-round driver
+        path); severs established conns only when NEW edges appear —
+        healing must not cut surviving connections."""
+        addrs = set(addrs)
+        grew = bool(addrs - self.blocked_peers)
+        self.blocked_peers = addrs
+        if grew and self._sever_cb is not None:
+            self._sever_cb()
+
     def heal(self) -> None:
         self.blocked_peers.clear()
+
+    def _link(self, addr: Optional[str]) -> LinkModel:
+        if addr is not None:
+            lm = self.links.get(addr)
+            if lm is not None:
+                return lm
+        return self
 
     def blocks(self, addr: str) -> bool:
         if "*" in self.blocked_peers or addr in self.blocked_peers:
@@ -186,15 +213,22 @@ class FaultInjector(LinkModel):
             return True
         return False
 
-    def drops(self) -> bool:
-        if self.drop():  # LinkModel's seeded loss
+    def drops(self, addr: Optional[str] = None) -> bool:
+        if self._link(addr).drop():  # seeded loss (per-dst stream first)
             self.dropped += 1
             return True
         return False
 
-    async def apply_delay(self) -> None:
-        if self.latency_s > 0:
-            await asyncio.sleep(self.latency_s)
+    def dups(self, addr: Optional[str] = None) -> bool:
+        return self._link(addr).dup()
+
+    def delay_for(self, addr: Optional[str] = None) -> float:
+        return self._link(addr).delay_s()
+
+    async def apply_delay(self, addr: Optional[str] = None) -> None:
+        d = self.delay_for(addr)
+        if d > 0:
+            await asyncio.sleep(d)
 
 
 class Transport:
@@ -679,11 +713,20 @@ class UdpTcpTransport(Transport):
                     raise
 
     async def send_datagram(self, addr: str, data: bytes) -> None:
+        dup = False
         if self.faults is not None:
             # UDP semantics: partitioned/lost datagrams vanish silently
-            if self.faults.blocks(addr) or self.faults.drops():
+            if self.faults.blocks(addr) or self.faults.drops(addr):
                 return
-            await self.faults.apply_delay()
+            dup = self.faults.dups(addr)
+            await self.faults.apply_delay(addr)
+        if dup:
+            # modeled duplication: the datagram arrives twice (the
+            # receiver's dedup/idempotency must absorb it)
+            await self._send_datagram_raw(addr, data)
+        await self._send_datagram_raw(addr, data)
+
+    async def _send_datagram_raw(self, addr: str, data: bytes) -> None:
         if self.tls:
             # SWIM rides the encrypted stream: plaintext UDP would leak
             # membership traffic QUIC encrypts in the reference.  The
@@ -723,19 +766,25 @@ class UdpTcpTransport(Transport):
         task.add_done_callback(self._tasks.discard)
 
     async def send_uni(self, addr: str, data: bytes) -> None:
+        dup = False
         if self.faults is not None:
             if self.faults.blocks(addr):
                 raise ConnectionError(f"fault injection: {addr} partitioned")
-            if self.faults.drops():
+            if self.faults.drops(addr):
                 return  # modeled payload loss: frame never delivered
-            await self.faults.apply_delay()
+            dup = self.faults.dups(addr)
+            await self.faults.apply_delay(addr)
+        if dup:
+            await self._send_frame(addr, self.KIND_UNI, data)
         await self._send_frame(addr, self.KIND_UNI, data)
 
     async def open_bi(self, addr: str) -> BiStream:
         if self.faults is not None:
             if self.faults.blocks(addr):
                 raise ConnectionError(f"fault injection: {addr} partitioned")
-            await self.faults.apply_delay()
+            # bi streams are reliable (no loss/dup), but fault latency
+            # delays session establishment like any other send
+            await self.faults.apply_delay(addr)
         reader, writer = await self._connect(addr)
         writer.write(self.TAG_BI)
         await writer.drain()

@@ -1,0 +1,18 @@
+"""Declarative experiment campaigns over the tpu-sim (ISSUE 3).
+
+- :mod:`.spec` — `CampaignSpec`: scenario × topology × FaultPlan ×
+  parameter grid × seed set, content-hashed for replay identity;
+- :mod:`.ensemble` — vmapped on-device seed ensembles (K fault-plan
+  replicas as ONE XLA program, each lane byte-identical to its solo
+  run);
+- :mod:`.engine` — grid expansion, wall budgeting, resumable JSON
+  artifacts, optional host-tier parity points;
+- :mod:`.report` — p50/p95/p99 convergence bands + baseline compare
+  with a pass/regress verdict.
+
+CLI surface: ``sim campaign run|compare`` (`corrosion_tpu.cli.main`).
+Heavy imports (jax, the sim stack) stay inside functions so the spec
+layer loads without an accelerator runtime.
+"""
+
+from .spec import BUILTIN_SPECS, CampaignSpec, builtin_spec, load_spec, save_spec  # noqa: F401

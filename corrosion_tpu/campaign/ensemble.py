@@ -1,0 +1,106 @@
+"""On-device vmapped seed ensembles: K replicas as ONE XLA program.
+
+The TPU is exactly the hardware where running 32 seeds costs barely
+more than one: the round kernel is already jitted over the whole
+cluster, so `jax.vmap` over a leading seed axis turns K independent
+fault-plan replicas into one batched while_loop — per-round HBM traffic
+scales with K but dispatch, compile, and host round-trips don't.
+
+**Sequential-equivalence guarantee**: each vmapped lane is byte-
+identical to the single-seed run of the same scenario
+(`tests/campaign/test_ensemble.py` pins it).  Why it holds:
+
+- lane state is built by exactly the single-run constructor
+  (`new_sim(cfg, seed)`) and stacked;
+- the fault schedule tensors are seed-independent (they lower the
+  event table), so lanes SHARE them unbatched — only the i32 plan-seed
+  scalar is batched (`in_axes` maps just ``SimFaultPlan.seed``), which
+  is what "per-seed RoundFaults compiled batch-first" means: one
+  [R+1, N, N] schedule in HBM, K seed scalars;
+- `lax.while_loop` under vmap keeps finished lanes frozen via select
+  masking, so a lane's final carry equals its solo-run fixpoint;
+- every RNG draw inside the round is a pure function of the lane's key
+  (threefry is elementwise in the key), so batching can't cross lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..faults import FaultPlan, derive_seed
+from ..sim.faults import SimFaultPlan, compile_plan, run_fault_plan
+from ..sim.round import RunMetrics, new_sim, run_to_convergence
+from ..sim.state import PayloadMeta, SimConfig, SimState
+from ..sim.topology import Topology
+
+
+def seed_states(cfg: SimConfig, seeds: Sequence[int]) -> SimState:
+    """Stack K single-run initial states along a new leading lane axis
+    (the byte-identity anchor: lane k IS ``new_sim(cfg, seeds[k])``)."""
+    states = [new_sim(cfg, int(s)) for s in seeds]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def lane_plan_seeds(seeds: Sequence[int]) -> jnp.ndarray:
+    """i32[K] per-lane sim fault-stream seeds — the SAME derivation
+    `compile_plan` applies to a single plan (``derive_seed(seed,
+    "sim")``), so lane k's fault draws equal a solo run of the plan
+    re-seeded with ``seeds[k]``."""
+    return jnp.asarray(
+        [derive_seed(int(s), "sim") & 0x7FFFFFFF for s in seeds],
+        jnp.int32,
+    )
+
+
+def run_ensemble(
+    states: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    fplan: Optional[SimFaultPlan] = None,
+    plan_seeds: Optional[jnp.ndarray] = None,
+    max_rounds: int = 1000,
+) -> Tuple[SimState, RunMetrics]:
+    """Run every lane to convergence (or ``max_rounds``) in one batched
+    program.  ``fplan`` holds the shared schedule tensors; ``plan_seeds``
+    (i32[K]) re-seeds each lane's fault streams.  Without a plan the
+    lanes ride `run_to_convergence` (packed dispatch included — the
+    batch rule vmaps whichever path the scenario compiles to)."""
+    if fplan is None:
+        return jax.vmap(
+            lambda st: run_to_convergence(st, meta, cfg, topo, max_rounds)
+        )(states)
+    if plan_seeds is None:
+        plan_seeds = jnp.broadcast_to(fplan.seed, states.t.shape)
+    # batch ONLY the plan-seed scalar; the schedule tensors stay shared
+    lane_axes = SimFaultPlan(
+        block=None, loss=None, delay=None, jitter=None, alive=None,
+        wipe=None, seed=0,
+    )
+    return jax.vmap(
+        lambda st, fp: run_fault_plan(st, meta, cfg, topo, fp, max_rounds),
+        in_axes=(0, lane_axes),
+    )(states, fplan._replace(seed=plan_seeds))
+
+
+def run_seed_ensemble(
+    plan: Optional[FaultPlan],
+    cfg: SimConfig,
+    topo: Topology,
+    meta: PayloadMeta,
+    seeds: Sequence[int],
+    max_rounds: int = 1000,
+) -> Tuple[SimState, RunMetrics]:
+    """Convenience wrapper: seeds → stacked states (+ per-lane plan
+    seeds when a plan is given) → one vmapped run."""
+    states = seed_states(cfg, seeds)
+    if plan is None:
+        return run_ensemble(states, meta, cfg, topo, max_rounds=max_rounds)
+    fplan = compile_plan(plan, cfg, topo)
+    return run_ensemble(
+        states, meta, cfg, topo, fplan=fplan,
+        plan_seeds=lane_plan_seeds(seeds), max_rounds=max_rounds,
+    )

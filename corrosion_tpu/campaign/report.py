@@ -1,0 +1,156 @@
+"""Convergence regression bands: the campaign's verdict machinery.
+
+A band is the cross-seed distribution summary (p50/p95/p99/min/max) of
+a per-seed metric — "rounds to convergence" is the headline one, the
+north star being a p99.  `compare` holds a candidate artifact against a
+stored baseline: a cell **regresses** when its band worsens beyond the
+tolerance envelope (fractional + absolute slack — seed ensembles are
+discrete round counts, so a ±1-round wobble at p99 must not page
+anyone); it **passes** otherwise, and a candidate re-run of the SAME
+spec hash must report zero regressions (band equality is exact under
+replay — every lane is deterministic; the acceptance gate).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .spec import canonical_json, content_hash
+
+#: per-seed metrics that band + regression-compare (higher = worse)
+BAND_METRICS = ("rounds", "p99_node_convergence_round")
+#: artifact keys excluded from the result digest (vary run to run
+#: without changing the campaign's *outcome*: walls are measurements,
+#: and host-tier parity points ride real wall-clock scheduling)
+NONDETERMINISTIC_KEYS = (
+    "wall_clock_s", "wall_defensible_s", "wall_verdict", "walls",
+    "host_parity",
+)
+
+
+def bands(values) -> Dict[str, float]:
+    """Distribution summary of one per-seed metric vector.  Percentiles
+    use the 'lower' interpolation so a band is always an OBSERVED value
+    (round counts stay integers and replay-exact).  None/NaN entries
+    (lanes with no signal, e.g. nothing converged) are excluded; an
+    all-None vector yields an all-None band, which `compare` treats as
+    worse than any observed baseline."""
+    arr = np.asarray(
+        [v for v in np.asarray(values, dtype=float) if np.isfinite(v)]
+    )
+    if arr.size == 0:
+        return {"p50": None, "p95": None, "p99": None, "min": None,
+                "max": None, "mean": None}
+    return {
+        "p50": float(np.percentile(arr, 50, method="lower")),
+        "p95": float(np.percentile(arr, 95, method="lower")),
+        "p99": float(np.percentile(arr, 99, method="lower")),
+        "min": float(arr.min()),
+        "max": float(arr.max()),
+        "mean": float(arr.mean()),
+    }
+
+
+def _strip_nondeterministic(cell: Dict) -> Dict:
+    return {
+        k: v for k, v in cell.items() if k not in NONDETERMINISTIC_KEYS
+    }
+
+
+def artifact_digest(cells: List[Dict]) -> str:
+    """Replay identity of a campaign's RESULTS: the blake2b fold over
+    the deterministic cell payloads.  Re-running the same spec hash must
+    reproduce this digest exactly (tests/campaign pins it)."""
+    return content_hash(
+        [_strip_nondeterministic(c) for c in cells], digest_size=16
+    )
+
+
+def _cell_key(cell: Dict) -> str:
+    return canonical_json(cell.get("params", {}))
+
+
+def compare(
+    baseline: Dict,
+    candidate: Dict,
+    tol_frac: float = 0.10,
+    tol_abs: float = 2.0,
+    metrics=BAND_METRICS,
+    quantiles=("p50", "p95", "p99"),
+) -> Dict:
+    """Hold ``candidate`` against ``baseline`` (both artifacts from
+    `engine.run_campaign`).  Returns a report with per-cell band deltas
+    and an overall ``verdict``: "pass" | "regress".
+
+    Regression rule per (cell, metric, quantile): candidate band value
+    > baseline · (1 + tol_frac) + tol_abs.  Cells present in baseline
+    but missing/skipped in candidate are regressions (a budget-starved
+    re-run must not silently pass); extra candidate cells are reported
+    but don't fail.
+    """
+    base_cells = {_cell_key(c): c for c in baseline.get("cells", [])}
+    cand_cells = {_cell_key(c): c for c in candidate.get("cells", [])}
+    report: Dict[str, object] = {
+        "baseline_spec_hash": baseline.get("spec_hash"),
+        "candidate_spec_hash": candidate.get("spec_hash"),
+        "same_spec": baseline.get("spec_hash") == candidate.get("spec_hash"),
+        "identical_results": (
+            baseline.get("result_digest") is not None
+            and baseline.get("result_digest") == candidate.get("result_digest")
+        ),
+        "cells": [],
+        "regressions": [],
+        "missing_cells": [],
+        "extra_cells": sorted(set(cand_cells) - set(base_cells)),
+    }
+    for key, base in base_cells.items():
+        cand = cand_cells.get(key)
+        if cand is None:
+            report["missing_cells"].append(key)
+            continue
+        entry = {"params": base.get("params", {}), "deltas": {}}
+        for m in metrics:
+            b = base.get("bands", {}).get(m)
+            c = cand.get("bands", {}).get(m)
+            if not b or not c:
+                continue
+            for q in quantiles:
+                bv, cv = b.get(q), c.get(q)
+                if bv is None and cv is None:
+                    worse, delta = False, None
+                elif cv is None:
+                    # the candidate lost the signal entirely (e.g. no
+                    # lane converged): worse than any observed baseline
+                    worse, delta = True, None
+                elif bv is None:
+                    worse, delta = False, None  # candidate gained signal
+                else:
+                    delta = cv - bv
+                    worse = cv > bv * (1.0 + tol_frac) + tol_abs
+                entry["deltas"][f"{m}.{q}"] = {
+                    "baseline": bv, "candidate": cv, "delta": delta,
+                    "regressed": bool(worse),
+                }
+                if worse:
+                    report["regressions"].append(
+                        {"cell": key, "metric": f"{m}.{q}",
+                         "baseline": bv, "candidate": cv}
+                    )
+        # a cell that converged in baseline but not in candidate is a
+        # regression regardless of its round bands
+        if base.get("all_converged", True) and not cand.get(
+            "all_converged", True
+        ):
+            report["regressions"].append(
+                {"cell": key, "metric": "all_converged",
+                 "baseline": True, "candidate": False}
+            )
+        report["cells"].append(entry)
+    report["verdict"] = (
+        "pass"
+        if not report["regressions"] and not report["missing_cells"]
+        else "regress"
+    )
+    return report

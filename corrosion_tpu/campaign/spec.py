@@ -1,0 +1,270 @@
+"""CampaignSpec: a declarative, serializable, content-hashed experiment.
+
+A campaign is scenario × topology × FaultPlan events × parameter grid ×
+seed set — everything a run needs, and NOTHING the run derives (walls,
+bands, artifacts live in the engine's output).  The spec serializes to
+canonical JSON and its blake2b fold is the campaign's **replay
+identity**: two specs with the same hash must produce byte-identical
+per-seed trajectories (the per-lane RNG and fault streams all derive
+from the spec's seeds — `tests/campaign` pins it), so the BENCH_*.json
+lineage becomes machine-checkable instead of folklore.
+
+Seed derivation: lane seed ``s`` drives BOTH the scenario PRNG
+(``new_sim(cfg, s)``) and the lane's FaultPlan seed (``replace(plan,
+seed=s)``), whose sim stream is ``derive_seed(s, "sim")`` — the same
+rule the host tier (``derive_seed(s, "link", src, dst, epoch)``) and
+the real-socket tier use, so one campaign seed set indexes the same
+adversarial randomness on every tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..faults import FaultEvent, FaultPlan
+
+#: spec fields that route to Topology rather than SimConfig when they
+#: appear in ``scenario`` or ``grid`` (``topo()`` reads them from either
+#: place; ``sim_config()`` strips them)
+_TOPOLOGY_KEYS = ("n_regions", "intra_delay", "inter_delay", "loss")
+#: spec-level (non-SimConfig) scenario keys
+_SCENARIO_META_KEYS = ("inject_every",)
+
+
+def canonical_json(obj) -> str:
+    """Deterministic JSON: sorted keys, no whitespace drift — the byte
+    stream every content hash in this subsystem folds over."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def content_hash(obj, digest_size: int = 8) -> str:
+    return hashlib.blake2b(
+        canonical_json(obj).encode(), digest_size=digest_size
+    ).hexdigest()
+
+
+_EVENT_FIELDS = [f.name for f in dataclasses.fields(FaultEvent)]
+
+
+def event_to_dict(ev: FaultEvent) -> Dict[str, object]:
+    return {k: getattr(ev, k) for k in _EVENT_FIELDS}
+
+
+def event_from_dict(d: Dict[str, object]) -> FaultEvent:
+    return FaultEvent(**{k: d[k] for k in _EVENT_FIELDS if k in d})
+
+
+@dataclass(frozen=True)
+class CampaignSpec:
+    """One declarative campaign (see module docstring).
+
+    - ``scenario``: SimConfig kwargs (plus ``inject_every``) shared by
+      every cell;
+    - ``topology``: Topology kwargs shared by every cell;
+    - ``events``: FaultPlan events (empty = fault-free campaign); each
+      lane's plan re-seeds with the lane seed;
+    - ``grid``: param name → list of values; the cartesian product
+      yields the campaign's cells, each overriding scenario/topology;
+    - ``seeds``: the lane seed set — every cell runs the whole set as
+      one vmapped on-device ensemble;
+    - ``host_parity``: also replay each cell's plan against the
+      in-process host cluster (PR 2 parity harness) and record whether
+      the eventual writer heads match the sim tier's ground truth.
+    """
+
+    name: str
+    scenario: Dict[str, object]
+    topology: Dict[str, object] = field(default_factory=dict)
+    events: Tuple[FaultEvent, ...] = ()
+    grid: Dict[str, List[object]] = field(default_factory=dict)
+    seeds: Tuple[int, ...] = (0,)
+    max_rounds: int = 1000
+    host_parity: bool = False
+    round_s: float = 0.05  # host-tier wall-clock per round
+
+    def __post_init__(self):
+        object.__setattr__(self, "events", tuple(self.events))
+        object.__setattr__(self, "seeds", tuple(int(s) for s in self.seeds))
+        if not self.seeds:
+            raise ValueError("a campaign needs at least one seed")
+        for k in self.grid:
+            if not self.grid[k]:
+                raise ValueError(f"grid axis {k!r} has no values")
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "scenario": dict(self.scenario),
+            "topology": dict(self.topology),
+            "events": [event_to_dict(ev) for ev in self.events],
+            "grid": {k: list(v) for k, v in self.grid.items()},
+            "seeds": list(self.seeds),
+            "max_rounds": self.max_rounds,
+            "host_parity": self.host_parity,
+            "round_s": self.round_s,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "CampaignSpec":
+        return cls(
+            name=d["name"],
+            scenario=dict(d.get("scenario", {})),
+            topology=dict(d.get("topology", {})),
+            events=tuple(event_from_dict(e) for e in d.get("events", [])),
+            grid={k: list(v) for k, v in d.get("grid", {}).items()},
+            seeds=tuple(d.get("seeds", (0,))),
+            max_rounds=int(d.get("max_rounds", 1000)),
+            host_parity=bool(d.get("host_parity", False)),
+            round_s=float(d.get("round_s", 0.05)),
+        )
+
+    def spec_hash(self) -> str:
+        """The campaign's replay identity (module docstring)."""
+        return content_hash(self.to_dict(), digest_size=8)
+
+    # -- grid expansion -----------------------------------------------------
+
+    def cells(self) -> List[Dict[str, object]]:
+        """Cartesian product of the grid axes in sorted-key order — a
+        pure function of the spec, so cell index i always names the same
+        parameter point (the resumable artifact keys on it)."""
+        if not self.grid:
+            return [{}]
+        keys = sorted(self.grid)
+        return [
+            dict(zip(keys, combo))
+            for combo in itertools.product(*(self.grid[k] for k in keys))
+        ]
+
+    # -- per-cell builders (import jax lazily: the CLI parses without it) ---
+
+    def sim_config(self, cell: Dict[str, object]):
+        from ..sim.state import SimConfig
+
+        kw = dict(self.scenario)
+        kw.update(cell)
+        for k in _TOPOLOGY_KEYS + _SCENARIO_META_KEYS:
+            kw.pop(k, None)
+        return SimConfig(**kw)
+
+    def topo(self, cell: Dict[str, object]):
+        from ..sim.topology import Topology
+
+        kw = dict(self.topology)
+        # topology keys may ride `scenario` (one flat dict in a spec
+        # file); they route here, and sim_config pops them — a key in
+        # both places is a spec bug, not a silent precedence question
+        for k in _TOPOLOGY_KEYS:
+            if k in self.scenario:
+                if k in self.topology:
+                    raise ValueError(
+                        f"{k!r} appears in both scenario and topology"
+                    )
+                kw[k] = self.scenario[k]
+        kw.update({k: cell[k] for k in _TOPOLOGY_KEYS if k in cell})
+        return Topology(**kw)
+
+    def inject_every(self, cell: Dict[str, object]) -> int:
+        return int(
+            cell.get(
+                "inject_every", self.scenario.get("inject_every", 1)
+            )
+        )
+
+    def fault_plan(
+        self, cell: Dict[str, object], seed: int
+    ) -> Optional[FaultPlan]:
+        """The cell's plan at a given lane seed (None = fault-free)."""
+        if not self.events:
+            return None
+        n = int(cell.get("n_nodes", self.scenario["n_nodes"]))
+        return FaultPlan(
+            n_nodes=n, seed=int(seed), events=self.events,
+            round_s=self.round_s,
+        )
+
+
+def load_spec(path: str) -> CampaignSpec:
+    with open(path) as f:
+        return CampaignSpec.from_dict(json.load(f))
+
+
+def save_spec(spec: CampaignSpec, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(spec.to_dict(), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# -- builtin specs -----------------------------------------------------------
+
+
+def fault_parity_3node_spec(
+    seeds: Sequence[int] = tuple(range(8)),
+) -> CampaignSpec:
+    """The 3-node fault-parity campaign (doc/faults.md schema example /
+    tests/cluster/test_fault_parity.py): loss burst + asymmetric
+    partition + delay/jitter + duplicate + crash-with-wipe + HLC skew,
+    12 single-writer versions — the seed-swept form of the PR 2 parity
+    gate, with optional host-tier parity points per cell."""
+    return CampaignSpec(
+        name="fault-parity-3node",
+        scenario={
+            "n_nodes": 3, "n_payloads": 12, "fanout": 2,
+            "sync_interval_rounds": 4, "n_delay_slots": 4,
+            "inject_every": 1,
+        },
+        events=(
+            FaultEvent("loss", 0, 36, p=0.4),
+            FaultEvent("partition", 6, 18, src=2, dst=0),
+            FaultEvent("delay", 4, 24, src=0, dst=1, delay_rounds=1),
+            FaultEvent("jitter", 4, 24, src=0, dst=1, delay_rounds=1),
+            FaultEvent("duplicate", 0, 24, src=1, dst=2, p=0.3),
+            FaultEvent("crash", 24, 34, node=2, wipe=True),
+            FaultEvent("clock_skew", 0, 36, node=1, skew_ns=100_000_000),
+        ),
+        seeds=tuple(seeds),
+        max_rounds=400,
+    )
+
+
+def fault_campaign_3node_spec(seed: int = 0) -> CampaignSpec:
+    """The demo FaultPlan campaign (`sim fault-campaign-3node`), as a
+    single-cell single-seed spec routed through the engine."""
+    from ..faults import demo_plan
+
+    plan = demo_plan(seed=seed)
+    return CampaignSpec(
+        name="fault-campaign-3node",
+        scenario={
+            "n_nodes": plan.n_nodes, "n_payloads": 16, "fanout": 2,
+            "sync_interval_rounds": 4, "n_delay_slots": 4,
+            "inject_every": 1,
+        },
+        events=plan.events,
+        seeds=(seed,),
+        max_rounds=1000,
+    )
+
+
+BUILTIN_SPECS = {
+    "fault-parity-3node": fault_parity_3node_spec,
+    "fault-campaign-3node": fault_campaign_3node_spec,
+}
+
+
+def builtin_spec(name: str, seeds: Optional[Sequence[int]] = None) -> CampaignSpec:
+    if name not in BUILTIN_SPECS:
+        raise KeyError(
+            f"unknown builtin campaign {name!r} (have {sorted(BUILTIN_SPECS)})"
+        )
+    spec = BUILTIN_SPECS[name]()
+    if seeds is not None:
+        spec = dataclasses.replace(spec, seeds=tuple(int(s) for s in seeds))
+    return spec

@@ -361,7 +361,7 @@ _SIM_SCENARIOS = {
 
 def cmd_sim(args) -> int:
     """Run a TPU-simulator benchmark config (rebuild-specific; these are
-    the BASELINE.md scenario tiers)."""
+    the BASELINE.md scenario tiers), or dispatch `sim campaign ...`."""
     # honor JAX_PLATFORMS even when an accelerator plugin would win over
     # the env var (jax.config takes precedence) — tests set cpu to keep
     # subprocess sims off the contended real chip
@@ -369,6 +369,8 @@ def cmd_sim(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if args.scenario == "campaign":
+        return cmd_campaign(args)
     from ..sim import runner
 
     fn = getattr(runner, _SIM_SCENARIOS[args.scenario])
@@ -379,13 +381,15 @@ def cmd_sim(args) -> int:
 
     if args.nodes and "n_nodes" in inspect.signature(fn).parameters:
         kwargs["n_nodes"] = args.nodes
-    if args.seeds <= 1:
-        print(json.dumps(fn(seed=args.seed, **kwargs), default=float))
+    base_seed = args.seed if args.seed is not None else 0
+    n_seeds = args.seeds or 1
+    if n_seeds <= 1:
+        print(json.dumps(fn(seed=base_seed, **kwargs), default=float))
         return 0
     # multi-seed distribution: per-seed records plus cross-seed
     # percentiles of every numeric field (the convergence-round
     # DISTRIBUTION the calibration contract compares, not one scalar)
-    runs = [fn(seed=args.seed + i, **kwargs) for i in range(args.seeds)]
+    runs = [fn(seed=base_seed + i, **kwargs) for i in range(n_seeds)]
     numeric = {
         k for k in runs[0]
         if all(isinstance(r.get(k), (int, float)) for r in runs)
@@ -403,6 +407,86 @@ def cmd_sim(args) -> int:
         {"seeds": args.seeds, "summary": summary, "runs": runs},
         default=float,
     ))
+    return 0
+
+
+def cmd_campaign(args) -> int:
+    """`sim campaign run|compare` (corrosion_tpu.campaign): declarative
+    seed-ensemble campaigns with convergence regression bands.
+
+    - ``run``: execute a spec (builtin name or JSON file) and write the
+      band artifact; resumable via the artifact path, wall-budgeted via
+      ``--budget-s``.
+    - ``compare``: hold a candidate artifact against a baseline; exits 1
+      on a regress verdict (the nightly gate's teeth).
+    """
+    import os as _os
+
+    from ..campaign import BUILTIN_SPECS, builtin_spec, load_spec
+    from ..campaign.engine import run_campaign
+    from ..campaign.report import compare
+
+    if args.campaign_cmd == "compare":
+        if not (args.baseline and args.candidate):
+            raise SystemExit(
+                "sim campaign compare needs --baseline and --candidate"
+            )
+        with open(args.baseline) as f:
+            base = json.load(f)
+        with open(args.candidate) as f:
+            cand = json.load(f)
+        report = compare(
+            base, cand, tol_frac=args.tol_frac, tol_abs=args.tol_abs
+        )
+        print(json.dumps(report, indent=2, default=float))
+        return 0 if report["verdict"] == "pass" else 1
+
+    if args.campaign_cmd != "run":
+        raise SystemExit("usage: sim campaign {run|compare} ...")
+    if not args.spec:
+        raise SystemExit(
+            f"--spec required: a JSON spec file or one of "
+            f"{sorted(BUILTIN_SPECS)}"
+        )
+    # --seeds/--seed override the spec's seed set ONLY when given: a
+    # builtin (or file) spec keeps its own documented seed set otherwise
+    # (fault-parity-3node defaults to 8 seeds — collapsing it to one
+    # would silently change the spec hash and break baselines).
+    # `--seed 0` counts as given (default is None, not 0).
+    seed_override = None
+    if args.seeds is not None or args.seed is not None:
+        base = args.seed if args.seed is not None else 0
+        seed_override = [base + i for i in range(max(1, args.seeds or 1))]
+    if _os.path.exists(args.spec):
+        spec = load_spec(args.spec)
+        if seed_override is not None:
+            import dataclasses as _dc
+
+            spec = _dc.replace(spec, seeds=tuple(seed_override))
+    else:
+        spec = builtin_spec(args.spec, seeds=seed_override)
+    out = args.out or f"CAMPAIGN_{spec.name}_{spec.spec_hash()}.json"
+    artifact = run_campaign(
+        spec, out_path=out, wall_budget_s=args.budget_s,
+        resume=not args.no_resume,
+    )
+    summary = {
+        "spec_hash": artifact["spec_hash"],
+        "result_digest": artifact["result_digest"],
+        "artifact": out,
+        "cells": len(artifact["cells"]),
+        "skipped_cells": artifact["skipped_cells"],
+        "all_converged": all(
+            c.get("all_converged", False) for c in artifact["cells"]
+        ),
+        "bands": {
+            json.dumps(c.get("params", {}), sort_keys=True): c["bands"][
+                "rounds"
+            ]
+            for c in artifact["cells"]
+        },
+    }
+    print(json.dumps(summary, indent=2, default=float))
     return 0
 
 
@@ -534,14 +618,53 @@ def build_parser() -> argparse.ArgumentParser:
     cs.add_argument("--once", action="store_true", help="one sync pass then exit")
     cs.set_defaults(fn=cmd_consul)
 
-    sm = sp.add_parser("sim", help="run a TPU-simulator benchmark config")
-    sm.add_argument("scenario", choices=sorted(_SIM_SCENARIOS))
-    sm.add_argument("--seed", type=int, default=0)
+    sm = sp.add_parser(
+        "sim",
+        help="run a TPU-simulator benchmark config, or "
+        "`sim campaign run|compare` for declarative seed-ensemble "
+        "campaigns",
+    )
+    sm.add_argument("scenario", choices=sorted(_SIM_SCENARIOS) + ["campaign"])
     sm.add_argument(
-        "--seeds", type=int, default=1,
-        help="run N seeds and report cross-seed percentiles",
+        "campaign_cmd", nargs="?", choices=["run", "compare"],
+        help="campaign action (scenario=campaign only)",
+    )
+    # default None so "explicitly given" is detectable: campaign run
+    # must distinguish `--seed 0` (override to one seed) from "no seed
+    # flags at all" (keep the spec's own seed set)
+    sm.add_argument("--seed", type=int, default=None)
+    sm.add_argument(
+        "--seeds", type=int, default=None,
+        help="run N seeds and report cross-seed percentiles "
+        "(campaign run: the ensemble seed set, seed..seed+N-1; "
+        "omitted = the spec's own seed set)",
     )
     sm.add_argument("--nodes", type=int, default=None)
+    sm.add_argument(
+        "--spec", help="campaign run: JSON spec file or builtin name"
+    )
+    sm.add_argument(
+        "--out", help="campaign run: artifact path (resumable)"
+    )
+    sm.add_argument(
+        "--budget-s", type=float, default=None,
+        help="campaign run: wall-clock budget; leftover cells are "
+        "skipped and resumed next run",
+    )
+    sm.add_argument(
+        "--no-resume", action="store_true",
+        help="campaign run: ignore an existing artifact",
+    )
+    sm.add_argument("--baseline", help="campaign compare: baseline artifact")
+    sm.add_argument("--candidate", help="campaign compare: candidate artifact")
+    sm.add_argument(
+        "--tol-frac", type=float, default=0.10,
+        help="campaign compare: fractional band tolerance",
+    )
+    sm.add_argument(
+        "--tol-abs", type=float, default=2.0,
+        help="campaign compare: absolute band tolerance (rounds)",
+    )
     sm.set_defaults(fn=cmd_sim)
 
     dc = sp.add_parser(

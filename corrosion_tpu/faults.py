@@ -289,6 +289,30 @@ def demo_plan(n_nodes: int = 3, seed: int = 0, rounds: int = 36) -> FaultPlan:
     )
 
 
+def advance_link_epochs(
+    epochs: Dict[Tuple[int, int], List[Tuple[int, LinkFault]]],
+    epoch_idx: Dict[Tuple[int, int], int],
+    r: int,
+    install,
+) -> None:
+    """Walk every link's parameter-change list up to round ``r``,
+    calling ``install(src, dst, epoch_index, params)`` at each boundary
+    crossed and advancing ``epoch_idx`` in place.
+
+    This is THE shared epoch-indexing rule for every real-time driver
+    (host memory AND real sockets): the ``epoch_index`` handed to
+    ``install`` is the one a driver folds into ``derive_seed(seed,
+    "link", src, dst, epoch)``, so cross-tier seed parity cannot drift
+    as long as both drivers route through here."""
+    for pair, changes in epochs.items():
+        idx = epoch_idx.get(pair, 0)
+        while idx < len(changes) and changes[idx][0] <= r:
+            _, params = changes[idx]
+            install(pair[0], pair[1], idx, params)
+            idx += 1
+            epoch_idx[pair] = idx
+
+
 class CampaignCoverage:
     """Scoped `sometimes` coverage over one campaign: snapshot the pass
     counters at entry, and :meth:`assert_covered` demands every expected
@@ -378,28 +402,24 @@ class HostFaultDriver:
         sched = plan.schedule_at(r)
 
         # -- link faults: (re)install LinkModels at epoch boundaries
-        for pair, changes in self._epochs.items():
-            idx = self._epoch_idx.get(pair, 0)
-            while idx < len(changes) and changes[idx][0] <= r:
-                _, params = changes[idx]
-                src, dst = pair
-                edge = (self._addr(src), self._addr(dst))
-                if params == CLEAR:
-                    # back to the network's own (per-link derived) model
-                    net.links.pop(edge, None)
-                else:
-                    base = net.default_link
-                    net.links[edge] = LinkModel(
-                        latency_s=base.latency_s
-                        + params.delay_rounds * plan.round_s,
-                        loss=1.0 - (1.0 - base.loss) * (1.0 - params.loss),
-                        jitter_s=params.jitter_rounds * plan.round_s,
-                        duplicate=params.duplicate,
-                        seed=derive_seed(plan.seed, "link", src, dst, idx),
-                    )
-                self.log.append((r, "link", (pair, idx, params)))
-                idx += 1
-                self._epoch_idx[pair] = idx
+        def install(src, dst, idx, params):
+            edge = (self._addr(src), self._addr(dst))
+            if params == CLEAR:
+                # back to the network's own (per-link derived) model
+                net.links.pop(edge, None)
+            else:
+                base = net.default_link
+                net.links[edge] = LinkModel(
+                    latency_s=base.latency_s
+                    + params.delay_rounds * plan.round_s,
+                    loss=1.0 - (1.0 - base.loss) * (1.0 - params.loss),
+                    jitter_s=params.jitter_rounds * plan.round_s,
+                    duplicate=params.duplicate,
+                    seed=derive_seed(plan.seed, "link", src, dst, idx),
+                )
+            self.log.append((r, "link", ((src, dst), idx, params)))
+
+        advance_link_epochs(self._epochs, self._epoch_idx, r, install)
 
         # -- coverage markers for whatever is active this round
         for kind in sched.active_kinds():
@@ -458,3 +478,127 @@ class HostFaultDriver:
             if r < self.plan.horizon:
                 await asyncio.sleep(self.plan.round_s)
         sometimes(True, "fault-campaign-completed")
+
+
+#: fault kinds the raw-socket driver can express at the transport seam
+#: (crash/clock_skew are PROCESS-level faults — the devcluster campaign
+#: owns those via CORRO_CAMPAIGN_SEED; a transport injector can't kill
+#: its own process)
+REALSOCKET_KINDS = frozenset(
+    {"loss", "delay", "jitter", "duplicate", "partition"}
+)
+
+
+class RealSocketFaultDriver:
+    """Compile a FaultPlan onto REAL sockets: the third backend of the
+    transport seam.  Each node's `UdpTcpTransport` gets a
+    :class:`~corrosion_tpu.agent.transport.FaultInjector`, and per round
+    the driver installs that round's :class:`RoundSchedule` into it:
+
+    - **link faults** become per-DESTINATION LinkModel streams on the
+      SENDING node's injector, re-seeded at every epoch boundary with
+      ``derive_seed(seed, "link", src, dst, epoch)`` — byte-for-byte the
+      derivation the host tier's `HostFaultDriver` uses, so the k-th
+      decision on a directed edge is the same pure function of
+      (seed, src, dst, epoch, k) on BOTH tiers regardless of wall-clock
+      timing;
+    - **partitions** become the egress ``blocked_peers`` set (installed
+      on the src side; a symmetric event lands on both sides via its
+      expanded directed pairs), severing established TCP like the
+      Antithesis rig's iptables cut;
+    - **crash/clock_skew** are out of scope at this seam
+      (`REALSOCKET_KINDS`): they are process-level faults the
+      multi-process campaign drives separately.
+
+    ``transports[i]`` is node i's transport, ``addrs[i]`` the gossip
+    addr its peers dial it at (the string other nodes pass to
+    send_datagram/send_uni/open_bi — blocking and per-dst streams key
+    on it).
+    """
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        transports: Sequence,
+        addrs: Sequence[str],
+        catalog: Catalog = CATALOG,
+    ):
+        from .agent.transport import FaultInjector
+
+        if len(transports) != plan.n_nodes or len(addrs) != plan.n_nodes:
+            raise ValueError(
+                f"plan is for {plan.n_nodes} nodes, got "
+                f"{len(transports)} transports / {len(addrs)} addrs"
+            )
+        self.plan = plan
+        self.transports = list(transports)
+        self.addrs = list(addrs)
+        self.catalog = catalog
+        self.round = -1
+        self._epochs = plan.link_epochs()
+        self._epoch_idx: Dict[Tuple[int, int], int] = {}
+        self.injectors = []
+        for t in self.transports:
+            fi = FaultInjector()
+            t.install_faults(fi)
+            self.injectors.append(fi)
+        self.log: List[Tuple[int, str, object]] = []
+
+    def apply_round(self, r: int) -> None:
+        """Install round ``r``'s schedule into every injector
+        (idempotent per round; synchronous — socket injectors mutate
+        plain state, no awaits)."""
+        from .agent.transport import LinkModel
+
+        plan = self.plan
+        sched = plan.schedule_at(r)
+
+        # -- link faults: (re)install per-dst LinkModels at epoch bounds
+        # (the SAME advance_link_epochs walk as HostFaultDriver — the
+        # epoch index it hands us is the cross-tier seed-parity anchor)
+        def install(src, dst, idx, params):
+            inj = self.injectors[src]
+            if params == CLEAR:
+                inj.links.pop(self.addrs[dst], None)
+            else:
+                inj.links[self.addrs[dst]] = LinkModel(
+                    latency_s=params.delay_rounds * plan.round_s,
+                    loss=params.loss,
+                    jitter_s=params.jitter_rounds * plan.round_s,
+                    duplicate=params.duplicate,
+                    seed=derive_seed(plan.seed, "link", src, dst, idx),
+                )
+            self.log.append((r, "link", ((src, dst), idx, params)))
+
+        advance_link_epochs(self._epochs, self._epoch_idx, r, install)
+
+        # -- partitions: per-src egress blocked sets
+        blocked: Dict[int, set] = {}
+        for (s, d), f in sched.links.items():
+            if f.blocked:
+                blocked.setdefault(s, set()).add(self.addrs[d])
+        for i, inj in enumerate(self.injectors):
+            inj.set_partition(blocked.get(i, set()))
+
+        # -- coverage markers for the kinds this seam can express
+        for kind in sched.active_kinds():
+            if kind in REALSOCKET_KINDS:
+                self.catalog.sometimes(True, f"fault-{kind}-active")
+
+    async def run(self) -> None:
+        """Drive the whole schedule in real time, one round per
+        ``plan.round_s``; uninstalls every injector at the end (the
+        all-clear steady state)."""
+        import asyncio
+
+        for r in range(self.plan.horizon + 1):
+            self.round = r
+            self.apply_round(r)
+            if r < self.plan.horizon:
+                await asyncio.sleep(self.plan.round_s)
+        self.clear()
+        sometimes(True, "fault-campaign-completed")
+
+    def clear(self) -> None:
+        for t in self.transports:
+            t.install_faults(None)

@@ -43,7 +43,7 @@ def state_shardings(mesh: Mesh, swim_full_view: bool) -> SimState:
     return SimState(
         t=r, key=r,
         have=n0p, injected=r, relay_left=n0p, inflight=dn,
-        sync_inflight=n0p,
+        sync_inflight=dn,
         sync_countdown=n0, sync_backoff=n0, alive=n0, incarnation=n0,
         group=n0,
         view=swim, vinc=swim, suspect_since=swim,

@@ -97,14 +97,16 @@ def broadcast_step(
     # frame on the wire (see edge_payload_drop)
     drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
 
+    delay_ep = None
     if faults is not None:
         # FaultPlan seam (sim/faults.py): directed cuts, extra per-link
-        # loss, fixed delay + jitter drawn per (edge, flush) — the
-        # round's batch shares one draw (coarser than the host tier's
-        # per-message jitter; doc/faults.md pins it).  Keys are fold_in-
-        # derived (never split from the phase keys) so the faults=None
-        # path stays byte-identical, and fold the PLAN seed so the fault
-        # decisions are plan-seeded, as on the host tier.
+        # loss, fixed delay + jitter drawn per (edge, PAYLOAD) — each
+        # changeset rides its own uni frame on the wire (the same grain
+        # as edge_payload_drop), so jitter reorders traffic within one
+        # flush exactly like the host tier's per-message draw.  Keys are
+        # fold_in-derived (never split from the phase keys) so the
+        # faults=None path stays byte-identical, and fold the PLAN seed
+        # so the fault decisions are plan-seeded, as on the host tier.
         k_fault = jax.random.fold_in(key, faults.seed)
         k_floss = jax.random.fold_in(k_fault, 101)
         k_fjit = jax.random.fold_in(k_fault, 102)
@@ -115,9 +117,11 @@ def broadcast_step(
         delay = delay + faults.delay[src, dst].astype(jnp.int32)
         jit = faults.jitter[src, dst].astype(jnp.int32)  # [E]
         draw = jax.random.randint(
-            k_fjit, (src.shape[0],), 0, jnp.iinfo(jnp.int32).max
+            k_fjit, (src.shape[0], p), 0, jnp.iinfo(jnp.int32).max
         )
-        delay = delay + jnp.where(jit > 0, draw % (jit + 1), 0)
+        delay_ep = delay[:, None] + jnp.where(
+            jit[:, None] > 0, draw % (jit[:, None] + 1), 0
+        )  # [E, P]
     payload = state.have.dtype
     # `sending[src]` is a regular f-fold repeat (src = repeat(arange, f))
     # — a broadcast, not a 100M-cell random gather at the gapstress shape
@@ -127,13 +131,28 @@ def broadcast_step(
         False,
     ).astype(payload).reshape(n * f, p)  # [E, P]
 
-    # scatter into the delay ring: slot (t + delay) mod D per edge
+    # scatter into the delay ring: slot (t + delay) mod D
     d_slots = state.inflight.shape[0]
-    slot = (state.t + delay) % d_slots  # [E]
-    flat_idx = slot * n + dst  # [E] into [D*N]
-    inflight = state.inflight.reshape(d_slots * n, p)
-    inflight = inflight.at[flat_idx].max(sent)
-    inflight = inflight.reshape(d_slots, n, p)
+    if delay_ep is not None:
+        # per-(edge, payload) delays (fault jitter): elementwise scatter
+        # — same element count as the row scatter, only the indexing is
+        # finer-grained; fault runs ride the dense path at small N
+        slot_ep = (state.t + delay_ep) % d_slots  # [E, P]
+        flat = (slot_ep * n + dst[:, None]) * p + jnp.arange(
+            p, dtype=jnp.int32
+        )[None, :]
+        inflight = (
+            state.inflight.reshape(-1)
+            .at[flat.reshape(-1)]
+            .max(sent.reshape(-1))
+            .reshape(d_slots, n, p)
+        )
+    else:
+        slot = (state.t + delay) % d_slots  # [E]
+        flat_idx = slot * n + dst  # [E] into [D*N]
+        inflight = state.inflight.reshape(d_slots * n, p)
+        inflight = inflight.at[flat_idx].max(sent)
+        inflight = inflight.reshape(d_slots, n, p)
 
     # transmission budget decays once per flush that actually SENT —
     # i.e. handed datagrams to the transport.  A sender cannot know the
@@ -150,18 +169,17 @@ def broadcast_step(
     return state._replace(inflight=inflight, relay_left=relay_left)
 
 
-def deliver_step(
-    state: SimState, cfg: SimConfig, sync_arrivals: jnp.ndarray
-) -> SimState:
-    """Pop this round's delay slot: newly BROADCAST-received payloads
-    become held and start relaying with one transmission spent
-    (rebroadcast semantics, handlers.rs:768-779).  ``sync_arrivals``
-    (the buffer sync filled LAST round) merges into ``have`` too but
+def deliver_step(state: SimState, cfg: SimConfig) -> SimState:
+    """Pop this round's delay slot of BOTH rings: newly BROADCAST-received
+    payloads become held and start relaying with one transmission spent
+    (rebroadcast semantics, handlers.rs:768-779).  The sync ring's slot
+    (pulls granted 1+fault_delay rounds ago) merges into ``have`` too but
     does NOT re-arm the relay budget — sync-received changesets are
     never rebroadcast in the reference."""
     d_slots = state.inflight.shape[0]
     slot = state.t % d_slots
     arriving = state.inflight[slot]  # [N, P]
+    sync_arrivals = state.sync_inflight[slot]  # [N, P]
     newly = (arriving > 0) & (state.have == 0)
     have = jnp.maximum(jnp.maximum(state.have, arriving), sync_arrivals)
     relay_init = max(cfg.max_transmissions - 1, 1)
@@ -169,7 +187,11 @@ def deliver_step(
         newly, jnp.uint8(relay_init), state.relay_left
     ).astype(state.relay_left.dtype)
     inflight = state.inflight.at[slot].set(0)
-    return state._replace(have=have, relay_left=relay_left, inflight=inflight)
+    sync_inflight = state.sync_inflight.at[slot].set(0)
+    return state._replace(
+        have=have, relay_left=relay_left, inflight=inflight,
+        sync_inflight=sync_inflight,
+    )
 
 
 def inject_step(state: SimState, meta: PayloadMeta, cfg: SimConfig) -> SimState:

@@ -13,10 +13,11 @@ DOWN/latency-class machinery:
   ~1.0 compiles into ``block`` instead (a u8 threshold cannot express
   certainty);
 - ``delay/jitter[R+1, N, N] u8`` — extra delivery delay in rounds:
-  fixed + uniform 0..jitter drawn per (edge, flush) — a round's whole
-  batch on one edge shares the draw, so jitter reorders traffic across
-  ROUNDS and EDGES, a coarser grain than the host tier's true
-  per-message draw (doc/faults.md "tier coverage" pins this);
+  fixed + uniform 0..jitter drawn per (edge, PAYLOAD) — each changeset
+  rides its own uni frame (the edge_payload_drop grain), so jitter
+  reorders traffic within a single flush exactly like the host tier's
+  per-message draw; fault latency also stretches sync delivery (the
+  bi-stream RTT rides the sync delay ring, slower direction wins);
 - ``alive[R+1, N] i8``     — scheduled alive override (-1 = leave to
   the scenario; ALIVE/DOWN during crash windows and at restart);
 - ``wipe[R+1, N] bool``    — the restart round of a crash with
@@ -99,15 +100,6 @@ def compile_plan(
         raise ValueError(
             f"plan is for {plan.n_nodes} nodes, SimConfig has {cfg.n_nodes}"
         )
-    if cfg.swim_partial_view:
-        # pswim_step does not consume RoundFaults yet (ROADMAP open
-        # item): probes would sail through partitions while broadcast/
-        # sync honor them — silently wrong campaign results.  Refuse
-        # loudly until the partial-view kernel carries the seam.
-        raise ValueError(
-            "FaultPlan does not yet thread faults through partial-view "
-            "SWIM (sim/pswim.py); use swim_full_view or oracle membership"
-        )
     n, rounds = plan.n_nodes, plan.horizon
     shape = (rounds + 1, n, n)
     block = np.zeros(shape, np.bool_)
@@ -170,25 +162,42 @@ def apply_node_faults(state: SimState, rf: RoundFaults) -> SimState:
     """Crash/restart/wipe, applied BEFORE the round's phases: the alive
     override makes `edge_alive` mask the node's edges this very round,
     and a wipe zeroes everything the node 'knew' — chunk bits, relay
-    budgets, in-flight deliveries addressed to it, and the advertised
-    bookkeeping tensors (heads/gaps), so the node rejoins as a cold
-    joiner and must recover purely via anti-entropy (the
-    crash-with-state-wipe shape of the reference's restore campaign)."""
+    budgets, in-flight deliveries addressed to it, the advertised
+    bookkeeping tensors (heads/gaps), AND its own membership beliefs
+    (full-view row back to the all-ALIVE init; partial-view table to
+    EMPTY, so the announce/refill/gossip paths must repopulate it) — so
+    the node rejoins as a cold joiner and must recover purely via
+    anti-entropy (the crash-with-state-wipe shape of the reference's
+    restore campaign).  Other nodes' beliefs ABOUT the wiped node are
+    untouched: refutation/rejoin heals them, as on the host tier."""
     alive = jnp.where(
         rf.alive >= 0, rf.alive.astype(state.alive.dtype), state.alive
     )
     w = rf.wipe
     wn = w[:, None]
-    return state._replace(
+    state = state._replace(
         alive=alive,
         have=jnp.where(wn, 0, state.have),
         relay_left=jnp.where(wn, 0, state.relay_left),
-        sync_inflight=jnp.where(wn, 0, state.sync_inflight),
+        sync_inflight=jnp.where(w[None, :, None], 0, state.sync_inflight),
         inflight=jnp.where(w[None, :, None], 0, state.inflight),
         heads=jnp.where(wn, 0, state.heads),
         gap_lo=jnp.where(w[:, None, None], 0, state.gap_lo),
         gap_hi=jnp.where(w[:, None, None], 0, state.gap_hi),
     )
+    if state.view.size:  # full-view SWIM: row back to the optimistic init
+        state = state._replace(
+            view=jnp.where(wn, jnp.int8(0), state.view),
+            vinc=jnp.where(wn, 0, state.vinc),
+            suspect_since=jnp.where(wn, -1, state.suspect_since),
+        )
+    if state.pid.size:  # partial-view SWIM: member table emptied
+        state = state._replace(
+            pid=jnp.where(wn, -1, state.pid),
+            pkey=jnp.where(wn, -1, state.pkey),
+            psince=jnp.where(wn, -1, state.psince),
+        )
+    return state
 
 
 def _all_have(state: SimState, meta: PayloadMeta, cfg: SimConfig) -> jnp.ndarray:

@@ -265,9 +265,11 @@ class PackedCarry(NamedTuple):
     have: jnp.ndarray  # u32[N, W]
     inflight: jnp.ndarray  # u8[D, N, P] — dense, see docstring
     relay: Planes  # 4 × u32[N, W]
-    # one-slot sync delivery buffer (SimState.sync_inflight) — stays
-    # PACKED: the sync fold produces words directly, no scatter
-    sync_buf: jnp.ndarray  # u32[N, W]
+    # sync delivery ring (SimState.sync_inflight) — stays PACKED: the
+    # packed path never carries faults, so only slot (t+1) % D is ever
+    # written (the one-round bi-stream RTT) and the sync fold produces
+    # words directly, no scatter
+    sync_buf: jnp.ndarray  # u32[D, N, W]
 
 
 def pack_state(state: SimState, cfg: SimConfig) -> PackedCarry:
@@ -436,22 +438,24 @@ def _fold_or_regular(words: jnp.ndarray, n: int, per: int) -> jnp.ndarray:
 
 def deliver_packed(
     carry: PackedCarry,
-    pending_sync: jnp.ndarray,
     t: jnp.ndarray,
     cfg: SimConfig,
 ) -> PackedCarry:
     """Broadcast arrivals re-arm the relay budget (rebroadcast path);
-    ``pending_sync`` (last round's sync grants, packed words) merges
-    into have WITHOUT re-arming — mirrors broadcast.deliver_step."""
+    the sync ring's slot t (grants from 1+delay rounds ago, packed
+    words) merges into have WITHOUT re-arming — mirrors
+    broadcast.deliver_step."""
     d_slots = carry.inflight.shape[0]
     slot = t % d_slots
     arriving = pack_bits(carry.inflight[slot])  # u8[N, P] → u32[N, W]
+    pending_sync = carry.sync_buf[slot]  # u32[N, W]
     newly = arriving & ~carry.have
     have = carry.have | arriving | pending_sync
     relay = planes_set(carry.relay, newly, max(cfg.max_transmissions - 1, 1))
     inflight = carry.inflight.at[slot].set(jnp.uint8(0))
+    sync_buf = carry.sync_buf.at[slot].set(U32(0))
     return PackedCarry(have=have, inflight=inflight, relay=relay,
-                       sync_buf=carry.sync_buf)
+                       sync_buf=sync_buf)
 
 
 def shrink_state(state: SimState) -> SimState:
@@ -468,7 +472,7 @@ def shrink_state(state: SimState) -> SimState:
         injected=jnp.zeros((0,), u8),
         relay_left=jnp.zeros((n, 0), u8),
         inflight=jnp.zeros((d, n, 0), u8),
-        sync_inflight=jnp.zeros((n, 0), u8),
+        sync_inflight=jnp.zeros((d, n, 0), u8),
     )
 
 
@@ -499,13 +503,13 @@ def packed_round_step(
     carry = broadcast_packed(
         carry, injected_p, state, cfg, topo, region, k_bcast, meta
     )
-    # capture last round's sync grants before sync overwrites the buffer
-    pending_sync = carry.sync_buf
+    # sync writes ring slot t+1, deliver pops slot t: no ordering hazard
+    # (round.round_step's contract)
     carry, countdown, backoff = sync_packed(
         carry, state, cfg, topo, k_sync, meta
     )
     state = state._replace(sync_countdown=countdown, sync_backoff=backoff)
-    carry = deliver_packed(carry, pending_sync, state.t, cfg)
+    carry = deliver_packed(carry, state.t, cfg)
 
     from .swim import swim_step
 
@@ -699,8 +703,11 @@ def sync_packed(
 
     # pulls land at the PULLER (src): exactly S edges per source in a
     # regular layout, so the OR-reduce is a packed fold — no scatter;
-    # the dense u8 ring takes the pulls after one unpack
+    # the words drop into ring slot t+1 (the packed path never carries
+    # faults, so the delay is always the one-round RTT)
     pulled = _fold_or_regular(granted, n, s)  # [N, W] — stays packed
+    d_slots = carry.sync_buf.shape[0]
+    sync_buf = carry.sync_buf.at[(state.t + 1) % d_slots].max(pulled)
 
     # fruitfulness-adaptive backoff, bit-identical to sync.sync_step
     fruitful = (pulled != U32(0)).any(axis=1)  # [N]
@@ -718,7 +725,7 @@ def sync_packed(
     countdown = jnp.where(due, rearm, state.sync_countdown - 1)
     return (
         PackedCarry(have=carry.have, inflight=carry.inflight,
-                    relay=carry.relay, sync_buf=pulled),
+                    relay=carry.relay, sync_buf=sync_buf),
         countdown,
         backoff,
     )

@@ -175,8 +175,16 @@ def _merge_entries(
 
 
 def pswim_step(
-    state: SimState, cfg: SimConfig, topo: Topology, key: jax.Array
+    state: SimState, cfg: SimConfig, topo: Topology, key: jax.Array,
+    faults=None,
 ) -> SimState:
+    """``faults`` (sim/faults.py RoundFaults, or None) threads the
+    FaultPlan seam through every probe/relay/gossip/announce message via
+    `_reachable` — directed cuts and extra per-link loss apply to the
+    partial-view tier exactly as to the full-view one (the ROADMAP gap
+    where probes sailed through partitions is closed).  Fault keys are
+    fold_in-derived inside `_reachable`'s ``faults is not None`` branch,
+    so the None path stays byte-identical to the pre-fault kernel."""
     n, m = state.pid.shape
     k = cfg.gossip_entries
     (
@@ -191,18 +199,18 @@ def pswim_step(
     target = psample_member_targets(state, cfg, k_probe, 1)[:, 0]
     do_probe = up & (state.t % cfg.probe_period_rounds == 0) & (target >= 0)
     target = jnp.maximum(target, 0)
-    direct = _reachable(state, topo, k_ploss, me, target)
+    direct = _reachable(state, topo, k_ploss, me, target, faults)
     relays = psample_member_targets(state, cfg, k_relay, cfg.indirect_probes)
     relay_ok = relays >= 0
     relays = jnp.maximum(relays, 0)
     hop_keys = jax.random.split(k_rloss, 2)
     leg1 = _reachable(
         state, topo, hop_keys[0],
-        jnp.repeat(me, cfg.indirect_probes), relays.reshape(-1),
+        jnp.repeat(me, cfg.indirect_probes), relays.reshape(-1), faults,
     ).reshape(n, cfg.indirect_probes)
     leg2 = _reachable(
         state, topo, hop_keys[1],
-        relays.reshape(-1), jnp.repeat(target, cfg.indirect_probes),
+        relays.reshape(-1), jnp.repeat(target, cfg.indirect_probes), faults,
     ).reshape(n, cfg.indirect_probes)
     acked = direct | (leg1 & leg2 & relay_ok).any(axis=1)
     probe_failed = do_probe & ~acked
@@ -239,7 +247,7 @@ def pswim_step(
     gdst = g_targets.reshape(-1)
     g_valid = gdst >= 0
     gdst = jnp.maximum(gdst, 0)
-    g_ok = _reachable(state, topo, k_gloss, gsrc, gdst) & g_valid
+    g_ok = _reachable(state, topo, k_gloss, gsrc, gdst, faults) & g_valid
     # post-probe packed table: one u32 gather per random (pid, pkey)
     # read below (sender filter, gossip picks, announce feedback)
     ptbl = _pack_tables(pid, pkey)
@@ -300,7 +308,7 @@ def pswim_step(
     ann_target = jax.random.randint(k_ann, (n,), 0, n, jnp.int32)
     ann_ok = (
         stagger & up & (ann_target != me)
-        & _reachable(state, topo, k_aloss, me, ann_target)
+        & _reachable(state, topo, k_aloss, me, ann_target, faults)
     )
     all_dst = jnp.concatenate([e_dst, ann_target])
     all_id = jnp.concatenate([e_id, me])

@@ -90,11 +90,12 @@ def round_step(
 
     state = inject_step(state, meta, cfg)
     state = broadcast_step(state, meta, cfg, topo, region, k_bcast, faults)
-    # sync pulls granted LAST round deliver this round (bi-stream RTT);
-    # capture the buffer before sync_step overwrites it with new pulls
-    pending_sync = state.sync_inflight
+    # sync pulls granted in round t land in ring slot t+1+fault_delay
+    # (≠ slot t: compile_plan/validate guarantee 1+delay < n_delay_slots),
+    # so deliver_step can pop slot t AFTER sync_step without ordering
+    # hazards — the bi-stream RTT plus any FaultPlan latency
     state = sync_step(state, meta, cfg, topo, k_sync, faults)
-    state = deliver_step(state, cfg, pending_sync)
+    state = deliver_step(state, cfg)
     state = swim_step(state, cfg, topo, k_swim, faults)
 
     # refresh the advertised bookkeeping tensors from this round's chunk

@@ -129,36 +129,33 @@ def config_fault_campaign_3node(seed: int = 0) -> Dict[str, float]:
     """The FaultPlan demo campaign (doc/faults.md) on the sim tier: loss
     burst + asymmetric partition + delay/jitter + crash-with-wipe, all
     from ONE plan seed; the identical schedule replays against the
-    in-process host cluster via `faults.HostFaultDriver`."""
-    from ..faults import demo_plan
-    from .faults import compile_plan, run_fault_plan
+    in-process host cluster via `faults.HostFaultDriver`.
 
-    plan = demo_plan(seed=seed)
-    cfg = SimConfig(
-        n_nodes=plan.n_nodes, n_payloads=16, fanout=2,
-        sync_interval_rounds=4, n_delay_slots=4,
-    )
-    meta = uniform_payloads(cfg, inject_every=1)
-    topo = Topology()
-    fplan = compile_plan(plan, cfg, topo)
-    state = new_sim(cfg, seed)
-    t0 = time.monotonic()
-    final, metrics = run_fault_plan(state, meta, cfg, topo, fplan, 1000)
-    jax.block_until_ready((final, metrics))
-    wall = time.monotonic() - t0
-    node_conv = np.asarray(metrics.converged_at)
-    alive = np.asarray(final.alive)
-    unconverged = int(((node_conv < 0) & (alive == ALIVE)).sum())
-    heads = np.asarray(final.heads)
+    Since ISSUE 3 this routes through the campaign engine
+    (`corrosion_tpu.campaign`): a single-cell single-seed spec run as a
+    (degenerate) vmapped ensemble — the same code path `sim campaign
+    run` exercises at ≥8 seeds.  The emitted record keeps the legacy
+    keys, still replay-identical across processes minus the wall."""
+    from ..campaign.engine import run_campaign
+    from ..campaign.spec import fault_campaign_3node_spec
+
+    spec = fault_campaign_3node_spec(seed=seed)
+    artifact = run_campaign(spec, out_path=None)
+    cell = artifact["cells"][0]
+    per_seed = cell["per_seed"]
     return {
-        "n_nodes": cfg.n_nodes,
-        "plan_seed": plan.seed,
-        "plan_horizon": plan.horizon,
-        "rounds": int(final.t),
-        "wall_clock_s": wall,
-        "converged": unconverged == 0 and bool((heads[:, 0] == cfg.n_versions).all()),
-        "unconverged_nodes": unconverged,
-        "p99_node_convergence_round": _percentile(node_conv, 99),
+        "n_nodes": cell["n_nodes"],
+        "plan_seed": seed,
+        "plan_horizon": cell["plan_horizon"],
+        "rounds": per_seed["rounds"][0],
+        "wall_clock_s": cell["wall_clock_s"],
+        "converged": per_seed["converged"][0],
+        "unconverged_nodes": per_seed["unconverged_nodes"][0],
+        "p99_node_convergence_round": per_seed[
+            "p99_node_convergence_round"
+        ][0],
+        "spec_hash": artifact["spec_hash"],
+        "result_digest": artifact["result_digest"],
     }
 
 

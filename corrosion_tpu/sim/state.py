@@ -274,14 +274,16 @@ class SimState(NamedTuple):
     injected: jnp.ndarray  # u8[P] payload entered the system (origin was up)
     relay_left: jnp.ndarray  # u8[N, P]
     inflight: jnp.ndarray  # u8[D, N, P]
-    # sync pulls granted last round, delivered this round (one-slot
-    # buffer = the bi-stream RTT).  Kept SEPARATE from the broadcast
-    # ring because sync-received changesets carry no retransmission
-    # budget in the reference (only the rebroadcast path re-arms,
-    # handlers.rs:768-779) — r4 ground-truth: conflating them let one
-    # early post-heal sync flood the cluster via rebroadcast, several×
-    # faster than the host tier recovers
-    sync_inflight: jnp.ndarray  # u8[N, P]
+    # sync pulls in flight: granted in round t, delivered at slot
+    # (t + 1 + fault_delay) — a delay ring like ``inflight`` so
+    # FaultPlan latency can slow the bi-stream RTT (without faults only
+    # slot t+1 is ever written, the classic one-round RTT).  Kept
+    # SEPARATE from the broadcast ring because sync-received changesets
+    # carry no retransmission budget in the reference (only the
+    # rebroadcast path re-arms, handlers.rs:768-779) — r4 ground-truth:
+    # conflating them let one early post-heal sync flood the cluster
+    # via rebroadcast, several× faster than the host tier recovers
+    sync_inflight: jnp.ndarray  # u8[D, N, P]
     sync_countdown: jnp.ndarray  # i32[N]
     # per-node re-arm window: grows ×2 on fruitless due syncs up to
     # cfg.sync_backoff_cap(), resets to sync_interval_rounds on ingest
@@ -336,7 +338,7 @@ def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
         injected=jnp.zeros((p,), jnp.uint8),
         relay_left=jnp.zeros((n, p), jnp.uint8),
         inflight=jnp.zeros((cfg.n_delay_slots, n, p), jnp.uint8),
-        sync_inflight=jnp.zeros((n, p), jnp.uint8),
+        sync_inflight=jnp.zeros((cfg.n_delay_slots, n, p), jnp.uint8),
         sync_countdown=jax.random.randint(
             sub, (n,), 0, cfg.sync_interval_rounds, jnp.int32
         ),

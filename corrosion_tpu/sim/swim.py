@@ -142,7 +142,7 @@ def swim_step(
     if cfg.swim_partial_view:
         from .pswim import pswim_step
 
-        return pswim_step(state, cfg, topo, key)
+        return pswim_step(state, cfg, topo, key, faults)
     if not cfg.swim_full_view:
         return state
     n = state.alive.shape[0]

@@ -155,8 +155,11 @@ def sync_step(
         # unblocked side keeps flowing, like established TCP across a
         # young one-way partition — doc/faults.md "tier coverage" pins
         # the divergence; it only lets the host converge faster.)  Fault
-        # loss/delay don't bite here for the same reliable-bi reason as
-        # topology loss above.
+        # loss doesn't bite here for the same reliable-bi reason as
+        # topology loss above; fault LATENCY does — it slows the
+        # session's RTT, applied below as extra ring slots on delivery
+        # (jitter stays out: retransmission inside the reliable stream
+        # smooths per-message jitter, only the fixed floor shifts RTT).
         ok &= ~faults.block[src, dst] & ~faults.block[dst, src]
 
     need = edge_needs(state, cfg, src, dst, regular_fanout=s) & ok[:, None]  # [E, P]
@@ -166,17 +169,34 @@ def sync_step(
     # (version, actor) request order — no per-round permutation needed
     granted = budget_prefix_mask(need, cfg.sync_budget_bytes, meta.nbytes)
 
-    # pulls land in the one-slot sync buffer, delivered NEXT round (the
-    # bi-stream round trip) — separate from the broadcast ring because
-    # sync-received changesets carry no retransmission budget (see
-    # SimState.sync_inflight).  Fold the s edges per puller first: the
-    # regular layout makes this a reshape-reduce, no scatter.
-    pulled = (
-        granted.reshape(n, s, p).max(axis=1).astype(state.have.dtype)
-    )  # [N, P]
-    # OVERWRITE, not merge: round_step captured the previous round's
-    # buffer before calling sync and hands it to deliver_step this round
-    sync_inflight = pulled
+    # pulls land in the sync delay ring at slot t+1+fault_delay (the
+    # bi-stream round trip, stretched by any FaultPlan latency) — a ring
+    # separate from the broadcast one because sync-received changesets
+    # carry no retransmission budget (see SimState.sync_inflight).
+    d_slots = state.sync_inflight.shape[0]
+    if faults is None:
+        # every edge delivers at t+1: fold the s edges per puller first
+        # (regular layout ⇒ reshape-reduce, no scatter) and write the
+        # one slot.  deliver_step zeroed this slot when it last popped,
+        # so max() is a plain fill.
+        pulled = (
+            granted.reshape(n, s, p).max(axis=1).astype(state.have.dtype)
+        )  # [N, P]
+        sync_inflight = state.sync_inflight.at[
+            (state.t + 1) % d_slots
+        ].max(pulled)
+    else:
+        # per-edge session latency: the slower direction bounds the
+        # bi-stream RTT (compile_plan validated 1 + delay < n_delay_slots,
+        # so the target slot never collides with this round's pop)
+        sdelay = jnp.maximum(
+            faults.delay[src, dst], faults.delay[dst, src]
+        ).astype(jnp.int32)  # [E]
+        slot = (state.t + 1 + sdelay) % d_slots
+        flat_idx = slot * n + src  # deliveries land at the PULLER
+        ring = state.sync_inflight.reshape(d_slots * n, p)
+        ring = ring.at[flat_idx].max(granted.astype(state.have.dtype))
+        sync_inflight = ring.reshape(d_slots, n, p)
 
     # fruitfulness-adaptive backoff (host _sync_loop: decorrelated
     # backoff, reset when a sync ingested changes): a due sync that

@@ -90,7 +90,7 @@ def main():
     )
     d["deliver"] = timeit(
         "deliver",
-        jax.jit(lambda s: deliver_step(s, cfg, s.sync_inflight)),
+        jax.jit(lambda s: deliver_step(s, cfg)),
         state,
     )
     d["swim"] = timeit(
@@ -128,7 +128,7 @@ def main():
     )
     q["deliver"] = timeit(
         "deliver",
-        jax.jit(lambda c, s: pk.deliver_packed(c, c.sync_buf, s.t, cfg)),
+        jax.jit(lambda c, s: pk.deliver_packed(c, s.t, cfg)),
         carry, slim,
     )
     q["swim"] = timeit(

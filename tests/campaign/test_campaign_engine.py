@@ -1,0 +1,226 @@
+"""Campaign engine + spec + report: replay identity, resumable
+artifacts, wall budgeting, regression bands, and the CLI surface.
+
+Marker discipline (pytest.ini): ``campaign`` tags the subsystem; the
+tier-1 quick smoke (3-node, 4 seeds, CPU) runs in the default
+``-m 'not slow'`` selection, and ``-m "campaign and slow"`` is the
+nightly seed-swept entry over the parity plan (≥8 seeds + host-tier
+parity points + the CLI run/compare round trip)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from corrosion_tpu.campaign.engine import run_campaign
+from corrosion_tpu.campaign.report import artifact_digest, bands, compare
+from corrosion_tpu.campaign.spec import (
+    CampaignSpec,
+    builtin_spec,
+    fault_parity_3node_spec,
+    load_spec,
+    save_spec,
+)
+from corrosion_tpu.faults import FaultEvent
+
+CLI = [sys.executable, "-m", "corrosion_tpu.cli.main"]
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def _quick_spec(seeds=(0, 1, 2, 3), **kw):
+    """The tier-1 campaign shape: 3 nodes, tiny payload set, short
+    horizon — one compile, seconds of wall."""
+    kw.setdefault("max_rounds", 200)
+    return CampaignSpec(
+        name="quick-smoke",
+        scenario={
+            "n_nodes": 3, "n_payloads": 8, "fanout": 2,
+            "sync_interval_rounds": 4, "n_delay_slots": 4,
+            "inject_every": 1,
+        },
+        events=(
+            FaultEvent("loss", 0, 10, p=0.3),
+            FaultEvent("partition", 2, 8, src=1, dst=0),
+        ),
+        seeds=tuple(seeds),
+        **kw,
+    )
+
+
+# -- spec ------------------------------------------------------------------
+
+
+def test_spec_roundtrip_hash_and_grid():
+    spec = _quick_spec(grid={"fanout": [2, 3], "loss": [0.0, 0.1]})
+    d = spec.to_dict()
+    again = CampaignSpec.from_dict(d)
+    assert again == spec
+    assert again.spec_hash() == spec.spec_hash()
+    # grid expansion is a pure sorted-key cartesian product
+    cells = spec.cells()
+    assert cells == [
+        {"fanout": 2, "loss": 0.0}, {"fanout": 2, "loss": 0.1},
+        {"fanout": 3, "loss": 0.0}, {"fanout": 3, "loss": 0.1},
+    ]
+    # grid keys route to the right layer
+    assert spec.sim_config(cells[2]).fanout == 3
+    assert spec.topo(cells[1]).loss == 0.1
+    # the hash moves with ANY field
+    import dataclasses
+
+    assert dataclasses.replace(spec, seeds=(9,)).spec_hash() != spec.spec_hash()
+    # topology keys riding a flat `scenario` dict still reach Topology
+    # (and are stripped from SimConfig kwargs) — a spec file naming
+    # loss=0.2 must never silently measure a loss-free network
+    flat = CampaignSpec(
+        name="flat",
+        scenario={"n_nodes": 3, "n_payloads": 4, "loss": 0.2},
+    )
+    assert flat.topo({}).loss == 0.2
+    assert flat.sim_config({}).n_nodes == 3
+    with pytest.raises(ValueError, match="both scenario and topology"):
+        CampaignSpec(
+            name="dup", scenario={"n_nodes": 3, "n_payloads": 4,
+                                  "loss": 0.2},
+            topology={"loss": 0.1},
+        ).topo({})
+
+
+def test_spec_file_roundtrip(tmp_path):
+    spec = fault_parity_3node_spec(seeds=range(4))
+    path = tmp_path / "spec.json"
+    save_spec(spec, str(path))
+    assert load_spec(str(path)) == spec
+
+
+# -- report ----------------------------------------------------------------
+
+
+def test_bands_and_compare_verdicts():
+    b = bands([30, 31, 32, 33, 40])
+    assert b["p50"] == 32 and b["max"] == 40 and b["min"] == 30
+    assert b["p99"] in (33, 40)  # 'lower' method: an observed value
+
+    def art(p99):
+        cell = {
+            "params": {"fanout": 2},
+            "per_seed": {"rounds": [p99 - 1, p99]},
+            "bands": {
+                "rounds": {"p50": p99 - 1, "p95": p99, "p99": p99},
+                "p99_node_convergence_round": {
+                    "p50": 10, "p95": 11, "p99": 12
+                },
+            },
+            "all_converged": True,
+        }
+        return {
+            "spec_hash": "x", "cells": [cell],
+            "result_digest": artifact_digest([cell]),
+        }
+
+    # within tolerance (10% + 2 rounds): pass
+    rep = compare(art(30), art(33))
+    assert rep["verdict"] == "pass" and not rep["regressions"]
+    # beyond tolerance: regress, and the offending band is named
+    rep = compare(art(30), art(40))
+    assert rep["verdict"] == "regress"
+    assert any(r["metric"] == "rounds.p99" for r in rep["regressions"])
+    # a candidate missing a baseline cell regresses (budget-starved
+    # re-runs must not silently pass)
+    empty = {"spec_hash": "x", "cells": [], "result_digest": "d"}
+    assert compare(art(30), empty)["verdict"] == "regress"
+    # improvements never regress
+    assert compare(art(40), art(30))["verdict"] == "pass"
+
+
+# -- engine ----------------------------------------------------------------
+
+
+@pytest.mark.campaign
+def test_quick_smoke_replay_reproduces_artifact_digest(tmp_path):
+    """Tier-1 quick smoke (3-node, 4 seeds, CPU): the campaign runs,
+    bands come out, and a replay of the same content hash reproduces the
+    result digest exactly — zero regressions by construction."""
+    spec = _quick_spec()
+    a = run_campaign(spec, out_path=str(tmp_path / "a.json"))
+    b = run_campaign(spec, out_path=str(tmp_path / "b.json"))
+    assert a["spec_hash"] == b["spec_hash"] == spec.spec_hash()
+    assert a["result_digest"] == b["result_digest"]
+    cell = a["cells"][0]
+    assert cell["all_converged"], cell["per_seed"]
+    assert cell["bands"]["rounds"]["p99"] >= cell["bands"]["rounds"]["p50"]
+    assert len(cell["per_seed"]["rounds"]) == 4
+    assert cell["wall_verdict"] == "ok"
+    rep = compare(a, b)
+    assert rep["verdict"] == "pass" and rep["identical_results"]
+    assert not rep["regressions"]
+
+
+@pytest.mark.campaign
+def test_resume_and_wall_budget(tmp_path):
+    """A zero budget skips every cell; the resumed run completes only
+    the remainder and the final artifact matches an unbudgeted run's
+    digest (cells are deterministic, so resume composes)."""
+    spec = _quick_spec(seeds=(0, 1), grid={"fanout": [2, 3]})
+    out = str(tmp_path / "art.json")
+    starved = run_campaign(spec, out_path=out, wall_budget_s=0.0)
+    assert starved["skipped_cells"] == [0, 1]
+    assert starved["cells"] == []
+    resumed = run_campaign(spec, out_path=out)  # no budget: completes
+    assert resumed["skipped_cells"] == []
+    assert [c["cell_index"] for c in resumed["cells"]] == [0, 1]
+    # the artifact on disk is the resumed one
+    with open(out) as f:
+        on_disk = json.load(f)
+    assert on_disk["result_digest"] == resumed["result_digest"]
+    # a fresh no-resume run agrees bit-for-bit on the deterministic part
+    fresh = run_campaign(spec, out_path=None)
+    assert fresh["result_digest"] == resumed["result_digest"]
+
+
+# -- nightly (slow) --------------------------------------------------------
+
+
+@pytest.mark.campaign
+@pytest.mark.slow
+def test_nightly_seed_swept_parity_plan(tmp_path):
+    """The `-m "campaign and slow"` nightly entry: the 3-node
+    fault-parity plan at 8 seeds WITH host-tier parity points, then the
+    CLI run/compare round trip on the same spec — `sim campaign run`
+    twice must compare to a zero-regression pass."""
+    import dataclasses
+
+    spec = dataclasses.replace(
+        fault_parity_3node_spec(seeds=range(8)), host_parity=True
+    )
+    art = run_campaign(spec, out_path=str(tmp_path / "nightly.json"))
+    cell = art["cells"][0]
+    assert cell["all_converged"]
+    hp = cell["host_parity"]
+    assert hp["heads_match"], hp
+
+    # CLI surface: run twice (resumable artifacts at distinct paths),
+    # compare must pass with identical digests
+    spec_path = tmp_path / "spec.json"
+    save_spec(dataclasses.replace(spec, host_parity=False), str(spec_path))
+    outs = []
+    for name in ("base.json", "cand.json"):
+        out = str(tmp_path / name)
+        r = subprocess.run(
+            [*CLI, "sim", "campaign", "run", "--spec", str(spec_path),
+             "--out", out],
+            capture_output=True, text=True, env=ENV, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr
+        outs.append(out)
+    r = subprocess.run(
+        [*CLI, "sim", "campaign", "compare", "--baseline", outs[0],
+         "--candidate", outs[1]],
+        capture_output=True, text=True, env=ENV, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["verdict"] == "pass" and not rep["regressions"]
+    assert rep["identical_results"]

@@ -1,0 +1,72 @@
+"""Ensemble determinism (ISSUE 3 acceptance): a vmapped K-seed run
+produces byte-identical per-lane state and metrics to K sequential
+single-seed runs — the sequential-equivalence guarantee the campaign
+engine's statistics stand on."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.campaign.ensemble import run_seed_ensemble
+from corrosion_tpu.campaign.spec import fault_parity_3node_spec
+from corrosion_tpu.sim.faults import compile_plan, run_fault_plan
+from corrosion_tpu.sim.round import new_sim, run_to_convergence
+from corrosion_tpu.sim.state import uniform_payloads
+
+LANE_FIELDS = (
+    "t", "have", "alive", "heads", "relay_left", "incarnation",
+    "sync_backoff", "gap_lo", "gap_hi",
+)
+
+
+@pytest.mark.campaign
+def test_vmapped_4seed_ensemble_matches_sequential_runs():
+    """The acceptance gate: 4 vmapped lanes of the fault-parity plan ==
+    4 sequential `run_fault_plan` runs, byte-for-byte, under
+    JAX_PLATFORMS=cpu (conftest forces it)."""
+    seeds = (0, 1, 2, 3)
+    spec = fault_parity_3node_spec(seeds=seeds)
+    cfg, topo = spec.sim_config({}), spec.topo({})
+    meta = uniform_payloads(cfg, inject_every=1)
+    plan = spec.fault_plan({}, seed=seeds[0])
+
+    finals, metrics = run_seed_ensemble(
+        plan, cfg, topo, meta, seeds, max_rounds=spec.max_rounds
+    )
+    for k, s in enumerate(seeds):
+        fp = compile_plan(dataclasses.replace(plan, seed=int(s)), cfg, topo)
+        solo, solo_m = run_fault_plan(
+            new_sim(cfg, int(s)), meta, cfg, topo, fp, spec.max_rounds
+        )
+        for name in LANE_FIELDS:
+            lane = np.asarray(getattr(finals, name)[k])
+            ref = np.asarray(getattr(solo, name))
+            assert (lane == ref).all(), (
+                f"lane {k} (seed {s}) field {name} diverged from the "
+                f"sequential run"
+            )
+        assert (
+            np.asarray(metrics.converged_at[k])
+            == np.asarray(solo_m.converged_at)
+        ).all()
+        assert (
+            np.asarray(metrics.coverage_at[k])
+            == np.asarray(solo_m.coverage_at)
+        ).all()
+
+
+@pytest.mark.campaign
+def test_fault_free_ensemble_matches_run_to_convergence():
+    """Without a plan the lanes ride `run_to_convergence` (same packed/
+    dense dispatch as a solo run) and stay byte-identical per lane."""
+    spec = fault_parity_3node_spec(seeds=(7, 8))
+    cfg, topo = spec.sim_config({}), spec.topo({})
+    meta = uniform_payloads(cfg, inject_every=1)
+    finals, _ = run_seed_ensemble(
+        None, cfg, topo, meta, (7, 8), max_rounds=200
+    )
+    for k, s in enumerate((7, 8)):
+        solo, _ = run_to_convergence(new_sim(cfg, s), meta, cfg, topo, 200)
+        assert int(finals.t[k]) == int(solo.t)
+        assert (np.asarray(finals.have[k]) == np.asarray(solo.have)).all()

@@ -194,3 +194,40 @@ def test_sim_smoke():
     out = run_cli("sim", "ground-truth-3node", timeout=300)
     m = json.loads(out.stdout)
     assert m.get("converged", 0) >= 1 or m.get("rounds", 0) > 0, m
+
+
+def test_sim_campaign_compare_cli(tmp_path):
+    """`sim campaign compare` verdict + exit codes on synthetic
+    artifacts (no jax in this path — the spec/report layer is plain
+    Python); the full run|compare round trip is the campaign nightly
+    (tests/campaign/test_campaign_engine.py)."""
+    cell = {
+        "params": {}, "per_seed": {"rounds": [30, 31]},
+        "bands": {"rounds": {"p50": 30, "p95": 31, "p99": 31}},
+        "all_converged": True,
+    }
+    base = {"spec_hash": "h", "cells": [cell], "result_digest": "d"}
+    worse = json.loads(json.dumps(base))
+    worse["cells"][0]["bands"]["rounds"]["p99"] = 60
+    worse["result_digest"] = "d2"
+    p_base, p_same, p_worse = (
+        tmp_path / "base.json", tmp_path / "same.json", tmp_path / "worse.json"
+    )
+    p_base.write_text(json.dumps(base))
+    p_same.write_text(json.dumps(base))
+    p_worse.write_text(json.dumps(worse))
+
+    out = run_cli(
+        "sim", "campaign", "compare",
+        "--baseline", str(p_base), "--candidate", str(p_same),
+    )
+    rep = json.loads(out.stdout)
+    assert rep["verdict"] == "pass" and rep["identical_results"]
+
+    out = run_cli(
+        "sim", "campaign", "compare",
+        "--baseline", str(p_base), "--candidate", str(p_worse),
+        check=False,
+    )
+    assert out.returncode == 1
+    assert json.loads(out.stdout)["verdict"] == "regress"

@@ -1,0 +1,150 @@
+"""FaultPlan → real sockets (ISSUE 3 satellite): the existing
+`UdpTcpTransport` `FaultInjector` driven from a compiled FaultPlan
+schedule, with the SAME per-link seed derivation as the host-memory and
+sim tiers — the third backend of the transport seam."""
+
+import asyncio
+import tempfile
+
+import pytest
+
+from corrosion_tpu.faults import (
+    CLEAR,
+    FaultEvent,
+    FaultPlan,
+    RealSocketFaultDriver,
+    derive_seed,
+)
+from corrosion_tpu.agent.transport import UdpTcpTransport
+
+
+def _lossy_plan(seed=11, rounds=4):
+    return FaultPlan(
+        n_nodes=2, seed=seed, round_s=0.02,
+        events=(
+            FaultEvent("loss", 0, rounds, src=0, dst=1, p=0.5),
+            FaultEvent("partition", rounds, rounds + 2, src=0, dst=1),
+        ),
+    )
+
+
+async def _drive_sends(plan, n_frames=40):
+    """Boot two bare transports, apply round 0 of the plan, fire
+    ``n_frames`` uni frames 0→1, and return the delivered payload set."""
+    t0, t1 = UdpTcpTransport(), UdpTcpTransport()
+    a0 = await t0.start()
+    a1 = await t1.start()
+    got = []
+
+    async def on_uni(_addr, data):
+        got.append(data)
+
+    async def nop(*_a):
+        return None
+
+    t1.set_handlers(nop, on_uni, nop)
+    t0.set_handlers(nop, nop, nop)
+    try:
+        driver = RealSocketFaultDriver(plan, [t0, t1], [a0, a1])
+        driver.apply_round(0)
+        for k in range(n_frames):
+            await t0.send_uni(a1, f"frame-{k}".encode())
+        await asyncio.sleep(0.2)  # let the frame pump drain
+        dropped = t0.faults.dropped
+        # the per-dst stream is derive_seed(seed, "link", 0, 1, epoch=0)
+        # — byte-identical to the host tier's derivation
+        lm = t0.faults.links[a1]
+        assert lm.seed == derive_seed(plan.seed, "link", 0, 1, 0)
+        assert lm.loss == 0.5
+
+        # partition window: the same driver blocks 0→1 entirely
+        driver.apply_round(plan.events[1].start)
+        with pytest.raises(ConnectionError):
+            await t0.send_uni(a1, b"through-the-cut")
+
+        # past the horizon the schedule is all-clear
+        driver.apply_round(plan.horizon)
+        assert not t0.faults.blocked_peers
+        assert a1 not in t0.faults.links
+        driver.clear()
+        assert t0.faults is None
+        return [d.decode() for d in got], dropped
+    finally:
+        await t0.close()
+        await t1.close()
+
+
+def test_faultplan_drives_real_sockets_deterministically():
+    """Same plan seed ⇒ the exact same frames survive the lossy link on
+    two independent boots (fresh sockets, fresh ports — only the seed
+    carries over); a different seed ⇒ a different drop pattern."""
+    plan = _lossy_plan(seed=11)
+    got_a, dropped_a = asyncio.run(_drive_sends(plan))
+    got_b, dropped_b = asyncio.run(_drive_sends(plan))
+    assert got_a == got_b
+    assert dropped_a == dropped_b
+    assert 0 < dropped_a < 40  # the loss actually bit, but not everything
+
+    got_c, _ = asyncio.run(_drive_sends(_lossy_plan(seed=12)))
+    assert got_c != got_a
+
+
+@pytest.mark.chaos
+def test_realsocket_campaign_converges_after_schedule():
+    """End-to-end: 3 real-socket agents under a compiled FaultPlan
+    (loss burst + one-way partition), writes during the schedule, then
+    `driver.run()` heals everything and check_bookkeeping must hold —
+    the PR 2 parity property on the third tier."""
+    from corrosion_tpu.agent.agent import Agent
+    from corrosion_tpu.agent.config import Config
+    from corrosion_tpu.testing import TEST_SCHEMA, fast_perf
+
+    from .test_realsocket_partition import _wait_bookkeeping
+
+    plan = FaultPlan(
+        n_nodes=3, seed=5, round_s=0.04,
+        events=(
+            FaultEvent("loss", 0, 10, p=0.3),
+            FaultEvent("partition", 2, 8, src=1, dst=0),
+        ),
+    )
+
+    async def body():
+        with tempfile.TemporaryDirectory() as tmp:
+            transports = [UdpTcpTransport() for _ in range(3)]
+            addrs = [await t.start() for t in transports]
+            agents = []
+            for i, t in enumerate(transports):
+                cfg = Config(
+                    db_path=f"{tmp}/n{i}.db",
+                    gossip_addr=addrs[i],
+                    bootstrap=[a for a in addrs if a != addrs[i]],
+                    perf=fast_perf(),
+                )
+                agent = Agent(cfg, t)
+                agent.store.execute_schema(TEST_SCHEMA)
+                agents.append(agent)
+            for a in agents:
+                await a.start()
+            try:
+                driver = RealSocketFaultDriver(plan, transports, addrs)
+                drive = asyncio.ensure_future(driver.run())
+                for k in range(8):
+                    agents[k % 3].exec_transaction(
+                        [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                          (k, f"rs-{k}"))]
+                    )
+                    await asyncio.sleep(plan.round_s)
+                await drive
+                assert all(t.faults is None for t in transports)
+                assert await _wait_bookkeeping(agents, 45), (
+                    "real-socket tier never re-converged after the plan"
+                )
+                for a in agents:
+                    (n,) = a.store.query("SELECT count(*) FROM tests")[0]
+                    assert n == 8
+            finally:
+                for a in agents:
+                    await a.stop()
+
+    asyncio.run(body())

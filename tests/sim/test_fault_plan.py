@@ -84,12 +84,11 @@ def test_compile_rejects_delay_overflowing_the_ring():
     )
     with pytest.raises(ValueError, match="n_delay_slots"):
         compile_plan(plan, _cfg(), Topology())
-    # partial-view SWIM doesn't carry the fault seam yet: a campaign
-    # whose probes ignore partitions would report silently-wrong
-    # convergence, so compile refuses (ROADMAP open item)
+    # partial-view SWIM carries the fault seam since ISSUE 3 (pswim_step
+    # consumes RoundFaults): compiling a partial-view campaign is legal
     ok_plan = FaultPlan(3, 0, (FaultEvent("loss", 0, 4, p=0.1),))
-    with pytest.raises(ValueError, match="partial-view"):
-        compile_plan(ok_plan, _cfg(swim_partial_view=True), Topology())
+    fp = compile_plan(ok_plan, _cfg(swim_partial_view=True), Topology())
+    assert fp.loss.shape == (6, 3, 3)  # rounds 0..horizon inclusive
 
 
 def test_fault_run_replays_identical_per_round_decisions():

@@ -1,0 +1,133 @@
+"""FaultPlan through partial-view SWIM (ISSUE 3 satellite): the ROADMAP
+gap where pswim probes sailed through partitions while broadcast/sync
+honored them is closed — `pswim_step` consumes `RoundFaults` via
+`_reachable`, same seam as the full-view kernel."""
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.faults import FaultEvent, FaultPlan
+from corrosion_tpu.sim.faults import compile_plan, round_faults, run_fault_plan
+from corrosion_tpu.sim.round import new_sim, round_step
+from corrosion_tpu.sim.state import ALIVE, SimConfig, uniform_payloads
+from corrosion_tpu.sim.topology import Topology, regions
+
+
+def _pcfg(n=12, **kw):
+    kw.setdefault("member_slots", 4)
+    kw.setdefault("probe_period_rounds", 1)
+    kw.setdefault("suspect_timeout_rounds", 2)
+    return SimConfig(
+        n_nodes=n, n_payloads=1, fanout=2, swim_partial_view=True,
+        sync_interval_rounds=4, **kw
+    )
+
+
+@pytest.mark.chaos
+def test_pswim_probes_honor_faultplan_partition():
+    """A node symmetric-partitioned by a FaultPlan must be detected by
+    the partial-view tier: probes to it fail (direct AND relayed), its
+    announces never land, so watchers' table entries for it go
+    SUSPECT→DOWN — while a fault-free control run of the same seed
+    never suspects anyone (no loss, no cuts ⇒ every probe acks)."""
+    cfg = _pcfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    topo = Topology()
+    plan = FaultPlan(
+        n_nodes=cfg.n_nodes, seed=4,
+        events=(
+            FaultEvent("partition", 0, 30, src=0, dst="*", symmetric=True),
+        ),
+    )
+    fplan = compile_plan(plan, cfg, topo)
+    final, _ = run_fault_plan(
+        new_sim(cfg, seed=9), meta, cfg, topo, fplan, max_rounds=30
+    )
+    pid = np.asarray(final.pid)
+    pkey = np.asarray(final.pkey)
+    alive = np.asarray(final.alive)
+    assert (alive == ALIVE).all()  # the partition downs nobody for real
+    # somebody tracked node 0 and marked it non-ALIVE (it cannot refute:
+    # every message it sends is cut)
+    about0 = (pid == 0) & (np.arange(cfg.n_nodes)[:, None] != 0)
+    assert about0.any()
+    assert ((pkey % 4 != ALIVE) & about0).sum() > 0, (
+        "no watcher ever suspected the partitioned node — probes are "
+        "sailing through the FaultPlan cut"
+    )
+
+    # control: same scenario seed, no faults — nobody is ever suspected
+    ctl, _ = run_fault_plan(
+        new_sim(cfg, seed=9), meta, cfg, topo,
+        compile_plan(FaultPlan(n_nodes=cfg.n_nodes, seed=4, events=()),
+                     cfg, topo),
+        max_rounds=30,
+    )
+    cpid, cpkey = np.asarray(ctl.pid), np.asarray(ctl.pkey)
+    filled = cpid >= 0
+    assert (cpkey[filled] % 4 == ALIVE).all()
+
+
+def test_wipe_empties_membership_beliefs_too():
+    """A crash-with-wipe must lose the node's own membership state —
+    partial-view: member table back to EMPTY (announce/refill/gossip
+    repopulate it); full-view: belief row back to the optimistic init —
+    else a 'wiped' node rejoins with a warm member list and campaign
+    recovery rounds are under-reported vs the host tier's cold rejoin."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.sim.faults import RoundFaults, apply_node_faults
+
+    for kind in ("partial", "full"):
+        cfg = (
+            _pcfg(n=6)
+            if kind == "partial"
+            else SimConfig(n_nodes=6, n_payloads=1, fanout=2,
+                           swim_full_view=True)
+        )
+        state = new_sim(cfg, seed=1)
+        n = cfg.n_nodes
+        rf = RoundFaults(
+            block=jnp.zeros((n, n), bool), loss=jnp.zeros((n, n), jnp.uint8),
+            delay=jnp.zeros((n, n), jnp.uint8),
+            jitter=jnp.zeros((n, n), jnp.uint8),
+            alive=jnp.full((n,), -1, jnp.int8),
+            wipe=jnp.arange(n) == 2, seed=jnp.int32(0),
+        )
+        wiped = apply_node_faults(state, rf)
+        if kind == "partial":
+            assert (np.asarray(wiped.pid)[2] == -1).all()
+            assert (np.asarray(wiped.pkey)[2] == -1).all()
+            assert (np.asarray(wiped.pid)[0] == np.asarray(state.pid)[0]).all()
+        else:
+            assert (np.asarray(wiped.view)[2] == 0).all()
+            assert (np.asarray(wiped.vinc)[2] == 0).all()
+            assert (
+                np.asarray(wiped.view)[0] == np.asarray(state.view)[0]
+            ).all()
+
+
+def test_pswim_all_clear_faults_byte_identical_to_none():
+    """RNG compatibility: an all-clear RoundFaults slice must leave the
+    pswim phase byte-identical to faults=None — fault keys are fold_in-
+    derived inside the `faults is not None` branch, never split from the
+    phase keys, so existing seeded partial-view runs replay unchanged."""
+    cfg = _pcfg(n=8)
+    meta = uniform_payloads(cfg, inject_every=1)
+    topo = Topology()
+    region = regions(cfg.n_nodes, topo.n_regions)
+    fplan = compile_plan(
+        FaultPlan(n_nodes=cfg.n_nodes, seed=0, events=()), cfg, topo
+    )
+    from corrosion_tpu.sim.round import new_metrics
+
+    sa = sb = new_sim(cfg, seed=3)
+    ma = mb = new_metrics(cfg)
+    for _ in range(6):
+        rf = round_faults(fplan, sa.t)
+        sa, ma = round_step(sa, ma, meta, cfg, topo, region, faults=rf)
+        sb, mb = round_step(sb, mb, meta, cfg, topo, region, faults=None)
+    for name in ("pid", "pkey", "psince", "incarnation", "have", "heads"):
+        assert (
+            np.asarray(getattr(sa, name)) == np.asarray(getattr(sb, name))
+        ).all(), f"{name} diverged under an all-clear fault slice"
