@@ -53,6 +53,7 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
 # best-so-far, printed exactly once (normal exit or signal)
 _best: dict | None = None
 _secondary: dict | None = None
+_fault_storm: dict | None = None
 _printed = False
 _diag: dict = {"attempts": [], "preflight": None, "started_unix": time.time()}
 
@@ -81,6 +82,10 @@ def _emit_and_exit(code: int = 0) -> None:
     # vs_baseline); the driver's primary schema is unchanged
     if _secondary is not None:
         out["secondary"] = _secondary
+    # fault-storm rung (ISSUE 4): the 100k storm under a loss+partition
+    # FaultPlan on the packed path, tracked as its own secondary record
+    if _fault_storm is not None:
+        out["packed_fault_storm"] = _fault_storm
     print(json.dumps(out), flush=True)
     _write_diag()
     os._exit(code)
@@ -371,6 +376,59 @@ def main() -> int:
                 "gap_overflow_frac_max": m.get("gap_overflow_frac_max"),
             }
             _diag["gapstress"] = {"nodes": gs_nodes, **m}
+        _write_diag()
+
+    # fault-storm rung (ISSUE 4): the headline storm shape under a
+    # loss burst + half-split partition + crash-with-wipe FaultPlan,
+    # on the PACKED round path (run_fault_plan dispatches packed over
+    # the bitpack envelope since this PR).  The child runs the fault
+    # storm AND a faultless packed run of the same scenario on the same
+    # platform, with the defensible-wall machinery (sim/perf.verify_wall)
+    # applied to the fault side — acceptance holds the fault wall ≤ 2×
+    # the faultless wall.  Reported as its own secondary record so the
+    # fault-path trajectory is tracked from this PR on.
+    global _fault_storm
+    if os.environ.get("BENCH_FAULT_STORM", "1") != "0" and _remaining() > 300:
+        fs_nodes = int(
+            os.environ.get(
+                "BENCH_FAULT_STORM_NODES",
+                str(_diag.get("best", {}).get("nodes", min(cap, 100_000))),
+            )
+        )
+        res = run_child(
+            {
+                "mode": "aux",
+                "platform": plat or None,
+                "fn": "config_packed_fault_storm",
+                "seed": 1,
+                "kwargs": {"n_nodes": fs_nodes, "n_payloads": n_payloads},
+            },
+            timeout=min(_remaining() - 60, 900.0),
+        )
+        _diag["attempts"].append(
+            {"phase": "fault_storm", "nodes": fs_nodes, **res}
+        )
+        m = res.get("metrics") or {}
+        if res.get("ok") and m.get("converged"):
+            value = round(float(m["wall_clock_s"]), 3)
+            suffix = "_cpu_fallback" if on_cpu else ""
+            _fault_storm = {
+                "metric": (
+                    f"sim_packed_fault_storm_{fs_nodes // 1000}k_"
+                    f"convergence_wallclock{suffix}"
+                ),
+                "value": value,
+                "unit": "s",
+                "round_path": m.get("round_path"),
+                "wall_verdict": m.get("sanity", {}).get("verdict"),
+                "faultless_wall_clock_s": m.get("faultless_wall_clock_s"),
+                # the acceptance ratio: defensible fault wall over the
+                # faultless packed wall, same platform both sides
+                "fault_over_faultless": round(
+                    float(m.get("fault_over_faultless", 0.0)), 3
+                ),
+            }
+            _diag["fault_storm"] = {"nodes": fs_nodes, **m}
         _write_diag()
 
     # packed-vs-dense A/B on the headline shape (VERDICT r3 item 2: the

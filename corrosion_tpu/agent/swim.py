@@ -101,6 +101,15 @@ class SwimRuntime:
         # load-robust detection latency in probe periods, not wall-clock
         self.probe_tick = 0
         self.down_tick: Dict[ActorId, int] = {}
+        # observed event-loop stretch (actual probe-period sleep over the
+        # requested interval): under suite load the scheduler stretches
+        # the whole node — probe cadence AND the peer's ack path — so the
+        # ack deadline must stretch with it or an overloaded-but-healthy
+        # peer gets falsely suspected (the full-suite stress flake: 27/30
+        # live under load, clean in isolation).  The suspicion WINDOW
+        # already runs on the probe-tick clock; this is its wall-clock
+        # sibling for the probe timeout.
+        self._lag_factor = 1.0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -373,7 +382,9 @@ class SwimRuntime:
             )
             try:
                 await asyncio.wait_for(
-                    ev.wait(), self.agent.config.perf.swim_probe_timeout_s
+                    ev.wait(),
+                    self.agent.config.perf.swim_probe_timeout_s
+                    * self._lag_factor,
                 )
                 return True
             except asyncio.TimeoutError:
@@ -385,7 +396,16 @@ class SwimRuntime:
         perf = self.agent.config.perf
         while not self._stopped:
             # cadence re-derived each tick from live membership
-            await asyncio.sleep(self.effective_probe_interval_s())
+            interval = self.effective_probe_interval_s()
+            slept_at = time.monotonic()
+            await asyncio.sleep(interval)
+            # re-measure the loop stretch every tick (EWMA so one long GC
+            # pause doesn't stick); clamp ≥1 (never shrink below config)
+            # and ≤8 (a truly dead peer must still be suspectable)
+            stretch = (time.monotonic() - slept_at) / max(interval, 1e-6)
+            self._lag_factor = min(
+                max(0.5 * self._lag_factor + 0.5 * stretch, 1.0), 8.0
+            )
             self.probe_tick += 1
             self._expire_suspects()
             candidates = [
@@ -415,7 +435,10 @@ class SwimRuntime:
                         },
                     )
                 try:
-                    await asyncio.wait_for(ev.wait(), perf.swim_probe_timeout_s * 2)
+                    await asyncio.wait_for(
+                        ev.wait(),
+                        perf.swim_probe_timeout_s * 2 * self._lag_factor,
+                    )
                     ok = True
                 except asyncio.TimeoutError:
                     ok = False

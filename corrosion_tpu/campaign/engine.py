@@ -8,6 +8,7 @@ One `run_campaign` call turns a `CampaignSpec` into an **artifact**:
   "spec": {...}, "spec_hash": "…",
   "cells": [
     {"cell_index": 0, "params": {...}, "seeds": [...],
+     "round_path": "packed" | "dense",   # which kernels the cell ran
      "per_seed": {"rounds": [...], "converged": [...],
                   "unconverged_nodes": [...],
                   "p99_node_convergence_round": [...]},
@@ -68,6 +69,7 @@ def _run_cell(
     reduced to per-seed records + cross-seed bands."""
     import jax
 
+    from ..sim.packed import packed_supported
     from ..sim.perf import analytic_min_round_s
     from ..sim.state import ALIVE, uniform_payloads
     from .ensemble import run_seed_ensemble
@@ -76,6 +78,10 @@ def _run_cell(
     topo = spec.topo(cell)
     meta = uniform_payloads(cfg, inject_every=spec.inject_every(cell))
     plan = spec.fault_plan(cell, seed=spec.seeds[0])
+    # which round implementation the ensemble dispatches (fault plans
+    # included — ISSUE 4): recorded per cell so dense fallbacks are
+    # visible in artifacts and CLI output instead of silent
+    round_path = "packed" if packed_supported(cfg, topo) else "dense"
 
     t0 = time.monotonic()
     finals, metrics = run_seed_ensemble(
@@ -115,6 +121,7 @@ def _run_cell(
         "params": dict(cell),
         "n_nodes": cfg.n_nodes,
         "n_payloads": cfg.n_payloads,
+        "round_path": round_path,
         "seeds": list(spec.seeds),
         "plan_horizon": plan.horizon if plan is not None else 0,
         "per_seed": per_seed,

@@ -66,20 +66,20 @@ def run_ensemble(
 ) -> Tuple[SimState, RunMetrics]:
     """Run every lane to convergence (or ``max_rounds``) in one batched
     program.  ``fplan`` holds the shared schedule tensors; ``plan_seeds``
-    (i32[K]) re-seeds each lane's fault streams.  Without a plan the
-    lanes ride `run_to_convergence` (packed dispatch included — the
-    batch rule vmaps whichever path the scenario compiles to)."""
+    (i32[K]) re-seeds each lane's fault streams.  Both entries dispatch
+    the packed round over the bitpack envelope (`run_to_convergence`
+    faultless, `run_fault_plan` under a plan since ISSUE 4) — the batch
+    rule vmaps whichever path the scenario compiles to."""
     if fplan is None:
         return jax.vmap(
             lambda st: run_to_convergence(st, meta, cfg, topo, max_rounds)
         )(states)
     if plan_seeds is None:
         plan_seeds = jnp.broadcast_to(fplan.seed, states.t.shape)
-    # batch ONLY the plan-seed scalar; the schedule tensors stay shared
-    lane_axes = SimFaultPlan(
-        block=None, loss=None, delay=None, jitter=None, alive=None,
-        wipe=None, seed=0,
-    )
+    # batch ONLY the plan-seed scalar; the schedule tensors stay shared.
+    # Built by tree-map so BOTH compiled forms work (matrix SimFaultPlan
+    # with optional None classes, and the storm-scale FactoredFaultPlan)
+    lane_axes = jax.tree.map(lambda _: None, fplan)._replace(seed=0)
     return jax.vmap(
         lambda st, fp: run_fault_plan(st, meta, cfg, topo, fp, max_rounds),
         in_axes=(0, lane_axes),

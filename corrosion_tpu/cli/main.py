@@ -356,6 +356,9 @@ _SIM_SCENARIOS = {
     # packed-vs-dense A/B on the storm shape (results must be identical;
     # reports the realized speedup)
     "storm-ab": "config_storm_ab",
+    # the storm shape under a loss+partition+crash FaultPlan, on the
+    # PACKED round path (ISSUE 4), with the defensible-wall protocol
+    "packed-fault-storm": "config_packed_fault_storm",
 }
 
 
@@ -483,6 +486,17 @@ def cmd_campaign(args) -> int:
             json.dumps(c.get("params", {}), sort_keys=True): c["bands"][
                 "rounds"
             ]
+            for c in artifact["cells"]
+        },
+        # which round kernels each grid point ran (ISSUE 4): dense
+        # fallbacks must be visible, not silent — a fault sweep that
+        # quietly dropped off the packed path costs 4-30× per primitive.
+        # Cells resumed from a pre-round_path artifact report "unknown",
+        # never a false "dense" alarm.
+        "kernel_paths": {
+            json.dumps(c.get("params", {}), sort_keys=True): c.get(
+                "round_path", "unknown"
+            )
             for c in artifact["cells"]
         },
     }

@@ -57,7 +57,22 @@ KINDS = (
     "clock_skew",  # HLC physical-clock offset on one node
 )
 
-NodeSel = Union[int, str]  # node index or "*"
+NodeSel = Union[int, str]  # node index, "*", or a "lo:hi" half-open range
+
+
+def sel_indices(sel: NodeSel, n: int) -> range:
+    """Node selector → index range: an int selects one node, ``"*"``
+    every node, and ``"lo:hi"`` the half-open range [lo, hi) — the
+    storm-scale selector (a 100k-node half-split partition must be ONE
+    event, not 2.5e9 expanded pairs; the factored sim compiler lowers a
+    range straight to a node mask)."""
+    if sel == "*":
+        return range(n)
+    if isinstance(sel, str) and ":" in sel:
+        lo, hi = sel.split(":", 1)
+        return range(int(lo), int(hi))
+    i = int(sel)
+    return range(i, i + 1)
 
 
 def derive_seed(seed: int, *tokens) -> int:
@@ -105,6 +120,13 @@ class FaultEvent:
             raise ValueError(f"{self.kind} needs node=")
         if self.kind in ("loss", "duplicate") and not (0.0 <= self.p <= 1.0):
             raise ValueError(f"{self.kind}: p={self.p} outside [0, 1]")
+        if self.delay_rounds > 255:
+            # the sim's matrix compiler stores delays at u8 grain; a
+            # silent clamp there would diverge from the factored form
+            raise ValueError(
+                f"{self.kind}: delay_rounds={self.delay_rounds} exceeds "
+                "the 255-round schedule grain"
+            )
 
 
 @dataclass(frozen=True)
@@ -178,7 +200,8 @@ class FaultPlan:
         object.__setattr__(self, "events", tuple(self.events))
         for ev in self.events:
             for sel in (ev.src, ev.dst):
-                if sel != "*" and not 0 <= int(sel) < self.n_nodes:
+                r = sel_indices(sel, self.n_nodes)
+                if len(r) == 0 or r.start < 0 or r.stop > self.n_nodes:
                     raise ValueError(f"node selector {sel} outside 0..{self.n_nodes - 1}")
             if ev.node is not None and not 0 <= ev.node < self.n_nodes:
                 raise ValueError(f"node {ev.node} outside 0..{self.n_nodes - 1}")
@@ -192,8 +215,8 @@ class FaultPlan:
         return max((ev.end for ev in self.events), default=0) + 1
 
     def _pairs(self, ev: FaultEvent):
-        srcs = range(self.n_nodes) if ev.src == "*" else (int(ev.src),)
-        dsts = range(self.n_nodes) if ev.dst == "*" else (int(ev.dst),)
+        srcs = sel_indices(ev.src, self.n_nodes)
+        dsts = sel_indices(ev.dst, self.n_nodes)
         for s in srcs:
             for d in dsts:
                 if s != d:

@@ -99,29 +99,19 @@ def broadcast_step(
 
     delay_ep = None
     if faults is not None:
-        # FaultPlan seam (sim/faults.py): directed cuts, extra per-link
+        # FaultPlan seam (sim/faults.py `fault_wire_effects`, shared
+        # verbatim with the packed path): directed cuts, extra per-link
         # loss, fixed delay + jitter drawn per (edge, PAYLOAD) — each
         # changeset rides its own uni frame on the wire (the same grain
         # as edge_payload_drop), so jitter reorders traffic within one
-        # flush exactly like the host tier's per-message draw.  Keys are
-        # fold_in-derived (never split from the phase keys) so the
-        # faults=None path stays byte-identical, and fold the PLAN seed
-        # so the fault decisions are plan-seeded, as on the host tier.
-        k_fault = jax.random.fold_in(key, faults.seed)
-        k_floss = jax.random.fold_in(k_fault, 101)
-        k_fjit = jax.random.fold_in(k_fault, 102)
-        ok &= ~faults.block[src, dst]
-        thr = faults.loss[src, dst]  # u8[E]
-        fbits = jax.random.bits(k_floss, (src.shape[0], p), dtype=jnp.uint8)
-        drop = drop | (fbits < thr[:, None])
-        delay = delay + faults.delay[src, dst].astype(jnp.int32)
-        jit = faults.jitter[src, dst].astype(jnp.int32)  # [E]
-        draw = jax.random.randint(
-            k_fjit, (src.shape[0], p), 0, jnp.iinfo(jnp.int32).max
+        # flush exactly like the host tier's per-message draw.  Classes
+        # the plan never schedules are trace-time no-ops — same results
+        # as all-zero tensors, none of the draws.
+        from .faults import fault_wire_effects
+
+        ok, drop, delay, delay_ep = fault_wire_effects(
+            faults, key, src, dst, p, ok, drop, delay
         )
-        delay_ep = delay[:, None] + jnp.where(
-            jit[:, None] > 0, draw % (jit[:, None] + 1), 0
-        )  # [E, P]
     payload = state.have.dtype
     # `sending[src]` is a regular f-fold repeat (src = repeat(arange, f))
     # — a broadcast, not a 100M-cell random gather at the gapstress shape

@@ -29,6 +29,28 @@ construction, and `round_faults` clamps its index there — after the
 horizon the sim runs fault-free, the steady state convergence is
 measured in.
 
+Two compiled representations, one consumer surface (ISSUE 4):
+
+- **matrix** (`SimFaultPlan`): the [R+1, N, N] tensors above — exact
+  for arbitrary per-link schedules, O(R·N²) HBM, the campaign-scale
+  form.  A fault class absent from the plan compiles to ``None`` (a
+  trace-time fact: the kernels skip that class's gathers and RNG draws
+  entirely — bit-identical to all-zero tensors, since fault keys are
+  fold_in-derived, never split from the phase stream).
+- **factored** (`FactoredFaultPlan`): each link event as a rank-1
+  (active[R+1], src_mask[N], dst_mask[N]) term — O(K·(R+N)) HBM, which
+  is what makes a 100k-node fault storm compilable at all (the matrix
+  form would be 10 GB *per round*).  Exact for block (OR of terms),
+  delay (sum — `LinkFault.merge` adds), and jitter (max); exact for
+  loss only when loss events never overlap on a (round, link), which
+  `compile_plan_factored` validates and refuses otherwise.
+
+The kernels never index the tensors directly: `fault_edge_block` /
+`fault_edge_loss` / `fault_edge_delay` / `fault_edge_jitter` evaluate
+either form at an edge list and return ``None`` when the class is
+absent, so both round paths (dense AND packed — the seam rides the
+packed carry since ISSUE 4) consume identical per-edge fault decisions.
+
 Tier coverage caveats (doc/faults.md): ``duplicate`` compiles to a
 no-op here — sim delivery is an idempotent scatter-max, so a duplicated
 payload is indistinguishable from the original (the host tier delivers
@@ -40,7 +62,7 @@ the plan's markers, fired by `run_fault_plan_checked`.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,12 +83,16 @@ from .topology import Topology, regions
 
 
 class SimFaultPlan(NamedTuple):
-    """Stacked per-round fault tensors (device); index with `round_faults`."""
+    """Stacked per-round fault tensors (device); index with `round_faults`.
 
-    block: jnp.ndarray   # bool[R+1, N, N] directed src→dst cut
-    loss: jnp.ndarray    # u8[R+1, N, N] extra drop threshold (p·256)
-    delay: jnp.ndarray   # u8[R+1, N, N] fixed extra delay, rounds
-    jitter: jnp.ndarray  # u8[R+1, N, N] max per-message extra delay, rounds
+    A fault class with no events compiles to ``None`` (pytree structure,
+    i.e. trace-time knowledge): the kernels skip that class's gathers
+    and RNG draws — results identical to all-zero tensors, cheaper."""
+
+    block: Optional[jnp.ndarray]   # bool[R+1, N, N] directed src→dst cut
+    loss: Optional[jnp.ndarray]    # u8[R+1, N, N] extra drop threshold (p·256)
+    delay: Optional[jnp.ndarray]   # u8[R+1, N, N] fixed extra delay, rounds
+    jitter: Optional[jnp.ndarray]  # u8[R+1, N, N] max per-message extra delay
     alive: jnp.ndarray   # i8[R+1, N] override: -1 none, else ALIVE/DOWN
     wipe: jnp.ndarray    # bool[R+1, N] zero the node's state this round
     # plan-seed fold (derive_seed(seed, "sim")): every stochastic fault
@@ -77,21 +103,227 @@ class SimFaultPlan(NamedTuple):
 
 
 class RoundFaults(NamedTuple):
-    """One round's slice of a SimFaultPlan, consumed by the kernels."""
+    """One round's slice of a SimFaultPlan, consumed by the kernels
+    (through the `fault_edge_*` helpers; ``None`` = class absent)."""
 
-    block: jnp.ndarray   # bool[N, N]
-    loss: jnp.ndarray    # u8[N, N]
-    delay: jnp.ndarray   # u8[N, N]
-    jitter: jnp.ndarray  # u8[N, N]
+    block: Optional[jnp.ndarray]   # bool[N, N]
+    loss: Optional[jnp.ndarray]    # u8[N, N]
+    delay: Optional[jnp.ndarray]   # u8[N, N]
+    jitter: Optional[jnp.ndarray]  # u8[N, N]
     alive: jnp.ndarray   # i8[N]
     wipe: jnp.ndarray    # bool[N]
     seed: jnp.ndarray    # i32 scalar (see SimFaultPlan.seed)
 
 
+class FactoredFaultPlan(NamedTuple):
+    """Rank-1-factored fault schedule: each link event is one
+    (active-rounds, src-mask, dst-mask) term instead of a [R+1, N, N]
+    slab — the representation that makes 100k-node fault storms
+    compilable (O(K·(R+N)) HBM).  The K axes are static shapes, so a
+    class with zero factors is trace-time absent exactly like a ``None``
+    matrix.  Node-level tensors (alive/wipe) stay dense [R+1, N]."""
+
+    alive: jnp.ndarray          # i8[R+1, N]
+    wipe: jnp.ndarray           # bool[R+1, N]
+    seed: jnp.ndarray           # i32 scalar (see SimFaultPlan.seed)
+    block_active: jnp.ndarray   # bool[Kb, R+1]
+    block_src: jnp.ndarray      # bool[Kb, N]
+    block_dst: jnp.ndarray      # bool[Kb, N]
+    loss_active: jnp.ndarray    # bool[Kl, R+1]
+    loss_src: jnp.ndarray       # bool[Kl, N]
+    loss_dst: jnp.ndarray       # bool[Kl, N]
+    loss_thr: jnp.ndarray       # u8[Kl] (non-overlap validated at compile)
+    delay_active: jnp.ndarray   # bool[Kd, R+1]
+    delay_src: jnp.ndarray      # bool[Kd, N]
+    delay_dst: jnp.ndarray      # bool[Kd, N]
+    delay_rounds: jnp.ndarray   # i32[Kd] (overlaps ADD, as LinkFault.merge)
+    jitter_active: jnp.ndarray  # bool[Kj, R+1]
+    jitter_src: jnp.ndarray     # bool[Kj, N]
+    jitter_dst: jnp.ndarray     # bool[Kj, N]
+    jitter_rounds: jnp.ndarray  # i32[Kj] (overlaps take the max)
+
+
+class FactoredRoundFaults(NamedTuple):
+    """One round's slice of a FactoredFaultPlan (the per-factor active
+    bits replace the matrix slices; masks are round-independent)."""
+
+    alive: jnp.ndarray          # i8[N]
+    wipe: jnp.ndarray           # bool[N]
+    seed: jnp.ndarray           # i32 scalar
+    block_on: jnp.ndarray       # bool[Kb]
+    block_src: jnp.ndarray      # bool[Kb, N]
+    block_dst: jnp.ndarray      # bool[Kb, N]
+    loss_on: jnp.ndarray        # bool[Kl]
+    loss_src: jnp.ndarray       # bool[Kl, N]
+    loss_dst: jnp.ndarray       # bool[Kl, N]
+    loss_thr: jnp.ndarray       # u8[Kl]
+    delay_on: jnp.ndarray       # bool[Kd]
+    delay_src: jnp.ndarray      # bool[Kd, N]
+    delay_dst: jnp.ndarray      # bool[Kd, N]
+    delay_rounds: jnp.ndarray   # i32[Kd]
+    jitter_on: jnp.ndarray      # bool[Kj]
+    jitter_src: jnp.ndarray     # bool[Kj, N]
+    jitter_dst: jnp.ndarray     # bool[Kj, N]
+    jitter_rounds: jnp.ndarray  # i32[Kj]
+
+
+#: auto-factor threshold: above this node count `compile_plan` lowers to
+#: the factored form (the matrix form's schedule() expansion alone is
+#: O(R·N²) Python at "*" selectors — already hopeless at 4096 nodes)
+FACTORED_MIN_NODES = 1024
+
+
+# -- per-edge fault evaluation (the ONE consumer surface) --------------------
+
+
+def _factored_hits(
+    on: jnp.ndarray, src_m: jnp.ndarray, dst_m: jnp.ndarray,
+    src: jnp.ndarray, dst: jnp.ndarray,
+) -> jnp.ndarray:
+    """bool[K, E]: factor k applies to edge e this round.  Self-edges
+    never fault (the matrix compiler's `_pairs` skips s == d; rank-1
+    masks would otherwise cover the diagonal — probe relay legs DO
+    evaluate (x, x) edges)."""
+    return (
+        on[:, None] & src_m[:, src] & dst_m[:, dst] & (src != dst)[None, :]
+    )
+
+
+def fault_edge_block(faults, src, dst):
+    """bool[E] directed-cut mask at the given edges, or None when the
+    plan schedules no cuts (trace-time: the kernel skips the class)."""
+    if isinstance(faults, RoundFaults):
+        return None if faults.block is None else faults.block[src, dst]
+    if faults.block_src.shape[0] == 0:
+        return None
+    return _factored_hits(
+        faults.block_on, faults.block_src, faults.block_dst, src, dst
+    ).any(axis=0)
+
+
+def fault_edge_loss(faults, src, dst):
+    """u8[E] extra-loss threshold (p·256) at the given edges, or None."""
+    if isinstance(faults, RoundFaults):
+        return None if faults.loss is None else faults.loss[src, dst]
+    if faults.loss_src.shape[0] == 0:
+        return None
+    hit = _factored_hits(
+        faults.loss_on, faults.loss_src, faults.loss_dst, src, dst
+    )
+    # loss factors are compile-validated non-overlapping per (round,
+    # link): at most one hits, so max == the merged threshold
+    return jnp.max(
+        jnp.where(hit, faults.loss_thr[:, None], jnp.uint8(0)), axis=0
+    )
+
+
+def fault_edge_delay(faults, src, dst):
+    """i32[E] extra fixed delay (rounds) at the given edges, or None.
+    Overlapping delay events ADD (`LinkFault.merge`)."""
+    if isinstance(faults, RoundFaults):
+        if faults.delay is None:
+            return None
+        return faults.delay[src, dst].astype(jnp.int32)
+    if faults.delay_src.shape[0] == 0:
+        return None
+    hit = _factored_hits(
+        faults.delay_on, faults.delay_src, faults.delay_dst, src, dst
+    )
+    return jnp.sum(
+        jnp.where(hit, faults.delay_rounds[:, None], 0), axis=0
+    )
+
+
+def fault_edge_jitter(faults, src, dst):
+    """i32[E] max per-message extra delay at the given edges, or None.
+    Overlapping jitter events take the max (`LinkFault.merge`)."""
+    if isinstance(faults, RoundFaults):
+        if faults.jitter is None:
+            return None
+        return faults.jitter[src, dst].astype(jnp.int32)
+    if faults.jitter_src.shape[0] == 0:
+        return None
+    hit = _factored_hits(
+        faults.jitter_on, faults.jitter_src, faults.jitter_dst, src, dst
+    )
+    return jnp.max(
+        jnp.where(hit, faults.jitter_rounds[:, None], 0), axis=0
+    )
+
+
+def fault_wire_effects(faults, key, src, dst, n_payloads, ok, drop, delay):
+    """The fire-and-forget (broadcast) fault seam, shared VERBATIM by
+    the dense and packed round paths — one implementation is what makes
+    their bit-identity structural rather than hand-synchronized: cuts
+    mask ``ok``, extra loss ORs into ``drop`` (per-(edge, payload) u8
+    threshold bits, fold_in key 101), fixed delay adds to ``delay``, and
+    jitter (fold_in key 102) expands to a per-(edge, payload)
+    ``delay_ep`` (None when the plan schedules no jitter).  All keys are
+    fold_in-derived from the PHASE key + plan seed, never split from the
+    phase stream, so a plan without a class consumes RNG identically to
+    one with all-zero tensors."""
+    blk = fault_edge_block(faults, src, dst)
+    if blk is not None:
+        ok = ok & ~blk
+    thr = fault_edge_loss(faults, src, dst)  # u8[E] | None
+    if thr is not None:
+        k_floss = jax.random.fold_in(
+            jax.random.fold_in(key, faults.seed), 101
+        )
+        fbits = jax.random.bits(
+            k_floss, (src.shape[0], n_payloads), dtype=jnp.uint8
+        )
+        drop = drop | (fbits < thr[:, None])
+    fdelay = fault_edge_delay(faults, src, dst)  # i32[E] | None
+    if fdelay is not None:
+        delay = delay + fdelay
+    delay_ep = None
+    jit = fault_edge_jitter(faults, src, dst)  # i32[E] | None
+    if jit is not None:
+        k_fjit = jax.random.fold_in(
+            jax.random.fold_in(key, faults.seed), 102
+        )
+        draw = jax.random.randint(
+            k_fjit, (src.shape[0], n_payloads), 0, jnp.iinfo(jnp.int32).max
+        )
+        delay_ep = delay[:, None] + jnp.where(
+            jit[:, None] > 0, draw % (jit[:, None] + 1), 0
+        )  # [E, P]
+    return ok, drop, delay, delay_ep
+
+
+def fault_session_refused(faults, src, dst):
+    """bool[E] (or None): the sync session is refused — a cut in EITHER
+    direction kills the bidirectional stream.  Shared by both paths."""
+    blk = fault_edge_block(faults, src, dst)
+    if blk is None:
+        return None
+    return blk | fault_edge_block(faults, dst, src)
+
+
+def fault_session_delay(faults, src, dst):
+    """i32[E] (or None): extra sync-session RTT — the slower direction
+    of the pair bounds the bi-stream.  Shared by both paths."""
+    d_fwd = fault_edge_delay(faults, src, dst)
+    if d_fwd is None:
+        return None
+    return jnp.maximum(d_fwd, fault_edge_delay(faults, dst, src))
+
+
 def compile_plan(
-    plan: FaultPlan, cfg: SimConfig, topo: Topology = Topology()
-) -> SimFaultPlan:
+    plan: FaultPlan,
+    cfg: SimConfig,
+    topo: Topology = Topology(),
+    factored: Optional[bool] = None,
+):
     """Lower ``plan.schedule()`` into device tensors.
+
+    ``factored=None`` auto-selects: clusters at/above FACTORED_MIN_NODES
+    lower to the rank-1 `FactoredFaultPlan` (the matrix form is O(R·N²)
+    — un-materializable at storm scale); smaller clusters keep the
+    proven matrix form.  Both forms produce identical per-edge fault
+    decisions through the `fault_edge_*` helpers (pinned by
+    tests/sim/test_fault_plan.py).
 
     Validates the delay-ring envelope at compile time: the ring must be
     able to represent every (topology + fault) delay, or a wrapped slot
@@ -100,6 +332,10 @@ def compile_plan(
         raise ValueError(
             f"plan is for {plan.n_nodes} nodes, SimConfig has {cfg.n_nodes}"
         )
+    if factored is None:
+        factored = cfg.n_nodes >= FACTORED_MIN_NODES
+    if factored:
+        return compile_plan_factored(plan, cfg, topo)
     n, rounds = plan.n_nodes, plan.horizon
     shape = (rounds + 1, n, n)
     block = np.zeros(shape, np.bool_)
@@ -120,8 +356,16 @@ def compile_plan(
                 block[r, s, d] = True
             elif thr > 0:
                 loss[r, s, d] = thr
-            delay[r, s, d] = min(f.delay_rounds, 255)
-            jitter[r, s, d] = min(f.jitter_rounds, 255)
+            if f.delay_rounds > 255 or f.jitter_rounds > 255:
+                # the u8 tensors can't carry it, and a silent clamp
+                # would diverge from the factored form's exact sum
+                raise ValueError(
+                    f"merged link delay/jitter ({f.delay_rounds}/"
+                    f"{f.jitter_rounds} rounds at round {r}) exceeds the "
+                    "255-round schedule grain"
+                )
+            delay[r, s, d] = f.delay_rounds
+            jitter[r, s, d] = f.jitter_rounds
             max_extra = max(max_extra, f.delay_rounds + f.jitter_rounds)
         for i in sched.down:
             alive[r, i] = DOWN
@@ -140,20 +384,204 @@ def compile_plan(
     from ..faults import derive_seed
 
     return SimFaultPlan(
-        block=jnp.asarray(block), loss=jnp.asarray(loss),
-        delay=jnp.asarray(delay), jitter=jnp.asarray(jitter),
+        # absent classes ride as None (pytree structure = trace-time
+        # fact): the kernels then skip the class's gathers/draws — same
+        # results as all-zero tensors, none of the cost
+        block=jnp.asarray(block) if block.any() else None,
+        loss=jnp.asarray(loss) if loss.any() else None,
+        delay=jnp.asarray(delay) if delay.any() else None,
+        jitter=jnp.asarray(jitter) if jitter.any() else None,
         alive=jnp.asarray(alive), wipe=jnp.asarray(wipe),
         seed=jnp.int32(derive_seed(plan.seed, "sim") & 0x7FFFFFFF),
     )
 
 
-def round_faults(fplan: SimFaultPlan, t: jnp.ndarray) -> RoundFaults:
+def _sel_mask(sel, n: int) -> np.ndarray:
+    from ..faults import sel_indices
+
+    m = np.zeros(n, np.bool_)
+    r = sel_indices(sel, n)
+    m[r.start:r.stop] = True
+    return m
+
+
+def _events_overlap(a, b, n: int) -> bool:
+    """Can events a and b affect the same (round, directed link)?"""
+    from ..faults import sel_indices
+
+    if a.end <= b.start or b.end <= a.start:
+        return False
+
+    def hits(x, y):
+        return max(x.start, y.start) < min(x.stop, y.stop)
+
+    return hits(sel_indices(a.src, n), sel_indices(b.src, n)) and hits(
+        sel_indices(a.dst, n), sel_indices(b.dst, n)
+    )
+
+
+def compile_plan_factored(
+    plan: FaultPlan, cfg: SimConfig, topo: Topology = Topology()
+) -> FactoredFaultPlan:
+    """Lower the plan into rank-1 link-event factors, straight from the
+    events (never via ``schedule()`` — its per-round dict is O(N²) at
+    "*" selectors).  Semantics match the matrix compiler exactly: block
+    ORs, delays add, jitter maxes, loss p≈1 compiles to a cut; the one
+    restriction is that 0<p<1 loss events must not overlap on a (round,
+    link) — combined-drop quantization (1-∏(1-pᵢ) → u8) is not
+    factorable bit-exactly, so the compiler refuses loudly rather than
+    approximate."""
+    if plan.n_nodes != cfg.n_nodes:
+        raise ValueError(
+            f"plan is for {plan.n_nodes} nodes, SimConfig has {cfg.n_nodes}"
+        )
+    n, rounds = plan.n_nodes, plan.horizon
+    alive = np.full((rounds + 1, n), -1, np.int8)
+    wipe = np.zeros((rounds + 1, n), np.bool_)
+    blocks, losses, delays, jitters = [], [], [], []
+    loss_events = []
+
+    def _act(ev):
+        a = np.zeros(rounds + 1, np.bool_)
+        a[ev.start:ev.end] = True
+        return a
+
+    crash_events = [ev for ev in plan.events if ev.kind == "crash"]
+    # two passes mirror the matrix compiler's per-round down-then-restart
+    # write order (overlapping crash windows: the restart wins the round)
+    for ev in crash_events:
+        alive[ev.start:ev.end, ev.node] = DOWN
+    for ev in crash_events:
+        alive[ev.end, ev.node] = ALIVE
+        if ev.wipe:
+            wipe[ev.end, ev.node] = True
+
+    for ev in plan.events:
+        if ev.kind in ("crash", "clock_skew", "duplicate"):
+            # crash handled above; clock_skew is host-only; duplicate is
+            # a sim no-op (idempotent scatter-max delivery) — coverage
+            # markers still fire via schedule_at on the checked tier
+            continue
+        term = (_act(ev), _sel_mask(ev.src, n), _sel_mask(ev.dst, n))
+        if ev.kind == "partition":
+            blocks.append(term)
+            if ev.symmetric:
+                blocks.append((term[0], term[2], term[1]))
+        elif ev.kind == "loss":
+            thr = int(round(ev.p * 256.0))
+            if thr >= 256:
+                blocks.append(term)  # certainty can't ride u8: sever
+            elif thr > 0:
+                losses.append(term + (thr,))
+                loss_events.append(ev)
+        elif ev.kind == "delay":
+            delays.append(term + (ev.delay_rounds,))
+        elif ev.kind == "jitter":
+            jitters.append(term + (ev.delay_rounds,))
+
+    for i in range(len(loss_events)):
+        for j in range(i + 1, len(loss_events)):
+            if _events_overlap(loss_events[i], loss_events[j], n):
+                raise ValueError(
+                    "factored fault compilation needs non-overlapping "
+                    "loss events (combined-drop u8 quantization is not "
+                    "factorable); compile with factored=False instead"
+                )
+
+    # ring-envelope validation: per round, a link's worst extra delay is
+    # the sum of the delay events covering it — bounded here by, for
+    # each active event, its delay plus every other active event it can
+    # share a (round, link) with (pairwise selector intersection).
+    # Exact when concurrent events either share links or are disjoint;
+    # never looser than the matrix compiler's per-link max, and never
+    # rejects a plan of pairwise-disjoint delays the matrix form accepts.
+    delay_events = [ev for ev in plan.events if ev.kind == "delay"]
+    max_extra = 0
+    for r in range(rounds + 1):
+        active = [ev for ev in delay_events if ev.start <= r < ev.end]
+        d = max(
+            (
+                ev.delay_rounds
+                + sum(
+                    o.delay_rounds for o in active
+                    if o is not ev and _events_overlap(ev, o, n)
+                )
+                for ev in active
+            ),
+            default=0,
+        )
+        j = max(
+            (ev.delay_rounds for ev in plan.events
+             if ev.kind == "jitter" and ev.start <= r < ev.end),
+            default=0,
+        )
+        max_extra = max(max_extra, d + j)
+    base = max(topo.intra_delay, topo.inter_delay, 1)
+    if base + max_extra >= cfg.n_delay_slots:
+        raise ValueError(
+            f"max edge delay {base + max_extra} rounds (topology {base} + "
+            f"fault {max_extra}) needs n_delay_slots > {base + max_extra}, "
+            f"got {cfg.n_delay_slots}"
+        )
+
+    def _stack(terms, extra_dtype=None):
+        k = len(terms)
+        act = np.zeros((k, rounds + 1), np.bool_)
+        sm = np.zeros((k, n), np.bool_)
+        dm = np.zeros((k, n), np.bool_)
+        vals = np.zeros((k,), extra_dtype) if extra_dtype else None
+        for i, t in enumerate(terms):
+            act[i], sm[i], dm[i] = t[0], t[1], t[2]
+            if extra_dtype:
+                vals[i] = t[3]
+        out = [jnp.asarray(act), jnp.asarray(sm), jnp.asarray(dm)]
+        if extra_dtype:
+            out.append(jnp.asarray(vals))
+        return out
+
+    from ..faults import derive_seed
+
+    b_act, b_src, b_dst = _stack(blocks)
+    l_act, l_src, l_dst, l_thr = _stack(losses, np.uint8)
+    d_act, d_src, d_dst, d_val = _stack(delays, np.int32)
+    j_act, j_src, j_dst, j_val = _stack(jitters, np.int32)
+    return FactoredFaultPlan(
+        alive=jnp.asarray(alive), wipe=jnp.asarray(wipe),
+        seed=jnp.int32(derive_seed(plan.seed, "sim") & 0x7FFFFFFF),
+        block_active=b_act, block_src=b_src, block_dst=b_dst,
+        loss_active=l_act, loss_src=l_src, loss_dst=l_dst, loss_thr=l_thr,
+        delay_active=d_act, delay_src=d_src, delay_dst=d_dst,
+        delay_rounds=d_val,
+        jitter_active=j_act, jitter_src=j_src, jitter_dst=j_dst,
+        jitter_rounds=j_val,
+    )
+
+
+def round_faults(fplan, t: jnp.ndarray):
     """Slice round ``t``'s fault state; past the horizon every round
     reads the final all-clear row (index clamp, not wraparound)."""
-    i = jnp.minimum(t, fplan.block.shape[0] - 1)
+    i = jnp.minimum(t, fplan.alive.shape[0] - 1)
+    if isinstance(fplan, FactoredFaultPlan):
+        return FactoredRoundFaults(
+            alive=fplan.alive[i], wipe=fplan.wipe[i], seed=fplan.seed,
+            block_on=fplan.block_active[:, i],
+            block_src=fplan.block_src, block_dst=fplan.block_dst,
+            loss_on=fplan.loss_active[:, i],
+            loss_src=fplan.loss_src, loss_dst=fplan.loss_dst,
+            loss_thr=fplan.loss_thr,
+            delay_on=fplan.delay_active[:, i],
+            delay_src=fplan.delay_src, delay_dst=fplan.delay_dst,
+            delay_rounds=fplan.delay_rounds,
+            jitter_on=fplan.jitter_active[:, i],
+            jitter_src=fplan.jitter_src, jitter_dst=fplan.jitter_dst,
+            jitter_rounds=fplan.jitter_rounds,
+        )
     return RoundFaults(
-        block=fplan.block[i], loss=fplan.loss[i], delay=fplan.delay[i],
-        jitter=fplan.jitter[i], alive=fplan.alive[i], wipe=fplan.wipe[i],
+        block=None if fplan.block is None else fplan.block[i],
+        loss=None if fplan.loss is None else fplan.loss[i],
+        delay=None if fplan.delay is None else fplan.delay[i],
+        jitter=None if fplan.jitter is None else fplan.jitter[i],
+        alive=fplan.alive[i], wipe=fplan.wipe[i],
         seed=fplan.seed,
     )
 
@@ -217,17 +645,24 @@ def run_fault_plan(
     meta: PayloadMeta,
     cfg: SimConfig,
     topo: Topology,
-    fplan: SimFaultPlan,
+    fplan,
     max_rounds: int = 1000,
 ) -> Tuple[SimState, RunMetrics]:
     """Advance rounds under the fault schedule until the cluster holds
     every payload AND the schedule is exhausted (a plan may crash a node
     after convergence — early exit would miss the rejoin), or
-    ``max_rounds``.  Always the DENSE round path: the packed kernels
-    don't carry the fault seam (doc/faults.md)."""
+    ``max_rounds``.  Over the bitpack envelope (`packed.packed_supported`)
+    the loop runs on the u32-packed carry — the fault seam rides the
+    packed kernels since ISSUE 4, bit-identical to the dense path
+    (tests/sim/test_packed_equivalence.py); cfg/topo are static, so the
+    dispatch is a trace-time branch and one path compiles."""
+    from .packed import packed_supported, run_packed_faults
+
+    if packed_supported(cfg, topo):
+        return run_packed_faults(state, meta, cfg, topo, fplan, max_rounds)
     region = regions(cfg.n_nodes, topo.n_regions)
     metrics = new_metrics(cfg)
-    horizon = fplan.block.shape[0] - 1  # static
+    horizon = fplan.alive.shape[0] - 1  # static
 
     def cond(carry):
         state, metrics = carry

@@ -39,6 +39,24 @@ being a dense-path exile:
   tensor is dense, but so is the broadcast scatter's delay ring — the
   packed win stays on have/relay/sync/bookkeeping.
 
+Since ISSUE 4 the FAULT SEAM rides the packed carry too — the reference
+never runs faultless (gossip under loss/partitions/crashes IS the
+workload), so fault campaigns must not be a dense-path exile either:
+
+- per-edge cut/loss masks apply as word operations on have/relay (loss
+  draws the same per-(edge, payload) threshold key as dense, so the
+  bits match);
+- crash-with-wipe zeroes the packed carry (`apply_carry_faults`) while
+  `apply_node_faults` on the slim state wipes membership — both SWIM
+  tiers — and bookkeeping;
+- fault latency stretches the packed sync ring by OR-folding each
+  session-delay class into its own slot (`sync_packed`), and jitter
+  rides the dense broadcast ring's per-element scatter exactly as the
+  dense kernel does;
+- the limiters (`budget_prefix_words`) compose with fault loss: the
+  budget spends on the attempt, loss eats the wire, as in
+  `broadcast_step`.
+
 Everything outside the envelope stays on the dense path — same
 results, just slower.
 """
@@ -266,9 +284,10 @@ class PackedCarry(NamedTuple):
     inflight: jnp.ndarray  # u8[D, N, P] — dense, see docstring
     relay: Planes  # 4 × u32[N, W]
     # sync delivery ring (SimState.sync_inflight) — stays PACKED: the
-    # packed path never carries faults, so only slot (t+1) % D is ever
-    # written (the one-round bi-stream RTT) and the sync fold produces
-    # words directly, no scatter
+    # sync fold produces words directly, no scatter.  Latency-free runs
+    # write only slot (t+1) % D (the one-round bi-stream RTT); FaultPlan
+    # latency partitions edges by session delay and OR-folds each delay
+    # class into its own slot (sync_packed), still scatter-free
     sync_buf: jnp.ndarray  # u32[D, N, W]
 
 
@@ -344,6 +363,7 @@ def broadcast_packed(
     region: jnp.ndarray,
     key: jax.Array,
     meta: PayloadMeta,
+    faults=None,
 ) -> PackedCarry:
     n = cfg.n_nodes
     f = cfg.fanout
@@ -399,6 +419,21 @@ def broadcast_packed(
     # shape, same bits (trace-time constant when loss == 0).
     p = cfg.n_payloads
     drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
+    delay_ep = None
+    if faults is not None:
+        # FaultPlan seam, word-path edition (ISSUE 4): the ONE shared
+        # implementation (`faults.fault_wire_effects`) — same keys, same
+        # draws, same per-(edge, payload) grain as broadcast_step, so
+        # the loss bits match bit for bit by construction.  The [E, P]
+        # mask is dense on both paths; the packed win stays on
+        # have/relay/sync.  Absent classes are trace-time no-ops, so a
+        # loss+partition storm pays neither the jitter draw nor the
+        # per-element ring scatter.
+        from .faults import fault_wire_effects
+
+        ok, drop, delay, delay_ep = fault_wire_effects(
+            faults, key, src, dst, p, ok, drop, delay
+        )
     elig8 = unpack_bits(sending, p).astype(carry.inflight.dtype)  # [N, P]
     sent = jnp.where(
         ok.reshape(n, f, 1) & ~drop.reshape(n, f, p),
@@ -407,11 +442,27 @@ def broadcast_packed(
     ).reshape(n * f, p)  # [E, P]
 
     d_slots = carry.inflight.shape[0]
-    slot = (state.t + delay) % d_slots
-    flat_idx = slot * n + dst
-    inflight = carry.inflight.reshape(d_slots * n, p)
-    inflight = inflight.at[flat_idx].max(sent)
-    inflight = inflight.reshape(d_slots, n, p)
+    if delay_ep is not None:
+        # per-(edge, payload) delays (fault jitter): elementwise scatter
+        # into the dense u8 ring — same element count as the row
+        # scatter, only the indexing is finer-grained (broadcast_step's
+        # fault branch, unchanged semantics)
+        slot_ep = (state.t + delay_ep) % d_slots  # [E, P]
+        flat = (slot_ep * n + dst[:, None]) * p + jnp.arange(
+            p, dtype=jnp.int32
+        )[None, :]
+        inflight = (
+            carry.inflight.reshape(-1)
+            .at[flat.reshape(-1)]
+            .max(sent.reshape(-1))
+            .reshape(d_slots, n, p)
+        )
+    else:
+        slot = (state.t + delay) % d_slots
+        flat_idx = slot * n + dst
+        inflight = carry.inflight.reshape(d_slots * n, p)
+        inflight = inflight.at[flat_idx].max(sent)
+        inflight = inflight.reshape(d_slots, n, p)
 
     # budget spends on the ATTEMPT (see broadcast.broadcast_step): a
     # sender can't observe partitions, dead targets, or wire loss —
@@ -485,10 +536,13 @@ def packed_round_step(
     cfg: SimConfig,
     topo: Topology,
     region: jnp.ndarray,
+    faults=None,
 ):
     """One gossip tick on packed words — phase-for-phase and PRNG-stream
     identical to `round.round_step` (inject → broadcast → sync → deliver →
-    SWIM → bookkeeping refresh → convergence record); tests/sim/
+    SWIM → bookkeeping refresh → convergence record), including the
+    FaultPlan seam (``faults`` is a RoundFaults/FactoredRoundFaults
+    slice, same draws and keys as the dense kernels); tests/sim/
     test_packed_equivalence.py holds the two bit-for-bit equal."""
     from .gaps import extract_gaps
     from .round import RunMetrics
@@ -501,19 +555,20 @@ def packed_round_step(
         carry, injected_p, state.t, meta, cfg, state.alive
     )
     carry = broadcast_packed(
-        carry, injected_p, state, cfg, topo, region, k_bcast, meta
+        carry, injected_p, state, cfg, topo, region, k_bcast, meta, faults
     )
-    # sync writes ring slot t+1, deliver pops slot t: no ordering hazard
-    # (round.round_step's contract)
+    # sync writes ring slots t+1.., deliver pops slot t: no ordering
+    # hazard (round.round_step's contract; compile_plan validated
+    # 1 + fault delay < n_delay_slots)
     carry, countdown, backoff = sync_packed(
-        carry, state, cfg, topo, k_sync, meta
+        carry, state, cfg, topo, k_sync, meta, faults
     )
     state = state._replace(sync_countdown=countdown, sync_backoff=backoff)
     carry = deliver_packed(carry, state.t, cfg)
 
     from .swim import swim_step
 
-    state = swim_step(state, cfg, topo, k_swim)
+    state = swim_step(state, cfg, topo, k_swim, faults)
 
     touched = group_grid(carry.have, cfg, "any")  # [N, A, V]
     heads = version_heads(touched)
@@ -608,6 +663,97 @@ def run_packed(
     return full, metrics
 
 
+# -- the packed fault seam (ISSUE 4) -----------------------------------------
+
+
+def apply_carry_faults(carry: PackedCarry, rf) -> PackedCarry:
+    """Packed twin of `faults.apply_node_faults`' payload-carry wipe: a
+    crash-with-wipe zeroes the node's have words, all four bitsliced
+    relay planes, its column of the dense broadcast ring, and its packed
+    sync-ring words — exactly the rows the dense path zeroes.  (The
+    membership/bookkeeping wipe — both SWIM tiers, heads, gaps — rides
+    `apply_node_faults` on the slim state, whose payload tensors are
+    zero-width in the packed loop.)"""
+    w = rf.wipe
+    wn = jnp.where(w[:, None], ONES, U32(0))  # [N, 1] word mask
+    return PackedCarry(
+        have=carry.have & ~wn,
+        inflight=jnp.where(w[None, :, None], jnp.uint8(0), carry.inflight),
+        relay=Planes(*(plane & ~wn for plane in carry.relay)),
+        sync_buf=jnp.where(w[None, :, None], U32(0), carry.sync_buf),
+    )
+
+
+def all_have_words(
+    carry: PackedCarry,
+    injected_p: jnp.ndarray,
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+) -> jnp.ndarray:
+    """Word-domain twin of `faults._all_have` (computed FRESH — the
+    sticky metrics must not mask a post-convergence wipe): every up node
+    holds every injected version completely."""
+    up = state.alive == ALIVE
+    c = cfg.chunks_per_version
+    comp_w = all_chunks_words(carry.have, cfg)  # [N, W]
+    act_w = _smear_groups(
+        _fold_any(injected_p, c) & _group_low_bits_mask(c), c
+    )  # [W]
+    node_done = ((comp_w | ~act_w[None, :]) == ONES).all(axis=1) | ~up
+    return jnp.all(meta.round <= state.t) & jnp.all(node_done)
+
+
+def run_packed_faults(
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    fplan,
+    max_rounds: int,
+):
+    """Packed-carry `run_fault_plan` body: the fault schedule drives the
+    u32-word round loop — pack once, apply each round's node faults to
+    BOTH the slim state (membership, bookkeeping) and the packed carry
+    (payload words), unpack once at the end.  Same exit rule as the
+    dense loop: never before the schedule's horizon (a plan may crash a
+    node after convergence), then the fresh all-have predicate.  Called
+    from `faults.run_fault_plan` under jit when `packed_supported`."""
+    from .faults import apply_node_faults, round_faults
+    from .round import new_metrics
+    from .topology import regions
+
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+    carry0 = pack_state(state, cfg)
+    injected0 = pack_bits(state.injected)
+    slim = shrink_state(state)
+    horizon = fplan.alive.shape[0] - 1  # static
+
+    def cond(c):
+        s, carry, inj, m = c
+        done = (s.t >= horizon) & all_have_words(carry, inj, s, meta, cfg)
+        return (s.t < max_rounds) & ~done
+
+    def body(c):
+        s, carry, inj, m = c
+        rf = round_faults(fplan, s.t)
+        s = apply_node_faults(s, rf)
+        carry = apply_carry_faults(carry, rf)
+        return packed_round_step(
+            s, carry, inj, m, meta, cfg, topo, region, faults=rf
+        )
+
+    slim, carry, inj, metrics = jax.lax.while_loop(
+        cond, body, (slim, carry0, injected0, metrics)
+    )
+    full = unpack_into_state(carry, slim, cfg)
+    full = full._replace(
+        injected=unpack_bits(inj, cfg.n_payloads).astype(full.have.dtype)
+    )
+    return full, metrics
+
+
 def _smear_groups(low: jnp.ndarray, c: int) -> jnp.ndarray:
     """Broadcast each aligned c-bit group's LOW bit across the group."""
     w = low
@@ -634,6 +780,7 @@ def sync_packed(
     topo: Topology,
     key: jax.Array,
     meta: PayloadMeta,
+    faults=None,
 ) -> Tuple[PackedCarry, jnp.ndarray, jnp.ndarray]:
     """Anti-entropy on packed words: needs computed from the SAME
     advertised gap/head tensors as the dense path (state.heads/gap_lo/
@@ -659,6 +806,16 @@ def sync_packed(
     ok &= edge_alive(state.group, state.alive, src, dst)
     ok &= due[src]
     ok &= dst != src
+    if faults is not None:
+        # sync is a bidirectional stream: a cut in EITHER direction
+        # refuses the session (the shared `fault_session_refused`, same
+        # implementation as sync_step); fault loss never bites the
+        # reliable bi-stream
+        from .faults import fault_session_refused
+
+        refused = fault_session_refused(faults, src, dst)
+        if refused is not None:
+            ok &= ~refused
 
     v = cfg.n_versions
     v_idx = jnp.arange(1, v + 1, dtype=jnp.int32)
@@ -702,15 +859,42 @@ def sync_packed(
     granted = budget_prefix_words(need, cfg.sync_budget_bytes, meta.nbytes)
 
     # pulls land at the PULLER (src): exactly S edges per source in a
-    # regular layout, so the OR-reduce is a packed fold — no scatter;
-    # the words drop into ring slot t+1 (the packed path never carries
-    # faults, so the delay is always the one-round RTT)
-    pulled = _fold_or_regular(granted, n, s)  # [N, W] — stays packed
+    # regular layout, so the OR-reduce is a packed fold — no scatter.
+    # Latency-free rounds (faultless, or a plan with no delay events)
+    # write the one-round-RTT slot t+1; FaultPlan latency instead
+    # partitions the edges by session delay (the slower direction of the
+    # bi-stream pair) and OR-folds each delay class into its own ring
+    # slot — a static D-1-step loop, never a word scatter (at[].max on
+    # u32 words is arithmetic max, NOT bitwise OR, and a slot written by
+    # two consecutive rounds under differing delays would corrupt).
     d_slots = carry.sync_buf.shape[0]
-    sync_buf = carry.sync_buf.at[(state.t + 1) % d_slots].max(pulled)
+    sdelay = None
+    if faults is not None:
+        from .faults import fault_session_delay
+
+        sdelay = fault_session_delay(faults, src, dst)  # i32[E] | None
+    if sdelay is None:
+        pulled = _fold_or_regular(granted, n, s)  # [N, W] — stays packed
+        sync_buf = carry.sync_buf.at[(state.t + 1) % d_slots].max(pulled)
+        fruitful = (pulled != U32(0)).any(axis=1)  # [N]
+    else:
+        sync_buf = carry.sync_buf
+        for d in range(d_slots - 1):  # compile validated 1+delay < D
+            g_d = granted & jnp.where(
+                (sdelay == d)[:, None], ONES, U32(0)
+            )
+            pulled_d = _fold_or_regular(g_d, n, s)  # [N, W]
+            slot = (state.t + 1 + d) % d_slots
+            # read-OR-write, not at[].max: the slot may already hold an
+            # earlier round's slower-delay grant words
+            sync_buf = sync_buf.at[slot].set(sync_buf[slot] | pulled_d)
+        # fruitfulness counts every granted word regardless of delay
+        # class — identical to sync_step's granted.any reduction
+        fruitful = (
+            (granted != U32(0)).any(axis=1).reshape(n, s).any(axis=1)
+        )
 
     # fruitfulness-adaptive backoff, bit-identical to sync.sync_step
-    fruitful = (pulled != U32(0)).any(axis=1)  # [N]
     cap = cfg.sync_backoff_cap()
     backoff = jnp.where(
         due & fruitful,

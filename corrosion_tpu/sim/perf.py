@@ -84,16 +84,25 @@ def measure_per_round(
     k_rounds: int = 8,
     reps: int = 3,
     mesh=None,
+    fplan=None,
 ) -> float:
     """Honest per-round seconds: jit a k-round `fori_loop` of the real
     `round_step`, block on the ENTIRE output pytree via host transfer,
     take the min over ``reps`` timed executions after a warmup.
 
+    ``fplan`` (a compiled SimFaultPlan/FactoredFaultPlan, or None)
+    microbenches the FAULT round body — per-round node-fault application
+    plus the fault seam through every phase — so a fault-storm wall is
+    verified against its own path's per-round cost, not the cheaper
+    faultless body.
+
     Host-transferring (`np.asarray`) one element of every output array is
     the strongest completion barrier available — it cannot return until
     the device actually produced the data, unlike an async-ready signal
     a tunnel plugin might fake."""
+    from .faults import apply_node_faults, round_faults
     from .packed import (
+        apply_carry_faults,
         pack_bits,
         pack_state,
         packed_round_step,
@@ -111,8 +120,8 @@ def measure_per_round(
         state = shard_state(state, mesh)
         meta = replicate_meta(meta, mesh)
 
-    # microbench the SAME path run_to_convergence dispatches, else the
-    # ×3 consistency check compares apples to oranges
+    # microbench the SAME path run_to_convergence/run_fault_plan
+    # dispatches, else the ×3 consistency check compares apples to oranges
     use_packed = packed_supported(cfg, topo)
 
     @jax.jit
@@ -124,6 +133,14 @@ def measure_per_round(
 
             def body(_, c):
                 s, carry, inj, m = c
+                if fplan is not None:
+                    rf = round_faults(fplan, s.t)
+                    s = apply_node_faults(s, rf)
+                    carry = apply_carry_faults(carry, rf)
+                    return packed_round_step(
+                        s, carry, inj, m, meta, cfg, topo, region,
+                        faults=rf,
+                    )
                 return packed_round_step(
                     s, carry, inj, m, meta, cfg, topo, region
                 )
@@ -135,6 +152,10 @@ def measure_per_round(
 
         def body(_, carry):
             s, m = carry
+            if fplan is not None:
+                rf = round_faults(fplan, s.t)
+                s = apply_node_faults(s, rf)
+                return round_step(s, m, meta, cfg, topo, region, faults=rf)
             return round_step(s, m, meta, cfg, topo, region)
 
         return jax.lax.fori_loop(0, k_rounds, body, (state, metrics))

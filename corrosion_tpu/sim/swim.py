@@ -125,13 +125,20 @@ def _reachable(
     if topo.loss > 0:
         ok &= ~jax.random.bernoulli(key, topo.loss, src.shape)
     if faults is not None:
-        ok &= ~faults.block[src, dst]
-        thr = faults.loss[src, dst]
-        bits = jax.random.bits(
-            jax.random.fold_in(jax.random.fold_in(key, faults.seed), 103),
-            src.shape, dtype=jnp.uint8,
-        )
-        ok &= ~(bits < thr)
+        from .faults import fault_edge_block, fault_edge_loss
+
+        blk = fault_edge_block(faults, src, dst)
+        if blk is not None:
+            ok &= ~blk
+        thr = fault_edge_loss(faults, src, dst)
+        if thr is not None:
+            bits = jax.random.bits(
+                jax.random.fold_in(
+                    jax.random.fold_in(key, faults.seed), 103
+                ),
+                src.shape, dtype=jnp.uint8,
+            )
+            ok &= ~(bits < thr)
     return ok
 
 

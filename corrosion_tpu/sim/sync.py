@@ -160,7 +160,13 @@ def sync_step(
         # session's RTT, applied below as extra ring slots on delivery
         # (jitter stays out: retransmission inside the reliable stream
         # smooths per-message jitter, only the fixed floor shifts RTT).
-        ok &= ~faults.block[src, dst] & ~faults.block[dst, src]
+        # `fault_session_refused` is the ONE implementation shared with
+        # the packed path, so the two can't drift.
+        from .faults import fault_session_refused
+
+        refused = fault_session_refused(faults, src, dst)
+        if refused is not None:
+            ok &= ~refused
 
     need = edge_needs(state, cfg, src, dst, regular_fanout=s) & ok[:, None]  # [E, P]
 
@@ -174,11 +180,20 @@ def sync_step(
     # separate from the broadcast one because sync-received changesets
     # carry no retransmission budget (see SimState.sync_inflight).
     d_slots = state.sync_inflight.shape[0]
-    if faults is None:
-        # every edge delivers at t+1: fold the s edges per puller first
-        # (regular layout ⇒ reshape-reduce, no scatter) and write the
-        # one slot.  deliver_step zeroed this slot when it last popped,
-        # so max() is a plain fill.
+    sdelay = None
+    if faults is not None:
+        # per-edge session latency: the slower direction bounds the
+        # bi-stream RTT (compile_plan validated 1+delay < n_delay_slots,
+        # so the target slot never collides with this round's pop);
+        # shared implementation with the packed path
+        from .faults import fault_session_delay
+
+        sdelay = fault_session_delay(faults, src, dst)  # i32[E] | None
+    if sdelay is None:
+        # every edge delivers at t+1 (latency-free plans included): fold
+        # the s edges per puller first (regular layout ⇒ reshape-reduce,
+        # no scatter) and write the one slot.  deliver_step zeroed this
+        # slot when it last popped, so max() is a plain fill.
         pulled = (
             granted.reshape(n, s, p).max(axis=1).astype(state.have.dtype)
         )  # [N, P]
@@ -186,12 +201,6 @@ def sync_step(
             (state.t + 1) % d_slots
         ].max(pulled)
     else:
-        # per-edge session latency: the slower direction bounds the
-        # bi-stream RTT (compile_plan validated 1 + delay < n_delay_slots,
-        # so the target slot never collides with this round's pop)
-        sdelay = jnp.maximum(
-            faults.delay[src, dst], faults.delay[dst, src]
-        ).astype(jnp.int32)  # [E]
         slot = (state.t + 1 + sdelay) % d_slots
         flat_idx = slot * n + src  # deliveries land at the PULLER
         ring = state.sync_inflight.reshape(d_slots * n, p)

@@ -180,6 +180,51 @@ def test_resume_and_wall_budget(tmp_path):
     assert fresh["result_digest"] == resumed["result_digest"]
 
 
+@pytest.mark.campaign
+def test_campaign_selects_packed_path_for_fault_cells(tmp_path):
+    """ISSUE 4 acceptance: a fault campaign whose cells sit inside the
+    bitpack envelope runs the PACKED round kernels (recorded per cell as
+    ``round_path`` — dense fallbacks visible, not silent), the replay
+    digest is unchanged on re-run across the path switch, and forcing
+    the dense path reproduces identical per-seed outcomes."""
+    import dataclasses
+
+    spec = CampaignSpec(
+        name="packed-fault-smoke",
+        scenario={
+            "n_nodes": 16, "n_payloads": 64, "n_writers": 2,
+            "chunks_per_version": 2, "fanout": 2,
+            "sync_interval_rounds": 4, "n_delay_slots": 4,
+            "rate_limit_bytes_round": None, "sync_budget_bytes": None,
+            "packed_min_cells": 0, "inject_every": 1,
+        },
+        events=(
+            FaultEvent("loss", 0, 10, p=0.3),
+            FaultEvent("partition", 2, 8, src=1, dst=0),
+        ),
+        seeds=(0, 1),
+        max_rounds=300,
+    )
+    a = run_campaign(spec, out_path=str(tmp_path / "a.json"))
+    cell = a["cells"][0]
+    assert cell["round_path"] == "packed"
+    assert cell["all_converged"], cell["per_seed"]
+    # determinism across the path switch: the replay digest holds
+    b = run_campaign(spec, out_path=None)
+    assert a["result_digest"] == b["result_digest"]
+    # dense forcing: same per-seed trajectories, path recorded as dense
+    dense = run_campaign(
+        dataclasses.replace(
+            spec,
+            scenario={**spec.scenario, "allow_packed": False},
+        ),
+        out_path=None,
+    )
+    dcell = dense["cells"][0]
+    assert dcell["round_path"] == "dense"
+    assert dcell["per_seed"] == cell["per_seed"]
+
+
 # -- nightly (slow) --------------------------------------------------------
 
 

@@ -140,6 +140,121 @@ def test_crash_with_state_wipe_rejoins_via_anti_entropy():
     assert (heads[:, 0] == cfg.n_versions).all()
 
 
+def test_factored_compile_matches_matrix_per_edge():
+    """The rank-1 factored form answers every per-edge fault query with
+    the same values as the matrix form, round by round — block ORs,
+    delay sums, jitter maxes, loss thresholds, self-edges excluded."""
+    import itertools
+
+    import jax.numpy as jnp
+
+    from corrosion_tpu.sim.faults import (
+        compile_plan,
+        fault_edge_block,
+        fault_edge_delay,
+        fault_edge_jitter,
+        fault_edge_loss,
+        round_faults,
+    )
+
+    cfg = _cfg(n_delay_slots=8)
+    plan = FaultPlan(
+        n_nodes=3, seed=3,
+        events=(
+            FaultEvent("loss", 0, 10, p=0.4),
+            FaultEvent("partition", 2, 8, src=2, dst=0),
+            FaultEvent("partition", 4, 9, src="0:2", dst="2:3",
+                       symmetric=True),
+            FaultEvent("delay", 1, 6, src=0, dst=1, delay_rounds=1),
+            FaultEvent("delay", 3, 7, src="*", dst=1, delay_rounds=2),
+            FaultEvent("jitter", 2, 6, src=0, dst="*", delay_rounds=2),
+            FaultEvent("crash", 5, 9, node=1, wipe=True),
+        ),
+    )
+    fp_m = compile_plan(plan, cfg, factored=False)
+    fp_f = compile_plan(plan, cfg, factored=True)
+    pairs = [(s, d) for s, d in itertools.product(range(3), range(3))]
+    src = jnp.asarray([p[0] for p in pairs])
+    dst = jnp.asarray([p[1] for p in pairs])
+    for r in range(plan.horizon + 1):
+        rm = round_faults(fp_m, jnp.int32(r))
+        rf = round_faults(fp_f, jnp.int32(r))
+        assert (np.asarray(rm.alive) == np.asarray(rf.alive)).all(), r
+        assert (np.asarray(rm.wipe) == np.asarray(rf.wipe)).all(), r
+        blocked = np.asarray(fault_edge_block(rm, src, dst))
+        for name, fn in (
+            ("block", fault_edge_block), ("loss", fault_edge_loss),
+            ("delay", fault_edge_delay), ("jitter", fault_edge_jitter),
+        ):
+            a, b = fn(rm, src, dst), fn(rf, src, dst)
+            a = np.zeros(len(pairs)) if a is None else np.asarray(a)
+            b = np.zeros(len(pairs)) if b is None else np.asarray(b)
+            if name == "loss":
+                # representations legitimately differ ON CUT EDGES: the
+                # matrix compiler folds a cut link's loss into `block`
+                # (loss=0 there), factored keeps both terms — immaterial
+                # to every kernel (ok &= ~block dominates the drop mask)
+                a, b = a[~blocked], b[~blocked]
+            assert (a == b).all(), (name, r, a, b)
+
+
+def test_factored_compile_refuses_overlapping_loss():
+    """Combined-drop u8 quantization is not factorable bit-exactly, so
+    two loss events overlapping on a (round, link) must refuse loudly —
+    and time- or selector-disjoint loss events must compile."""
+    from corrosion_tpu.sim.faults import compile_plan_factored
+
+    cfg = _cfg()
+    bad = FaultPlan(
+        3, 0,
+        events=(
+            FaultEvent("loss", 0, 10, p=0.2),
+            FaultEvent("loss", 5, 12, p=0.3, src=0, dst=1),
+        ),
+    )
+    with pytest.raises(ValueError, match="non-overlapping"):
+        compile_plan_factored(bad, cfg)
+    disjoint_time = FaultPlan(
+        3, 0,
+        events=(
+            FaultEvent("loss", 0, 5, p=0.2),
+            FaultEvent("loss", 5, 12, p=0.3),
+        ),
+    )
+    compile_plan_factored(disjoint_time, cfg)
+    disjoint_links = FaultPlan(
+        3, 0,
+        events=(
+            FaultEvent("loss", 0, 10, p=0.2, src=0, dst=1),
+            FaultEvent("loss", 0, 10, p=0.3, src=1, dst=0),
+        ),
+    )
+    compile_plan_factored(disjoint_links, cfg)
+    # and the factored ring-envelope validation keeps its teeth
+    with pytest.raises(ValueError, match="n_delay_slots"):
+        compile_plan_factored(
+            FaultPlan(3, 0, (FaultEvent("delay", 0, 4, delay_rounds=6),)),
+            _cfg(),
+        )
+
+
+def test_range_selectors_validate_and_expand():
+    """"lo:hi" selectors: bounds-checked at plan build, expanded by
+    `_pairs` on the host/matrix tier, lowered to node masks factored."""
+    from corrosion_tpu.faults import sel_indices
+
+    assert sel_indices("*", 5) == range(5)
+    assert sel_indices("1:4", 5) == range(1, 4)
+    assert sel_indices(2, 5) == range(2, 3)
+    with pytest.raises(ValueError, match="selector"):
+        FaultPlan(3, 0, (FaultEvent("loss", 0, 2, p=0.1, src="1:9"),))
+    plan = FaultPlan(
+        4, 0, (FaultEvent("partition", 0, 2, src="0:2", dst="2:4"),)
+    )
+    pairs = set(plan._pairs(plan.events[0]))
+    assert pairs == {(0, 2), (0, 3), (1, 2), (1, 3)}
+
+
 @pytest.mark.chaos
 def test_chaos_smoke_sim_tier():
     """Tier-1-sized FaultPlan smoke (3 nodes, well under 5 s): converge

@@ -11,6 +11,12 @@ the in-flight delay ring, injected flags, advertised bookkeeping
 metrics.  Scenarios cover multi-writer chunked storms, partial-view SWIM,
 full-view SWIM with node kills, multi-region ring0 tiering, and a
 mid-run partition + heal (VERDICT r3 item 2).
+
+Since ISSUE 4 the suite also pins the FAULT SEAM: packed == dense
+round-by-round under a FaultPlan — loss, asymmetric partitions,
+crash-with-wipe, fault latency, the metered limiter class, the
+storm-scale factored plan form, and a 4096-node storm through the
+public `run_fault_plan` entry (the acceptance gate).
 """
 
 from __future__ import annotations
@@ -364,6 +370,230 @@ def test_metered_lossy_gapstress_class():
     topo = Topology(loss=0.3)
     assert packed_supported(cfg, topo)
     _run_lockstep(cfg, topo, meta, rounds=40, seed=29)
+
+
+# -- the fault seam (ISSUE 4): packed == dense under a FaultPlan ------------
+
+
+def _fault_lockstep(cfg, topo, plan, meta, rounds, seed=0, factored=False):
+    """Advance dense and packed paths side by side UNDER A FAULT
+    SCHEDULE, comparing every round: each step slices the round's
+    faults, applies node faults (alive/wipe) to both representations,
+    and runs the faulted round body."""
+    from corrosion_tpu.sim.faults import (
+        apply_node_faults,
+        compile_plan,
+        round_faults,
+    )
+    from corrosion_tpu.sim.packed import apply_carry_faults
+
+    assert packed_supported(cfg, topo), "scenario must be in the envelope"
+    fplan = compile_plan(plan, cfg, topo, factored=factored)
+    region = regions(cfg.n_nodes, topo.n_regions)
+
+    @jax.jit
+    def dense(state, metrics, meta):
+        rf = round_faults(fplan, state.t)
+        state = apply_node_faults(state, rf)
+        return round_step(state, metrics, meta, cfg, topo, region, faults=rf)
+
+    @jax.jit
+    def packed(state, carry, inj, metrics, meta):
+        rf = round_faults(fplan, state.t)
+        state = apply_node_faults(state, rf)
+        carry = apply_carry_faults(carry, rf)
+        return packed_round_step(
+            state, carry, inj, metrics, meta, cfg, topo, region, faults=rf
+        )
+
+    sd = new_sim(cfg, seed)
+    md = new_metrics(cfg)
+    carry = pack_state(sd, cfg)
+    inj = pack_bits(sd.injected)
+    sp = shrink_state(sd)
+    mp = new_metrics(cfg)
+    for t in range(rounds):
+        sd, md = dense(sd, md, meta)
+        sp, carry, inj, mp = packed(sp, carry, inj, mp, meta)
+        _compare_round(t, sd, md, sp, carry, inj, mp, cfg)
+    _assert_equal("alive", sd.alive, sp.alive)
+    return sd, md
+
+
+def _fault_cfg(**kw):
+    kw.setdefault("n_payloads", 128)  # 8 versions x 4 writers x 4 chunks
+    kw.setdefault("n_writers", 4)
+    kw.setdefault("chunks_per_version", 4)
+    kw.setdefault("fanout", 3)
+    kw.setdefault("sync_interval_rounds", 4)
+    kw.setdefault("swim_partial_view", True)
+    kw.setdefault("member_slots", 16)
+    kw.setdefault("rate_limit_bytes_round", None)
+    kw.setdefault("sync_budget_bytes", None)
+    kw.setdefault("packed_min_cells", 0)
+    kw.setdefault("n_delay_slots", 4)
+    return SimConfig.wan_tuned(48, **kw)
+
+
+from corrosion_tpu.faults import FaultEvent, FaultPlan  # noqa: E402
+
+
+_FAULT_PLANS = {
+    "loss": (FaultEvent("loss", 0, 20, p=0.35),),
+    "asym-partition": (
+        FaultEvent("partition", 2, 16, src="0:24", dst="24:48"),
+    ),
+    "crash-wipe": (FaultEvent("crash", 6, 18, node=2, wipe=True),),
+    "latency": (
+        FaultEvent("delay", 2, 16, src="0:8", dst="*", delay_rounds=1),
+        FaultEvent("jitter", 2, 16, src="0:8", dst="*", delay_rounds=1),
+    ),
+    "storm-mix": (
+        FaultEvent("loss", 0, 20, p=0.3),
+        FaultEvent(
+            "partition", 4, 14, src="0:24", dst="24:48", symmetric=True
+        ),
+        FaultEvent("delay", 2, 16, src="0:8", dst="*", delay_rounds=1),
+        FaultEvent("jitter", 2, 16, src="0:8", dst="*", delay_rounds=1),
+        FaultEvent("crash", 10, 22, node=2, wipe=True),
+    ),
+}
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", sorted(_FAULT_PLANS))
+def test_fault_seam_packed_equals_dense(kind):
+    """ISSUE 4 satellite: packed == dense bit-for-bit, round-by-round,
+    under each fault class — loss masks on the same per-(edge, payload)
+    keys, asymmetric cuts, crash-with-wipe zeroing the packed carry +
+    both SWIM tiers, and fault latency stretching the packed sync delay
+    ring."""
+    cfg = _fault_cfg()
+    meta = uniform_payloads(cfg, inject_every=2)
+    plan = FaultPlan(n_nodes=48, seed=5, events=_FAULT_PLANS[kind])
+    _fault_lockstep(cfg, Topology(), plan, meta, rounds=30, seed=9)
+
+
+@pytest.mark.chaos
+def test_fault_seam_metered_class_packed_equals_dense():
+    """The limiter class composes with fault loss on the packed path:
+    binding broadcast governor + binding sync budget + mixed payload
+    sizes + a loss burst and an asymmetric cut — budget_prefix_words
+    spends on the attempt, loss eats the wire, bit-identical to dense."""
+    from corrosion_tpu.sim.runner import gapstress_payload_sizes
+
+    cfg = _fault_cfg(
+        n_payloads=256,  # 16 versions x 4 writers x 4 chunks
+        gap_slots=4,
+        rate_limit_bytes_round=32 * 1024,
+        sync_budget_bytes=24 * 1024,
+    )
+    meta = uniform_payloads(
+        cfg, inject_every=0,
+        payload_bytes=gapstress_payload_sizes(cfg.n_payloads),
+    )
+    plan = FaultPlan(
+        n_nodes=48, seed=11,
+        events=(
+            FaultEvent("loss", 0, 18, p=0.3),
+            FaultEvent("partition", 3, 12, src="0:16", dst="16:48"),
+        ),
+    )
+    _fault_lockstep(cfg, Topology(loss=0.2), plan, meta, rounds=30, seed=17)
+
+
+@pytest.mark.chaos
+def test_fault_seam_factored_form_matches_matrix():
+    """The storm-scale FactoredFaultPlan drives the packed round to the
+    SAME bits as the matrix form (lockstep vs the matrix-compiled dense
+    path): rank-1 factoring is a representation change, not a semantics
+    change."""
+    cfg = _fault_cfg()
+    meta = uniform_payloads(cfg, inject_every=2)
+    plan = FaultPlan(
+        n_nodes=48, seed=5, events=_FAULT_PLANS["storm-mix"]
+    )
+    # packed path on the FACTORED plan, dense path on the MATRIX plan
+    from corrosion_tpu.sim.faults import (
+        apply_node_faults,
+        compile_plan,
+        round_faults,
+    )
+    from corrosion_tpu.sim.packed import apply_carry_faults
+
+    topo = Topology()
+    fp_m = compile_plan(plan, cfg, topo, factored=False)
+    fp_f = compile_plan(plan, cfg, topo, factored=True)
+    region = regions(cfg.n_nodes, topo.n_regions)
+
+    @jax.jit
+    def dense(state, metrics, meta):
+        rf = round_faults(fp_m, state.t)
+        state = apply_node_faults(state, rf)
+        return round_step(state, metrics, meta, cfg, topo, region, faults=rf)
+
+    @jax.jit
+    def packed(state, carry, inj, metrics, meta):
+        rf = round_faults(fp_f, state.t)
+        state = apply_node_faults(state, rf)
+        carry = apply_carry_faults(carry, rf)
+        return packed_round_step(
+            state, carry, inj, metrics, meta, cfg, topo, region, faults=rf
+        )
+
+    sd = new_sim(cfg, 9)
+    md = new_metrics(cfg)
+    carry = pack_state(sd, cfg)
+    inj = pack_bits(sd.injected)
+    sp = shrink_state(sd)
+    mp = new_metrics(cfg)
+    for t in range(30):
+        sd, md = dense(sd, md, meta)
+        sp, carry, inj, mp = packed(sp, carry, inj, mp, meta)
+        _compare_round(t, sd, md, sp, carry, inj, mp, cfg)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_fault_storm_4096_packed_vs_dense():
+    """The acceptance storm: 4096 nodes under a nontrivial FaultPlan
+    (loss burst + half-split symmetric partition + crash-with-wipe)
+    converge bit-identically on the packed vs dense paths through the
+    PUBLIC entry (`run_fault_plan`, which dispatches on the envelope) —
+    same heads, same rounds, same digests."""
+    import hashlib
+
+    from corrosion_tpu.sim.faults import compile_plan, run_fault_plan
+    from corrosion_tpu.sim.runner import _write_storm, storm_fault_plan
+
+    cfg, meta = _write_storm(4096, 512)
+    cfg = dataclasses.replace(cfg, packed_min_cells=0)
+    topo = Topology()
+    plan = storm_fault_plan(4096, seed=3)
+    assert packed_supported(cfg, topo)
+
+    fplan = compile_plan(plan, cfg, topo)  # auto-factored at 4096
+    fp, mp = run_fault_plan(new_sim(cfg, 7), meta, cfg, topo, fplan, 1000)
+
+    cfgd = dataclasses.replace(cfg, allow_packed=False)
+    fd, md = run_fault_plan(
+        new_sim(cfgd, 7), meta, cfgd, topo,
+        compile_plan(plan, cfgd, topo), 1000,
+    )
+
+    assert int(fp.t) == int(fd.t) >= plan.horizon
+    digests = []
+    for final in (fp, fd):
+        h = hashlib.blake2b(digest_size=16)
+        for name in ("have", "heads", "alive", "relay_left", "injected"):
+            h.update(np.asarray(getattr(final, name)).tobytes())
+        digests.append(h.hexdigest())
+    assert digests[0] == digests[1]
+    _assert_equal("storm converged_at", md.converged_at, mp.converged_at)
+    _assert_equal("storm coverage_at", md.coverage_at, mp.coverage_at)
+    # and it actually converged (all up nodes) after the schedule
+    up = np.asarray(fp.alive) == 0
+    assert (np.asarray(mp.converged_at)[up] >= 0).all()
 
 
 def test_budget_prefix_words_matches_dense_mask():
