@@ -58,59 +58,32 @@ def main() -> int:
         res["n_devices"] = len(devs)
         res["devices_s"] = round(time.time() - t0, 1)
 
-        if spec["mode"] == "preflight":
-            import jax.numpy as jnp
+        # optional XLA profiler capture (ISSUE 5: --xla-profile /
+        # BENCH_XLA_PROFILE): a TensorBoard trace of THIS attempt's
+        # device work lands in the given dir; profiling never gates the
+        # result — a capture failure is recorded and the run proceeds,
+        # and stop_trace rides a finally so a crashing attempt (the one
+        # a profiler exists to explain) still flushes its capture
+        prof_dir = spec.get("xla_profile")
+        if prof_dir:
+            try:
+                os.makedirs(prof_dir, exist_ok=True)
+                jax.profiler.start_trace(prof_dir)
+                res["xla_profile"] = prof_dir
+            except Exception as exc:  # noqa: BLE001
+                res["xla_profile_error"] = f"{type(exc).__name__}: {exc}"
+                prof_dir = None
 
-            x = jnp.ones((512, 512), jnp.float32)
-            jax.block_until_ready(x @ x)
-            res["probe_s"] = round(time.time() - t0, 1)
-            res["ok"] = True
-
-        elif spec["mode"] == "storm":
-            from corrosion_tpu.sim.runner import config_write_storm_verified
-
-            n, p = int(spec["nodes"]), int(spec["payloads"])
-            # on a real multi-chip slice the storm runs node-axis-sharded
-            # over the whole mesh (VERDICT r2 item 4); single chip = None
-            mesh = None
-            if len(devs) > 1:
-                from corrosion_tpu.parallel.mesh import make_mesh
-
-                mesh = make_mesh()
-            # verified protocol (VERDICT r2 item 1): per-round microbench
-            # + HBM bound + ×3 consistency; wall_clock_s is the defensible
-            # (conservative) wall, sanity carries the raw record.  Compile
-            # warmup happens inside (microbench warmup + an AOT prime of
-            # the convergence loop), so no separate warmup call here.
-            m = config_write_storm_verified(
-                seed=1, n_nodes=n, n_payloads=p, mesh=mesh
-            )
-            # setup = everything before the measured run (compile + the
-            # per-round microbench); subtract the RAW wall, not the
-            # corrected one, which can exceed real elapsed time
-            raw_wall = m["sanity"]["full_run_wall_s"]
-            res["setup_s"] = round(time.time() - t0 - raw_wall, 1)
-            res["metrics"] = m
-            verdict = m.get("sanity", {}).get("verdict", "missing")
-            res["ok"] = bool(m.get("converged")) and verdict != "hbm-bound-violated"
-            if not m.get("converged"):
-                res["error"] = "ran but did not converge"
-            elif verdict == "hbm-bound-violated":
-                res["error"] = (
-                    "measurement chain broken: per-round wall implies "
-                    "impossible HBM bandwidth (see metrics.sanity)"
-                )
-
-        elif spec["mode"] == "aux":
-            from corrosion_tpu.sim import runner
-
-            fn = getattr(runner, spec["fn"])
-            m = fn(seed=int(spec.get("seed", 0)), **spec.get("kwargs", {}))
-            res["metrics"] = m
-            res["ok"] = True
-
-        else:
-            res["error"] = f"unknown mode {spec['mode']!r}"
+        try:
+            _run_mode(spec, res, devs, t0)
+        finally:
+            if prof_dir:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as exc:  # noqa: BLE001
+                    res["xla_profile_error"] = (
+                        f"{type(exc).__name__}: {exc}"
+                    )
     except BaseException as exc:  # noqa: BLE001 — report, never raise
         res["error"] = f"{type(exc).__name__}: {exc}"
     res["total_s"] = round(time.time() - t0, 1)
@@ -120,6 +93,64 @@ def main() -> int:
         json.dump(res, f, default=_jsonable)
     os.replace(tmp, out_path)
     return 0
+
+
+def _run_mode(spec, res, devs, t0) -> None:
+    import jax
+
+    if spec["mode"] == "preflight":
+        import jax.numpy as jnp
+
+        x = jnp.ones((512, 512), jnp.float32)
+        jax.block_until_ready(x @ x)
+        res["probe_s"] = round(time.time() - t0, 1)
+        res["ok"] = True
+
+    elif spec["mode"] == "storm":
+        from corrosion_tpu.sim.runner import config_write_storm_verified
+
+        n, p = int(spec["nodes"]), int(spec["payloads"])
+        # on a real multi-chip slice the storm runs node-axis-sharded
+        # over the whole mesh (VERDICT r2 item 4); single chip = None
+        mesh = None
+        if len(devs) > 1:
+            from corrosion_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh()
+        # verified protocol (VERDICT r2 item 1): per-round microbench
+        # + HBM bound + ×3 consistency; wall_clock_s is the defensible
+        # (conservative) wall, sanity carries the raw record.  Compile
+        # warmup happens inside (microbench warmup + an AOT prime of
+        # the convergence loop), so no separate warmup call here.
+        m = config_write_storm_verified(
+            seed=1, n_nodes=n, n_payloads=p, mesh=mesh
+        )
+        # setup = everything before the measured run (compile + the
+        # per-round microbench); subtract the RAW wall, not the
+        # corrected one, which can exceed real elapsed time
+        raw_wall = m["sanity"]["full_run_wall_s"]
+        res["setup_s"] = round(time.time() - t0 - raw_wall, 1)
+        res["metrics"] = m
+        verdict = m.get("sanity", {}).get("verdict", "missing")
+        res["ok"] = bool(m.get("converged")) and verdict != "hbm-bound-violated"
+        if not m.get("converged"):
+            res["error"] = "ran but did not converge"
+        elif verdict == "hbm-bound-violated":
+            res["error"] = (
+                "measurement chain broken: per-round wall implies "
+                "impossible HBM bandwidth (see metrics.sanity)"
+            )
+
+    elif spec["mode"] == "aux":
+        from corrosion_tpu.sim import runner
+
+        fn = getattr(runner, spec["fn"])
+        m = fn(seed=int(spec.get("seed", 0)), **spec.get("kwargs", {}))
+        res["metrics"] = m
+        res["ok"] = True
+
+    else:
+        res["error"] = f"unknown mode {spec['mode']!r}"
 
 
 if __name__ == "__main__":

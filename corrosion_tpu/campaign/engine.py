@@ -62,54 +62,179 @@ def _percentile_lower(arr: np.ndarray, q: float):
     return float(np.percentile(valid, q, method="lower"))
 
 
+def _membership_lane_stats(finals, cfg) -> Dict[str, List]:
+    """Host-side per-lane detection quality for membership cells — the
+    runner configs' `detected_fraction` / `false_positive_downs`,
+    vectorized over the lane axis."""
+    from ..sim.state import ALIVE, DOWN
+
+    alive = np.asarray(finals.alive)  # [K, N]
+    fracs: List[float] = []
+    fps: List[int] = []
+    if cfg.swim_full_view:
+        view = np.asarray(finals.view)  # [K, N, N]
+        for k in range(alive.shape[0]):
+            up = alive[k] == ALIVE
+            dead = ~up
+            watched = view[k][np.ix_(up, dead)]
+            fracs.append(
+                float((watched == DOWN).mean()) if watched.size else 1.0
+            )
+            fps.append(int((view[k][np.ix_(up, up)] == DOWN).sum()))
+    else:
+        pid = np.asarray(finals.pid)  # [K, N, M]
+        pkey = np.asarray(finals.pkey)
+        for k in range(alive.shape[0]):
+            up = alive[k] == ALIVE
+            watched = (
+                (pid[k] >= 0)
+                & ~up[np.maximum(pid[k], 0)]
+                & up[:, None]
+            )
+            marked = pkey[k] % 4 == DOWN
+            fracs.append(
+                float((watched & marked).sum() / watched.sum())
+                if watched.any()
+                else 1.0
+            )
+    out: Dict[str, List] = {"detected_fraction": fracs}
+    if cfg.swim_full_view:
+        out["false_positive_downs"] = fps
+    return out
+
+
 def _run_cell(
-    spec: CampaignSpec, cell: Dict[str, object]
+    spec: CampaignSpec,
+    cell: Dict[str, object],
+    cell_index: int = 0,
+    telemetry: bool = False,
+    trace_dir: Optional[str] = None,
 ) -> Dict[str, object]:
     """One parameter point: the whole seed set as one vmapped ensemble,
-    reduced to per-seed records + cross-seed bands."""
+    reduced to per-seed records + cross-seed bands.
+
+    The cell runs inside a ``campaign_cell`` span with child spans per
+    lane (cell → lanes → convergence) — the cell's ``traceparent`` is
+    recorded in the artifact and handed to the host-parity replay, so
+    ONE distributed trace covers both ends of a parity check (ISSUE 5).
+
+    ``telemetry`` threads the flight recorder through the ensemble: the
+    cell gains a deterministic ``telemetry`` summary block and, with
+    ``trace_dir``, per-lane flight-recorder JSONL artifacts.
+
+    Membership cells (``detect_membership`` scenario key) run the
+    on-device detection loop instead of the convergence loop and band
+    ``detect_round`` per seed — runner configs #2/#2b routed through the
+    engine."""
     import jax
 
     from ..sim.packed import packed_supported
     from ..sim.perf import analytic_min_round_s
     from ..sim.state import ALIVE, uniform_payloads
-    from .ensemble import run_seed_ensemble
+    from ..tracing import span
+    from .ensemble import run_detect_ensemble, run_seed_ensemble
 
     cfg = spec.sim_config(cell)
     topo = spec.topo(cell)
     meta = uniform_payloads(cfg, inject_every=spec.inject_every(cell))
-    plan = spec.fault_plan(cell, seed=spec.seeds[0])
+    detect = spec.detect_membership(cell)
+    plan = (
+        None if detect else spec.fault_plan(cell, seed=spec.seeds[0])
+    )
     # which round implementation the ensemble dispatches (fault plans
     # included — ISSUE 4): recorded per cell so dense fallbacks are
     # visible in artifacts and CLI output instead of silent
     round_path = "packed" if packed_supported(cfg, topo) else "dense"
 
-    t0 = time.monotonic()
-    finals, metrics = run_seed_ensemble(
-        plan, cfg, topo, meta, spec.seeds, max_rounds=spec.max_rounds
-    )
-    jax.block_until_ready((finals, metrics))
-    np.asarray(finals.have[0, 0, 0])  # force a real host read
-    wall = time.monotonic() - t0
-
     k = len(spec.seeds)
-    rounds = np.asarray(finals.t)  # [K]
-    alive = np.asarray(finals.alive)  # [K, N]
-    node_conv = np.asarray(metrics.converged_at)  # [K, N]
-    heads = np.asarray(finals.heads)  # [K, N, A]
-    unconverged = ((node_conv < 0) & (alive == ALIVE)).sum(axis=1)  # [K]
-    heads_ok = (
-        (heads == cfg.n_versions) | (alive[:, :, None] != ALIVE)
-    ).all(axis=(1, 2))  # [K] every up node's head hit the version count
-    converged = (unconverged == 0) & heads_ok
-    p99_node = [_percentile_lower(node_conv[i], 99) for i in range(k)]
+    traces = None
+    detect_rounds = None
+    with span(
+        "campaign_cell",
+        campaign=spec.name,
+        cell_index=cell_index,
+        params=dict(cell),
+        seeds=k,
+    ) as cell_span:
+        traceparent = cell_span.context.traceparent()
+        t0 = time.monotonic()
+        if detect:
+            out = run_detect_ensemble(
+                cfg, topo, meta, spec.seeds,
+                kill_every=spec.kill_every(cell),
+                max_rounds=spec.max_rounds, telemetry=telemetry,
+            )
+            finals, metrics, detect_rounds = out[0], out[1], out[2]
+            if telemetry:
+                traces = out[3]
+        else:
+            out = run_seed_ensemble(
+                plan, cfg, topo, meta, spec.seeds,
+                max_rounds=spec.max_rounds, telemetry=telemetry,
+            )
+            finals, metrics = out[0], out[1]
+            if telemetry:
+                traces = out[2]
+        jax.block_until_ready(out)
+        np.asarray(finals.have[0, 0, 0])  # force a real host read
+        wall = time.monotonic() - t0
 
-    per_seed = {
-        "rounds": [int(r) for r in rounds],
-        "converged": [bool(c) for c in converged],
-        "unconverged_nodes": [int(u) for u in unconverged],
-        "p99_node_convergence_round": p99_node,  # None = lane never converged
+        rounds = np.asarray(finals.t)  # [K]
+        alive = np.asarray(finals.alive)  # [K, N]
+        node_conv = np.asarray(metrics.converged_at)  # [K, N]
+        if detect:
+            dr = np.asarray(detect_rounds)  # [K]
+            converged = dr >= 0
+            per_seed = {
+                "rounds": [int(r) for r in rounds],
+                "converged": [bool(c) for c in converged],
+                # None (not -1) for never-detected lanes: a -1 would
+                # flow into bands() as a spuriously GOOD observation
+                # and mask regressions (_percentile_lower's rule)
+                "detect_round": [
+                    int(d) if d >= 0 else None for d in dr
+                ],
+            }
+            per_seed.update(_membership_lane_stats(finals, cfg))
+        else:
+            unconverged = ((node_conv < 0) & (alive == ALIVE)).sum(axis=1)
+            heads = np.asarray(finals.heads)  # [K, N, A]
+            heads_ok = (
+                (heads == cfg.n_versions) | (alive[:, :, None] != ALIVE)
+            ).all(axis=(1, 2))  # [K] every up node's head hit the count
+            converged = (unconverged == 0) & heads_ok
+            per_seed = {
+                "rounds": [int(r) for r in rounds],
+                "converged": [bool(c) for c in converged],
+                "unconverged_nodes": [int(u) for u in unconverged],
+                # None = lane never converged
+                "p99_node_convergence_round": [
+                    _percentile_lower(node_conv[i], 99) for i in range(k)
+                ],
+            }
+        # the lane → convergence span tree (host-synthesized after the
+        # vmapped run — lanes execute as ONE program, so their spans
+        # carry outcomes, not per-lane walls)
+        for i, s in enumerate(spec.seeds):
+            with span(
+                "lane", seed=int(s), rounds=int(rounds[i]),
+                converged=bool(converged[i]),
+            ):
+                attrs = (
+                    {"detect_round": int(dr[i])}
+                    if detect
+                    else {
+                        "p99_node_convergence_round": per_seed[
+                            "p99_node_convergence_round"
+                        ][i]
+                    }
+                )
+                with span("convergence", **attrs):
+                    pass
+
+    cell_bands = {
+        m: bands(per_seed[m]) for m in BAND_METRICS if m in per_seed
     }
-    cell_bands = {m: bands(per_seed[m]) for m in BAND_METRICS}
 
     # defensible wall: the batched program writes K lanes' carries every
     # executed round (frozen lanes still ride the select), and executed
@@ -130,22 +255,68 @@ def _run_cell(
         "wall_clock_s": round(wall, 4),
         "wall_defensible_s": round(max(wall, floor), 4),
         "wall_verdict": verdict,
+        # excluded from the result digest (report.NONDETERMINISTIC_KEYS):
+        # ids are random unless CORRO_CAMPAIGN_SEED pins the stream
+        "traceparent": traceparent,
     }
+    if traces is not None:
+        result["telemetry"] = _cell_telemetry(
+            spec, cell_index, traces, rounds, cfg, traceparent, trace_dir
+        )
     if spec.host_parity and plan is not None:
-        result["host_parity"] = host_parity_point(plan, cfg.n_versions)
+        result["host_parity"] = host_parity_point(
+            plan, cfg.n_versions, traceparent=traceparent
+        )
     return result
 
 
-def host_parity_point(plan, n_versions: int) -> Dict[str, object]:
+def _cell_telemetry(
+    spec, cell_index, traces, rounds, cfg, traceparent, trace_dir
+) -> Dict[str, object]:
+    """Per-cell flight-recorder export: a deterministic summary block
+    for the artifact (digest-stable under replay) and, when asked, one
+    JSONL per lane under ``trace_dir``."""
+    import jax
+
+    from ..sim.telemetry import trace_host, trace_summary, write_flight_jsonl
+
+    summaries = []
+    for i, seed in enumerate(spec.seeds):
+        lane = jax.tree.map(lambda x: x[i], traces)
+        r = int(rounds[i])
+        host = trace_host(lane, r)
+        summaries.append(trace_summary(host, r, cfg))
+        if trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+            path = _lane_trace_path(trace_dir, spec, cell_index, seed)
+            write_flight_jsonl(
+                path, host, r, cfg,
+                header={
+                    "campaign": spec.name,
+                    "spec_hash": spec.spec_hash(),
+                    "cell_index": cell_index,
+                    "seed": int(seed),
+                    "traceparent": traceparent,
+                },
+            )
+    return {"per_seed": summaries}
+
+
+def host_parity_point(
+    plan, n_versions: int, traceparent: Optional[str] = None
+) -> Dict[str, object]:
     """Replay the cell's plan (first-seed lane) against the in-process
     host cluster — the PR 2 parity harness as an engine primitive: write
     ``n_versions`` on node 0 under the schedule, then record whether
     every node's eventual head for the writer matches the sim tier's
-    ground truth."""
+    ground truth.  ``traceparent`` (the cell span's W3C context) parents
+    the replay's span, so one trace covers both ends of the parity
+    check."""
     import asyncio
 
     from ..faults import HostFaultDriver
     from ..testing import Cluster
+    from ..tracing import extract, span
 
     async def body():
         cluster = Cluster(plan.n_nodes, use_swim=False)
@@ -177,7 +348,15 @@ def host_parity_point(plan, n_versions: int) -> Dict[str, object]:
         finally:
             await cluster.stop()
 
-    return asyncio.run(body())
+    # the replay continues the CELL's trace (extract tolerates a missing
+    # or malformed parent, as on the wire), so the sim ensemble and its
+    # host-tier parity replay share one distributed trace
+    with span(
+        "host_parity", parent=extract(traceparent), plan_seed=plan.seed
+    ) as sp:
+        result = asyncio.run(body())
+        sp.set_attribute("heads_match", result["heads_match"])
+    return result
 
 
 def _load_artifact(path: str, spec_hash: str) -> Optional[Dict]:
@@ -206,6 +385,8 @@ def run_campaign(
     out_path: Optional[str] = None,
     wall_budget_s: Optional[float] = None,
     resume: bool = True,
+    telemetry: Optional[bool] = None,
+    trace_dir: Optional[str] = None,
 ) -> Dict:
     """Run every (cell × seed-ensemble) of the campaign.
 
@@ -216,9 +397,25 @@ def run_campaign(
       never an unbounded nightly) — unfinished cells land in
       ``skipped_cells`` and a later resume completes them;
     - ``resume``: reuse completed cells from an existing artifact with
-      the SAME spec hash (a hash mismatch starts from scratch).
+      the SAME spec hash (a hash mismatch starts from scratch);
+    - ``telemetry``: thread the flight recorder through every cell
+      (None defers to ``spec.telemetry``); ``trace_dir`` additionally
+      writes one flight-recorder JSONL per (cell, lane).
     """
+    if telemetry is None:
+        telemetry = spec.telemetry
+    if trace_dir:
+        telemetry = True
     spec_hash = spec.spec_hash()
+    campaign_seed = os.environ.get("CORRO_CAMPAIGN_SEED")
+    if campaign_seed:
+        # campaign artifacts embed traceparents: pin the span/trace-id
+        # stream to (campaign seed, spec hash) so a seeded replay of
+        # THIS spec reproduces its traceparents exactly while distinct
+        # campaigns in the same process still draw distinct id streams
+        from ..tracing import seed_trace_ids
+
+        seed_trace_ids(f"{campaign_seed}:{spec_hash}")
     cells = spec.cells()
     done: Dict[int, Dict] = {}
     if resume and out_path:
@@ -232,7 +429,9 @@ def run_campaign(
     results: List[Dict] = []
     skipped: List[int] = []
     for i, cell in enumerate(cells):
-        if i in done:
+        if i in done and _cached_cell_satisfies(
+            done[i], spec, i, telemetry, trace_dir
+        ):
             results.append(done[i])
             continue
         if (
@@ -241,7 +440,10 @@ def run_campaign(
         ):
             skipped.append(i)
             continue
-        res = _run_cell(spec, cell)
+        res = _run_cell(
+            spec, cell, cell_index=i, telemetry=telemetry,
+            trace_dir=trace_dir,
+        )
         res["cell_index"] = i
         results.append(res)
         if out_path:
@@ -251,6 +453,40 @@ def run_campaign(
     if out_path:
         _write_artifact(out_path, artifact)
     return artifact
+
+
+def _lane_trace_path(
+    trace_dir: str, spec, cell_index: int, seed
+) -> str:
+    """One flight-recorder JSONL per (cell, lane) — the single source of
+    the naming scheme, shared by the writer (`_cell_telemetry`) and the
+    resume check (`_cached_cell_satisfies`)."""
+    return os.path.join(
+        trace_dir,
+        f"{spec.name}_cell{cell_index}_seed{int(seed)}.jsonl",
+    )
+
+
+def _cached_cell_satisfies(
+    cached: Dict, spec, cell_index: int, telemetry: bool,
+    trace_dir: Optional[str],
+) -> bool:
+    """Resume reuses a cached cell only when it already carries what this
+    run asked for: the telemetry summary block, and (under ``trace_dir``)
+    each lane's flight-recorder JSONL on disk.  Otherwise the cell
+    re-runs — telemetry-on results are digest-identical to telemetry-off
+    (the ISSUE 5 contract), so replay digests stay stable."""
+    if not telemetry:
+        return True
+    if "telemetry" not in cached:
+        return False
+    if trace_dir:
+        for seed in spec.seeds:
+            if not os.path.exists(
+                _lane_trace_path(trace_dir, spec, cell_index, seed)
+            ):
+                return False
+    return True
 
 
 def _artifact(spec, spec_hash, results, skipped, t0) -> Dict:

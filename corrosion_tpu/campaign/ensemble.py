@@ -63,16 +63,25 @@ def run_ensemble(
     fplan: Optional[SimFaultPlan] = None,
     plan_seeds: Optional[jnp.ndarray] = None,
     max_rounds: int = 1000,
-) -> Tuple[SimState, RunMetrics]:
+    telemetry: bool = False,
+):
     """Run every lane to convergence (or ``max_rounds``) in one batched
     program.  ``fplan`` holds the shared schedule tensors; ``plan_seeds``
     (i32[K]) re-seeds each lane's fault streams.  Both entries dispatch
     the packed round over the bitpack envelope (`run_to_convergence`
     faultless, `run_fault_plan` under a plan since ISSUE 4) — the batch
-    rule vmaps whichever path the scenario compiles to."""
+    rule vmaps whichever path the scenario compiles to.
+
+    ``telemetry=True`` threads the flight recorder (ISSUE 5): the trace
+    is allocated INSIDE the jitted run, so vmap stacks per-lane buffers
+    and lane k's trace slice is byte-identical to its solo run's trace
+    (tests/sim/test_telemetry.py pins it).  Adds a stacked RoundTrace to
+    the return."""
     if fplan is None:
         return jax.vmap(
-            lambda st: run_to_convergence(st, meta, cfg, topo, max_rounds)
+            lambda st: run_to_convergence(
+                st, meta, cfg, topo, max_rounds, telemetry=telemetry
+            )
         )(states)
     if plan_seeds is None:
         plan_seeds = jnp.broadcast_to(fplan.seed, states.t.shape)
@@ -81,7 +90,9 @@ def run_ensemble(
     # with optional None classes, and the storm-scale FactoredFaultPlan)
     lane_axes = jax.tree.map(lambda _: None, fplan)._replace(seed=0)
     return jax.vmap(
-        lambda st, fp: run_fault_plan(st, meta, cfg, topo, fp, max_rounds),
+        lambda st, fp: run_fault_plan(
+            st, meta, cfg, topo, fp, max_rounds, telemetry=telemetry
+        ),
         in_axes=(0, lane_axes),
     )(states, fplan._replace(seed=plan_seeds))
 
@@ -93,14 +104,50 @@ def run_seed_ensemble(
     meta: PayloadMeta,
     seeds: Sequence[int],
     max_rounds: int = 1000,
-) -> Tuple[SimState, RunMetrics]:
+    telemetry: bool = False,
+):
     """Convenience wrapper: seeds → stacked states (+ per-lane plan
     seeds when a plan is given) → one vmapped run."""
     states = seed_states(cfg, seeds)
     if plan is None:
-        return run_ensemble(states, meta, cfg, topo, max_rounds=max_rounds)
+        return run_ensemble(
+            states, meta, cfg, topo, max_rounds=max_rounds,
+            telemetry=telemetry,
+        )
     fplan = compile_plan(plan, cfg, topo)
     return run_ensemble(
         states, meta, cfg, topo, fplan=fplan,
         plan_seeds=lane_plan_seeds(seeds), max_rounds=max_rounds,
+        telemetry=telemetry,
     )
+
+
+def run_detect_ensemble(
+    cfg: SimConfig,
+    topo: Topology,
+    meta: PayloadMeta,
+    seeds: Sequence[int],
+    kill_every: int = 0,
+    max_rounds: int = 400,
+    telemetry: bool = False,
+):
+    """Membership-churn seed ensemble (runner configs #2/#2b through the
+    engine — ROADMAP "detect-round bands"): kill every ``kill_every``-th
+    node at t=0 on every lane, then vmap `telemetry.run_membership_detect`
+    so the on-device detection predicate early-exits each lane.  Returns
+    (finals, metrics, detect_rounds[K][, traces])."""
+    from ..sim.state import ALIVE, DOWN
+    from ..sim.telemetry import run_membership_detect
+
+    states = seed_states(cfg, seeds)
+    if kill_every:
+        kill = jnp.arange(cfg.n_nodes) % kill_every == 0
+        alive = jnp.where(kill, jnp.uint8(DOWN), jnp.uint8(ALIVE))
+        states = states._replace(
+            alive=jnp.broadcast_to(alive, states.alive.shape)
+        )
+    return jax.vmap(
+        lambda st: run_membership_detect(
+            st, meta, cfg, topo, max_rounds, telemetry=telemetry
+        )
+    )(states)

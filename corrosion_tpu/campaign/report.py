@@ -19,14 +19,22 @@ import numpy as np
 
 from .spec import canonical_json, content_hash
 
-#: per-seed metrics that band + regression-compare (higher = worse)
-BAND_METRICS = ("rounds", "p99_node_convergence_round")
-#: artifact keys excluded from the result digest (vary run to run
-#: without changing the campaign's *outcome*: walls are measurements,
-#: and host-tier parity points ride real wall-clock scheduling)
+#: per-seed metrics that band + regression-compare (higher = worse).
+#: ``detect_round`` exists only on membership cells (detect_membership
+#: scenarios — runner configs #2/#2b through the engine); `compare`
+#: skips bands a cell doesn't carry.
+BAND_METRICS = ("rounds", "p99_node_convergence_round", "detect_round")
+#: artifact keys excluded from the result digest (vary run to run —
+#: or run-CONFIG to run-config — without changing the campaign's
+#: *outcome*: walls are measurements, host-tier parity points ride real
+#: wall-clock scheduling, span ids are random unless
+#: CORRO_CAMPAIGN_SEED pins the stream, and the telemetry summary
+#: block, while deterministic, is toggled by a CLI flag — keeping it
+#: out means a telemetry-on candidate still byte-certifies against a
+#: telemetry-off baseline of the same spec hash)
 NONDETERMINISTIC_KEYS = (
     "wall_clock_s", "wall_defensible_s", "wall_verdict", "walls",
-    "host_parity",
+    "host_parity", "traceparent", "telemetry",
 )
 
 

@@ -32,8 +32,19 @@ from ..faults import FaultEvent, FaultPlan
 #: appear in ``scenario`` or ``grid`` (``topo()`` reads them from either
 #: place; ``sim_config()`` strips them)
 _TOPOLOGY_KEYS = ("n_regions", "intra_delay", "inter_delay", "loss")
-#: spec-level (non-SimConfig) scenario keys
-_SCENARIO_META_KEYS = ("inject_every",)
+#: spec-level (non-SimConfig) scenario keys:
+#: - ``inject_every`` — payload injection cadence;
+#: - ``wan_tuned`` — build the cell's SimConfig via `SimConfig.wan_tuned`
+#:   (cluster-size-adaptive SWIM timing), as the runner configs do;
+#: - ``detect_membership`` — the cell is a membership-churn scenario:
+#:   run `telemetry.run_membership_detect` (on-device detection
+#:   early-exit) instead of the convergence loop, and band the per-seed
+#:   ``detect_round`` (ROADMAP "detect-round bands");
+#: - ``kill_every`` — kill every k-th node at t=0 on every lane (the
+#:   churn configs' mutator, 0 = none).
+_SCENARIO_META_KEYS = (
+    "inject_every", "detect_membership", "kill_every",
+)
 
 
 def canonical_json(obj) -> str:
@@ -75,6 +86,11 @@ class CampaignSpec:
     - ``host_parity``: also replay each cell's plan against the
       in-process host cluster (PR 2 parity harness) and record whether
       the eventual writer heads match the sim tier's ground truth.
+    - ``telemetry``: thread the flight recorder (sim/telemetry.py)
+      through every cell's ensemble — per-cell telemetry summaries land
+      in the artifact and `run_campaign(trace_dir=...)` writes per-lane
+      flight-recorder JSONL.  Serialized only when True, so existing
+      spec hashes (and committed baselines) are untouched.
     """
 
     name: str
@@ -86,6 +102,7 @@ class CampaignSpec:
     max_rounds: int = 1000
     host_parity: bool = False
     round_s: float = 0.05  # host-tier wall-clock per round
+    telemetry: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "events", tuple(self.events))
@@ -99,7 +116,7 @@ class CampaignSpec:
     # -- serialization ------------------------------------------------------
 
     def to_dict(self) -> Dict[str, object]:
-        return {
+        d = {
             "name": self.name,
             "scenario": dict(self.scenario),
             "topology": dict(self.topology),
@@ -110,6 +127,12 @@ class CampaignSpec:
             "host_parity": self.host_parity,
             "round_s": self.round_s,
         }
+        # serialized only when on: telemetry observes a run without
+        # changing its trajectory, and a False key would shift EVERY
+        # existing spec hash (committed baselines included) for nothing
+        if self.telemetry:
+            d["telemetry"] = True
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, object]) -> "CampaignSpec":
@@ -123,6 +146,7 @@ class CampaignSpec:
             max_rounds=int(d.get("max_rounds", 1000)),
             host_parity=bool(d.get("host_parity", False)),
             round_s=float(d.get("round_s", 0.05)),
+            telemetry=bool(d.get("telemetry", False)),
         )
 
     def spec_hash(self) -> str:
@@ -150,8 +174,14 @@ class CampaignSpec:
 
         kw = dict(self.scenario)
         kw.update(cell)
+        wan = bool(kw.pop("wan_tuned", False))
         for k in _TOPOLOGY_KEYS + _SCENARIO_META_KEYS:
             kw.pop(k, None)
+        if wan:
+            # the runner configs' cluster-size-adaptive SWIM timing —
+            # a spec routing one of them through the engine must build
+            # the identical SimConfig or the RNG streams diverge
+            return SimConfig.wan_tuned(kw.pop("n_nodes"), **kw)
         return SimConfig(**kw)
 
     def topo(self, cell: Dict[str, object]):
@@ -176,6 +206,19 @@ class CampaignSpec:
             cell.get(
                 "inject_every", self.scenario.get("inject_every", 1)
             )
+        )
+
+    def detect_membership(self, cell: Dict[str, object]) -> bool:
+        return bool(
+            cell.get(
+                "detect_membership",
+                self.scenario.get("detect_membership", False),
+            )
+        )
+
+    def kill_every(self, cell: Dict[str, object]) -> int:
+        return int(
+            cell.get("kill_every", self.scenario.get("kill_every", 0))
         )
 
     def fault_plan(
@@ -253,9 +296,46 @@ def fault_campaign_3node_spec(seed: int = 0) -> CampaignSpec:
     )
 
 
+def swim_churn_64_spec(
+    seeds: Sequence[int] = (0,), n: int = 64, max_rounds: int = 400
+) -> CampaignSpec:
+    """Runner config #2 through the engine (ISSUE 5, closing the ROADMAP
+    "detect-round bands for membership scenarios" item): kill a third of
+    an n-node full-view cluster at t=0, band the rounds until every
+    survivor marks every dead node DOWN."""
+    return CampaignSpec(
+        name="swim-churn-64",
+        scenario={
+            "n_nodes": n, "n_payloads": 1, "swim_full_view": True,
+            "wan_tuned": True, "detect_membership": True, "kill_every": 3,
+        },
+        seeds=tuple(seeds),
+        max_rounds=max_rounds,
+    )
+
+
+def swim_churn_partial_spec(
+    seeds: Sequence[int] = (0,), n: int = 4096, max_rounds: int = 600
+) -> CampaignSpec:
+    """Runner config #2b (partial-view scale tier) through the engine:
+    the same churn shape on O(N·M) member tables."""
+    return CampaignSpec(
+        name="swim-churn-partial",
+        scenario={
+            "n_nodes": n, "n_payloads": 1, "swim_partial_view": True,
+            "probe_period_rounds": 1,
+            "wan_tuned": True, "detect_membership": True, "kill_every": 3,
+        },
+        seeds=tuple(seeds),
+        max_rounds=max_rounds,
+    )
+
+
 BUILTIN_SPECS = {
     "fault-parity-3node": fault_parity_3node_spec,
     "fault-campaign-3node": fault_campaign_3node_spec,
+    "swim-churn-64": swim_churn_64_spec,
+    "swim-churn-partial": swim_churn_partial_spec,
 }
 
 

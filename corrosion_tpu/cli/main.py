@@ -359,12 +359,20 @@ _SIM_SCENARIOS = {
     # the storm shape under a loss+partition+crash FaultPlan, on the
     # PACKED round path (ISSUE 4), with the defensible-wall protocol
     "packed-fault-storm": "config_packed_fault_storm",
+    # the fault storm WITH the flight recorder on (ISSUE 5): per-round
+    # telemetry overhead vs plain + the coverage-curve summary
+    "fault-storm-telemetry": "config_fault_storm_telemetry",
 }
 
 
 def cmd_sim(args) -> int:
     """Run a TPU-simulator benchmark config (rebuild-specific; these are
-    the BASELINE.md scenario tiers), or dispatch `sim campaign ...`."""
+    the BASELINE.md scenario tiers), or dispatch `sim campaign ...` /
+    `sim trace show ...`."""
+    if args.scenario == "trace":
+        # pure host-side artifact rendering — dispatched before the
+        # platform setup below so it never pays the jax import
+        return cmd_trace(args)
     # honor JAX_PLATFORMS even when an accelerator plugin would win over
     # the env var (jax.config takes precedence) — tests set cpu to keep
     # subprocess sims off the contended real chip
@@ -372,8 +380,46 @@ def cmd_sim(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    if args.scenario == "campaign":
-        return cmd_campaign(args)
+    # --trace-out is the scenario form (one JSONL per run/seed);
+    # --trace-dir is the campaign form (one JSONL per cell/lane) —
+    # refuse the mismatched flag loudly rather than silently
+    # recording nothing
+    if args.scenario == "campaign" and args.trace_out:
+        print(
+            "error: campaign runs write per-cell traces via "
+            "--trace-dir DIR, not --trace-out",
+            file=sys.stderr,
+        )
+        return 2
+    if args.scenario != "campaign" and args.trace_dir:
+        print(
+            "error: --trace-dir is a campaign flag; scenario runs "
+            "take --trace-out FILE",
+            file=sys.stderr,
+        )
+        return 2
+    profiling = None
+    if args.xla_profile:
+        # optional XLA profiler capture around the run (jax.profiler
+        # TensorBoard trace into DIR) — covers scenario AND campaign
+        # runs; the bench storm rungs use the same hook via
+        # BENCH_XLA_PROFILE
+        import jax
+
+        jax.profiler.start_trace(args.xla_profile)
+        profiling = args.xla_profile
+    try:
+        if args.scenario == "campaign":
+            return cmd_campaign(args)
+        return _run_sim_scenario(args)
+    finally:
+        if profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+
+
+def _run_sim_scenario(args) -> int:
     from ..sim import runner
 
     fn = getattr(runner, _SIM_SCENARIOS[args.scenario])
@@ -382,17 +428,64 @@ def cmd_sim(args) -> int:
     # list to forget when adding a scenario
     import inspect
 
-    if args.nodes and "n_nodes" in inspect.signature(fn).parameters:
+    params = inspect.signature(fn).parameters
+    if args.nodes and "n_nodes" in params:
         kwargs["n_nodes"] = args.nodes
+    # flight recorder (ISSUE 5): --telemetry adds the summary block to
+    # the record; --trace-out also writes the per-round JSONL artifact.
+    # A scenario supports the recorder if its config fn takes `telemetry`
+    # or `trace_path` (fault-storm-telemetry is always-on: trace_path
+    # only); anything else refuses the flags loudly rather than silently
+    # running without them.
+    if (args.telemetry or args.trace_out) and not (
+        "telemetry" in params or "trace_path" in params
+    ):
+        print(
+            f"error: scenario {args.scenario!r} does not support "
+            "--telemetry/--trace-out",
+            file=sys.stderr,
+        )
+        return 2
+    if args.trace_out and "trace_path" not in params:
+        print(
+            f"error: scenario {args.scenario!r} supports --telemetry "
+            "but not --trace-out",
+            file=sys.stderr,
+        )
+        return 2
+    if (args.telemetry or args.trace_out) and "telemetry" in params:
+        kwargs["telemetry"] = True
+    trace_out = args.trace_out
     base_seed = args.seed if args.seed is not None else 0
     n_seeds = args.seeds or 1
     if n_seeds <= 1:
+        if trace_out:
+            kwargs["trace_path"] = trace_out
         print(json.dumps(fn(seed=base_seed, **kwargs), default=float))
         return 0
+
+    def seed_trace_path(seed: int):
+        # one artifact PER SEED: a shared path would atomically replace
+        # itself n_seeds times and silently keep only the last trace
+        if not trace_out:
+            return None
+        root, ext = os.path.splitext(trace_out)
+        return f"{root}.seed{seed}{ext or '.jsonl'}"
+
     # multi-seed distribution: per-seed records plus cross-seed
     # percentiles of every numeric field (the convergence-round
     # DISTRIBUTION the calibration contract compares, not one scalar)
-    runs = [fn(seed=base_seed + i, **kwargs) for i in range(n_seeds)]
+    runs = [
+        fn(
+            seed=base_seed + i,
+            **(
+                dict(kwargs, trace_path=seed_trace_path(base_seed + i))
+                if trace_out
+                else kwargs
+            ),
+        )
+        for i in range(n_seeds)
+    ]
     numeric = {
         k for k in runs[0]
         if all(isinstance(r.get(k), (int, float)) for r in runs)
@@ -413,21 +506,89 @@ def cmd_sim(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`sim trace show --in FILE`: render a flight-recorder JSONL
+    artifact (header summary + a compact per-round table) without
+    touching jax — the artifact is plain JSON lines."""
+    if args.campaign_cmd != "show":
+        raise SystemExit("usage: sim trace show --in FILE [--json]")
+    if not args.in_path:
+        raise SystemExit("sim trace show needs --in FILE")
+    with open(args.in_path) as f:
+        head = json.loads(f.readline())
+        rows = [json.loads(line) for line in f if line.strip()]
+    if head.get("kind") != "flight_recorder":
+        raise SystemExit(f"{args.in_path} is not a flight-recorder artifact")
+    if args.json:
+        _print_json({"header": head, "rounds": rows})
+        return 0
+    print(
+        f"flight recorder v{head.get('version')}: "
+        f"{head['n_nodes']} nodes × {head['n_payloads']} payloads, "
+        f"{head['rounds']} rounds"
+    )
+    for k in ("campaign", "cell_index", "seed", "scenario", "traceparent"):
+        if k in head:
+            print(f"  {k}: {head[k]}")
+    _print_json(head.get("summary", {}))
+    cols = (
+        "t", "coverage_frac", "delivered", "bcast_bytes", "sync_bytes",
+        "sync_sessions", "bcast_dropped", "bcast_cut", "swim_down",
+        "crashes", "wipes", "gap_overflow",
+    )
+    print("  ".join(f"{c:>13}" for c in cols))
+    for row in rows:
+        print("  ".join(f"{row.get(c, ''):>13}" for c in cols))
+    return 0
+
+
 def cmd_campaign(args) -> int:
-    """`sim campaign run|compare` (corrosion_tpu.campaign): declarative
-    seed-ensemble campaigns with convergence regression bands.
+    """`sim campaign run|compare|report` (corrosion_tpu.campaign):
+    declarative seed-ensemble campaigns with convergence regression
+    bands.
 
     - ``run``: execute a spec (builtin name or JSON file) and write the
       band artifact; resumable via the artifact path, wall-budgeted via
-      ``--budget-s``.
+      ``--budget-s``; ``--telemetry``/``--trace-dir`` thread the flight
+      recorder through every cell.
     - ``compare``: hold a candidate artifact against a baseline; exits 1
       on a regress verdict (the nightly gate's teeth).
+    - ``report``: print an artifact's band summary — with
+      ``--telemetry``, the per-cell flight-recorder blocks too.
     """
     import os as _os
 
     from ..campaign import BUILTIN_SPECS, builtin_spec, load_spec
     from ..campaign.engine import run_campaign
     from ..campaign.report import compare
+
+    if args.campaign_cmd == "report":
+        path = args.in_path or args.candidate
+        if not path:
+            raise SystemExit("sim campaign report needs --in ARTIFACT")
+        with open(path) as f:
+            art = json.load(f)
+        out = {
+            "name": art.get("spec", {}).get("name"),
+            "spec_hash": art.get("spec_hash"),
+            "result_digest": art.get("result_digest"),
+            "skipped_cells": art.get("skipped_cells", []),
+            "cells": [],
+        }
+        for c in art.get("cells", []):
+            entry = {
+                "params": c.get("params", {}),
+                "round_path": c.get("round_path", "unknown"),
+                "all_converged": c.get("all_converged"),
+                "bands": c.get("bands", {}),
+            }
+            if c.get("traceparent"):
+                entry["traceparent"] = c["traceparent"]
+            if args.telemetry and "telemetry" in c:
+                entry["telemetry"] = c["telemetry"]
+            out["cells"].append(entry)
+        _print_json(out)
+        return 0
 
     if args.campaign_cmd == "compare":
         if not (args.baseline and args.candidate):
@@ -445,7 +606,7 @@ def cmd_campaign(args) -> int:
         return 0 if report["verdict"] == "pass" else 1
 
     if args.campaign_cmd != "run":
-        raise SystemExit("usage: sim campaign {run|compare} ...")
+        raise SystemExit("usage: sim campaign {run|compare|report} ...")
     if not args.spec:
         raise SystemExit(
             f"--spec required: a JSON spec file or one of "
@@ -472,6 +633,8 @@ def cmd_campaign(args) -> int:
     artifact = run_campaign(
         spec, out_path=out, wall_budget_s=args.budget_s,
         resume=not args.no_resume,
+        telemetry=args.telemetry or None,
+        trace_dir=args.trace_dir,
     )
     summary = {
         "spec_hash": artifact["spec_hash"],
@@ -634,14 +797,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     sm = sp.add_parser(
         "sim",
-        help="run a TPU-simulator benchmark config, or "
-        "`sim campaign run|compare` for declarative seed-ensemble "
-        "campaigns",
+        help="run a TPU-simulator benchmark config, "
+        "`sim campaign run|compare|report` for declarative seed-ensemble "
+        "campaigns, or `sim trace show` for flight-recorder artifacts",
     )
-    sm.add_argument("scenario", choices=sorted(_SIM_SCENARIOS) + ["campaign"])
     sm.add_argument(
-        "campaign_cmd", nargs="?", choices=["run", "compare"],
-        help="campaign action (scenario=campaign only)",
+        "scenario", choices=sorted(_SIM_SCENARIOS) + ["campaign", "trace"]
+    )
+    sm.add_argument(
+        "campaign_cmd", nargs="?",
+        choices=["run", "compare", "report", "show"],
+        help="campaign action (scenario=campaign), or `show` "
+        "(scenario=trace)",
     )
     # default None so "explicitly given" is detectable: campaign run
     # must distinguish `--seed 0` (override to one seed) from "no seed
@@ -671,6 +838,35 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sm.add_argument("--baseline", help="campaign compare: baseline artifact")
     sm.add_argument("--candidate", help="campaign compare: candidate artifact")
+    sm.add_argument(
+        "--telemetry", action="store_true",
+        help="flight recorder (ISSUE 5): record in-kernel per-round "
+        "telemetry (scenario runs gain a summary block; campaign run "
+        "threads it through every cell; campaign report prints it)",
+    )
+    sm.add_argument(
+        "--trace-out",
+        help="scenario runs: write the flight-recorder JSONL here "
+        "(implies --telemetry)",
+    )
+    sm.add_argument(
+        "--trace-dir",
+        help="campaign run: write per-(cell, lane) flight-recorder "
+        "JSONL traces here (implies --telemetry)",
+    )
+    sm.add_argument(
+        "--in", dest="in_path",
+        help="trace show / campaign report: input artifact path",
+    )
+    sm.add_argument(
+        "--json", action="store_true",
+        help="trace show: raw JSON instead of the table",
+    )
+    sm.add_argument(
+        "--xla-profile", metavar="DIR",
+        help="capture a jax.profiler (TensorBoard) trace of the run "
+        "into DIR",
+    )
     sm.add_argument(
         "--tol-frac", type=float, default=0.10,
         help="campaign compare: fractional band tolerance",

@@ -31,7 +31,12 @@ def broadcast_step(
     region: jnp.ndarray,
     key: jax.Array,
     faults=None,
-) -> SimState:
+    telem: bool = False,
+):
+    """``telem=True`` (static, the RoundTrace seam) additionally returns
+    a `telemetry.WireTel` of this round's wire activity — pure
+    reductions over tensors the kernel already materializes, no RNG, so
+    the telem=False path is untouched."""
     n, p = state.have.shape
     f = cfg.fanout
     k_targets, k_drop, k_ring0 = jax.random.split(key, 3)
@@ -98,6 +103,11 @@ def broadcast_step(
     drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
 
     delay_ep = None
+    cut = jnp.int32(0)
+    if telem:
+        from .telemetry import wire_loss_active
+
+        _tel_loss = wire_loss_active(topo, faults)
     if faults is not None:
         # FaultPlan seam (sim/faults.py `fault_wire_effects`, shared
         # verbatim with the packed path): directed cuts, extra per-link
@@ -109,12 +119,23 @@ def broadcast_step(
         # as all-zero tensors, none of the draws.
         from .faults import fault_wire_effects
 
+        ok_pre = ok
         ok, drop, delay, delay_ep = fault_wire_effects(
             faults, key, src, dst, p, ok, drop, delay
         )
+        if telem:
+            # the only thing fault_wire_effects masks out of ``ok`` is
+            # the directed-cut class, so this IS the cut-edge count
+            cut = jnp.sum(ok_pre & ~ok, dtype=jnp.int32)
     payload = state.have.dtype
     # `sending[src]` is a regular f-fold repeat (src = repeat(arange, f))
     # — a broadcast, not a 100M-cell random gather at the gapstress shape
+    if telem and _tel_loss:
+        # pin ONE materialization of the loss mask: the telemetry drop
+        # count consumes it too, and without the barrier XLA duplicates
+        # the whole drop expression (threefry included) into that
+        # second consumer
+        drop = jax.lax.optimization_barrier(drop)
     sent = jnp.where(
         ok.reshape(n, f, 1) & ~drop.reshape(n, f, p),
         sending[:, None, :],
@@ -156,7 +177,60 @@ def broadcast_step(
     spent = sending & any_attempt[:, None]
     relay_left = state.relay_left - spent.astype(state.relay_left.dtype)
 
-    return state._replace(inflight=inflight, relay_left=relay_left)
+    state = state._replace(inflight=inflight, relay_left=relay_left)
+    if not telem:
+        return state
+    # wire telemetry off the hot path: transmitted frames/bytes fold
+    # per-NODE sending stats (one [N, P] pass) over the [E]-shaped edge
+    # mask — no extra [E, P] traversal; the drop count packs the loss
+    # mask to words and popcounts, and only when a loss class exists at
+    # trace time.  The packed kernel computes the SAME quantities from
+    # identical-valued tensors with identical reduction shapes, so the
+    # two paths' channels agree bit-for-bit (test_telemetry pins it).
+    from .telemetry import WireTel
+
+    send_frames = jnp.sum(sending, axis=-1, dtype=jnp.int32)  # [N]
+    # exact i32 per-node byte totals — the identical integers the packed
+    # twin computes on words, so the f32 fold below matches bit-for-bit
+    send_bytes = jnp.sum(
+        jnp.where(sending, meta.nbytes[None, :], 0), axis=-1,
+        dtype=jnp.int32,
+    )  # [N]
+    okf = ok.reshape(n, f)
+    frames = jnp.sum(
+        jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32
+    )
+    dropped = jnp.int32(0)
+    if _tel_loss:
+        if p % 32 == 0:
+            # word-domain count of loss hits on eligible live frames —
+            # the packed kernel's formula on identical values
+            from .packed import pack_bits
+
+            w = p // 32
+            hit = pack_bits(drop).reshape(n, f, w) & pack_bits(sending)[
+                :, None, :
+            ] & jnp.where(
+                okf[:, :, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+            )
+            dropped = jnp.sum(
+                jax.lax.population_count(hit), dtype=jnp.int32
+            )
+        else:  # outside the word envelope: small P, plain reduce
+            dropped = jnp.sum(
+                ok.reshape(n, f, 1) & drop.reshape(n, f, p)
+                & sending[:, None, :],
+                dtype=jnp.int32,
+            )
+    tel = WireTel(
+        frames=frames,
+        bytes=jnp.sum(
+            jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
+        ),
+        dropped=dropped,
+        cut=cut,
+    )
+    return state, tel
 
 
 def deliver_step(state: SimState, cfg: SimConfig) -> SimState:

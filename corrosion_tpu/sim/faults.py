@@ -639,7 +639,9 @@ def _all_have(state: SimState, meta: PayloadMeta, cfg: SimConfig) -> jnp.ndarray
     return jnp.all(meta.round <= state.t) & jnp.all(node_done)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "topo", "max_rounds"))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "topo", "max_rounds", "telemetry")
+)
 def run_fault_plan(
     state: SimState,
     meta: PayloadMeta,
@@ -647,7 +649,8 @@ def run_fault_plan(
     topo: Topology,
     fplan,
     max_rounds: int = 1000,
-) -> Tuple[SimState, RunMetrics]:
+    telemetry: bool = False,
+):
     """Advance rounds under the fault schedule until the cluster holds
     every payload AND the schedule is exhausted (a plan may crash a node
     after convergence — early exit would miss the rejoin), or
@@ -655,19 +658,43 @@ def run_fault_plan(
     the loop runs on the u32-packed carry — the fault seam rides the
     packed kernels since ISSUE 4, bit-identical to the dense path
     (tests/sim/test_packed_equivalence.py); cfg/topo are static, so the
-    dispatch is a trace-time branch and one path compiles."""
+    dispatch is a trace-time branch and one path compiles.
+
+    ``telemetry=True`` (static) threads a `telemetry.RoundTrace` through
+    the loop — including the fault-seam crash/wipe channels — and
+    returns (state, metrics, trace); False compiles to exactly the
+    pre-telemetry program."""
     from .packed import packed_supported, run_packed_faults
 
     if packed_supported(cfg, topo):
-        return run_packed_faults(state, meta, cfg, topo, fplan, max_rounds)
+        return run_packed_faults(
+            state, meta, cfg, topo, fplan, max_rounds, telemetry
+        )
     region = regions(cfg.n_nodes, topo.n_regions)
     metrics = new_metrics(cfg)
     horizon = fplan.alive.shape[0] - 1  # static
 
     def cond(carry):
-        state, metrics = carry
+        state = carry[0]
         done = (state.t >= horizon) & _all_have(state, meta, cfg)
         return (state.t < max_rounds) & ~done
+
+    if telemetry:
+        from .telemetry import new_trace, record_node_faults
+
+        def body(carry):
+            state, metrics, trace = carry
+            rf = round_faults(fplan, state.t)
+            trace = record_node_faults(trace, state.t, rf)
+            state = apply_node_faults(state, rf)
+            return round_step(
+                state, metrics, meta, cfg, topo, region, faults=rf,
+                trace=trace,
+            )
+
+        return jax.lax.while_loop(
+            cond, body, (state, metrics, new_trace(cfg, max_rounds))
+        )
 
     def body(carry):
         state, metrics = carry

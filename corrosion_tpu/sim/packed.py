@@ -67,13 +67,18 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .state import ALIVE, PayloadMeta, SimConfig, SimState
 from .swim import sample_member_targets
 from .topology import Topology, edge_alive, edge_delay, edge_payload_drop
 
 U32 = jnp.uint32
-ONES = jnp.uint32(0xFFFFFFFF)
+# a NUMPY scalar on purpose: a module-level jnp constant would be
+# created inside whichever trace first imports this module (the round
+# kernels import packed lazily) and leak as a tracer into every later
+# jit; np.uint32 converts per-use and cannot leak
+ONES = np.uint32(0xFFFFFFFF)
 
 
 def packed_supported(cfg: SimConfig, topo: Topology) -> bool:
@@ -364,7 +369,8 @@ def broadcast_packed(
     key: jax.Array,
     meta: PayloadMeta,
     faults=None,
-) -> PackedCarry:
+    telem: bool = False,
+):
     n = cfg.n_nodes
     f = cfg.fanout
     k_targets, k_drop, k_ring0 = jax.random.split(key, 3)
@@ -420,6 +426,11 @@ def broadcast_packed(
     p = cfg.n_payloads
     drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
     delay_ep = None
+    cut = jnp.int32(0)
+    if telem:
+        from .telemetry import wire_loss_active
+
+        _tel_loss = wire_loss_active(topo, faults)
     if faults is not None:
         # FaultPlan seam, word-path edition (ISSUE 4): the ONE shared
         # implementation (`faults.fault_wire_effects`) — same keys, same
@@ -431,9 +442,19 @@ def broadcast_packed(
         # per-element ring scatter.
         from .faults import fault_wire_effects
 
+        ok_pre = ok
         ok, drop, delay, delay_ep = fault_wire_effects(
             faults, key, src, dst, p, ok, drop, delay
         )
+        if telem:
+            # cuts are the only ok-mask fault_wire_effects applies
+            cut = jnp.sum(ok_pre & ~ok, dtype=jnp.int32)
+    if telem and _tel_loss:
+        # pin ONE materialization of the loss mask: the telemetry drop
+        # count below consumes it too, and without the barrier XLA
+        # duplicates the whole drop expression (threefry included) into
+        # that second consumer
+        drop = jax.lax.optimization_barrier(drop)
     elig8 = unpack_bits(sending, p).astype(carry.inflight.dtype)  # [N, P]
     sent = jnp.where(
         ok.reshape(n, f, 1) & ~drop.reshape(n, f, p),
@@ -471,8 +492,42 @@ def broadcast_packed(
     any_attempt = attempted.any(axis=1) & (state.alive == ALIVE)  # [N]
     spent = sending & jnp.where(any_attempt[:, None], ONES, U32(0))
     relay = planes_dec(carry.relay, spent)
-    return PackedCarry(have=carry.have, inflight=inflight, relay=relay,
-                       sync_buf=carry.sync_buf)
+    out = PackedCarry(have=carry.have, inflight=inflight, relay=relay,
+                      sync_buf=carry.sync_buf)
+    if not telem:
+        return out
+    # wire telemetry — same quantities as broadcast_step's telem branch
+    # from identical-valued tensors (elig8 == the dense `sending`):
+    # per-node frame counts ride a word popcount, per-node bytes exact
+    # i32 word totals, and the drop count packs the (barrier-pinned)
+    # loss mask to words + popcounts, emitted only when a loss class
+    # exists at trace time — bit-equal traces, none of the hot-path cost
+    from .telemetry import WireTel, word_byte_totals
+
+    send_frames = jnp.sum(
+        jax.lax.population_count(sending), axis=-1, dtype=jnp.int32
+    )  # [N]
+    send_bytes = word_byte_totals(sending, meta.nbytes)  # i32[N], exact
+    okf = ok.reshape(n, f)
+    frames = jnp.sum(
+        jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32
+    )
+    dropped = jnp.int32(0)
+    if _tel_loss:
+        dw = pack_bits(drop).reshape(n, f, sending.shape[-1])
+        hit = dw & sending[:, None, :] & jnp.where(
+            okf[:, :, None], ONES, U32(0)
+        )
+        dropped = jnp.sum(jax.lax.population_count(hit), dtype=jnp.int32)
+    tel = WireTel(
+        frames=frames,
+        bytes=jnp.sum(
+            jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
+        ),
+        dropped=dropped,
+        cut=cut,
+    )
+    return out, tel
 
 
 def _fold_or_regular(words: jnp.ndarray, n: int, per: int) -> jnp.ndarray:
@@ -537,13 +592,19 @@ def packed_round_step(
     topo: Topology,
     region: jnp.ndarray,
     faults=None,
+    trace=None,
 ):
     """One gossip tick on packed words — phase-for-phase and PRNG-stream
     identical to `round.round_step` (inject → broadcast → sync → deliver →
     SWIM → bookkeeping refresh → convergence record), including the
     FaultPlan seam (``faults`` is a RoundFaults/FactoredRoundFaults
     slice, same draws and keys as the dense kernels); tests/sim/
-    test_packed_equivalence.py holds the two bit-for-bit equal."""
+    test_packed_equivalence.py holds the two bit-for-bit equal.
+
+    ``trace`` (a `telemetry.RoundTrace`, or None) mirrors the dense
+    round's flight-recorder seam: same channels, same values (integer
+    counts of the same sets; byte channels fold identically-shaped
+    per-edge totals), appended to the return when given."""
     from .gaps import extract_gaps
     from .round import RunMetrics
     from .state import version_heads
@@ -551,18 +612,31 @@ def packed_round_step(
     key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
     state = state._replace(key=key)
 
+    have0_w = carry.have  # pre-round holdings (delivered-count base)
     carry, injected_p = inject_packed(
         carry, injected_p, state.t, meta, cfg, state.alive
     )
-    carry = broadcast_packed(
-        carry, injected_p, state, cfg, topo, region, k_bcast, meta, faults
-    )
+    if trace is None:
+        carry = broadcast_packed(
+            carry, injected_p, state, cfg, topo, region, k_bcast, meta,
+            faults,
+        )
+    else:
+        carry, wire = broadcast_packed(
+            carry, injected_p, state, cfg, topo, region, k_bcast, meta,
+            faults, telem=True,
+        )
     # sync writes ring slots t+1.., deliver pops slot t: no ordering
     # hazard (round.round_step's contract; compile_plan validated
     # 1 + fault delay < n_delay_slots)
-    carry, countdown, backoff = sync_packed(
-        carry, state, cfg, topo, k_sync, meta, faults
-    )
+    if trace is None:
+        carry, countdown, backoff = sync_packed(
+            carry, state, cfg, topo, k_sync, meta, faults
+        )
+    else:
+        carry, countdown, backoff, stel = sync_packed(
+            carry, state, cfg, topo, k_sync, meta, faults, telem=True
+        )
     state = state._replace(sync_countdown=countdown, sync_backoff=backoff)
     carry = deliver_packed(carry, state.t, cfg)
 
@@ -612,12 +686,38 @@ def packed_round_step(
         metrics.converged_at,
     )
 
-    state = state._replace(t=state.t + 1)
-    return state, carry, injected_p, RunMetrics(
+    out_metrics = RunMetrics(
         coverage_at=coverage_at,
         converged_at=converged_at,
         overflow_frac=overflow_frac,
     )
+    if trace is not None:
+        from .telemetry import (
+            record_round,
+            swim_belief_counts,
+            word_coverage_delivered,
+        )
+
+        susp, dn = swim_belief_counts(state, cfg)
+        coverage, delivered = word_coverage_delivered(
+            carry.have, have0_w, up, cfg.n_payloads
+        )
+        trace = record_round(
+            trace,
+            state.t,
+            coverage=coverage,
+            delivered=delivered,
+            up_nodes=jnp.sum(up, dtype=jnp.int32),
+            wire=wire,
+            sync=stel,
+            swim_suspect=susp,
+            swim_down=dn,
+            gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
+        )
+    state = state._replace(t=state.t + 1)
+    if trace is not None:
+        return state, carry, injected_p, out_metrics, trace
+    return state, carry, injected_p, out_metrics
 
 
 def run_packed(
@@ -626,12 +726,13 @@ def run_packed(
     cfg: SimConfig,
     topo: Topology,
     max_rounds: int,
+    telemetry: bool = False,
 ):
     """Packed-carry `run_to_convergence` body: pack once, loop on u32
     words, unpack once at the end.  Returns the same (SimState,
-    RunMetrics) as the dense loop — bit-identical over the supported
-    envelope.  Called from round.run_to_convergence under jit when
-    `packed_supported(cfg, topo)`; not jitted itself."""
+    RunMetrics[, RoundTrace]) as the dense loop — bit-identical over the
+    supported envelope.  Called from round.run_to_convergence under jit
+    when `packed_supported(cfg, topo)`; not jitted itself."""
     from .round import new_metrics
     from .topology import regions
 
@@ -642,24 +743,43 @@ def run_packed(
     slim = shrink_state(state)
 
     def cond(c):
-        s, _carry, _inj, m = c
+        s, m = c[0], c[3]
         all_injected = jnp.all(meta.round <= s.t)
         done = all_injected & jnp.all(
             (m.converged_at >= 0) | (s.alive != ALIVE)
         )
         return (s.t < max_rounds) & ~done
 
-    def body(c):
-        s, carry, inj, m = c
-        return packed_round_step(s, carry, inj, m, meta, cfg, topo, region)
+    if telemetry:
+        from .telemetry import new_trace
 
-    slim, carry, inj, metrics = jax.lax.while_loop(
-        cond, body, (slim, carry0, injected0, metrics)
-    )
+        def body(c):
+            s, carry, inj, m, trace = c
+            return packed_round_step(
+                s, carry, inj, m, meta, cfg, topo, region, trace=trace
+            )
+
+        slim, carry, inj, metrics, trace = jax.lax.while_loop(
+            cond, body,
+            (slim, carry0, injected0, metrics, new_trace(cfg, max_rounds)),
+        )
+    else:
+
+        def body(c):
+            s, carry, inj, m = c
+            return packed_round_step(
+                s, carry, inj, m, meta, cfg, topo, region
+            )
+
+        slim, carry, inj, metrics = jax.lax.while_loop(
+            cond, body, (slim, carry0, injected0, metrics)
+        )
     full = unpack_into_state(carry, slim, cfg)
     full = full._replace(
         injected=unpack_bits(inj, cfg.n_payloads).astype(full.have.dtype)
     )
+    if telemetry:
+        return full, metrics, trace
     return full, metrics
 
 
@@ -711,6 +831,7 @@ def run_packed_faults(
     topo: Topology,
     fplan,
     max_rounds: int,
+    telemetry: bool = False,
 ):
     """Packed-carry `run_fault_plan` body: the fault schedule drives the
     u32-word round loop — pack once, apply each round's node faults to
@@ -731,26 +852,48 @@ def run_packed_faults(
     horizon = fplan.alive.shape[0] - 1  # static
 
     def cond(c):
-        s, carry, inj, m = c
+        s, carry, inj = c[0], c[1], c[2]
         done = (s.t >= horizon) & all_have_words(carry, inj, s, meta, cfg)
         return (s.t < max_rounds) & ~done
 
-    def body(c):
-        s, carry, inj, m = c
-        rf = round_faults(fplan, s.t)
-        s = apply_node_faults(s, rf)
-        carry = apply_carry_faults(carry, rf)
-        return packed_round_step(
-            s, carry, inj, m, meta, cfg, topo, region, faults=rf
-        )
+    if telemetry:
+        from .telemetry import new_trace, record_node_faults
 
-    slim, carry, inj, metrics = jax.lax.while_loop(
-        cond, body, (slim, carry0, injected0, metrics)
-    )
+        def body(c):
+            s, carry, inj, m, trace = c
+            rf = round_faults(fplan, s.t)
+            trace = record_node_faults(trace, s.t, rf)
+            s = apply_node_faults(s, rf)
+            carry = apply_carry_faults(carry, rf)
+            return packed_round_step(
+                s, carry, inj, m, meta, cfg, topo, region, faults=rf,
+                trace=trace,
+            )
+
+        slim, carry, inj, metrics, trace = jax.lax.while_loop(
+            cond, body,
+            (slim, carry0, injected0, metrics, new_trace(cfg, max_rounds)),
+        )
+    else:
+
+        def body(c):
+            s, carry, inj, m = c
+            rf = round_faults(fplan, s.t)
+            s = apply_node_faults(s, rf)
+            carry = apply_carry_faults(carry, rf)
+            return packed_round_step(
+                s, carry, inj, m, meta, cfg, topo, region, faults=rf
+            )
+
+        slim, carry, inj, metrics = jax.lax.while_loop(
+            cond, body, (slim, carry0, injected0, metrics)
+        )
     full = unpack_into_state(carry, slim, cfg)
     full = full._replace(
         injected=unpack_bits(inj, cfg.n_payloads).astype(full.have.dtype)
     )
+    if telemetry:
+        return full, metrics, trace
     return full, metrics
 
 
@@ -781,7 +924,8 @@ def sync_packed(
     key: jax.Array,
     meta: PayloadMeta,
     faults=None,
-) -> Tuple[PackedCarry, jnp.ndarray, jnp.ndarray]:
+    telem: bool = False,
+):
     """Anti-entropy on packed words: needs computed from the SAME
     advertised gap/head tensors as the dense path (state.heads/gap_lo/
     gap_hi), but factored into per-NODE group-uniform word masks first —
@@ -806,6 +950,7 @@ def sync_packed(
     ok &= edge_alive(state.group, state.alive, src, dst)
     ok &= due[src]
     ok &= dst != src
+    refused_cnt = jnp.int32(0)
     if faults is not None:
         # sync is a bidirectional stream: a cut in EITHER direction
         # refuses the session (the shared `fault_session_refused`, same
@@ -815,6 +960,8 @@ def sync_packed(
 
         refused = fault_session_refused(faults, src, dst)
         if refused is not None:
+            if telem:
+                refused_cnt = jnp.sum(ok & refused, dtype=jnp.int32)
             ok &= ~refused
 
     v = cfg.n_versions
@@ -857,6 +1004,11 @@ def sync_packed(
     # per-sync byte budget, oldest-version-first (sync_step's
     # budget_prefix_mask) — evaluated per edge row in the word domain
     granted = budget_prefix_words(need, cfg.sync_budget_bytes, meta.nbytes)
+    if telem:
+        # pin ONE materialization: the telemetry grant counts below
+        # consume `granted` too, and without a source-level barrier XLA
+        # would recompute the whole need/budget pipeline into them
+        granted = jax.lax.optimization_barrier(granted)
 
     # pulls land at the PULLER (src): exactly S edges per source in a
     # regular layout, so the OR-reduce is a packed fold — no scatter.
@@ -907,9 +1059,28 @@ def sync_packed(
     )
     rearm = jax.random.randint(k_rearm, (n,), 1, backoff + 1, jnp.int32)
     countdown = jnp.where(due, rearm, state.sync_countdown - 1)
-    return (
+    out = (
         PackedCarry(have=carry.have, inflight=carry.inflight,
                     relay=carry.relay, sync_buf=sync_buf),
         countdown,
         backoff,
     )
+    if not telem:
+        return out
+    # session telemetry in the word domain: per-PAYLOAD grant counts via
+    # 32 shifted reductions over the [E, W] words (`word_bit_counts`) —
+    # the exact integers the dense kernel sums over its [E, P] bools —
+    # then the identical [P]-shaped f32 dot, so both paths' channels
+    # match bit-for-bit
+    from .telemetry import SyncTel, word_bit_counts
+
+    counts = word_bit_counts(granted, cfg.n_payloads)  # i32[P]
+    tel = SyncTel(
+        sessions=jnp.sum(ok, dtype=jnp.int32),
+        refused=refused_cnt,
+        frames=jnp.sum(counts, dtype=jnp.int32),
+        bytes=jnp.dot(
+            counts.astype(jnp.float32), meta.nbytes.astype(jnp.float32)
+        ),
+    )
+    return out + (tel,)

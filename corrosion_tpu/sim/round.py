@@ -76,25 +76,46 @@ def round_step(
     topo: Topology,
     region: jnp.ndarray,
     faults=None,
-) -> Tuple[SimState, RunMetrics]:
+    trace=None,
+):
     """``faults`` (a `sim.faults.RoundFaults` slice, or None) threads
     the FaultPlan seam through every phase: directed edge cuts, extra
     per-link loss, delay/jitter on the fire-and-forget paths, and SWIM
     probe reachability.  The None path is byte-identical to the
     pre-fault kernels — fault keys are `fold_in`-derived inside the
     ``faults is not None`` trace branch, never split from the phase
-    keys, so existing seeded runs replay unchanged."""
+    keys, so existing seeded runs replay unchanged.
+
+    ``trace`` (a `sim.telemetry.RoundTrace`, or None) is the flight-
+    recorder seam: when given, the phases report wire telemetry, row
+    ``state.t`` is written via indexed updates, and the return grows to
+    (state, metrics, trace).  Telemetry consumes NO RNG and feeds
+    nothing back into the round, so the trace=None path compiles to
+    exactly the pre-telemetry kernel."""
     validate(cfg, topo)
     key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
     state = state._replace(key=key)
 
+    have0 = state.have  # pre-round holdings (the delivered-count base)
     state = inject_step(state, meta, cfg)
-    state = broadcast_step(state, meta, cfg, topo, region, k_bcast, faults)
+    if trace is None:
+        state = broadcast_step(
+            state, meta, cfg, topo, region, k_bcast, faults
+        )
+    else:
+        state, wire = broadcast_step(
+            state, meta, cfg, topo, region, k_bcast, faults, telem=True
+        )
     # sync pulls granted in round t land in ring slot t+1+fault_delay
     # (≠ slot t: compile_plan/validate guarantee 1+delay < n_delay_slots),
     # so deliver_step can pop slot t AFTER sync_step without ordering
     # hazards — the bi-stream RTT plus any FaultPlan latency
-    state = sync_step(state, meta, cfg, topo, k_sync, faults)
+    if trace is None:
+        state = sync_step(state, meta, cfg, topo, k_sync, faults)
+    else:
+        state, stel = sync_step(
+            state, meta, cfg, topo, k_sync, faults, telem=True
+        )
     state = deliver_step(state, cfg)
     state = swim_step(state, cfg, topo, k_swim, faults)
 
@@ -131,22 +152,71 @@ def round_step(
         metrics.converged_at,
     )
 
-    state = state._replace(t=state.t + 1)
-    return state, RunMetrics(
+    out_metrics = RunMetrics(
         coverage_at=coverage_at,
         converged_at=converged_at,
         overflow_frac=overflow_frac,
     )
+    if trace is not None:
+        from .telemetry import (
+            record_round,
+            swim_belief_counts,
+            word_coverage_delivered,
+        )
+
+        if cfg.n_payloads % 32 == 0:
+            # word-domain counters (pack once, 32 shifted reductions):
+            # ~10× cheaper than the bool pass, and the exact integers
+            # the packed round computes on its native words
+            from .packed import pack_bits
+
+            coverage, delivered = word_coverage_delivered(
+                pack_bits(state.have),
+                pack_bits(have0),
+                up,
+                cfg.n_payloads,
+            )
+        else:
+            # P outside the word envelope (e.g. membership configs'
+            # single payload) — small by construction, the bool pass
+            # is fine and the packed path can't run here anyway
+            held = state.have > 0
+            coverage = jnp.sum(
+                held & up[:, None], axis=0, dtype=jnp.int32
+            )
+            delivered = jnp.sum(
+                held & ~(have0 > 0), axis=0, dtype=jnp.int32
+            )
+        susp, dn = swim_belief_counts(state, cfg)
+        trace = record_round(
+            trace,
+            state.t,
+            coverage=coverage,
+            delivered=delivered,
+            up_nodes=jnp.sum(up, dtype=jnp.int32),
+            wire=wire,
+            sync=stel,
+            swim_suspect=susp,
+            swim_down=dn,
+            gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
+        )
+    state = state._replace(t=state.t + 1)
+    if trace is not None:
+        return state, out_metrics, trace
+    return state, out_metrics
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "topo", "max_rounds"))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "topo", "max_rounds", "telemetry")
+)
 def run_to_convergence(
     state: SimState,
     meta: PayloadMeta,
     cfg: SimConfig,
     topo: Topology,
     max_rounds: int = 1000,
-) -> Tuple[SimState, RunMetrics]:
+    telemetry: bool = False,
+):
     """Advance rounds until every up node holds every payload (the
     check_bookkeeping.py property: need == 0 ∧ equal heads) or max_rounds.
 
@@ -156,22 +226,39 @@ def run_to_convergence(
     traffic on the hot carries, bit-identical results
     (tests/sim/test_packed_equivalence.py).  cfg/topo are static args,
     so the dispatch is a trace-time Python branch — one path compiles.
+
+    ``telemetry=True`` (static) threads a `telemetry.RoundTrace` through
+    the loop carry and returns (state, metrics, trace); False compiles
+    to exactly the pre-telemetry program.
     """
     from .packed import packed_supported, run_packed
 
     validate(cfg, topo)
     if packed_supported(cfg, topo):
-        return run_packed(state, meta, cfg, topo, max_rounds)
+        return run_packed(state, meta, cfg, topo, max_rounds, telemetry)
     region = regions(cfg.n_nodes, topo.n_regions)
     metrics = new_metrics(cfg)
 
     def cond(carry):
-        state, metrics = carry
+        state, metrics = carry[0], carry[1]
         all_injected = jnp.all(meta.round <= state.t)
         done = all_injected & jnp.all(
             (metrics.converged_at >= 0) | (state.alive != ALIVE)
         )
         return (state.t < max_rounds) & ~done
+
+    if telemetry:
+        from .telemetry import new_trace
+
+        def body(carry):
+            state, metrics, trace = carry
+            return round_step(
+                state, metrics, meta, cfg, topo, region, trace=trace
+            )
+
+        return jax.lax.while_loop(
+            cond, body, (state, metrics, new_trace(cfg, max_rounds))
+        )
 
     def body(carry):
         state, metrics = carry

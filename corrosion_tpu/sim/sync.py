@@ -125,7 +125,11 @@ def sync_step(
     topo: Topology,
     key: jax.Array,
     faults=None,
-) -> SimState:
+    telem: bool = False,
+):
+    """``telem=True`` (static, the RoundTrace seam) additionally returns
+    a `telemetry.SyncTel` of this round's session/grant activity — pure
+    reductions, no RNG, telem=False untouched."""
     n, p = state.have.shape
     s = cfg.sync_peers
     k_peers, k_drop, k_rearm = jax.random.split(key, 3)
@@ -147,6 +151,7 @@ def sync_step(
     # paths (LinkModel marks bi streams reliable on the host tier too)
     ok &= due[src]
     ok &= dst != src
+    refused_cnt = jnp.int32(0)
     if faults is not None:
         # a sync session is a BIDIRECTIONAL stream: an asymmetric cut in
         # either direction refuses the session here, while one-way
@@ -166,6 +171,8 @@ def sync_step(
 
         refused = fault_session_refused(faults, src, dst)
         if refused is not None:
+            if telem:
+                refused_cnt = jnp.sum(ok & refused, dtype=jnp.int32)
             ok &= ~refused
 
     need = edge_needs(state, cfg, src, dst, regular_fanout=s) & ok[:, None]  # [E, P]
@@ -174,6 +181,13 @@ def sync_step(
     # CONSTRUCTION (uniform_payloads), so index order is already global
     # (version, actor) request order — no per-round permutation needed
     granted = budget_prefix_mask(need, cfg.sync_budget_bytes, meta.nbytes)
+    if telem:
+        # pin ONE materialization (the packed twin does the same): the
+        # telemetry grant counts below add a reduce consumer to
+        # `granted`, and without a source-level barrier XLA can
+        # duplicate the need/budget pipeline into it (measured
+        # cost-neutral at small dense shapes, load-bearing at scale)
+        granted = jax.lax.optimization_barrier(granted)
 
     # pulls land in the sync delay ring at slot t+1+fault_delay (the
     # bi-stream round trip, stretched by any FaultPlan latency) — a ring
@@ -228,8 +242,26 @@ def sync_step(
     rearm = jax.random.randint(k_rearm, (n,), 1, backoff + 1, jnp.int32)
     countdown = jnp.where(due, rearm, state.sync_countdown - 1)
 
-    return state._replace(
+    state = state._replace(
         sync_inflight=sync_inflight,
         sync_countdown=countdown,
         sync_backoff=backoff,
     )
+    if not telem:
+        return state
+    # session telemetry: per-PAYLOAD grant counts are exact i32 (≤ E per
+    # payload), then one [P]-shaped f32 dot against the size vector —
+    # the identical fold the packed kernel performs on its word counts,
+    # so both paths' sync channels agree bit-for-bit
+    from .telemetry import SyncTel
+
+    counts = jnp.sum(granted, axis=0, dtype=jnp.int32)  # [P]
+    tel = SyncTel(
+        sessions=jnp.sum(ok, dtype=jnp.int32),
+        refused=refused_cnt,
+        frames=jnp.sum(counts, dtype=jnp.int32),
+        bytes=jnp.dot(
+            counts.astype(jnp.float32), meta.nbytes.astype(jnp.float32)
+        ),
+    )
+    return state, tel

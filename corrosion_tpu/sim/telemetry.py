@@ -1,0 +1,603 @@
+"""Flight recorder: in-kernel per-round telemetry for the simulator.
+
+The jitted round loop used to be a black box — a run reported only
+terminal scalars (``converged_at`` / ``coverage_at``), so a bad
+convergence run was undebuggable without rerunning and ROADMAP's richer
+band metrics (coverage-latency percentiles, detect-round bands) had no
+data to stand on.  `RoundTrace` fixes that with **preallocated
+[R_max, ·] device buffers written inside the loop via indexed updates**:
+
+- ``coverage[R, P] i32``  — up nodes holding each payload at round end;
+- ``delivered[R, P] i32`` — (node, payload) bits newly held this round
+  (inject + broadcast + sync deliveries);
+- ``up_nodes[R] i32``     — denominator for coverage fractions;
+- broadcast wire: ``bcast_bytes f32`` / ``bcast_frames`` /
+  ``bcast_dropped`` (frames eaten by wire loss, topology + fault) /
+  ``bcast_cut`` (edges severed by FaultPlan cuts this round);
+- sync wire: ``sync_bytes f32`` / ``sync_frames`` / ``sync_sessions``
+  (due sessions established) / ``sync_refused`` (sessions killed by a
+  cut in either direction);
+- fault seam: ``crashes`` (nodes held down by the schedule) /
+  ``wipes`` (state wipes fired) — written by `record_node_faults` from
+  the run loop, where the RoundFaults slice lives;
+- SWIM: ``swim_suspect`` / ``swim_down`` belief totals (both tiers);
+- ``gap_overflow`` — (node, actor) pairs in the K-slot clamp.
+
+Contract (pinned by tests/sim/test_telemetry.py):
+
+- **zero host syncs per round** — buffers live on device, read once
+  after the run;
+- **compiled out entirely when ``telemetry=False``** — the flag is a
+  static jit arg, telemetry draws no RNG and feeds nothing back, so
+  off-runs are byte-identical to pre-telemetry builds;
+- **identical on the dense and packed kernels** — integer channels are
+  exact counts of the same sets; the two float byte channels reduce
+  identically-shaped per-edge i32 totals, so dense-vs-packed traces are
+  bit-equal under the same FaultPlan;
+- **vmap-safe** — the trace is allocated inside the jitted run, so an
+  ensemble lane's trace slice equals its solo run's trace.
+
+Host-side exports: `trace_summary` (deterministic dict for artifacts),
+`write_flight_jsonl` (the flight-recorder artifact, one row per round),
+and `trace_to_registry` (sim_* Prometheus families on the process
+`metrics.Registry`, scraped by `MetricsServer`).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .state import ALIVE, DOWN, SUSPECT, PayloadMeta, SimConfig, SimState
+from .topology import Topology, regions
+
+# The telemetry kernels pin shared intermediates with
+# lax.optimization_barrier (one materialization instead of XLA
+# duplicating a producer pipeline into each telemetry consumer), and the
+# campaign engine vmaps those kernels over ensemble lanes — but this JAX
+# version ships no batching rule for the primitive.  The barrier is
+# elementwise in the batch dimension, so the rule is the identity map.
+from jax.interpreters import batching as _batching  # noqa: E402
+
+_ob_p = getattr(jax.lax, "optimization_barrier_p", None)
+if _ob_p is None:  # pragma: no cover - layout varies across jax versions
+    try:
+        from jax._src.lax import lax as _lax_internal
+
+        _ob_p = getattr(_lax_internal, "optimization_barrier_p", None)
+    except ImportError:
+        _ob_p = None
+if _ob_p is not None and _ob_p not in _batching.primitive_batchers:
+
+    def _optimization_barrier_batcher(args, dims):
+        return _ob_p.bind(*args), dims
+
+    _batching.primitive_batchers[_ob_p] = _optimization_barrier_batcher
+
+
+class WireTel(NamedTuple):
+    """One round's broadcast-wire telemetry (device scalars).
+
+    ``frames``/``bytes`` count what was TRANSMITTED on live edges — the
+    wire carried lost frames too; ``dropped`` says how many of them the
+    loss processes (topology + FaultPlan) then ate.  This framing is
+    also what keeps telemetry off the hot path: transmitted totals fold
+    per-NODE sending stats over the edge list (no [E, P] traversal);
+    only the drop count needs the per-(edge, payload) mask, and only
+    when a loss class is active at trace time (`wire_loss_active`)."""
+
+    frames: jnp.ndarray   # i32 payload frames transmitted on live edges
+    bytes: jnp.ndarray    # f32 bytes transmitted
+    dropped: jnp.ndarray  # i32 frames eaten by wire loss (topology+fault)
+    cut: jnp.ndarray      # i32 edges severed by FaultPlan cuts
+
+
+class SyncTel(NamedTuple):
+    """One round's sync-session telemetry (device scalars)."""
+
+    sessions: jnp.ndarray  # i32 due sessions established
+    refused: jnp.ndarray   # i32 sessions refused by fault cuts
+    frames: jnp.ndarray    # i32 chunk frames granted
+    bytes: jnp.ndarray     # f32 bytes granted
+
+
+class RoundTrace(NamedTuple):
+    """Preallocated per-round telemetry buffers (device; see module doc)."""
+
+    coverage: jnp.ndarray       # i32[R, P]
+    delivered: jnp.ndarray      # i32[R, P]
+    up_nodes: jnp.ndarray       # i32[R]
+    bcast_bytes: jnp.ndarray    # f32[R]
+    bcast_frames: jnp.ndarray   # i32[R]
+    bcast_dropped: jnp.ndarray  # i32[R]
+    bcast_cut: jnp.ndarray      # i32[R]
+    sync_bytes: jnp.ndarray     # f32[R]
+    sync_frames: jnp.ndarray    # i32[R]
+    sync_sessions: jnp.ndarray  # i32[R]
+    sync_refused: jnp.ndarray   # i32[R]
+    swim_suspect: jnp.ndarray   # i32[R]
+    swim_down: jnp.ndarray      # i32[R]
+    crashes: jnp.ndarray        # i32[R]
+    wipes: jnp.ndarray          # i32[R]
+    gap_overflow: jnp.ndarray   # i32[R]
+
+
+def new_trace(cfg: SimConfig, max_rounds: int) -> RoundTrace:
+    r, p = max_rounds, cfg.n_payloads
+    z = functools.partial(jnp.zeros, dtype=jnp.int32)
+    return RoundTrace(
+        coverage=z((r, p)),
+        delivered=z((r, p)),
+        up_nodes=z((r,)),
+        bcast_bytes=jnp.zeros((r,), jnp.float32),
+        bcast_frames=z((r,)),
+        bcast_dropped=z((r,)),
+        bcast_cut=z((r,)),
+        sync_bytes=jnp.zeros((r,), jnp.float32),
+        sync_frames=z((r,)),
+        sync_sessions=z((r,)),
+        sync_refused=z((r,)),
+        swim_suspect=z((r,)),
+        swim_down=z((r,)),
+        crashes=z((r,)),
+        wipes=z((r,)),
+        gap_overflow=z((r,)),
+    )
+
+
+def swim_belief_counts(state: SimState, cfg: SimConfig):
+    """(suspect, down) belief totals — both SWIM tiers read the slim
+    state's membership fields, which the dense and packed paths share,
+    so the counts are structurally identical across kernels."""
+    if cfg.swim_full_view:
+        return (
+            jnp.sum(state.view == SUSPECT, dtype=jnp.int32),
+            jnp.sum(state.view == DOWN, dtype=jnp.int32),
+        )
+    if cfg.swim_partial_view:
+        valid = state.pid >= 0
+        st = state.pkey & 3  # == pkey % 4 for two's complement i32
+        return (
+            jnp.sum(valid & (st == SUSPECT), dtype=jnp.int32),
+            jnp.sum(valid & (st == DOWN), dtype=jnp.int32),
+        )
+    return jnp.int32(0), jnp.int32(0)
+
+
+def record_round(
+    trace: RoundTrace,
+    t: jnp.ndarray,
+    *,
+    coverage: jnp.ndarray,
+    delivered: jnp.ndarray,
+    up_nodes: jnp.ndarray,
+    wire: WireTel,
+    sync: SyncTel,
+    swim_suspect: jnp.ndarray,
+    swim_down: jnp.ndarray,
+    gap_overflow: jnp.ndarray,
+) -> RoundTrace:
+    """Write row ``t`` (the pre-increment round counter — run loops
+    guarantee t < R_max).  One indexed update per channel, no host
+    sync; `crashes`/`wipes` ride `record_node_faults` instead (the
+    RoundFaults slice lives in the run loop, not the round step)."""
+    return trace._replace(
+        coverage=trace.coverage.at[t].set(coverage),
+        delivered=trace.delivered.at[t].set(delivered),
+        up_nodes=trace.up_nodes.at[t].set(up_nodes),
+        bcast_bytes=trace.bcast_bytes.at[t].set(wire.bytes),
+        bcast_frames=trace.bcast_frames.at[t].set(wire.frames),
+        bcast_dropped=trace.bcast_dropped.at[t].set(wire.dropped),
+        bcast_cut=trace.bcast_cut.at[t].set(wire.cut),
+        sync_bytes=trace.sync_bytes.at[t].set(sync.bytes),
+        sync_frames=trace.sync_frames.at[t].set(sync.frames),
+        sync_sessions=trace.sync_sessions.at[t].set(sync.sessions),
+        sync_refused=trace.sync_refused.at[t].set(sync.refused),
+        swim_suspect=trace.swim_suspect.at[t].set(swim_suspect),
+        swim_down=trace.swim_down.at[t].set(swim_down),
+        gap_overflow=trace.gap_overflow.at[t].set(gap_overflow),
+    )
+
+
+def record_node_faults(trace: RoundTrace, t: jnp.ndarray, rf) -> RoundTrace:
+    """Fault-seam node channels for row ``t``: nodes the schedule holds
+    DOWN this round and wipes fired.  Called from the fault run loops
+    right after `round_faults` slices the plan (same row the round step
+    fills)."""
+    return trace._replace(
+        crashes=trace.crashes.at[t].set(
+            jnp.sum(rf.alive == DOWN, dtype=jnp.int32)
+        ),
+        wipes=trace.wipes.at[t].set(jnp.sum(rf.wipe, dtype=jnp.int32)),
+    )
+
+
+def wire_loss_active(topo, faults) -> bool:
+    """Trace-time fact: can the broadcast wire drop frames in this
+    scenario?  False ⇒ the dropped channel is the constant 0 and the
+    [E, P] drop-mask reduction is never emitted (the one telemetry
+    term that would otherwise cost a full edge×payload traversal)."""
+    if int(round(topo.loss * 256.0)) > 0:
+        return True
+    if faults is None:
+        return False
+    from .faults import RoundFaults
+
+    if isinstance(faults, RoundFaults):
+        return faults.loss is not None
+    return faults.loss_thr.shape[0] > 0
+
+
+def word_bit_counts(words: jnp.ndarray, n_payloads: int) -> jnp.ndarray:
+    """i32[P] per-bit-position set counts over the leading (node) axis
+    of u32 payload words — the per-payload coverage/delivered counters.
+    32 shifted [N, W] reductions instead of an unpack-to-bool pass: same
+    exact integers, ~10× cheaper at storm shape (the bool intermediate
+    was the single hottest telemetry term)."""
+    # NOTE: callers whose ``words`` is a large fused expression must pin
+    # it with lax.optimization_barrier AT THE SOURCE (so every consumer
+    # shares one materialization) — a barrier here would pin a private
+    # copy and duplicate the producer pipeline instead
+    one = jnp.uint32(1)
+    cols = [
+        jnp.sum((words >> jnp.uint32(j)) & one, axis=0, dtype=jnp.int32)
+        for j in range(32)
+    ]
+    return jnp.stack(cols, axis=-1).reshape(n_payloads)  # [W, 32] → [P]
+
+
+def word_coverage_delivered(
+    held_w: jnp.ndarray,
+    held0_w: jnp.ndarray,
+    up: jnp.ndarray,
+    n_payloads: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(coverage, delivered) i32[P] from u32[N, W] payload words at
+    round start (``held0_w``) and end (``held_w``) — the ONE
+    implementation both the dense and packed round kernels record, so
+    the tested dense==packed bit-equality of these channels cannot
+    drift between two copies.  The barrier pins the two masked buffers
+    at the source (one cheap elementwise pass each) so the 32 shifted
+    reductions re-read small L2-resident buffers instead of recomputing
+    the masks per shift."""
+    cov_w, del_w = jax.lax.optimization_barrier((
+        jnp.where(up[:, None], held_w, jnp.uint32(0)),
+        held_w & ~held0_w,
+    ))
+    return (
+        word_bit_counts(cov_w, n_payloads),
+        word_bit_counts(del_w, n_payloads),
+    )
+
+
+def word_byte_totals(words: jnp.ndarray, nbytes: jnp.ndarray) -> jnp.ndarray:
+    """i32[...] masked per-row byte totals of u32 bit-words — the packed
+    twin of ``where(granted, nbytes, 0).sum(-1)``: exact integer totals
+    wherever a row's selected bytes stay under i32 (every current
+    scenario: the payload-size validator caps P·64 KiB well below the
+    exactness envelope the budget kernels already assume), so the packed
+    and dense byte channels agree bit-for-bit before the final f32
+    fold."""
+    w = words.shape[-1]
+    nb = nbytes.astype(jnp.int32).reshape(w, 32)
+    tot = jnp.zeros(words.shape[:-1], jnp.int32)
+    for j in range(32):
+        bit = ((words >> j) & jnp.uint32(1)).astype(jnp.int32)
+        tot = tot + (bit * nb[None, :, j]).sum(axis=-1)
+    return tot
+
+
+# -- the membership-churn driver (runner configs #2/#2b, engine-routed) ------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "topo", "max_rounds", "telemetry")
+)
+def run_membership_detect(
+    state: SimState,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+    topo: Topology,
+    max_rounds: int = 400,
+    telemetry: bool = False,
+):
+    """Membership-churn run: advance rounds until every survivor marks
+    every dead node DOWN (full-view: all watched (up, dead) pairs;
+    partial-view: every live table entry referencing a dead member), or
+    ``max_rounds``.  The detection predicate runs ON DEVICE inside the
+    while_loop — the runner configs #2/#2b loops, lifted here so the
+    campaign engine can vmap seed ensembles over them and band the
+    detect rounds (ROADMAP "detect-round bands for membership
+    scenarios").  Returns (state, metrics, detect_round[, trace])."""
+    from .round import new_metrics, round_step
+
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+    up_mask = state.alive == ALIVE  # static after t=0 (kill pre-applied)
+
+    if cfg.swim_full_view:
+        pair_watched = up_mask[:, None] & ~up_mask[None, :]
+
+        def detected(s):
+            return jnp.all(jnp.where(pair_watched, s.view == DOWN, True))
+
+    elif cfg.swim_partial_view:
+
+        def detected(s):
+            watcher_up = up_mask[:, None]
+            entry_dead = (s.pid >= 0) & ~up_mask[jnp.maximum(s.pid, 0)]
+            marked = s.pkey % 4 == DOWN
+            return jnp.all(jnp.where(watcher_up & entry_dead, marked, True))
+
+    else:
+        raise ValueError(
+            "membership detection needs a SWIM tier "
+            "(swim_full_view or swim_partial_view)"
+        )
+
+    trace = new_trace(cfg, max_rounds) if telemetry else None
+
+    def cond(carry):
+        detect_round = carry[2]
+        return (detect_round < 0) & (carry[0].t < max_rounds)
+
+    def body(carry):
+        if telemetry:
+            state, metrics, detect_round, trace = carry
+            state, metrics, trace = round_step(
+                state, metrics, meta, cfg, topo, region, trace=trace
+            )
+        else:
+            state, metrics, detect_round = carry
+            state, metrics = round_step(
+                state, metrics, meta, cfg, topo, region
+            )
+        detect_round = jnp.where(
+            (detect_round < 0) & detected(state), state.t, detect_round
+        )
+        if telemetry:
+            return state, metrics, detect_round, trace
+        return state, metrics, detect_round
+
+    init = (state, metrics, jnp.int32(-1))
+    if telemetry:
+        init = init + (trace,)
+    return jax.lax.while_loop(cond, body, init)
+
+
+# -- host-side exports -------------------------------------------------------
+
+
+FLIGHT_VERSION = 1
+
+
+def trace_host(trace, rounds: int):
+    """Host copies of every channel, sliced to the executed rounds.
+    Idempotent: a dict from a previous call passes through (re-sliced),
+    so callers that fan a trace out to several consumers — summary,
+    digest, JSONL rows — pay the device-to-host copy exactly once.
+    Every exporter below accepts either a RoundTrace or this dict."""
+    r = int(rounds)
+    if isinstance(trace, dict):
+        return {f: v[:r] for f, v in trace.items()}
+    return {f: np.asarray(getattr(trace, f))[:r] for f in RoundTrace._fields}
+
+
+def coverage_curve_digest(trace, rounds: int) -> str:
+    """Replay identity of the per-round per-payload coverage curve —
+    the compact fingerprint bench/campaign artifacts record so a
+    convergence trajectory (not just its endpoint) is regression-
+    checkable across runs."""
+    r = int(rounds)
+    cov = (
+        trace["coverage"][:r]
+        if isinstance(trace, dict)
+        else np.asarray(trace.coverage)[:r]
+    )
+    cov = np.ascontiguousarray(cov, np.int32)
+    return hashlib.blake2b(cov.tobytes(), digest_size=8).hexdigest()
+
+
+def coverage_latency_rounds(trace, rounds: int) -> np.ndarray:
+    """i32[P] first round each payload reached FULL coverage (held by
+    every up node), -1 if never — computed from the trace alone, so the
+    per-payload coverage-latency percentiles ROADMAP asks for need no
+    extra kernel output."""
+    t = trace_host(trace, rounds)
+    full = (t["coverage"] == t["up_nodes"][:, None]) & (
+        t["up_nodes"][:, None] > 0
+    )  # [R, P]
+    if full.shape[0] == 0:  # zero-round run: argmax chokes on an empty axis
+        return np.full(full.shape[1], -1, np.int32)
+    any_full = full.any(axis=0)
+    first = full.argmax(axis=0)
+    return np.where(any_full, first, -1).astype(np.int32)
+
+
+def trace_summary(trace, rounds: int, cfg: SimConfig) -> dict:
+    """Deterministic per-run summary block (bench records / campaign
+    artifacts): coverage-curve digest, coverage-latency percentiles,
+    bytes/round, fault-seam and SWIM totals.  Every value derives from
+    device-deterministic integers, so a replay reproduces it exactly."""
+    r = int(rounds)
+    t = trace_host(trace, r)
+    lat = coverage_latency_rounds(t, r)
+    covered = lat[lat >= 0]
+
+    def pct(q):
+        if covered.size == 0:
+            return None
+        return float(np.percentile(covered, q, method="lower"))
+
+    bcast = float(t["bcast_bytes"].sum())
+    sync = float(t["sync_bytes"].sum())
+    return {
+        "rounds": r,
+        "coverage_curve_digest": coverage_curve_digest(t, r),
+        "coverage_latency_rounds": {
+            "p50": pct(50), "p95": pct(95), "p99": pct(99),
+            "uncovered_payloads": int((lat < 0).sum()),
+        },
+        "wire_bytes": {
+            "broadcast": round(bcast, 1),
+            "sync": round(sync, 1),
+            "per_round_mean": round((bcast + sync) / max(r, 1), 1),
+        },
+        "wire_frames": {
+            "broadcast": int(t["bcast_frames"].sum()),
+            "sync": int(t["sync_frames"].sum()),
+        },
+        "fault": {
+            "dropped_frames": int(t["bcast_dropped"].sum()),
+            "cut_edges": int(t["bcast_cut"].sum()),
+            "refused_sessions": int(t["sync_refused"].sum()),
+            "crash_node_rounds": int(t["crashes"].sum()),
+            "wipes": int(t["wipes"].sum()),
+        },
+        "sync_sessions": int(t["sync_sessions"].sum()),
+        "swim": {
+            "peak_suspect": int(t["swim_suspect"].max(initial=0)),
+            "peak_down": int(t["swim_down"].max(initial=0)),
+        },
+        "gap_overflow_rounds": int((t["gap_overflow"] > 0).sum()),
+    }
+
+
+def trace_rows(trace, rounds: int, cfg: SimConfig, per_payload: bool = None):
+    """Per-round dict rows for the flight-recorder JSONL / CLI table.
+    ``per_payload`` includes the raw coverage vector per row (defaults
+    to on for P ≤ 256 — the debuggable scales — off at storm shape)."""
+    r = int(rounds)
+    t = trace_host(trace, r)
+    if per_payload is None:
+        per_payload = cfg.n_payloads <= 256
+    rows = []
+    for i in range(r):
+        up = int(t["up_nodes"][i])
+        cov = t["coverage"][i]
+        row = {
+            "t": i,
+            "up_nodes": up,
+            "coverage_frac": round(
+                float(cov.sum()) / max(up * cfg.n_payloads, 1), 6
+            ),
+            "delivered": int(t["delivered"][i].sum()),
+            "bcast_bytes": round(float(t["bcast_bytes"][i]), 1),
+            "bcast_frames": int(t["bcast_frames"][i]),
+            "bcast_dropped": int(t["bcast_dropped"][i]),
+            "bcast_cut": int(t["bcast_cut"][i]),
+            "sync_bytes": round(float(t["sync_bytes"][i]), 1),
+            "sync_frames": int(t["sync_frames"][i]),
+            "sync_sessions": int(t["sync_sessions"][i]),
+            "sync_refused": int(t["sync_refused"][i]),
+            "swim_suspect": int(t["swim_suspect"][i]),
+            "swim_down": int(t["swim_down"][i]),
+            "crashes": int(t["crashes"][i]),
+            "wipes": int(t["wipes"][i]),
+            "gap_overflow": int(t["gap_overflow"][i]),
+        }
+        if per_payload:
+            row["coverage"] = [int(c) for c in cov]
+        rows.append(row)
+    return rows
+
+
+def write_flight_jsonl(
+    path: str,
+    trace,
+    rounds: int,
+    cfg: SimConfig,
+    header: Optional[dict] = None,
+    per_payload: bool = None,
+) -> None:
+    """The flight-recorder artifact: line 1 is a header (shape, summary,
+    any caller context — campaign cell params, seeds, traceparent), then
+    one JSON line per executed round.  Atomic replace, like every other
+    artifact writer in the tree."""
+    import os
+
+    t = trace_host(trace, rounds)
+    head = {
+        "kind": "flight_recorder",
+        "version": FLIGHT_VERSION,
+        "n_nodes": cfg.n_nodes,
+        "n_payloads": cfg.n_payloads,
+        "rounds": int(rounds),
+        "summary": trace_summary(t, rounds, cfg),
+    }
+    if header:
+        head.update(header)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(head, sort_keys=True, default=float) + "\n")
+        for row in trace_rows(t, rounds, cfg, per_payload=per_payload):
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    os.replace(tmp, path)
+
+
+#: coverage-latency histogram buckets (rounds — round counts, not the
+#: host ladder's seconds)
+LATENCY_ROUND_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def trace_to_registry(
+    trace,
+    rounds: int,
+    cfg: SimConfig,
+    registry=None,
+    **labels,
+) -> None:
+    """Export a completed trace as ``sim_*`` Prometheus families on a
+    `metrics.Registry` (the process-wide one by default), so
+    `MetricsServer` scrapes sim runs exactly like host-agent state.
+    ``labels`` (e.g. run="packed_fault_storm") tag every family."""
+    from ..metrics import REGISTRY
+
+    reg = registry if registry is not None else REGISTRY
+    r = int(rounds)
+    t = trace_host(trace, r)
+
+    reg.counter("sim_rounds_total").inc(r, **labels)
+    wire = reg.counter("sim_wire_bytes_total")
+    wire.inc(float(t["bcast_bytes"].sum()), path="broadcast", **labels)
+    wire.inc(float(t["sync_bytes"].sum()), path="sync", **labels)
+    frames = reg.counter("sim_wire_frames_total")
+    frames.inc(int(t["bcast_frames"].sum()), path="broadcast", **labels)
+    frames.inc(int(t["sync_frames"].sum()), path="sync", **labels)
+    reg.counter("sim_fault_dropped_frames_total").inc(
+        int(t["bcast_dropped"].sum()), **labels
+    )
+    reg.counter("sim_fault_cut_edges_total").inc(
+        int(t["bcast_cut"].sum()), **labels
+    )
+    reg.counter("sim_fault_refused_sessions_total").inc(
+        int(t["sync_refused"].sum()), **labels
+    )
+    reg.counter("sim_fault_crash_node_rounds_total").inc(
+        int(t["crashes"].sum()), **labels
+    )
+    reg.counter("sim_fault_wipes_total").inc(int(t["wipes"].sum()), **labels)
+    reg.counter("sim_sync_sessions_total").inc(
+        int(t["sync_sessions"].sum()), **labels
+    )
+    reg.counter("sim_gap_overflow_rounds_total").inc(
+        int((t["gap_overflow"] > 0).sum()), **labels
+    )
+    reg.gauge("sim_swim_suspect_peak").set(
+        int(t["swim_suspect"].max(initial=0)), **labels
+    )
+    reg.gauge("sim_swim_down_peak").set(
+        int(t["swim_down"].max(initial=0)), **labels
+    )
+    hist = reg.histogram(
+        "sim_coverage_latency_rounds", buckets=LATENCY_ROUND_BUCKETS
+    )
+    for lat in coverage_latency_rounds(t, r):
+        if lat >= 0:
+            hist.observe(float(lat), **labels)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextvars
 import logging
+import os
 import random
 import threading
 import time
@@ -31,7 +32,31 @@ from typing import Callable, Deque, Dict, List, Optional
 
 log = logging.getLogger("corrosion_tpu.tracing")
 
-_rng = random.Random()
+
+def _make_rng(seed: Optional[str]) -> random.Random:
+    if seed is None:
+        return random.Random()
+    try:
+        return random.Random(int(seed))
+    except (TypeError, ValueError):
+        # random.seed(str) folds through sha512 — byte-stable across
+        # processes, unlike hash() (salted per process)
+        return random.Random(seed)
+
+
+def seed_trace_ids(seed=None) -> None:
+    """Re-seed span/trace id generation.  With no argument, derive from
+    ``CORRO_CAMPAIGN_SEED`` when set (unseeded otherwise) — campaign
+    replay artifacts embed traceparents, so a seeded campaign must
+    reproduce its id stream exactly (`campaign.engine.run_campaign`
+    calls this at start; ISSUE 5 satellite)."""
+    global _rng
+    if seed is None:
+        seed = os.environ.get("CORRO_CAMPAIGN_SEED")
+    _rng = _make_rng(seed)
+
+
+_rng = _make_rng(os.environ.get("CORRO_CAMPAIGN_SEED"))
 
 
 @dataclass(frozen=True)

@@ -101,3 +101,42 @@ def test_sync_trace_spans_both_nodes():
             await cluster.stop()
 
     asyncio.run(body())
+
+
+def test_campaign_seeded_trace_ids(monkeypatch):
+    """ISSUE 5 satellite: with CORRO_CAMPAIGN_SEED set, span/trace ids
+    come from a seeded stream — re-seeding replays the identical id
+    sequence, so campaign artifacts that embed traceparents are
+    digest-stable under seeded replay.  Unseeded runs stay random."""
+    from corrosion_tpu import tracing
+
+    monkeypatch.setenv("CORRO_CAMPAIGN_SEED", "1234")
+    try:
+        tracing.seed_trace_ids()
+        tracer = Tracer()
+        with span("a", tracer=tracer) as a:
+            pass
+        first = (a.context.trace_id, a.context.span_id)
+        tracing.seed_trace_ids()
+        with span("b", tracer=tracer) as b:
+            pass
+        assert (b.context.trace_id, b.context.span_id) == first
+        # an explicit seed overrides the env
+        tracing.seed_trace_ids(99)
+        with span("c", tracer=tracer) as c:
+            pass
+        assert (c.context.trace_id, c.context.span_id) != first
+        # a non-integer seed still seeds deterministically (sha512 fold)
+        tracing.seed_trace_ids("storm-A")
+        with span("d", tracer=tracer) as d:
+            pass
+        tracing.seed_trace_ids("storm-A")
+        with span("e", tracer=tracer) as e:
+            pass
+        assert (d.context.trace_id, d.context.span_id) == (
+            e.context.trace_id, e.context.span_id,
+        )
+    finally:
+        # restore the unseeded stream for the rest of the suite
+        monkeypatch.delenv("CORRO_CAMPAIGN_SEED", raising=False)
+        tracing.seed_trace_ids()

@@ -1,0 +1,345 @@
+"""Flight-recorder telemetry (ISSUE 5): the RoundTrace contract.
+
+Pins the four guarantees sim/telemetry.py makes:
+
+1. **telemetry=None compiles out** — a telemetry-off run is byte-
+   identical to the pre-telemetry build (state, metrics, AND the
+   replay digests of the checked fault driver), and a telemetry-ON run
+   perturbs nothing (same state/metrics bits, trace riding alongside);
+2. **dense == packed traces** — every channel bit-equal under the same
+   FaultPlan (integer channels count the same sets; byte channels fold
+   identically-shaped per-edge totals);
+3. **vmapped ensemble lane slices == solo runs** — the trace is
+   allocated inside the jitted run, so vmap stacks per-lane buffers;
+4. host-side exports (summary / JSONL / Registry) are deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# import the packed module before any tracing happens: its module-level
+# u32 constants must not be created inside a jit trace (the lazy
+# `from .packed import ...` in run_to_convergence would otherwise
+# execute the module mid-trace)
+import corrosion_tpu.sim.packed  # noqa: F401
+from corrosion_tpu.faults import FaultEvent, FaultPlan
+from corrosion_tpu.sim.faults import compile_plan, run_fault_plan
+from corrosion_tpu.sim.round import new_sim, run_to_convergence
+from corrosion_tpu.sim.state import ALIVE, DOWN, SimConfig, uniform_payloads
+from corrosion_tpu.sim.telemetry import (
+    RoundTrace,
+    coverage_latency_rounds,
+    trace_summary,
+    trace_to_registry,
+    write_flight_jsonl,
+)
+from corrosion_tpu.sim.topology import Topology
+
+
+def _cfg(**kw):
+    kw.setdefault("n_payloads", 64)  # 16 versions x 2 writers x 2 chunks
+    kw.setdefault("n_writers", 2)
+    kw.setdefault("chunks_per_version", 2)
+    kw.setdefault("fanout", 2)
+    kw.setdefault("sync_interval_rounds", 3)
+    kw.setdefault("swim_partial_view", True)
+    kw.setdefault("member_slots", 8)
+    kw.setdefault("rate_limit_bytes_round", None)
+    kw.setdefault("sync_budget_bytes", None)
+    kw.setdefault("packed_min_cells", 0)
+    kw.setdefault("n_delay_slots", 4)
+    return SimConfig.wan_tuned(32, **kw)
+
+
+_PLAN = FaultPlan(
+    n_nodes=32, seed=5,
+    events=(
+        FaultEvent("loss", 0, 12, p=0.3),
+        FaultEvent("partition", 2, 10, src="0:16", dst="16:32"),
+        FaultEvent("delay", 2, 10, src="0:8", dst="*", delay_rounds=1),
+        FaultEvent("jitter", 2, 10, src="0:8", dst="*", delay_rounds=1),
+        FaultEvent("crash", 6, 14, node=2, wipe=True),
+    ),
+)
+
+
+def _assert_traces_equal(a, b, tag=""):
+    for name in RoundTrace._fields:
+        x = np.asarray(getattr(a, name))
+        y = np.asarray(getattr(b, name))
+        assert (x == y).all(), (
+            f"{tag}{name}: {int((x != y).sum())} mismatches, "
+            f"first at {np.argwhere(x != y)[:5].tolist()}"
+        )
+
+
+def test_telemetry_off_is_byte_identical():
+    """The acceptance gate: telemetry=None (the default) produces bit-
+    identical results, and telemetry=True observes without perturbing —
+    faultless and fault-plan entries both."""
+    cfg = _cfg()
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=1)
+
+    f0, m0 = run_to_convergence(new_sim(cfg, 3), meta, cfg, topo, 200)
+    f1, m1, _tr = run_to_convergence(
+        new_sim(cfg, 3), meta, cfg, topo, 200, telemetry=True
+    )
+    assert int(f0.t) == int(f1.t)
+    for name in ("have", "relay_left", "heads", "alive", "key"):
+        assert (
+            np.asarray(getattr(f0, name)) == np.asarray(getattr(f1, name))
+        ).all(), name
+    assert (
+        np.asarray(m0.converged_at) == np.asarray(m1.converged_at)
+    ).all()
+    assert (np.asarray(m0.coverage_at) == np.asarray(m1.coverage_at)).all()
+
+    fplan = compile_plan(_PLAN, cfg, topo)
+    g0, n0 = run_fault_plan(new_sim(cfg, 7), meta, cfg, topo, fplan, 300)
+    g1, n1, _ftr = run_fault_plan(
+        new_sim(cfg, 7), meta, cfg, topo, fplan, 300, telemetry=True
+    )
+    assert int(g0.t) == int(g1.t)
+    assert (np.asarray(g0.have) == np.asarray(g1.have)).all()
+    assert (
+        np.asarray(n0.converged_at) == np.asarray(n1.converged_at)
+    ).all()
+
+
+@pytest.mark.chaos
+def test_dense_and_packed_traces_bit_equal_under_faults():
+    """ISSUE 5 satellite: dense-vs-packed RoundTrace equality under the
+    same FaultPlan, through the public dispatching entry."""
+    cfg = _cfg()
+    cfgd = dataclasses.replace(cfg, allow_packed=False)
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=1)
+    from corrosion_tpu.sim.packed import packed_supported
+
+    assert packed_supported(cfg, topo)
+    assert not packed_supported(cfgd, topo)
+
+    fp, mp, tr_p = run_fault_plan(
+        new_sim(cfg, 7), meta, cfg, topo, compile_plan(_PLAN, cfg, topo),
+        300, telemetry=True,
+    )
+    fd, md, tr_d = run_fault_plan(
+        new_sim(cfgd, 7), meta, cfgd, topo,
+        compile_plan(_PLAN, cfgd, topo), 300, telemetry=True,
+    )
+    assert int(fp.t) == int(fd.t)
+    _assert_traces_equal(tr_p, tr_d, "fault ")
+    # the fault channels actually fired (a trivially-zero trace would
+    # pass equality while recording nothing)
+    r = int(fp.t)
+    t = {f: np.asarray(getattr(tr_p, f))[:r] for f in RoundTrace._fields}
+    assert t["bcast_dropped"].sum() > 0
+    assert t["bcast_cut"].sum() > 0
+    assert t["crashes"].sum() > 0
+    assert t["wipes"].sum() == 1
+    assert t["bcast_bytes"].sum() > 0
+    assert t["sync_sessions"].sum() > 0
+
+
+def test_dense_and_packed_traces_bit_equal_faultless():
+    cfg = _cfg()
+    cfgd = dataclasses.replace(cfg, allow_packed=False)
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=1)
+
+    fp, mp, tr_p = run_to_convergence(
+        new_sim(cfg, 3), meta, cfg, topo, 200, telemetry=True
+    )
+    fd, md, tr_d = run_to_convergence(
+        new_sim(cfgd, 3), meta, cfgd, topo, 200, telemetry=True
+    )
+    assert int(fp.t) == int(fd.t)
+    _assert_traces_equal(tr_p, tr_d, "faultless ")
+
+
+@pytest.mark.campaign
+def test_vmapped_ensemble_lane_traces_match_solo_runs():
+    """ISSUE 5 satellite: lane k of a vmapped telemetry ensemble slices
+    to exactly the solo run's trace (the trace is allocated inside the
+    jitted run, so vmap batches the buffers per lane)."""
+    from corrosion_tpu.campaign.ensemble import run_seed_ensemble
+
+    cfg = _cfg()
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=1)
+    seeds = (0, 1, 2)
+
+    finals, metrics, traces = run_seed_ensemble(
+        _PLAN, cfg, topo, meta, seeds, max_rounds=300, telemetry=True
+    )
+    for k, s in enumerate(seeds):
+        fp = compile_plan(
+            dataclasses.replace(_PLAN, seed=int(s)), cfg, topo
+        )
+        solo, _m, solo_trace = run_fault_plan(
+            new_sim(cfg, int(s)), meta, cfg, topo, fp, 300, telemetry=True
+        )
+        lane = jax.tree.map(lambda x: x[k], traces)
+        _assert_traces_equal(lane, solo_trace, f"lane{k} ")
+        assert int(finals.t[k]) == int(solo.t)
+
+
+def test_trace_channels_are_consistent():
+    """Cross-channel sanity on a small faultless run: coverage is the
+    cumulative delivered count per payload (no crashes), the final
+    coverage row is full, and the latency percentiles derive from it."""
+    cfg = _cfg()
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=1)
+    final, metrics, trace = run_to_convergence(
+        new_sim(cfg, 11), meta, cfg, topo, 200, telemetry=True
+    )
+    r = int(final.t)
+    cov = np.asarray(trace.coverage)[:r]
+    dlv = np.asarray(trace.delivered)[:r]
+    up = np.asarray(trace.up_nodes)[:r]
+    # no deaths in this scenario: coverage == running sum of delivered
+    assert (up == cfg.n_nodes).all()
+    assert (cov == np.cumsum(dlv, axis=0)).all()
+    # converged ⇒ the last row is full coverage
+    assert (cov[-1] == cfg.n_nodes).all()
+    lat = coverage_latency_rounds(trace, r)
+    assert (lat >= 0).all()
+    # full coverage can't precede the payload's injection round
+    assert (lat >= np.asarray(meta.round)).all()
+    summ = trace_summary(trace, r, cfg)
+    assert summ["rounds"] == r
+    assert summ["coverage_latency_rounds"]["uncovered_payloads"] == 0
+    assert summ["wire_bytes"]["broadcast"] > 0
+
+
+def test_flight_jsonl_roundtrip_and_digest_stability(tmp_path):
+    """The JSONL artifact: header + one row per round, deterministic
+    across replays (same digest, same bytes)."""
+    cfg = _cfg()
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=1)
+
+    paths = []
+    digests = []
+    for i in range(2):
+        final, _m, trace = run_to_convergence(
+            new_sim(cfg, 13), meta, cfg, topo, 200, telemetry=True
+        )
+        p = tmp_path / f"run{i}.jsonl"
+        write_flight_jsonl(
+            str(p), trace, int(final.t), cfg, header={"seed": 13}
+        )
+        paths.append(p)
+        digests.append(trace_summary(trace, int(final.t), cfg))
+    assert digests[0] == digests[1]
+    assert paths[0].read_bytes() == paths[1].read_bytes()
+
+    with open(paths[0]) as f:
+        head = json.loads(f.readline())
+        rows = [json.loads(line) for line in f]
+    assert head["kind"] == "flight_recorder"
+    assert head["seed"] == 13
+    assert head["rounds"] == len(rows)
+    assert rows[0]["t"] == 0 and rows[-1]["t"] == head["rounds"] - 1
+    assert rows[-1]["coverage_frac"] == 1.0
+    # P = 64 ≤ 256: per-payload coverage vectors ride along
+    assert len(rows[0]["coverage"]) == cfg.n_payloads
+
+
+def test_trace_to_registry_families():
+    """trace→Registry bridge: sim_* families land on a Registry and
+    render in the Prometheus exposition MetricsServer scrapes."""
+    from corrosion_tpu.metrics import Registry
+
+    cfg = _cfg()
+    topo = Topology()
+    meta = uniform_payloads(cfg, inject_every=1)
+    final, _m, trace = run_to_convergence(
+        new_sim(cfg, 3), meta, cfg, topo, 200, telemetry=True
+    )
+    reg = Registry()
+    trace_to_registry(trace, int(final.t), cfg, registry=reg, run="smoke")
+    out = reg.render()
+    for family in (
+        "sim_rounds_total", "sim_wire_bytes_total", "sim_wire_frames_total",
+        "sim_sync_sessions_total", "sim_coverage_latency_rounds_bucket",
+        "sim_fault_dropped_frames_total",
+    ):
+        assert family in out, family
+    assert 'path="broadcast"' in out and 'path="sync"' in out
+    assert 'run="smoke"' in out
+    assert f"sim_rounds_total{{run=\"smoke\"}} {int(final.t)}" in out
+
+
+def test_membership_detect_driver_full_and_partial():
+    """`run_membership_detect` (the engine-routed configs #2/#2b loop):
+    detection fires, the trace's swim_down channel is monotone up to
+    detection, and the full-view/partial-view predicates both compile."""
+    from corrosion_tpu.sim.telemetry import run_membership_detect
+
+    topo = Topology()
+    for cfg in (
+        SimConfig.wan_tuned(24, n_payloads=1, swim_full_view=True),
+        SimConfig.wan_tuned(
+            96, n_payloads=1, swim_partial_view=True, member_slots=16,
+            probe_period_rounds=1,
+        ),
+    ):
+        meta = uniform_payloads(cfg)
+        state = new_sim(cfg, 0)
+        kill = jnp.arange(cfg.n_nodes) % 3 == 0
+        state = state._replace(
+            alive=jnp.where(kill, jnp.uint8(DOWN), jnp.uint8(ALIVE))
+        )
+        s, m, dr, trace = run_membership_detect(
+            state, meta, cfg, topo, 600, telemetry=True
+        )
+        dr = int(dr)
+        assert dr >= 0, f"no detection at n={cfg.n_nodes}"
+        downs = np.asarray(trace.swim_down)[:dr]
+        assert downs[-1] > 0
+        # killed nodes never rejoin in this scenario, so the DOWN-belief
+        # total must grow monotonically up to the detection round
+        assert (np.diff(downs.astype(np.int64)) >= 0).all()
+        # the driver's early exit matches the recorded round count
+        assert int(s.t) == dr
+
+
+def test_perf_microbench_supports_telemetry():
+    """measure_per_round(telemetry=True) — the flight-recorder round
+    body is microbenchable — runs on both the plain and fault bodies."""
+    from corrosion_tpu.sim.perf import measure_per_round
+
+    cfg = _cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    fplan = compile_plan(_PLAN, cfg, Topology())
+    for fp in (None, fplan):
+        pr = measure_per_round(
+            cfg, meta, seed=1, k_rounds=2, reps=1, fplan=fp,
+            telemetry=True,
+        )
+        assert pr > 0
+
+
+def test_perf_overhead_pair_interleaved():
+    """measure_overhead_pair — the defensible form of the ≤10% overhead
+    ratio (interleaved A/B, per-variant min) — returns a positive
+    (plain, telemetry) pair on the fault body."""
+    from corrosion_tpu.sim.perf import measure_overhead_pair
+
+    cfg = _cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    fplan = compile_plan(_PLAN, cfg, Topology())
+    pr_plain, pr_tel = measure_overhead_pair(
+        cfg, meta, seed=1, k_rounds=2, reps=1, fplan=fplan
+    )
+    assert pr_plain > 0 and pr_tel > 0
