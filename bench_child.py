@@ -44,6 +44,16 @@ def main() -> int:
         if spec.get("platform"):
             # must win over the image profile's JAX_PLATFORMS=axon pin
             os.environ["JAX_PLATFORMS"] = spec["platform"]
+        if spec.get("virtual_devices"):
+            # sharded-rung validation on a single host (ISSUE 7): arm
+            # the virtual CPU mesh BEFORE jax initializes, as the test
+            # conftest does — real multi-chip slices skip this
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{int(spec['virtual_devices'])}"
+                ).strip()
 
         import jax
 

@@ -93,7 +93,10 @@ class Config:
     @classmethod
     def load(cls, path: str) -> "Config":
         """TOML + `CORRO__SECTION__KEY` env overrides (config.rs:315-329)."""
-        import tomllib
+        try:
+            import tomllib  # 3.11+ stdlib
+        except ModuleNotFoundError:  # 3.10: the API-compatible backport
+            import tomli as tomllib
 
         with open(path, "rb") as f:
             raw = tomllib.load(f)

@@ -109,6 +109,7 @@ def _run_cell(
     cell_index: int = 0,
     telemetry: bool = False,
     trace_dir: Optional[str] = None,
+    mesh_devices: Optional[int] = None,
 ) -> Dict[str, object]:
     """One parameter point: the whole seed set as one vmapped ensemble,
     reduced to per-seed records + cross-seed bands.
@@ -125,14 +126,27 @@ def _run_cell(
     Membership cells (``detect_membership`` scenario key) run the
     on-device detection loop instead of the convergence loop and band
     ``detect_round`` per seed — runner configs #2/#2b routed through the
-    engine."""
+    engine.
+
+    ``mesh_devices`` (ISSUE 7) runs the cell SHARDED: the ensemble's
+    node axis splits across up to that many devices (mesh × lane
+    batching — `ensemble.ensemble_mesh` picks the largest dividing mesh,
+    so a non-divisible cell degrades to fewer devices rather than
+    padding, which would change trajectories).  Sharding never changes a
+    lane's result; the cell records the realized ``mesh`` shape so the
+    artifact says what actually ran."""
     import jax
 
+    from ..parallel.mesh import mesh_record, mesh_size
     from ..sim.packed import packed_supported
     from ..sim.perf import analytic_min_round_s
     from ..sim.state import ALIVE, uniform_payloads
     from ..tracing import span
-    from .ensemble import run_detect_ensemble, run_seed_ensemble
+    from .ensemble import (
+        ensemble_mesh,
+        run_detect_ensemble,
+        run_seed_ensemble,
+    )
 
     cfg = spec.sim_config(cell)
     topo = spec.topo(cell)
@@ -145,6 +159,8 @@ def _run_cell(
     # included — ISSUE 4): recorded per cell so dense fallbacks are
     # visible in artifacts and CLI output instead of silent
     round_path = "packed" if packed_supported(cfg, topo) else "dense"
+    mesh = ensemble_mesh(cfg, mesh_devices)
+    n_devices = mesh_size(mesh)
 
     k = len(spec.seeds)
     traces = None
@@ -163,6 +179,7 @@ def _run_cell(
                 cfg, topo, meta, spec.seeds,
                 kill_every=spec.kill_every(cell),
                 max_rounds=spec.max_rounds, telemetry=telemetry,
+                mesh=mesh,
             )
             finals, metrics, detect_rounds = out[0], out[1], out[2]
             if telemetry:
@@ -171,6 +188,7 @@ def _run_cell(
             out = run_seed_ensemble(
                 plan, cfg, topo, meta, spec.seeds,
                 max_rounds=spec.max_rounds, telemetry=telemetry,
+                mesh=mesh,
             )
             finals, metrics = out[0], out[1]
             if telemetry:
@@ -238,15 +256,21 @@ def _run_cell(
 
     # defensible wall: the batched program writes K lanes' carries every
     # executed round (frozen lanes still ride the select), and executed
-    # rounds = the slowest lane's count
+    # rounds = the slowest lane's count; a sharded cell verifies against
+    # the mesh's AGGREGATE bandwidth, so a multi-device wall can't
+    # launder an async artifact either
     executed = int(rounds.max()) if k else 0
-    floor = executed * k * analytic_min_round_s(cfg)
+    floor = executed * k * analytic_min_round_s(cfg, n_devices)
     verdict = WALL_OK if wall >= floor else WALL_VIOLATED
     result = {
         "params": dict(cell),
         "n_nodes": cfg.n_nodes,
         "n_payloads": cfg.n_payloads,
         "round_path": round_path,
+        # the realized mesh (ISSUE 7): None = unsharded; a sharded cell
+        # records its axes/devices so "what ran where" is in the artifact
+        "mesh": mesh_record(mesh),
+        "n_devices": n_devices,
         "seeds": list(spec.seeds),
         "plan_horizon": plan.horizon if plan is not None else 0,
         "per_seed": per_seed,
@@ -387,6 +411,7 @@ def run_campaign(
     resume: bool = True,
     telemetry: Optional[bool] = None,
     trace_dir: Optional[str] = None,
+    mesh_devices: Optional[int] = None,
 ) -> Dict:
     """Run every (cell × seed-ensemble) of the campaign.
 
@@ -400,7 +425,12 @@ def run_campaign(
       the SAME spec hash (a hash mismatch starts from scratch);
     - ``telemetry``: thread the flight recorder through every cell
       (None defers to ``spec.telemetry``); ``trace_dir`` additionally
-      writes one flight-recorder JSONL per (cell, lane).
+      writes one flight-recorder JSONL per (cell, lane);
+    - ``mesh_devices``: run every cell node-axis-sharded over up to
+      that many devices (ISSUE 7 mesh × lane batching).  A run-config
+      like ``trace_dir``, NOT part of the spec: sharding never changes
+      results, so the spec hash, replay digest, and committed baselines
+      are untouched — the realized mesh is recorded per cell instead.
     """
     if telemetry is None:
         telemetry = spec.telemetry
@@ -442,7 +472,7 @@ def run_campaign(
             continue
         res = _run_cell(
             spec, cell, cell_index=i, telemetry=telemetry,
-            trace_dir=trace_dir,
+            trace_dir=trace_dir, mesh_devices=mesh_devices,
         )
         res["cell_index"] = i
         results.append(res)

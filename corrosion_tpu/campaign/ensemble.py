@@ -21,7 +21,18 @@ identical to the single-seed run of the same scenario
   masking, so a lane's final carry equals its solo-run fixpoint;
 - every RNG draw inside the round is a pure function of the lane's key
   (threefry is elementwise in the key), so batching can't cross lanes.
-"""
+
+**Mesh × lane batching (ISSUE 7)**: every entry point takes a ``mesh``
+(a 1-D ``nodes`` `jax.sharding.Mesh`, or None).  The stacked [K, ...]
+states are placed with the LANE axis whole and the NODE axis split
+(`parallel.mesh.shard_ensemble_states`), the shared schedule tensors
+ride node-sharded (`shard_fault_plan`), and payload metadata
+replicates — GSPMD propagates that layout through the vmapped
+while_loop, so the gossip scatters partition across the mesh while the
+per-round convergence folds become cross-shard reductions.  Sharding
+partitions the math without changing it: each lane remains
+byte-identical to its solo single-device run
+(tests/sim/test_packed_sharded.py pins it)."""
 
 from __future__ import annotations
 
@@ -55,6 +66,51 @@ def lane_plan_seeds(seeds: Sequence[int]) -> jnp.ndarray:
     )
 
 
+def place_ensemble(
+    states: SimState,
+    meta: PayloadMeta,
+    fplan,
+    mesh,
+):
+    """Mesh-place an ensemble's inputs (identity when ``mesh`` is None):
+    stacked states lane-whole × node-split, metadata replicated, shared
+    schedule tensors node-sharded.  The vmapped run itself takes no mesh
+    argument — GSPMD propagates the input layout through the batched
+    while_loop, which keeps the vmap batching rules untouched."""
+    if mesh is None:
+        return states, meta, fplan
+    from ..parallel.mesh import (
+        replicate_meta,
+        shard_ensemble_states,
+        shard_fault_plan,
+    )
+
+    states = shard_ensemble_states(states, mesh)
+    meta = replicate_meta(meta, mesh)
+    if fplan is not None:
+        fplan = shard_fault_plan(fplan, mesh)
+    return states, meta, fplan
+
+
+def ensemble_mesh(cfg: SimConfig, n_devices: Optional[int]):
+    """The cell's mesh for a requested device count: the largest mesh of
+    ≤ ``n_devices`` devices whose size divides the node axis (explicit
+    NamedSharding placement needs even shards; the engine never pads a
+    campaign cell — padding would change tensor shapes, hence RNG
+    streams, and break the byte-identity contract).  None when sharding
+    degenerates to one device or none were requested."""
+    if not n_devices or n_devices <= 1:
+        return None
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    d = min(int(n_devices), len(jax.devices()))
+    while d > 1 and cfg.n_nodes % d:
+        d -= 1
+    return make_mesh(d) if d > 1 else None
+
+
 def run_ensemble(
     states: SimState,
     meta: PayloadMeta,
@@ -64,6 +120,7 @@ def run_ensemble(
     plan_seeds: Optional[jnp.ndarray] = None,
     max_rounds: int = 1000,
     telemetry: bool = False,
+    mesh=None,
 ):
     """Run every lane to convergence (or ``max_rounds``) in one batched
     program.  ``fplan`` holds the shared schedule tensors; ``plan_seeds``
@@ -76,7 +133,11 @@ def run_ensemble(
     is allocated INSIDE the jitted run, so vmap stacks per-lane buffers
     and lane k's trace slice is byte-identical to its solo run's trace
     (tests/sim/test_telemetry.py pins it).  Adds a stacked RoundTrace to
-    the return."""
+    the return.
+
+    ``mesh`` shards the node axis across the devices (mesh × lane
+    batching, module docstring) without changing any lane's result."""
+    states, meta, fplan = place_ensemble(states, meta, fplan, mesh)
     if fplan is None:
         return jax.vmap(
             lambda st: run_to_convergence(
@@ -105,6 +166,7 @@ def run_seed_ensemble(
     seeds: Sequence[int],
     max_rounds: int = 1000,
     telemetry: bool = False,
+    mesh=None,
 ):
     """Convenience wrapper: seeds → stacked states (+ per-lane plan
     seeds when a plan is given) → one vmapped run."""
@@ -112,13 +174,13 @@ def run_seed_ensemble(
     if plan is None:
         return run_ensemble(
             states, meta, cfg, topo, max_rounds=max_rounds,
-            telemetry=telemetry,
+            telemetry=telemetry, mesh=mesh,
         )
     fplan = compile_plan(plan, cfg, topo)
     return run_ensemble(
         states, meta, cfg, topo, fplan=fplan,
         plan_seeds=lane_plan_seeds(seeds), max_rounds=max_rounds,
-        telemetry=telemetry,
+        telemetry=telemetry, mesh=mesh,
     )
 
 
@@ -130,6 +192,7 @@ def run_detect_ensemble(
     kill_every: int = 0,
     max_rounds: int = 400,
     telemetry: bool = False,
+    mesh=None,
 ):
     """Membership-churn seed ensemble (runner configs #2/#2b through the
     engine — ROADMAP "detect-round bands"): kill every ``kill_every``-th
@@ -146,6 +209,7 @@ def run_detect_ensemble(
         states = states._replace(
             alive=jnp.broadcast_to(alive, states.alive.shape)
         )
+    states, meta, _ = place_ensemble(states, meta, None, mesh)
     return jax.vmap(
         lambda st: run_membership_detect(
             st, meta, cfg, topo, max_rounds, telemetry=telemetry

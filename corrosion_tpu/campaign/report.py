@@ -35,6 +35,10 @@ BAND_METRICS = ("rounds", "p99_node_convergence_round", "detect_round")
 NONDETERMINISTIC_KEYS = (
     "wall_clock_s", "wall_defensible_s", "wall_verdict", "walls",
     "host_parity", "traceparent", "telemetry",
+    # sharding is a run-config: it partitions the math without changing
+    # it (ISSUE 7), so a mesh-sharded candidate must byte-certify
+    # against an unsharded baseline of the same spec hash
+    "mesh", "n_devices",
 )
 
 

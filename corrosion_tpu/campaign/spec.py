@@ -91,6 +91,14 @@ class CampaignSpec:
       in the artifact and `run_campaign(trace_dir=...)` writes per-lane
       flight-recorder JSONL.  Serialized only when True, so existing
       spec hashes (and committed baselines) are untouched.
+
+    Mesh sharding (ISSUE 7) is deliberately NOT a spec field: sharding
+    partitions the math without changing any lane's trajectory, so it
+    belongs to the run, not the replay identity — pass
+    ``run_campaign(spec, mesh_devices=N)`` (CLI ``--mesh-devices``) and
+    the realized mesh is recorded per cell instead (doc/sharding.md).
+    A ``mesh_devices`` spec field would fork spec hashes between
+    sharded and unsharded runs of byte-identical experiments.
     """
 
     name: str

@@ -362,6 +362,14 @@ _SIM_SCENARIOS = {
     # the fault storm WITH the flight recorder on (ISSUE 5): per-round
     # telemetry overhead vs plain + the coverage-curve summary
     "fault-storm-telemetry": "config_fault_storm_telemetry",
+    # the fault storm node-axis-SHARDED over a device mesh (ISSUE 7):
+    # GSPMD-partitioned packed carry, bit-identical to single-device
+    # (--devices caps the mesh; at ≤ 8192 nodes the rung re-runs
+    # unsharded and asserts bit-equality in the record itself)
+    "packed-fault-storm-sharded": "config_packed_fault_storm_sharded",
+    # the 1M-node tier (ISSUE 7): the storm schedule at a million nodes,
+    # sharded, ground-truth membership, defensible-wall verified
+    "fault-storm-1m": "config_fault_storm_1m",
 }
 
 
@@ -431,6 +439,28 @@ def _run_sim_scenario(args) -> int:
     params = inspect.signature(fn).parameters
     if args.nodes and "n_nodes" in params:
         kwargs["n_nodes"] = args.nodes
+    # mesh sharding (ISSUE 7): --devices caps the 1-D nodes mesh on
+    # scenarios that take one; refuse it loudly elsewhere (a silently
+    # ignored device cap would fake a sharded measurement).  The same
+    # rule for the campaign-only twin flag: a scenario run given
+    # --mesh-devices must not silently execute unsharded.
+    if args.mesh_devices:
+        print(
+            "error: --mesh-devices is a campaign-run flag; scenario "
+            "runs take --devices (sharded rungs only)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.devices:
+        if "n_devices" not in params:
+            print(
+                f"error: scenario {args.scenario!r} does not take "
+                "--devices (sharded rungs: packed-fault-storm-sharded, "
+                "fault-storm-1m)",
+                file=sys.stderr,
+            )
+            return 2
+        kwargs["n_devices"] = args.devices
     # flight recorder (ISSUE 5): --telemetry adds the summary block to
     # the record; --trace-out also writes the per-round JSONL artifact.
     # A scenario supports the recorder if its config fn takes `telemetry`
@@ -579,6 +609,10 @@ def cmd_campaign(args) -> int:
             entry = {
                 "params": c.get("params", {}),
                 "round_path": c.get("round_path", "unknown"),
+                # the realized mesh per cell (ISSUE 7): which devices the
+                # round_path above actually partitioned over — None /
+                # absent = unsharded (or a pre-sharding artifact)
+                "mesh": c.get("mesh"),
                 "all_converged": c.get("all_converged"),
                 "bands": c.get("bands", {}),
             }
@@ -607,6 +641,13 @@ def cmd_campaign(args) -> int:
 
     if args.campaign_cmd != "run":
         raise SystemExit("usage: sim campaign {run|compare|report} ...")
+    if args.devices:
+        # the scenario flag on a campaign run would be silently ignored
+        # — same loud refusal the scenario path gives --mesh-devices
+        raise SystemExit(
+            "error: campaign runs shard via --mesh-devices N, "
+            "not --devices"
+        )
     if not args.spec:
         raise SystemExit(
             f"--spec required: a JSON spec file or one of "
@@ -635,6 +676,7 @@ def cmd_campaign(args) -> int:
         resume=not args.no_resume,
         telemetry=args.telemetry or None,
         trace_dir=args.trace_dir,
+        mesh_devices=args.mesh_devices,
     )
     summary = {
         "spec_hash": artifact["spec_hash"],
@@ -655,10 +697,17 @@ def cmd_campaign(args) -> int:
         # fallbacks must be visible, not silent — a fault sweep that
         # quietly dropped off the packed path costs 4-30× per primitive.
         # Cells resumed from a pre-round_path artifact report "unknown",
-        # never a false "dense" alarm.
+        # never a false "dense" alarm.  Since ISSUE 7 the path is
+        # reported PER MESH — "packed@nodes=8" says the packed kernels
+        # ran node-split over 8 devices; no suffix = unsharded.
         "kernel_paths": {
-            json.dumps(c.get("params", {}), sort_keys=True): c.get(
-                "round_path", "unknown"
+            json.dumps(c.get("params", {}), sort_keys=True): (
+                c.get("round_path", "unknown")
+                + (
+                    "@nodes={}".format(c["mesh"]["axes"]["nodes"])
+                    if c.get("mesh")
+                    else ""
+                )
             )
             for c in artifact["cells"]
         },
@@ -821,6 +870,17 @@ def build_parser() -> argparse.ArgumentParser:
         "omitted = the spec's own seed set)",
     )
     sm.add_argument("--nodes", type=int, default=None)
+    sm.add_argument(
+        "--devices", type=int, default=None,
+        help="sharded scenarios (ISSUE 7): cap the 1-D nodes mesh at N "
+        "devices (default: every visible device)",
+    )
+    sm.add_argument(
+        "--mesh-devices", type=int, default=None,
+        help="campaign run: shard every cell's node axis over up to N "
+        "devices (mesh × lane batching; results and digests are "
+        "unchanged — the realized mesh is recorded per cell)",
+    )
     sm.add_argument(
         "--spec", help="campaign run: JSON spec file or builtin name"
     )

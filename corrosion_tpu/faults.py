@@ -154,6 +154,21 @@ class LinkFault:
 CLEAR = LinkFault()
 
 
+def _event_link_fault(ev: "FaultEvent") -> LinkFault:
+    """The LinkFault one active link event contributes — the single
+    lowering rule both the pairwise (`schedule_at`) and range-atom
+    (`range_link_epochs`) expansions share, so they cannot drift."""
+    if ev.kind == "loss":
+        return LinkFault(loss=ev.p)
+    if ev.kind == "delay":
+        return LinkFault(delay_rounds=ev.delay_rounds)
+    if ev.kind == "jitter":
+        return LinkFault(jitter_rounds=ev.delay_rounds)
+    if ev.kind == "duplicate":
+        return LinkFault(duplicate=ev.p)
+    return LinkFault(blocked=True)  # partition
+
+
 @dataclass(frozen=True)
 class RoundSchedule:
     """Canonical fault state of ONE round — what both compilers consume."""
@@ -224,10 +239,17 @@ class FaultPlan:
                     if ev.kind == "partition" and ev.symmetric:
                         yield (d, s)
 
-    def schedule_at(self, r: int) -> RoundSchedule:
+    def schedule_at(self, r: int, include_links: bool = True) -> RoundSchedule:
         """The resolved fault state of round ``r`` — a pure function of
         the plan, so the host driver and the sim compiler can never
-        disagree on what round r looks like."""
+        disagree on what round r looks like.
+
+        ``include_links=False`` skips the pairwise link expansion and
+        returns an empty ``links`` dict — the node-fault-only view the
+        range-aware drivers use (ISSUE 7 satellite: a storm-shaped
+        ``"lo:hi"`` plan must never expand |src|·|dst| pairs per round;
+        link state rides `range_link_epochs` / `blocked_pairs_at`
+        instead)."""
         links: Dict[Tuple[int, int], LinkFault] = {}
         down, restart, wipe = set(), set(), set()
         skews: Dict[int, int] = {}
@@ -245,22 +267,142 @@ class FaultPlan:
             if ev.kind == "clock_skew":
                 skews[ev.node] = skews.get(ev.node, 0) + ev.skew_ns
                 continue
-            if ev.kind == "loss":
-                f = LinkFault(loss=ev.p)
-            elif ev.kind == "delay":
-                f = LinkFault(delay_rounds=ev.delay_rounds)
-            elif ev.kind == "jitter":
-                f = LinkFault(jitter_rounds=ev.delay_rounds)
-            elif ev.kind == "duplicate":
-                f = LinkFault(duplicate=ev.p)
-            else:  # partition
-                f = LinkFault(blocked=True)
+            if not include_links:
+                continue
+            f = _event_link_fault(ev)
             for pair in self._pairs(ev):
                 links[pair] = links.get(pair, CLEAR).merge(f)
         return RoundSchedule(
             links=links, down=frozenset(down), restart=frozenset(restart),
             wipe=frozenset(wipe), skews=skews,
         )
+
+    def _has_pair(self, ev: FaultEvent) -> bool:
+        """Whether an event's src × dst rectangle contains any s ≠ d
+        pair (the only degenerate case is a 1×1 rectangle on the
+        diagonal)."""
+        sr = sel_indices(ev.src, self.n_nodes)
+        dr = sel_indices(ev.dst, self.n_nodes)
+        return not (
+            len(sr) == 1 and len(dr) == 1 and sr.start == dr.start
+        )
+
+    def active_kinds_at(self, r: int) -> List[str]:
+        """Fault kinds in effect at round ``r``, straight from the event
+        table — equal to ``schedule_at(r).active_kinds()`` (zero-effect
+        events filtered the same way the pairwise expansion drops them)
+        but O(events) instead of O(events · pairs), so the range-aware
+        drivers can fire coverage markers at storm scale."""
+        kinds = set()
+        for ev in self.events:
+            if not ev.start <= r < ev.end:
+                continue
+            if ev.kind in ("loss", "duplicate") and ev.p <= 0:
+                continue
+            if ev.kind in ("delay", "jitter") and ev.delay_rounds <= 0:
+                continue
+            if ev.kind not in ("crash", "clock_skew") and not self._has_pair(ev):
+                continue
+            kinds.add(ev.kind)
+        return sorted(kinds)
+
+    def blocked_pairs_at(self, r: int):
+        """Directed (src, dst) edges partition-cut at round ``r`` —
+        yielded lazily so a driver can build its blocked set without the
+        full pairwise `schedule_at` links dict.  The edge count itself
+        is irreducible (the transports key partitions per edge), but
+        nothing else pays the expansion.  Drivers should gate the
+        expansion on `partition_epoch` so an UNCHANGED partition set is
+        never rebuilt round over round."""
+        seen = set()
+        for ev in self.events:
+            if ev.kind != "partition" or not ev.start <= r < ev.end:
+                continue
+            for pair in self._pairs(ev):
+                if pair not in seen:
+                    seen.add(pair)
+                    yield pair
+
+    def partition_epoch(self, r: int):
+        """Hashable identity of the ACTIVE partition-event set at round
+        ``r``: the blocked edge set is a pure function of it, so a
+        driver rebuilds its `blocked_pairs_at` expansion only when this
+        changes (O(events) per round instead of O(pairs))."""
+        return tuple(
+            i
+            for i, ev in enumerate(self.events)
+            if ev.kind == "partition" and ev.start <= r < ev.end
+        )
+
+    def _link_rects(self):
+        """Directed link-event rectangles in merge order: (event,
+        src_range, dst_range), with symmetric partitions expanded into
+        their reversed twin — exactly the pair stream `_pairs` yields,
+        lifted to ranges."""
+        rects = []
+        for ev in self.events:
+            if ev.kind in ("crash", "clock_skew"):
+                continue
+            sr = sel_indices(ev.src, self.n_nodes)
+            dr = sel_indices(ev.dst, self.n_nodes)
+            rects.append((ev, sr, dr))
+            if ev.kind == "partition" and ev.symmetric:
+                rects.append((ev, dr, sr))
+        return rects
+
+    def range_link_epochs(self):
+        """Range-level twin of `link_epochs` (ISSUE 7 satellite): the
+        plan's link parameter-change timeline, grouped into **atoms** —
+        (src_range, dst_range, [(round, LinkFault), ...]) rectangles
+        over the interval partition induced by every event's selector
+        boundaries.  Within an atom every s ≠ d pair has the IDENTICAL
+        change list (an event's rectangle is a union of atoms by
+        construction), so a driver walks O(atoms · horizon) instead of
+        O(pairs · horizon) and only touches per-edge state at install
+        time — what lets host-tier parity replay a storm-shaped
+        ``"lo:hi"`` `FactoredFaultPlan` without expanding 2.5e9 pairs.
+        Epoch indices and parameters match the pairwise walk exactly
+        (tests/cluster/test_fault_parity.py pins it), so the installed
+        ``derive_seed(seed, "link", src, dst, epoch)`` streams are
+        byte-identical."""
+        rects = self._link_rects()
+        if not rects:
+            return []
+        n = self.n_nodes
+        src_b, dst_b = set(), set()
+        for _, sr, dr in rects:
+            src_b.update((sr.start, sr.stop))
+            dst_b.update((dr.start, dr.stop))
+        src_iv = sorted(src_b)
+        dst_iv = sorted(dst_b)
+        atoms = []
+        for s_lo, s_hi in zip(src_iv, src_iv[1:]):
+            for d_lo, d_hi in zip(dst_iv, dst_iv[1:]):
+                cover = [
+                    ev
+                    for ev, sr, dr in rects
+                    if sr.start <= s_lo
+                    and s_hi <= sr.stop
+                    and dr.start <= d_lo
+                    and d_hi <= dr.stop
+                ]
+                if not cover:
+                    continue
+                changes: List[Tuple[int, LinkFault]] = []
+                prev = CLEAR
+                for r in range(self.horizon + 1):
+                    cur = CLEAR
+                    for ev in cover:
+                        if ev.start <= r < ev.end:
+                            cur = cur.merge(_event_link_fault(ev))
+                    if cur != prev:
+                        changes.append((r, cur))
+                        prev = cur
+                if changes:
+                    atoms.append(
+                        (range(s_lo, s_hi), range(d_lo, d_hi), changes)
+                    )
+        return atoms
 
     def schedule(self) -> List[RoundSchedule]:
         """Every round of the plan, rounds ``0..horizon`` inclusive (the
@@ -336,6 +478,34 @@ def advance_link_epochs(
             epoch_idx[pair] = idx
 
 
+def advance_range_epochs(
+    atoms,
+    epoch_idx: Dict[int, int],
+    r: int,
+    install,
+) -> None:
+    """Range-atom twin of `advance_link_epochs` (ISSUE 7 satellite):
+    walk each atom of `FaultPlan.range_link_epochs` up to round ``r``,
+    calling ``install(src, dst, epoch_index, params)`` for every s ≠ d
+    edge in the atom at each boundary crossed.  Per-edge work happens
+    only AT install boundaries (where it is irreducible — the network
+    keys LinkModels per edge); the schedule walk itself is O(atoms).
+    Every pair in an atom shares one change timeline by construction,
+    so the ``epoch_index`` handed to ``install`` — the one drivers fold
+    into ``derive_seed(seed, "link", src, dst, epoch)`` — is exactly
+    what the pairwise walk would have produced."""
+    for a, (src_r, dst_r, changes) in enumerate(atoms):
+        idx = epoch_idx.get(a, 0)
+        while idx < len(changes) and changes[idx][0] <= r:
+            _, params = changes[idx]
+            for s in src_r:
+                for d in dst_r:
+                    if s != d:
+                        install(s, d, idx, params)
+            idx += 1
+            epoch_idx[a] = idx
+
+
 class CampaignCoverage:
     """Scoped `sometimes` coverage over one campaign: snapshot the pass
     counters at entry, and :meth:`assert_covered` demands every expected
@@ -405,8 +575,12 @@ class HostFaultDriver:
         self.cluster = cluster
         self.catalog = catalog
         self.round = -1
-        self._epochs = plan.link_epochs()
-        self._epoch_idx: Dict[Tuple[int, int], int] = {}
+        # range atoms, not pairwise link_epochs (ISSUE 7 satellite):
+        # a storm-shaped "lo:hi" plan walks O(atoms · horizon), and
+        # per-edge LinkModels are only materialized at install time
+        self._atoms = plan.range_link_epochs()
+        self._epoch_idx: Dict[int, int] = {}
+        self._partition_epoch = None  # last applied partition-event set
         self._skewed: Dict[int, object] = {}  # node -> original _now_ns
         self._skew_offset: Dict[int, int] = {}  # node -> installed offset
         self.log: List[Tuple[int, str, object]] = []  # (round, action, detail)
@@ -422,7 +596,8 @@ class HostFaultDriver:
         from .agent.transport import LinkModel
 
         plan, net = self.plan, self.cluster.net
-        sched = plan.schedule_at(r)
+        # node faults only — link state rides the range atoms below
+        sched = plan.schedule_at(r, include_links=False)
 
         # -- link faults: (re)install LinkModels at epoch boundaries
         def install(src, dst, idx, params):
@@ -442,18 +617,23 @@ class HostFaultDriver:
                 )
             self.log.append((r, "link", ((src, dst), idx, params)))
 
-        advance_link_epochs(self._epochs, self._epoch_idx, r, install)
+        advance_range_epochs(self._atoms, self._epoch_idx, r, install)
 
         # -- coverage markers for whatever is active this round
-        for kind in sched.active_kinds():
+        for kind in plan.active_kinds_at(r):
             self._mark(kind)
 
-        # -- partitions: the driver owns the directed blocked-edge set
-        net.partitioned = {
-            (self._addr(s), self._addr(d))
-            for (s, d), f in sched.links.items()
-            if f.blocked
-        }
+        # -- partitions: the driver owns the directed blocked-edge set,
+        # rebuilt only when the ACTIVE partition-event set changes (the
+        # pair expansion is the one irreducibly per-edge cost — never
+        # pay it for a round whose partitions are unchanged)
+        pepoch = plan.partition_epoch(r)
+        if pepoch != self._partition_epoch:
+            self._partition_epoch = pepoch
+            net.partitioned = {
+                (self._addr(s), self._addr(d))
+                for s, d in plan.blocked_pairs_at(r)
+            }
 
         # -- crash / restart / wipe
         for i in sorted(sched.down):
@@ -558,8 +738,10 @@ class RealSocketFaultDriver:
         self.addrs = list(addrs)
         self.catalog = catalog
         self.round = -1
-        self._epochs = plan.link_epochs()
-        self._epoch_idx: Dict[Tuple[int, int], int] = {}
+        # range atoms (ISSUE 7 satellite; see HostFaultDriver)
+        self._atoms = plan.range_link_epochs()
+        self._epoch_idx: Dict[int, int] = {}
+        self._partition_epoch = None  # last applied partition-event set
         self.injectors = []
         for t in self.transports:
             fi = FaultInjector()
@@ -574,11 +756,10 @@ class RealSocketFaultDriver:
         from .agent.transport import LinkModel
 
         plan = self.plan
-        sched = plan.schedule_at(r)
 
         # -- link faults: (re)install per-dst LinkModels at epoch bounds
-        # (the SAME advance_link_epochs walk as HostFaultDriver — the
-        # epoch index it hands us is the cross-tier seed-parity anchor)
+        # (the SAME range-atom walk as HostFaultDriver — the epoch index
+        # it hands us is the cross-tier seed-parity anchor)
         def install(src, dst, idx, params):
             inj = self.injectors[src]
             if params == CLEAR:
@@ -593,18 +774,21 @@ class RealSocketFaultDriver:
                 )
             self.log.append((r, "link", ((src, dst), idx, params)))
 
-        advance_link_epochs(self._epochs, self._epoch_idx, r, install)
+        advance_range_epochs(self._atoms, self._epoch_idx, r, install)
 
-        # -- partitions: per-src egress blocked sets
-        blocked: Dict[int, set] = {}
-        for (s, d), f in sched.links.items():
-            if f.blocked:
+        # -- partitions: per-src egress blocked sets, rebuilt only at
+        # partition-epoch boundaries (see HostFaultDriver.apply_round)
+        pepoch = plan.partition_epoch(r)
+        if pepoch != self._partition_epoch:
+            self._partition_epoch = pepoch
+            blocked: Dict[int, set] = {}
+            for s, d in plan.blocked_pairs_at(r):
                 blocked.setdefault(s, set()).add(self.addrs[d])
-        for i, inj in enumerate(self.injectors):
-            inj.set_partition(blocked.get(i, set()))
+            for i, inj in enumerate(self.injectors):
+                inj.set_partition(blocked.get(i, set()))
 
         # -- coverage markers for the kinds this seam can express
-        for kind in sched.active_kinds():
+        for kind in plan.active_kinds_at(r):
             if kind in REALSOCKET_KINDS:
                 self.catalog.sometimes(True, f"fault-{kind}-active")
 

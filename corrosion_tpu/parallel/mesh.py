@@ -1,4 +1,4 @@
-"""Device-mesh sharding for the simulator.
+"""Device-mesh sharding for the simulator: dense AND packed envelopes.
 
 The cluster's node axis is the parallel axis (SURVEY §2.3: full-state
 replication ⇒ node-major sharded state matrix): every SimState array is
@@ -6,6 +6,38 @@ sharded on its node dimension across a 1-D ``nodes`` mesh, payload metadata
 is replicated, and XLA/GSPMD inserts the collectives for the cross-shard
 scatters (fan-out targets land on other shards' rows — the ICI all-to-all
 the north star describes).
+
+Since ISSUE 7 the sharding layer covers the BITPACK envelope too — the
+only path that reaches 100k+ nodes (`sim/packed.py`):
+
+- `packed_carry_shardings` splits the NODE axis of the u32-word carry
+  (``have[N, W]``, the four bitsliced relay planes, the packed sync
+  ring) and of the dense u8 broadcast ring.  The payload-WORD axis is
+  never split, so shard boundaries are word-aligned by construction and
+  every word-local kernel (`pack_bits`, the `_fold_*` group folds,
+  `group_grid`, `budget_prefix_words`) runs entirely inside its shard;
+- `fault_plan_shardings` keeps the `FactoredFaultPlan` rank-1 node
+  masks (``*_src``/``*_dst``/``alive``/``wipe``) sharded WITH their
+  nodes, so a 1M-node fault tensor never materializes replicated;
+- `constrain_replicated` pins the `RoundTrace` [R_max, ·]
+  flight-recorder buffers REPLICATED inside the telemetry loop bodies:
+  every telemetry channel is the result of a cross-shard fold
+  (psum-style — see doc/sharding.md "collective folds"), so replication
+  is the correct (and only safe) layout — a node-split trace row would
+  silently record one shard's partial sums;
+- `constrain_packed` / `constrain_replicated` re-pin the layouts inside
+  the jitted while_loops (`run_packed` / `run_packed_faults`), so GSPMD
+  keeps the node split stable across rounds instead of re-deriving it
+  per iteration.
+
+The per-round reductions — the convergence AND-fold over nodes, the
+`all_have_words` exit predicate, wire-byte and telemetry counter sums —
+reduce over the sharded node axis, which GSPMD lowers to all-reduces.
+Swing/Flare (PAPERS.md) teach that on a 1-D ring the bandwidth-optimal
+schedule for these small folds is the latency-bound one — exactly what
+XLA emits for scalar/[P]-sized all-reduces — so no hand-written
+collective is needed; the layout's job is to keep the reduced operands
+node-split (cheap partial sums per shard) and the results replicated.
 
 No hand-written shard_map: the round step is pure gather/scatter/elementwise,
 exactly the op mix GSPMD partitions well.  `dryrun_multichip` in
@@ -70,3 +102,169 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 def replicate_meta(meta: PayloadMeta, mesh: Mesh) -> PayloadMeta:
     r = NamedSharding(mesh, P())
     return jax.tree.map(lambda a: jax.device_put(a, r), meta)
+
+
+# -- packed envelope (ISSUE 7) ----------------------------------------------
+
+
+def packed_carry_shardings(mesh: Mesh):
+    """A PackedCarry-shaped pytree of NamedShardings: the NODE axis of
+    every carry tensor is split, the payload-word axis never is — shard
+    boundaries land between node rows, so they are word-aligned by
+    construction and `pack_bits`/`_fold_*`/`group_grid` stay local to
+    their shard (the module-doc invariant)."""
+    from ..sim.packed import PackedCarry, Planes
+
+    n0w = NamedSharding(mesh, P(NODE_AXIS, None))     # u32[N, W]
+    dnp = NamedSharding(mesh, P(None, NODE_AXIS, None))  # [D, N, P]
+    return PackedCarry(
+        have=n0w,
+        inflight=dnp,
+        relay=Planes(n0w, n0w, n0w, n0w),
+        sync_buf=dnp,
+    )
+
+
+def fault_plan_shardings(fplan, mesh: Mesh):
+    """A pytree of NamedShardings matching ``fplan``: the
+    `FactoredFaultPlan` rank-1 node masks and the [R+1, N] alive/wipe
+    schedules shard WITH their nodes (a 1M-node plan's fault tensors
+    must never sit replicated on every device); the tiny per-factor
+    active/threshold vectors replicate.  The matrix `SimFaultPlan` form
+    (only compiled below `FACTORED_MIN_NODES`) replicates whole — its
+    [R+1, N, N] slabs are gathered by BOTH endpoints of an edge, so at
+    sub-1024-node scale replication is cheaper than the two-sided
+    collective a split would force."""
+    from ..sim.faults import FactoredFaultPlan
+
+    r = NamedSharding(mesh, P())
+    if not isinstance(fplan, FactoredFaultPlan):
+        return jax.tree.map(lambda _: r, fplan)
+    rn = NamedSharding(mesh, P(None, NODE_AXIS))  # [R+1, N] / [K, N]
+    return FactoredFaultPlan(
+        alive=rn, wipe=rn, seed=r,
+        block_active=r, block_src=rn, block_dst=rn,
+        loss_active=r, loss_src=rn, loss_dst=rn, loss_thr=r,
+        delay_active=r, delay_src=rn, delay_dst=rn, delay_rounds=r,
+        jitter_active=r, jitter_src=rn, jitter_dst=rn, jitter_rounds=r,
+    )
+
+
+def shard_fault_plan(fplan, mesh: Mesh):
+    """Place a compiled fault plan onto the mesh (fault rows sharded
+    with their nodes; see `fault_plan_shardings`)."""
+    return jax.tree.map(jax.device_put, fplan, fault_plan_shardings(fplan, mesh))
+
+
+def place_run(state: SimState, meta: PayloadMeta, fplan, mesh: Optional[Mesh]):
+    """Mesh-place one run's inputs (identity when ``mesh`` is None):
+    state node-split, metadata replicated, compiled fault plan (or
+    None) riding its `fault_plan_shardings` — the ONE placement rule
+    every sharded entry point shares (runner rungs, perf microbench,
+    the graft dryrun; `campaign.ensemble.place_ensemble` is the stacked
+    [K, ...] twin)."""
+    if mesh is None:
+        return state, meta, fplan
+    state = shard_state(state, mesh)
+    meta = replicate_meta(meta, mesh)
+    if fplan is not None:
+        fplan = shard_fault_plan(fplan, mesh)
+    return state, meta, fplan
+
+
+def constrain_packed(carry, mesh: Optional[Mesh]):
+    """Re-pin the packed carry's node-split layout inside a jitted loop
+    (identity when ``mesh`` is None — the single-device and vmapped
+    ensemble paths compile exactly as before)."""
+    if mesh is None:
+        return carry
+    return jax.lax.with_sharding_constraint(
+        carry, packed_carry_shardings(mesh)
+    )
+
+
+def constrain_metrics(metrics: RunMetrics, mesh: Optional[Mesh]) -> RunMetrics:
+    """Pin RunMetrics layouts inside a jitted loop: per-node
+    ``converged_at`` sharded with its nodes, the per-payload and scalar
+    channels replicated (they are cross-shard fold results)."""
+    if mesh is None:
+        return metrics
+    return jax.lax.with_sharding_constraint(metrics, metrics_shardings(mesh))
+
+
+def constrain_replicated(tree, mesh: Optional[Mesh]):
+    """Pin a pytree replicated — the layout of every cross-shard fold
+    result (metrics, trace rows, exit predicates)."""
+    if mesh is None:
+        return tree
+    r = NamedSharding(mesh, P())
+    return jax.lax.with_sharding_constraint(
+        tree, jax.tree.map(lambda _: r, tree)
+    )
+
+
+# -- mesh × lane batching (vmapped seed ensembles over a sharded node axis) --
+
+
+def _with_lane_axis(sharding_tree):
+    """Prepend an UNsharded lane axis to every spec: ensemble lanes are
+    batch-replicated across the mesh while the node axis stays split —
+    the mesh × lane layout campaign cells run under."""
+
+    def lane(sh: NamedSharding) -> NamedSharding:
+        return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+    return jax.tree.map(lane, sharding_tree)
+
+
+def shard_ensemble_states(states: SimState, mesh: Mesh) -> SimState:
+    """Place stacked [K, ...] ensemble states: node axis split, lane
+    axis whole (mesh × lane batching)."""
+    sh = _with_lane_axis(state_shardings(mesh, states.view.size > 0))
+    return jax.tree.map(jax.device_put, states, sh)
+
+
+def padded_node_count(n_nodes: int, n_devices: int) -> int:
+    """Smallest multiple of ``n_devices`` ≥ ``n_nodes``: explicit
+    NamedSharding placement requires the sharded axis to divide evenly
+    (this JAX rejects uneven shards at device_put/out_shardings), so a
+    non-divisible cluster pads its node axis up and marks the tail
+    permanently DOWN (`down_padding`)."""
+    return -(-int(n_nodes) // int(n_devices)) * int(n_devices)
+
+
+def down_padding(state: SimState, n_real: int) -> SimState:
+    """Mark every node row ≥ ``n_real`` permanently DOWN — the padding
+    members a non-divisible cluster carries so its node axis divides the
+    mesh.  DOWN rows are excluded from every coverage/convergence fold
+    by the existing up-mask algebra (the same masks that exclude crashed
+    nodes), so padding can never leak into coverage counts — pinned by
+    tests/sim/test_packed_sharded.py."""
+    from ..sim.state import DOWN
+
+    idx = jnp.arange(state.alive.shape[0])
+    return state._replace(
+        alive=jnp.where(
+            idx >= n_real, jnp.asarray(DOWN, state.alive.dtype), state.alive
+        )
+    )
+
+
+def mesh_size(mesh: Optional[Mesh]) -> int:
+    """Device count of a (possibly absent) mesh — the ONE derivation
+    the bench records, `verify_wall` floors, and campaign artifacts
+    share (None = unsharded = 1)."""
+    if mesh is None:
+        return 1
+    return int(len(mesh.devices.flat))
+
+
+def mesh_record(mesh: Optional[Mesh]):
+    """The artifact/bench description of a mesh: JSON-friendly shape."""
+    if mesh is None:
+        return None
+    return {
+        "axes": {k: int(v) for k, v in mesh.shape.items()},
+        "n_devices": mesh_size(mesh),
+        "platform": mesh.devices.flat[0].platform,
+    }

@@ -43,6 +43,7 @@ from ..core.types import Change, SqliteValue
 # SQLite authorizer action code for column reads
 _SQLITE_READ = 20
 
+
 _KEYED_BREAKERS = re.compile(
     r"(?i)\b(distinct|group|union|intersect|except|limit|having|window)\b"
 )
@@ -302,13 +303,65 @@ class Matcher:
                 seen.add(a1)
             return sqlite3.SQLITE_OK
 
-        self.main.set_authorizer(auth)
+        # discovery runs on a THROWAWAY in-memory clone of main's
+        # schema, never on the shared connection: (a) on some CPython
+        # 3.10 sqlite3 builds set_authorizer(None) fails to clear and
+        # leaves a DENY-ALL hook, poisoning every later op on the
+        # connection ("not authorized" from the db-maintenance PRAGMAs);
+        # (b) any Python authorizer left installed is invoked from the
+        # maintenance executor thread's long PRAGMAs and deadlocks
+        # against the GIL (main thread holds GIL, waits db mutex;
+        # checkpoint thread holds db mutex, waits GIL).  The scratch
+        # connection is private and closed immediately, so neither
+        # failure mode can reach the live connection.
+        scratch = sqlite3.connect(":memory:")
         try:
-            self.main.execute("EXPLAIN " + self.sql, self.params).fetchone()
-        except sqlite3.Error as e:
-            raise MatcherError(f"invalid query: {e}") from e
+            # custom SQL functions registered on main (crdt_*,
+            # corro_json_contains, …) must EXIST on scratch or a valid
+            # subscription using one fails to compile; no-arg-checking
+            # stubs suffice — EXPLAIN never executes them.  Registered
+            # BEFORE the schema replay: a GENERATED column may reference
+            # a custom function in its table's DDL
+            try:
+                have = {
+                    (name, narg)
+                    for name, narg in scratch.execute(
+                        "SELECT name, narg FROM pragma_function_list"
+                    )
+                }
+                for name, narg in self.main.execute(
+                    "SELECT DISTINCT name, narg FROM pragma_function_list"
+                ):
+                    if (name, narg) not in have:
+                        # deterministic: generated-column DDL rejects
+                        # non-deterministic functions at CREATE time
+                        scratch.create_function(
+                            name, narg, lambda *a: None,
+                            deterministic=True,
+                        )
+            except sqlite3.Error:
+                # pragma_function_list missing (ancient sqlite): fall
+                # back to compiling without stubs — only subscriptions
+                # using custom functions regress, loudly
+                pass
+            for (ddl,) in self.main.execute(
+                "SELECT sql FROM sqlite_master WHERE sql IS NOT NULL"
+            ):
+                try:
+                    scratch.execute(ddl)
+                except sqlite3.Error:
+                    # internal/auto indexes etc. — discovery only needs
+                    # enough schema for the query to COMPILE
+                    pass
+            scratch.set_authorizer(auth)
+            try:
+                scratch.execute(
+                    "EXPLAIN " + self.sql, self.params
+                ).fetchone()
+            except sqlite3.Error as e:
+                raise MatcherError(f"invalid query: {e}") from e
         finally:
-            self.main.set_authorizer(None)
+            scratch.close()
         return seen
 
     def _plan_keyed(self) -> bool:

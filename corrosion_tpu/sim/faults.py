@@ -267,12 +267,14 @@ def fault_wire_effects(faults, key, src, dst, n_payloads, ok, drop, delay):
         ok = ok & ~blk
     thr = fault_edge_loss(faults, src, dst)  # u8[E] | None
     if thr is not None:
+        from .topology import aligned_u8_bits
+
         k_floss = jax.random.fold_in(
             jax.random.fold_in(key, faults.seed), 101
         )
-        fbits = jax.random.bits(
-            k_floss, (src.shape[0], n_payloads), dtype=jnp.uint8
-        )
+        # aligned draw (ISSUE 7): byte-identical to the raw u8 draw at
+        # every 128-aligned [E, P] (all storm shapes); shard-safe always
+        fbits = aligned_u8_bits(k_floss, (src.shape[0], n_payloads))
         drop = drop | (fbits < thr[:, None])
     fdelay = fault_edge_delay(faults, src, dst)  # i32[E] | None
     if fdelay is not None:
@@ -640,7 +642,7 @@ def _all_have(state: SimState, meta: PayloadMeta, cfg: SimConfig) -> jnp.ndarray
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "topo", "max_rounds", "telemetry")
+    jax.jit, static_argnames=("cfg", "topo", "max_rounds", "telemetry", "mesh")
 )
 def run_fault_plan(
     state: SimState,
@@ -650,6 +652,7 @@ def run_fault_plan(
     fplan,
     max_rounds: int = 1000,
     telemetry: bool = False,
+    mesh=None,
 ):
     """Advance rounds under the fault schedule until the cluster holds
     every payload AND the schedule is exhausted (a plan may crash a node
@@ -663,46 +666,64 @@ def run_fault_plan(
     ``telemetry=True`` (static) threads a `telemetry.RoundTrace` through
     the loop — including the fault-seam crash/wipe channels — and
     returns (state, metrics, trace); False compiles to exactly the
-    pre-telemetry program."""
+    pre-telemetry program.
+
+    ``mesh`` (static) shards the node axis across a 1-D ``nodes`` mesh
+    (ISSUE 7): callers place state with `parallel.mesh.shard_state` and
+    the compiled plan with `parallel.mesh.shard_fault_plan`; the packed
+    loop re-pins the word-carry layout per round.  Bit-identical to
+    single-device (tests/sim/test_packed_sharded.py)."""
     from .packed import packed_supported, run_packed_faults
 
     if packed_supported(cfg, topo):
         return run_packed_faults(
-            state, meta, cfg, topo, fplan, max_rounds, telemetry
+            state, meta, cfg, topo, fplan, max_rounds, telemetry, mesh=mesh
         )
     region = regions(cfg.n_nodes, topo.n_regions)
     metrics = new_metrics(cfg)
     horizon = fplan.alive.shape[0] - 1  # static
 
-    def cond(carry):
-        state = carry[0]
-        done = (state.t >= horizon) & _all_have(state, meta, cfg)
-        return (state.t < max_rounds) & ~done
+    def _done(state):
+        return (state.t >= horizon) & _all_have(state, meta, cfg)
 
+    def cond(carry):
+        return (carry[0].t < max_rounds) & ~carry[2]
+
+    # per-lane done flag in the carry (ISSUE 7 satellite; see
+    # round.run_to_convergence): O(1) cond, frozen converged lanes
     if telemetry:
         from .telemetry import new_trace, record_node_faults
 
         def body(carry):
-            state, metrics, trace = carry
+            state, metrics, _, trace = carry
             rf = round_faults(fplan, state.t)
-            trace = record_node_faults(trace, state.t, rf)
+            trace = record_node_faults(trace, state.t, rf, every=cfg.trace_every)
             state = apply_node_faults(state, rf)
-            return round_step(
+            state, metrics, trace = round_step(
                 state, metrics, meta, cfg, topo, region, faults=rf,
                 trace=trace,
             )
+            return state, metrics, _done(state), trace
 
-        return jax.lax.while_loop(
-            cond, body, (state, metrics, new_trace(cfg, max_rounds))
+        state, metrics, _, trace = jax.lax.while_loop(
+            cond, body,
+            (state, metrics, _done(state), new_trace(cfg, max_rounds)),
         )
+        return state, metrics, trace
 
     def body(carry):
-        state, metrics = carry
+        state, metrics, _ = carry
         rf = round_faults(fplan, state.t)
         state = apply_node_faults(state, rf)
-        return round_step(state, metrics, meta, cfg, topo, region, faults=rf)
+        state, metrics = round_step(
+            state, metrics, meta, cfg, topo, region, faults=rf
+        )
+        return state, metrics, _done(state)
 
-    return jax.lax.while_loop(cond, body, (state, metrics))
+    state, metrics, _ = jax.lax.while_loop(
+        cond, body, (state, metrics, _done(state))
+    )
+    return state, metrics
 
 
 def run_fault_plan_checked(

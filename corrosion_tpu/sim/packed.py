@@ -370,6 +370,7 @@ def broadcast_packed(
     meta: PayloadMeta,
     faults=None,
     telem: bool = False,
+    done=None,
 ):
     n = cfg.n_nodes
     f = cfg.fanout
@@ -381,6 +382,13 @@ def broadcast_packed(
     sending = budget_prefix_words(
         eligible, cfg.rate_limit_bytes_round, meta.nbytes
     )
+    if done is not None:
+        # per-lane early-exit gate (ISSUE 7 satellite): a converged
+        # lane's scatter work is pure waste — its carry is select-frozen
+        # by the batched while_loop anyway, so zeroing the send set is
+        # unobservable (and in solo runs the loop's cond guarantees the
+        # body never executes with done=True, making this an identity)
+        sending = jnp.where(done, U32(0), sending)
 
     targets = sample_member_targets(state, cfg, k_targets, f)  # [N, F]
     if cfg.ring0_first and topo.n_regions > 1:
@@ -593,6 +601,7 @@ def packed_round_step(
     region: jnp.ndarray,
     faults=None,
     trace=None,
+    done=None,
 ):
     """One gossip tick on packed words — phase-for-phase and PRNG-stream
     identical to `round.round_step` (inject → broadcast → sync → deliver →
@@ -604,7 +613,16 @@ def packed_round_step(
     ``trace`` (a `telemetry.RoundTrace`, or None) mirrors the dense
     round's flight-recorder seam: same channels, same values (integer
     counts of the same sets; byte channels fold identically-shaped
-    per-edge totals), appended to the return when given."""
+    per-edge totals), appended to the return when given.
+
+    ``done`` (a per-lane bool scalar, or None) is the vmapped-ensemble
+    early-exit gate: a lane whose flag is set sends and pulls nothing
+    (broadcast `sending` and sync `due` zeroed).  Metrics stay
+    byte-identical — in solo runs the loop cond guarantees the body
+    never executes with done=True, and in batched loops a done lane's
+    carry is select-frozen, so the gated body's output is discarded.
+    RNG draws are untouched either way (the gate masks AFTER the
+    draws), so the PRNG stream cannot shift."""
     from .gaps import extract_gaps
     from .round import RunMetrics
     from .state import version_heads
@@ -619,23 +637,24 @@ def packed_round_step(
     if trace is None:
         carry = broadcast_packed(
             carry, injected_p, state, cfg, topo, region, k_bcast, meta,
-            faults,
+            faults, done=done,
         )
     else:
         carry, wire = broadcast_packed(
             carry, injected_p, state, cfg, topo, region, k_bcast, meta,
-            faults, telem=True,
+            faults, telem=True, done=done,
         )
     # sync writes ring slots t+1.., deliver pops slot t: no ordering
     # hazard (round.round_step's contract; compile_plan validated
     # 1 + fault delay < n_delay_slots)
     if trace is None:
         carry, countdown, backoff = sync_packed(
-            carry, state, cfg, topo, k_sync, meta, faults
+            carry, state, cfg, topo, k_sync, meta, faults, done=done
         )
     else:
         carry, countdown, backoff, stel = sync_packed(
-            carry, state, cfg, topo, k_sync, meta, faults, telem=True
+            carry, state, cfg, topo, k_sync, meta, faults, telem=True,
+            done=done,
         )
     state = state._replace(sync_countdown=countdown, sync_backoff=backoff)
     carry = deliver_packed(carry, state.t, cfg)
@@ -713,11 +732,52 @@ def packed_round_step(
             swim_suspect=susp,
             swim_down=dn,
             gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
+            every=cfg.trace_every,
         )
     state = state._replace(t=state.t + 1)
     if trace is not None:
         return state, carry, injected_p, out_metrics, trace
     return state, carry, injected_p, out_metrics
+
+
+def _converged_done(slim: SimState, metrics, meta: PayloadMeta) -> jnp.ndarray:
+    """The convergence exit predicate, as a carried per-lane flag: every
+    payload injected and every up node converged.  Computed ONCE at the
+    end of each round body (on the fresh metrics) instead of re-scanned
+    in the while cond — under vmap this is what lets a converged lane's
+    next-round work be gated off (the `done` seam in
+    `packed_round_step`) while the cond check itself is O(1)."""
+    all_injected = jnp.all(meta.round <= slim.t)
+    return all_injected & jnp.all(
+        (metrics.converged_at >= 0) | (slim.alive != ALIVE)
+    )
+
+
+def _pin(mesh, slim, carry, metrics, trace=None):
+    """Re-pin the loop carry's sharded layout each round (identity when
+    ``mesh`` is None): packed carry node-split, metrics per their
+    `metrics_shardings` (converged_at with its nodes, fold results
+    replicated), and the flight-recorder buffers REPLICATED (every
+    trace channel is a finished cross-shard fold — a node-split row
+    would hold one shard's partial sums), so GSPMD keeps one stable
+    layout across the whole while_loop instead of re-deriving it per
+    iteration."""
+    if mesh is None:
+        return slim, carry, metrics, trace
+    from ..parallel.mesh import (
+        constrain_metrics,
+        constrain_packed,
+        constrain_replicated,
+    )
+
+    if trace is not None:
+        trace = constrain_replicated(trace, mesh)
+    return (
+        slim,
+        constrain_packed(carry, mesh),
+        constrain_metrics(metrics, mesh),
+        trace,
+    )
 
 
 def run_packed(
@@ -727,12 +787,20 @@ def run_packed(
     topo: Topology,
     max_rounds: int,
     telemetry: bool = False,
+    mesh=None,
 ):
     """Packed-carry `run_to_convergence` body: pack once, loop on u32
     words, unpack once at the end.  Returns the same (SimState,
     RunMetrics[, RoundTrace]) as the dense loop — bit-identical over the
     supported envelope.  Called from round.run_to_convergence under jit
-    when `packed_supported(cfg, topo)`; not jitted itself."""
+    when `packed_supported(cfg, topo)`; not jitted itself.
+
+    ``mesh`` (a 1-D ``nodes`` `jax.sharding.Mesh`, or None) shards the
+    node axis of the packed carry across the mesh: the carry layout is
+    re-pinned every round (`parallel.mesh.constrain_packed`) so GSPMD
+    partitions the gossip scatter/gather while the per-round convergence
+    folds become cross-shard all-reduces.  Bit-identical to the
+    single-device run (tests/sim/test_packed_sharded.py)."""
     from .round import new_metrics
     from .topology import regions
 
@@ -741,38 +809,42 @@ def run_packed(
     carry0 = pack_state(state, cfg)
     injected0 = pack_bits(state.injected)
     slim = shrink_state(state)
+    slim, carry0, metrics, _ = _pin(mesh, slim, carry0, metrics)
+    done0 = _converged_done(slim, metrics, meta)
 
     def cond(c):
-        s, m = c[0], c[3]
-        all_injected = jnp.all(meta.round <= s.t)
-        done = all_injected & jnp.all(
-            (m.converged_at >= 0) | (s.alive != ALIVE)
-        )
+        s, done = c[0], c[4]
         return (s.t < max_rounds) & ~done
 
     if telemetry:
         from .telemetry import new_trace
 
         def body(c):
-            s, carry, inj, m, trace = c
-            return packed_round_step(
-                s, carry, inj, m, meta, cfg, topo, region, trace=trace
+            s, carry, inj, m, done, trace = c
+            s, carry, inj, m, trace = packed_round_step(
+                s, carry, inj, m, meta, cfg, topo, region, trace=trace,
+                done=done,
             )
+            s, carry, m, trace = _pin(mesh, s, carry, m, trace)
+            return s, carry, inj, m, _converged_done(s, m, meta), trace
 
-        slim, carry, inj, metrics, trace = jax.lax.while_loop(
+        slim, carry, inj, metrics, _, trace = jax.lax.while_loop(
             cond, body,
-            (slim, carry0, injected0, metrics, new_trace(cfg, max_rounds)),
+            (slim, carry0, injected0, metrics, done0,
+             new_trace(cfg, max_rounds)),
         )
     else:
 
         def body(c):
-            s, carry, inj, m = c
-            return packed_round_step(
-                s, carry, inj, m, meta, cfg, topo, region
+            s, carry, inj, m, done = c
+            s, carry, inj, m = packed_round_step(
+                s, carry, inj, m, meta, cfg, topo, region, done=done
             )
+            s, carry, m, _ = _pin(mesh, s, carry, m)
+            return s, carry, inj, m, _converged_done(s, m, meta)
 
-        slim, carry, inj, metrics = jax.lax.while_loop(
-            cond, body, (slim, carry0, injected0, metrics)
+        slim, carry, inj, metrics, _ = jax.lax.while_loop(
+            cond, body, (slim, carry0, injected0, metrics, done0)
         )
     full = unpack_into_state(carry, slim, cfg)
     full = full._replace(
@@ -832,6 +904,7 @@ def run_packed_faults(
     fplan,
     max_rounds: int,
     telemetry: bool = False,
+    mesh=None,
 ):
     """Packed-carry `run_fault_plan` body: the fault schedule drives the
     u32-word round loop — pack once, apply each round's node faults to
@@ -839,7 +912,12 @@ def run_packed_faults(
     (payload words), unpack once at the end.  Same exit rule as the
     dense loop: never before the schedule's horizon (a plan may crash a
     node after convergence), then the fresh all-have predicate.  Called
-    from `faults.run_fault_plan` under jit when `packed_supported`."""
+    from `faults.run_fault_plan` under jit when `packed_supported`.
+
+    ``mesh`` shards the node axis exactly as in `run_packed`; callers
+    place the compiled plan with `parallel.mesh.shard_fault_plan` so the
+    rank-1 fault masks ride sharded with their node rows and the
+    all-have exit fold is a cross-shard all-reduce."""
     from .faults import apply_node_faults, round_faults
     from .round import new_metrics
     from .topology import regions
@@ -849,44 +927,57 @@ def run_packed_faults(
     carry0 = pack_state(state, cfg)
     injected0 = pack_bits(state.injected)
     slim = shrink_state(state)
+    slim, carry0, metrics, _ = _pin(mesh, slim, carry0, metrics)
     horizon = fplan.alive.shape[0] - 1  # static
 
+    def _fault_done(s, carry, inj):
+        # never before the horizon, then the FRESH all-have predicate
+        # (sticky metrics must not mask a post-convergence wipe)
+        return (s.t >= horizon) & all_have_words(carry, inj, s, meta, cfg)
+
+    done0 = _fault_done(slim, carry0, injected0)
+
     def cond(c):
-        s, carry, inj = c[0], c[1], c[2]
-        done = (s.t >= horizon) & all_have_words(carry, inj, s, meta, cfg)
+        s, done = c[0], c[4]
         return (s.t < max_rounds) & ~done
 
     if telemetry:
         from .telemetry import new_trace, record_node_faults
 
         def body(c):
-            s, carry, inj, m, trace = c
+            s, carry, inj, m, done, trace = c
             rf = round_faults(fplan, s.t)
-            trace = record_node_faults(trace, s.t, rf)
+            trace = record_node_faults(trace, s.t, rf, every=cfg.trace_every)
             s = apply_node_faults(s, rf)
             carry = apply_carry_faults(carry, rf)
-            return packed_round_step(
+            s, carry, inj, m, trace = packed_round_step(
                 s, carry, inj, m, meta, cfg, topo, region, faults=rf,
-                trace=trace,
+                trace=trace, done=done,
             )
+            s, carry, m, trace = _pin(mesh, s, carry, m, trace)
+            return s, carry, inj, m, _fault_done(s, carry, inj), trace
 
-        slim, carry, inj, metrics, trace = jax.lax.while_loop(
+        slim, carry, inj, metrics, _, trace = jax.lax.while_loop(
             cond, body,
-            (slim, carry0, injected0, metrics, new_trace(cfg, max_rounds)),
+            (slim, carry0, injected0, metrics, done0,
+             new_trace(cfg, max_rounds)),
         )
     else:
 
         def body(c):
-            s, carry, inj, m = c
+            s, carry, inj, m, done = c
             rf = round_faults(fplan, s.t)
             s = apply_node_faults(s, rf)
             carry = apply_carry_faults(carry, rf)
-            return packed_round_step(
-                s, carry, inj, m, meta, cfg, topo, region, faults=rf
+            s, carry, inj, m = packed_round_step(
+                s, carry, inj, m, meta, cfg, topo, region, faults=rf,
+                done=done,
             )
+            s, carry, m, _ = _pin(mesh, s, carry, m)
+            return s, carry, inj, m, _fault_done(s, carry, inj)
 
-        slim, carry, inj, metrics = jax.lax.while_loop(
-            cond, body, (slim, carry0, injected0, metrics)
+        slim, carry, inj, metrics, _ = jax.lax.while_loop(
+            cond, body, (slim, carry0, injected0, metrics, done0)
         )
     full = unpack_into_state(carry, slim, cfg)
     full = full._replace(
@@ -925,6 +1016,7 @@ def sync_packed(
     meta: PayloadMeta,
     faults=None,
     telem: bool = False,
+    done=None,
 ):
     """Anti-entropy on packed words: needs computed from the SAME
     advertised gap/head tensors as the dense path (state.heads/gap_lo/
@@ -941,6 +1033,11 @@ def sync_packed(
     k_peers, _k_drop, k_rearm = jax.random.split(key, 3)
 
     due = state.sync_countdown <= 0
+    if done is not None:
+        # early-exit gate (see broadcast_packed): a converged lane pulls
+        # nothing — identical semantics, the batched loop discards its
+        # carry, and solo loops never reach here with done=True
+        due &= ~done
 
     peers = sample_member_targets(state, cfg, k_peers, s)
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s)

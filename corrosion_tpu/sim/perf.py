@@ -92,6 +92,9 @@ def _per_round_runner(
     blocked on the ENTIRE output pytree via host transfer."""
     from .faults import apply_node_faults, round_faults
     from .packed import (
+        _converged_done,
+        _pin,
+        all_have_words,
         apply_carry_faults,
         pack_bits,
         pack_state,
@@ -101,14 +104,11 @@ def _per_round_runner(
         unpack_into_state,
     )
 
-    region = regions(cfg.n_nodes, topo.n_regions)
-    state = new_sim(cfg, seed)
-    metrics = new_metrics(cfg)
-    if mesh is not None:
-        from ..parallel.mesh import replicate_meta, shard_state
+    from ..parallel.mesh import place_run
 
-        state = shard_state(state, mesh)
-        meta = replicate_meta(meta, mesh)
+    region = regions(cfg.n_nodes, topo.n_regions)
+    metrics = new_metrics(cfg)
+    state, meta, fplan = place_run(new_sim(cfg, seed), meta, fplan, mesh)
 
     # microbench the SAME path run_to_convergence/run_fault_plan
     # dispatches, else the ×3 consistency check compares apples to oranges
@@ -130,19 +130,39 @@ def _per_round_runner(
                 else:
                     s, carry, inj, m = c
                     trace = None
+                # the microbenched body must match the run loops' real
+                # per-round work, which since ISSUE 7 includes the
+                # per-lane done predicate + gated masks and (sharded)
+                # the per-round layout pins
                 if fplan is not None:
+                    horizon = fplan.alive.shape[0] - 1
+                    done = (s.t >= horizon) & all_have_words(
+                        carry, inj, s, meta, cfg
+                    )
                     rf = round_faults(fplan, s.t)
                     if trace is not None:
-                        trace = record_node_faults(trace, s.t, rf)
+                        trace = record_node_faults(
+                            trace, s.t, rf, every=cfg.trace_every
+                        )
                     s = apply_node_faults(s, rf)
                     carry = apply_carry_faults(carry, rf)
-                    return packed_round_step(
+                    out = packed_round_step(
                         s, carry, inj, m, meta, cfg, topo, region,
-                        faults=rf, trace=trace,
+                        faults=rf, trace=trace, done=done,
                     )
-                return packed_round_step(
-                    s, carry, inj, m, meta, cfg, topo, region, trace=trace
+                else:
+                    done = _converged_done(s, m, meta)
+                    out = packed_round_step(
+                        s, carry, inj, m, meta, cfg, topo, region,
+                        trace=trace, done=done,
+                    )
+                trace2 = out[4] if len(out) > 4 else None
+                s2, carry2, m2, trace2 = _pin(
+                    mesh, out[0], out[1], out[3], trace2
                 )
+                if trace2 is not None:
+                    return (s2, carry2, out[2], m2, trace2)
+                return (s2, carry2, out[2], m2)
 
             init = (slim, carry0, inj0, metrics)
             if telemetry:
@@ -162,7 +182,9 @@ def _per_round_runner(
             if fplan is not None:
                 rf = round_faults(fplan, s.t)
                 if trace is not None:
-                    trace = record_node_faults(trace, s.t, rf)
+                    trace = record_node_faults(
+                        trace, s.t, rf, every=cfg.trace_every
+                    )
                 s = apply_node_faults(s, rf)
                 return round_step(
                     s, m, meta, cfg, topo, region, faults=rf, trace=trace

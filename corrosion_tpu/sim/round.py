@@ -199,6 +199,7 @@ def round_step(
             swim_suspect=susp,
             swim_down=dn,
             gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
+            every=cfg.trace_every,
         )
     state = state._replace(t=state.t + 1)
     if trace is not None:
@@ -207,7 +208,7 @@ def round_step(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "topo", "max_rounds", "telemetry")
+    jax.jit, static_argnames=("cfg", "topo", "max_rounds", "telemetry", "mesh")
 )
 def run_to_convergence(
     state: SimState,
@@ -216,6 +217,7 @@ def run_to_convergence(
     topo: Topology,
     max_rounds: int = 1000,
     telemetry: bool = False,
+    mesh=None,
 ):
     """Advance rounds until every up node holds every payload (the
     check_bookkeeping.py property: need == 0 ∧ equal heads) or max_rounds.
@@ -230,41 +232,63 @@ def run_to_convergence(
     ``telemetry=True`` (static) threads a `telemetry.RoundTrace` through
     the loop carry and returns (state, metrics, trace); False compiles
     to exactly the pre-telemetry program.
+
+    ``mesh`` (static; a 1-D ``nodes`` `jax.sharding.Mesh` or None)
+    shards the node axis across the mesh — the packed path re-pins the
+    word-carry layout every round (doc/sharding.md); the dense path
+    keeps relying on input placement (`parallel.mesh.shard_state`),
+    which GSPMD already propagates through the loop.  Results are
+    bit-identical either way (tests/sim/test_mesh_storm.py,
+    tests/sim/test_packed_sharded.py).
     """
     from .packed import packed_supported, run_packed
 
     validate(cfg, topo)
     if packed_supported(cfg, topo):
-        return run_packed(state, meta, cfg, topo, max_rounds, telemetry)
+        return run_packed(
+            state, meta, cfg, topo, max_rounds, telemetry, mesh=mesh
+        )
     region = regions(cfg.n_nodes, topo.n_regions)
     metrics = new_metrics(cfg)
 
-    def cond(carry):
-        state, metrics = carry[0], carry[1]
+    def _done(state, metrics):
         all_injected = jnp.all(meta.round <= state.t)
-        done = all_injected & jnp.all(
+        return all_injected & jnp.all(
             (metrics.converged_at >= 0) | (state.alive != ALIVE)
         )
-        return (state.t < max_rounds) & ~done
 
+    def cond(carry):
+        return (carry[0].t < max_rounds) & ~carry[2]
+
+    # the per-lane done flag rides the carry (ISSUE 7 satellite): cond
+    # reads a precomputed scalar instead of re-scanning converged_at,
+    # and vmapped ensembles freeze converged lanes on an O(1) check
     if telemetry:
         from .telemetry import new_trace
 
         def body(carry):
-            state, metrics, trace = carry
-            return round_step(
+            state, metrics, _, trace = carry
+            state, metrics, trace = round_step(
                 state, metrics, meta, cfg, topo, region, trace=trace
             )
+            return state, metrics, _done(state, metrics), trace
 
-        return jax.lax.while_loop(
-            cond, body, (state, metrics, new_trace(cfg, max_rounds))
+        state, metrics, _, trace = jax.lax.while_loop(
+            cond, body,
+            (state, metrics, _done(state, metrics),
+             new_trace(cfg, max_rounds)),
         )
+        return state, metrics, trace
 
     def body(carry):
-        state, metrics = carry
-        return round_step(state, metrics, meta, cfg, topo, region)
+        state, metrics, _ = carry
+        state, metrics = round_step(state, metrics, meta, cfg, topo, region)
+        return state, metrics, _done(state, metrics)
 
-    return jax.lax.while_loop(cond, body, (state, metrics))
+    state, metrics, _ = jax.lax.while_loop(
+        cond, body, (state, metrics, _done(state, metrics))
+    )
+    return state, metrics
 
 
 def new_sim(cfg: SimConfig, seed: int = 0) -> SimState:

@@ -81,13 +81,14 @@ def run_scenario(
 
     if compile_only:
         run_to_convergence.lower(
-            state, meta, cfg, topo, max_rounds, telemetry=telemetry
+            state, meta, cfg, topo, max_rounds, telemetry=telemetry,
+            mesh=mesh,
         ).compile()
         return None
 
     t0 = time.monotonic()
     out = run_to_convergence(
-        state, meta, cfg, topo, max_rounds, telemetry=telemetry
+        state, meta, cfg, topo, max_rounds, telemetry=telemetry, mesh=mesh
     )
     final, metrics = out[0], out[1]
     trace = out[2] if telemetry else None
@@ -107,10 +108,13 @@ def run_scenario(
     unconverged = int(((node_conv < 0) & (alive == ALIVE)).sum())
     from .packed import packed_supported
 
+    from ..parallel.mesh import mesh_record, mesh_size
+
     result = {
         "n_nodes": cfg.n_nodes,
         "n_payloads": cfg.n_payloads,
-        "n_devices": len(mesh.devices.flat) if mesh is not None else 1,
+        "n_devices": mesh_size(mesh),
+        "mesh": mesh_record(mesh),
         # which round implementation run_to_convergence dispatched to
         # (VERDICT r3 item 2: the bench must say which path ran)
         "round_path": "packed" if packed_supported(cfg, topo) else "dense",
@@ -323,6 +327,12 @@ def config_partition_heal_10k(seed: int = 0) -> Dict[str, float]:
 
 
 def _write_storm(n_nodes: int, n_payloads: int):
+    # partial-view SWIM packs (belief, id) into one i32 scatter word —
+    # 2^18 nodes max (SimConfig validation).  Beyond that cap (the 1M
+    # tier) the storm runs ground-truth membership (alive mask only),
+    # the scale regime state.py's layout doc already describes: at 1M
+    # nodes the dissemination question doesn't need per-node beliefs.
+    partial = n_nodes <= 262144
     cfg = SimConfig.wan_tuned(
         n_nodes,
         n_payloads=n_payloads,
@@ -331,7 +341,7 @@ def _write_storm(n_nodes: int, n_payloads: int):
         fanout=3,
         sync_interval_rounds=8,
         sync_peers=3,
-        swim_partial_view=True,
+        swim_partial_view=partial,
         member_slots=64,
         # the storm runs one region (intra delay 0) + sync's t+1 slot:
         # 2 ring slots suffice (validate() enforces it), and inflight is
@@ -429,7 +439,8 @@ def storm_fault_plan(n_nodes: int, seed: int = 0):
 
 
 def _measured_fault_storm(
-    cfg, meta, topo, fplan, seed, per_round_s, packed, telemetry=False
+    cfg, meta, topo, fplan, seed, per_round_s, packed, telemetry=False,
+    mesh=None,
 ) -> Dict[str, object]:
     """The measured-run protocol BOTH storm rungs share — AOT-prime the
     convergence loop, time the run behind a full block + host read,
@@ -437,19 +448,26 @@ def _measured_fault_storm(
     survivors that never converged.  One copy on purpose: the bench
     divides the telemetry rung's wall by the headline rung's, so the two
     must be the same protocol or the ratio silently stops meaning
-    anything."""
+    anything.
+
+    ``mesh`` (ISSUE 7) shards the node axis: state, payload metadata,
+    and the compiled fault plan are mesh-placed before the jitted loop
+    and the wall verifies against the mesh's aggregate HBM bound."""
     from .faults import run_fault_plan
     from .perf import verify_wall
 
-    state = new_sim(cfg, seed)
+    from ..parallel.mesh import mesh_size, place_run
+
+    state, meta, fplan = place_run(new_sim(cfg, seed), meta, fplan, mesh)
+    n_devices = mesh_size(mesh)
     run_fault_plan.lower(
         state, meta, cfg, topo, fplan, max_rounds=3000,
-        telemetry=telemetry,
+        telemetry=telemetry, mesh=mesh,
     ).compile()
     t0 = time.monotonic()
     out = run_fault_plan(
         state, meta, cfg, topo, fplan, max_rounds=3000,
-        telemetry=telemetry,
+        telemetry=telemetry, mesh=mesh,
     )
     jax.block_until_ready(out)
     final, metrics = out[0], out[1]
@@ -458,7 +476,8 @@ def _measured_fault_storm(
 
     rounds = int(final.t)
     wall, report = verify_wall(
-        raw_wall, rounds, per_round_s, cfg, packed=packed
+        raw_wall, rounds, per_round_s, cfg, n_devices=n_devices,
+        packed=packed,
     )
     node_conv = np.asarray(metrics.converged_at)
     alive = np.asarray(final.alive)
@@ -477,6 +496,7 @@ def config_packed_fault_storm(
     n_nodes: int = 100_000,
     n_payloads: int = 512,
     microbench_rounds: int = 4,
+    mesh=None,
 ) -> Dict[str, object]:
     """The fault-storm bench rung (ISSUE 4): the headline storm shape
     under `storm_fault_plan`, run through `run_fault_plan` — which
@@ -484,7 +504,13 @@ def config_packed_fault_storm(
     the full defensible-wall protocol (fault-path per-round microbench,
     HBM bound, ×3 consistency) and a faultless packed run of the same
     scenario on the same platform, so the reported
-    ``fault_over_faultless`` ratio is apples-to-apples."""
+    ``fault_over_faultless`` ratio is apples-to-apples.
+
+    ``mesh`` (ISSUE 7) runs BOTH sides node-axis-sharded — the packed
+    carry, the factored fault tensors, and the telemetry folds partition
+    across the 1-D ``nodes`` mesh, bit-identically to single-device
+    (tests/sim/test_packed_sharded.py)."""
+    from ..parallel.mesh import mesh_record, mesh_size
     from .faults import compile_plan
     from .packed import packed_supported
     from .perf import measure_per_round, verify_wall
@@ -494,13 +520,14 @@ def config_packed_fault_storm(
     plan = storm_fault_plan(n_nodes, seed)
     fplan = compile_plan(plan, cfg, topo)  # auto-factored at storm scale
     packed = packed_supported(cfg, topo)
+    n_devices = mesh_size(mesh)
 
     per_round_s = measure_per_round(
         cfg, meta, seed=seed + 1000, k_rounds=microbench_rounds,
-        fplan=fplan,
+        fplan=fplan, mesh=mesh,
     )
     run = _measured_fault_storm(
-        cfg, meta, topo, fplan, seed, per_round_s, packed
+        cfg, meta, topo, fplan, seed, per_round_s, packed, mesh=mesh
     )
     rounds, wall = run["rounds"], run["wall"]
 
@@ -509,21 +536,23 @@ def config_packed_fault_storm(
     # must be artifact-proof, or a lying denominator (the round-2
     # "1.6 ms" failure mode) would spuriously fail/pass the bar
     fl_per_round_s = measure_per_round(
-        cfg, meta, seed=seed + 2000, k_rounds=microbench_rounds
+        cfg, meta, seed=seed + 2000, k_rounds=microbench_rounds, mesh=mesh
     )
     run_scenario(cfg, meta, topo=topo, seed=seed, max_rounds=3000,
-                 compile_only=True)
+                 compile_only=True, mesh=mesh)
     faultless = run_scenario(
-        cfg, meta, topo=topo, seed=seed, max_rounds=3000
+        cfg, meta, topo=topo, seed=seed, max_rounds=3000, mesh=mesh
     )
     fl_wall, fl_report = verify_wall(
         faultless["wall_clock_s"], faultless["rounds"], fl_per_round_s,
-        cfg, packed=packed,
+        cfg, n_devices=n_devices, packed=packed,
     )
     ratio = wall / fl_wall if fl_wall > 0 else float("inf")
     return {
         "n_nodes": n_nodes,
         "n_payloads": n_payloads,
+        "n_devices": n_devices,
+        "mesh": mesh_record(mesh),
         "round_path": "packed" if packed else "dense",
         "plan_horizon": plan.horizon,
         "plan_seed": seed,
@@ -539,12 +568,120 @@ def config_packed_fault_storm(
     }
 
 
+def config_packed_fault_storm_sharded(
+    seed: int = 0,
+    n_nodes: int = 100_000,
+    n_payloads: int = 512,
+    microbench_rounds: int = 4,
+    n_devices: Optional[int] = None,
+    check_single_device: Optional[bool] = None,
+) -> Dict[str, object]:
+    """The fault-storm rung MESH-SHARDED (ISSUE 7): the identical storm
+    schedule with the packed carry's node axis split across every
+    available device (or the first ``n_devices``), under the same
+    defensible-wall protocol — `verify_wall` holds the wall against the
+    mesh's AGGREGATE HBM bound, so a sharded wall can't launder an
+    async artifact either.
+
+    ``check_single_device`` (default: on at ≤ 8192 nodes — the CI smoke
+    shape; off at storm scale, where a second full run would double the
+    rung's budget) re-runs the schedule unsharded and asserts the
+    RunMetrics are bit-identical — the sharding-changes-nothing
+    contract, enforced in the bench record itself."""
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_devices)
+    m = config_packed_fault_storm(
+        seed=seed, n_nodes=n_nodes, n_payloads=n_payloads,
+        microbench_rounds=microbench_rounds, mesh=mesh,
+    )
+    if check_single_device is None:
+        check_single_device = n_nodes <= 8192
+    if check_single_device:
+        single = config_packed_fault_storm(
+            seed=seed, n_nodes=n_nodes, n_payloads=n_payloads,
+            microbench_rounds=microbench_rounds,
+        )
+        mismatch = [
+            k
+            for k in (
+                "rounds", "converged", "unconverged_nodes",
+                "p99_node_convergence_round",
+            )
+            if m[k] != single[k]
+        ]
+        m["sharded_matches_single"] = not mismatch
+        m["mismatched_keys"] = mismatch
+        m["single_device_wall_clock_s"] = single["wall_clock_s"]
+        if mismatch:
+            raise AssertionError(
+                f"sharded storm diverged from single-device on {mismatch}"
+            )
+    return m
+
+
+def config_fault_storm_1m(
+    seed: int = 0,
+    n_nodes: int = 1_000_000,
+    n_payloads: int = 512,
+    microbench_rounds: int = 2,
+    n_devices: Optional[int] = None,
+) -> Dict[str, object]:
+    """The 1M-node tier (ISSUE 7): the storm fault schedule at a million
+    nodes, node-axis-sharded over every available device, ground-truth
+    membership (partial-view SWIM caps at 2^18 — `_write_storm` drops
+    it above the cap), measured under the defensible-wall protocol
+    (fault-path per-round microbench + aggregate HBM bound + ×3
+    consistency).  Unlike `config_packed_fault_storm` this rung runs
+    the fault side ONLY — at 1M nodes the faultless reference would
+    double a rung whose job is the scale point, and the ≤2× ratio is
+    already tracked at 100k."""
+    from ..parallel.mesh import make_mesh, mesh_record, mesh_size
+    from .faults import compile_plan
+    from .packed import packed_supported
+    from .perf import measure_per_round
+
+    mesh = make_mesh(n_devices)
+    cfg, meta = _write_storm(n_nodes, n_payloads)
+    topo = Topology()
+    plan = storm_fault_plan(n_nodes, seed)
+    fplan = compile_plan(plan, cfg, topo)
+    packed = packed_supported(cfg, topo)
+
+    per_round_s = measure_per_round(
+        cfg, meta, seed=seed + 1000, k_rounds=microbench_rounds,
+        reps=2, fplan=fplan, mesh=mesh,
+    )
+    run = _measured_fault_storm(
+        cfg, meta, topo, fplan, seed, per_round_s, packed, mesh=mesh
+    )
+    return {
+        "n_nodes": n_nodes,
+        "n_payloads": n_payloads,
+        "n_devices": len(mesh.devices.flat),
+        "mesh": mesh_record(mesh),
+        "round_path": "packed" if packed else "dense",
+        "membership": "ground-truth" if not cfg.swim_partial_view
+        else "partial-view",
+        "plan_horizon": plan.horizon,
+        "plan_seed": seed,
+        "rounds": run["rounds"],
+        "converged": run["unconverged"] == 0
+        and run["rounds"] >= plan.horizon,
+        "unconverged_nodes": run["unconverged"],
+        "p99_node_convergence_round": _percentile(run["node_conv"], 99),
+        "wall_clock_s": run["wall"],
+        "sanity": run["report"],
+    }
+
+
 def config_fault_storm_telemetry(
     seed: int = 0,
     n_nodes: int = 100_000,
     n_payloads: int = 512,
     microbench_rounds: int = 4,
     trace_path: Optional[str] = None,
+    mesh=None,
 ) -> Dict[str, object]:
     """The packed fault storm WITH the flight recorder on (ISSUE 5
     acceptance: telemetry adds ≤ 10% wall under the defensible-wall
@@ -576,10 +713,11 @@ def config_fault_storm_telemetry(
     # each other
     pr_plain, pr_tel = measure_overhead_pair(
         cfg, meta, seed=seed + 1000, k_rounds=microbench_rounds,
-        fplan=fplan,
+        fplan=fplan, mesh=mesh,
     )
     run = _measured_fault_storm(
-        cfg, meta, topo, fplan, seed, pr_tel, packed, telemetry=True
+        cfg, meta, topo, fplan, seed, pr_tel, packed, telemetry=True,
+        mesh=mesh,
     )
     rounds, wall = run["rounds"], run["wall"]
     host = trace_host(run["trace"], rounds)
@@ -723,11 +861,12 @@ def config_write_storm_verified(
     run_scenario(cfg, meta, seed=seed, max_rounds=3000, compile_only=True,
                  mesh=mesh)
     m = run_scenario(cfg, meta, seed=seed, max_rounds=3000, mesh=mesh)
+    from ..parallel.mesh import mesh_size
     from .packed import packed_supported
 
     wall, report = verify_wall(
         m["wall_clock_s"], m["rounds"], per_round_s, cfg,
-        n_devices=len(mesh.devices.flat) if mesh is not None else 1,
+        n_devices=mesh_size(mesh),
         packed=packed_supported(cfg, Topology()),
     )
     m["wall_clock_s"] = wall

@@ -134,8 +134,19 @@ class SimConfig:
     packed_min_cells: int = 10 * 1024 * 1024
     # payload byte size assumed when metadata gives none
     default_payload_bytes: int = 8 * 1024
+    # flight-recorder round stride (ISSUE 7 satellite): record row t
+    # only when t % trace_every == 0, so a 10k-payload × high-max_rounds
+    # sweep allocates ceil(R/stride) trace rows instead of a full
+    # [R_max, P] channel set.  1 (the default) is the exact recorder —
+    # byte-identical buffers, stable digests; >1 is a deliberate
+    # sampling (summary totals become stride samples, labeled as such)
+    trace_every: int = 1
 
     def __post_init__(self) -> None:
+        if self.trace_every < 1:
+            raise ValueError(
+                f"trace_every must be >= 1, got {self.trace_every}"
+            )
         wave = self.n_writers * self.chunks_per_version
         if self.n_payloads % wave != 0:
             raise ValueError(

@@ -132,11 +132,17 @@ def _reachable(
             ok &= ~blk
         thr = fault_edge_loss(faults, src, dst)
         if thr is not None:
-            bits = jax.random.bits(
+            from .topology import aligned_u8_bits
+
+            # aligned draw (ISSUE 7): probe/announce edge sets are
+            # [N]-shaped, which shards on non-word boundaries at
+            # non-128-aligned N — the raw u8 draw silently diverges
+            # from single-device there (see aligned_u8_bits)
+            bits = aligned_u8_bits(
                 jax.random.fold_in(
                     jax.random.fold_in(key, faults.seed), 103
                 ),
-                src.shape, dtype=jnp.uint8,
+                src.shape,
             )
             ok &= ~(bits < thr)
     return ok

@@ -128,8 +128,36 @@ class RoundTrace(NamedTuple):
     gap_overflow: jnp.ndarray   # i32[R]
 
 
+def trace_rows_for(max_rounds: int, every: int = 1) -> int:
+    """Sampled rows a decimated trace holds for ``max_rounds`` executed
+    rounds: the rounds t with t % every == 0 in [0, max_rounds)."""
+    return -(-int(max_rounds) // max(int(every), 1))
+
+
+def _trace_row(trace: RoundTrace, t, every: int):
+    """Buffer row for round ``t`` under a ``trace_every`` stride: row
+    t // every when t is a sample round, else the SCRATCH row (the extra
+    last row `new_trace` allocates when every > 1) — a predicated write
+    target, so non-sample rounds cost the same indexed update but land
+    in a row no exporter ever reads.  every == 1 compiles to ``t``
+    exactly (the digest-stable off state)."""
+    if every <= 1:
+        return t
+    n_rows = trace.up_nodes.shape[0]
+    return jnp.where(t % every == 0, t // every, n_rows - 1)
+
+
 def new_trace(cfg: SimConfig, max_rounds: int) -> RoundTrace:
-    r, p = max_rounds, cfg.n_payloads
+    """Preallocate the trace buffers.  ``cfg.trace_every`` > 1 (the
+    decimated variant — ISSUE 7 satellite) allocates ceil(R/every) + 1
+    rows instead of R: one row per sampled round plus one scratch row
+    that absorbs the predicated writes of non-sample rounds, so a
+    10k-payload × high-max_rounds sweep stops paying a full [R_max, P]
+    channel.  every == 1 (the default) allocates exactly the original
+    [R, ·] buffers — byte-identical traces, stable digests."""
+    every = max(int(cfg.trace_every), 1)
+    r = max_rounds if every == 1 else trace_rows_for(max_rounds, every) + 1
+    p = cfg.n_payloads
     z = functools.partial(jnp.zeros, dtype=jnp.int32)
     return RoundTrace(
         coverage=z((r, p)),
@@ -182,39 +210,46 @@ def record_round(
     swim_suspect: jnp.ndarray,
     swim_down: jnp.ndarray,
     gap_overflow: jnp.ndarray,
+    every: int = 1,
 ) -> RoundTrace:
-    """Write row ``t`` (the pre-increment round counter — run loops
-    guarantee t < R_max).  One indexed update per channel, no host
+    """Write round ``t``'s row (the pre-increment round counter — run
+    loops guarantee t < R_max).  One indexed update per channel, no host
     sync; `crashes`/`wipes` ride `record_node_faults` instead (the
-    RoundFaults slice lives in the run loop, not the round step)."""
+    RoundFaults slice lives in the run loop, not the round step).
+    ``every`` > 1 routes non-sample rounds to the scratch row
+    (`_trace_row`); 1 writes row t exactly as before."""
+    row = _trace_row(trace, t, every)
     return trace._replace(
-        coverage=trace.coverage.at[t].set(coverage),
-        delivered=trace.delivered.at[t].set(delivered),
-        up_nodes=trace.up_nodes.at[t].set(up_nodes),
-        bcast_bytes=trace.bcast_bytes.at[t].set(wire.bytes),
-        bcast_frames=trace.bcast_frames.at[t].set(wire.frames),
-        bcast_dropped=trace.bcast_dropped.at[t].set(wire.dropped),
-        bcast_cut=trace.bcast_cut.at[t].set(wire.cut),
-        sync_bytes=trace.sync_bytes.at[t].set(sync.bytes),
-        sync_frames=trace.sync_frames.at[t].set(sync.frames),
-        sync_sessions=trace.sync_sessions.at[t].set(sync.sessions),
-        sync_refused=trace.sync_refused.at[t].set(sync.refused),
-        swim_suspect=trace.swim_suspect.at[t].set(swim_suspect),
-        swim_down=trace.swim_down.at[t].set(swim_down),
-        gap_overflow=trace.gap_overflow.at[t].set(gap_overflow),
+        coverage=trace.coverage.at[row].set(coverage),
+        delivered=trace.delivered.at[row].set(delivered),
+        up_nodes=trace.up_nodes.at[row].set(up_nodes),
+        bcast_bytes=trace.bcast_bytes.at[row].set(wire.bytes),
+        bcast_frames=trace.bcast_frames.at[row].set(wire.frames),
+        bcast_dropped=trace.bcast_dropped.at[row].set(wire.dropped),
+        bcast_cut=trace.bcast_cut.at[row].set(wire.cut),
+        sync_bytes=trace.sync_bytes.at[row].set(sync.bytes),
+        sync_frames=trace.sync_frames.at[row].set(sync.frames),
+        sync_sessions=trace.sync_sessions.at[row].set(sync.sessions),
+        sync_refused=trace.sync_refused.at[row].set(sync.refused),
+        swim_suspect=trace.swim_suspect.at[row].set(swim_suspect),
+        swim_down=trace.swim_down.at[row].set(swim_down),
+        gap_overflow=trace.gap_overflow.at[row].set(gap_overflow),
     )
 
 
-def record_node_faults(trace: RoundTrace, t: jnp.ndarray, rf) -> RoundTrace:
-    """Fault-seam node channels for row ``t``: nodes the schedule holds
+def record_node_faults(
+    trace: RoundTrace, t: jnp.ndarray, rf, every: int = 1
+) -> RoundTrace:
+    """Fault-seam node channels for round ``t``: nodes the schedule holds
     DOWN this round and wipes fired.  Called from the fault run loops
     right after `round_faults` slices the plan (same row the round step
     fills)."""
+    row = _trace_row(trace, t, every)
     return trace._replace(
-        crashes=trace.crashes.at[t].set(
+        crashes=trace.crashes.at[row].set(
             jnp.sum(rf.alive == DOWN, dtype=jnp.int32)
         ),
-        wipes=trace.wipes.at[t].set(jnp.sum(rf.wipe, dtype=jnp.int32)),
+        wipes=trace.wipes.at[row].set(jnp.sum(rf.wipe, dtype=jnp.int32)),
     )
 
 
@@ -377,24 +412,27 @@ def run_membership_detect(
 FLIGHT_VERSION = 1
 
 
-def trace_host(trace, rounds: int):
-    """Host copies of every channel, sliced to the executed rounds.
-    Idempotent: a dict from a previous call passes through (re-sliced),
-    so callers that fan a trace out to several consumers — summary,
-    digest, JSONL rows — pay the device-to-host copy exactly once.
-    Every exporter below accepts either a RoundTrace or this dict."""
-    r = int(rounds)
+def trace_host(trace, rounds: int, every: int = 1):
+    """Host copies of every channel, sliced to the executed rounds
+    (``every`` > 1: to the SAMPLED rows — ceil(rounds/every), which
+    excludes the scratch row by construction).  Idempotent: a dict from
+    a previous call passes through (re-sliced; slicing an already-short
+    array is a no-op), so callers that fan a trace out to several
+    consumers — summary, digest, JSONL rows — pay the device-to-host
+    copy exactly once.  Every exporter below accepts either a RoundTrace
+    or this dict."""
+    r = trace_rows_for(rounds, every)
     if isinstance(trace, dict):
         return {f: v[:r] for f, v in trace.items()}
     return {f: np.asarray(getattr(trace, f))[:r] for f in RoundTrace._fields}
 
 
-def coverage_curve_digest(trace, rounds: int) -> str:
+def coverage_curve_digest(trace, rounds: int, every: int = 1) -> str:
     """Replay identity of the per-round per-payload coverage curve —
     the compact fingerprint bench/campaign artifacts record so a
     convergence trajectory (not just its endpoint) is regression-
     checkable across runs."""
-    r = int(rounds)
+    r = trace_rows_for(rounds, every)
     cov = (
         trace["coverage"][:r]
         if isinstance(trace, dict)
@@ -404,19 +442,23 @@ def coverage_curve_digest(trace, rounds: int) -> str:
     return hashlib.blake2b(cov.tobytes(), digest_size=8).hexdigest()
 
 
-def coverage_latency_rounds(trace, rounds: int) -> np.ndarray:
+def coverage_latency_rounds(
+    trace, rounds: int, every: int = 1
+) -> np.ndarray:
     """i32[P] first round each payload reached FULL coverage (held by
     every up node), -1 if never — computed from the trace alone, so the
     per-payload coverage-latency percentiles ROADMAP asks for need no
-    extra kernel output."""
-    t = trace_host(trace, rounds)
+    extra kernel output.  Decimated traces report the first SAMPLED
+    round (i·every — an upper bound within one stride of the true
+    latency; the knob is off by default)."""
+    t = trace_host(trace, rounds, every)
     full = (t["coverage"] == t["up_nodes"][:, None]) & (
         t["up_nodes"][:, None] > 0
     )  # [R, P]
     if full.shape[0] == 0:  # zero-round run: argmax chokes on an empty axis
         return np.full(full.shape[1], -1, np.int32)
     any_full = full.any(axis=0)
-    first = full.argmax(axis=0)
+    first = full.argmax(axis=0) * every
     return np.where(any_full, first, -1).astype(np.int32)
 
 
@@ -424,10 +466,14 @@ def trace_summary(trace, rounds: int, cfg: SimConfig) -> dict:
     """Deterministic per-run summary block (bench records / campaign
     artifacts): coverage-curve digest, coverage-latency percentiles,
     bytes/round, fault-seam and SWIM totals.  Every value derives from
-    device-deterministic integers, so a replay reproduces it exactly."""
+    device-deterministic integers, so a replay reproduces it exactly.
+    ``cfg.trace_every`` > 1 summarizes the sampled rows (wire/fault
+    totals become stride samples, labeled by a ``trace_every`` key);
+    the default stride 1 emits the exact block prior builds did."""
     r = int(rounds)
-    t = trace_host(trace, r)
-    lat = coverage_latency_rounds(t, r)
+    every = max(int(cfg.trace_every), 1)
+    t = trace_host(trace, r, every)
+    lat = coverage_latency_rounds(t, r, every)
     covered = lat[lat >= 0]
 
     def pct(q):
@@ -437,7 +483,8 @@ def trace_summary(trace, rounds: int, cfg: SimConfig) -> dict:
 
     bcast = float(t["bcast_bytes"].sum())
     sync = float(t["sync_bytes"].sum())
-    return {
+    sampled = trace_rows_for(r, every)
+    out = {
         "rounds": r,
         "coverage_curve_digest": coverage_curve_digest(t, r),
         "coverage_latency_rounds": {
@@ -447,7 +494,9 @@ def trace_summary(trace, rounds: int, cfg: SimConfig) -> dict:
         "wire_bytes": {
             "broadcast": round(bcast, 1),
             "sync": round(sync, 1),
-            "per_round_mean": round((bcast + sync) / max(r, 1), 1),
+            # mean over the rows the trace actually holds (== rounds at
+            # the default stride; sampled rows when decimated)
+            "per_round_mean": round((bcast + sync) / max(sampled, 1), 1),
         },
         "wire_frames": {
             "broadcast": int(t["bcast_frames"].sum()),
@@ -467,14 +516,22 @@ def trace_summary(trace, rounds: int, cfg: SimConfig) -> dict:
         },
         "gap_overflow_rounds": int((t["gap_overflow"] > 0).sum()),
     }
+    if every > 1:
+        # self-describing only when the knob is ON: the default-stride
+        # summary dict is byte-identical to prior builds (digest-stable)
+        out["trace_every"] = every
+    return out
 
 
 def trace_rows(trace, rounds: int, cfg: SimConfig, per_payload: bool = None):
-    """Per-round dict rows for the flight-recorder JSONL / CLI table.
-    ``per_payload`` includes the raw coverage vector per row (defaults
-    to on for P ≤ 256 — the debuggable scales — off at storm shape)."""
-    r = int(rounds)
-    t = trace_host(trace, r)
+    """Per-round dict rows for the flight-recorder JSONL / CLI table
+    (sampled rows when ``cfg.trace_every`` > 1 — each row's ``t`` is the
+    real round it recorded).  ``per_payload`` includes the raw coverage
+    vector per row (defaults to on for P ≤ 256 — the debuggable scales —
+    off at storm shape)."""
+    every = max(int(cfg.trace_every), 1)
+    t = trace_host(trace, rounds, every)
+    r = trace_rows_for(rounds, every)
     if per_payload is None:
         per_payload = cfg.n_payloads <= 256
     rows = []
@@ -482,7 +539,7 @@ def trace_rows(trace, rounds: int, cfg: SimConfig, per_payload: bool = None):
         up = int(t["up_nodes"][i])
         cov = t["coverage"][i]
         row = {
-            "t": i,
+            "t": i * every,
             "up_nodes": up,
             "coverage_frac": round(
                 float(cov.sum()) / max(up * cfg.n_payloads, 1), 6
@@ -522,7 +579,7 @@ def write_flight_jsonl(
     artifact writer in the tree."""
     import os
 
-    t = trace_host(trace, rounds)
+    t = trace_host(trace, rounds, max(int(cfg.trace_every), 1))
     head = {
         "kind": "flight_recorder",
         "version": FLIGHT_VERSION,
@@ -531,6 +588,8 @@ def write_flight_jsonl(
         "rounds": int(rounds),
         "summary": trace_summary(t, rounds, cfg),
     }
+    if cfg.trace_every > 1:
+        head["trace_every"] = int(cfg.trace_every)
     if header:
         head.update(header)
     tmp = path + ".tmp"
@@ -561,7 +620,8 @@ def trace_to_registry(
 
     reg = registry if registry is not None else REGISTRY
     r = int(rounds)
-    t = trace_host(trace, r)
+    every = max(int(cfg.trace_every), 1)
+    t = trace_host(trace, r, every)
 
     reg.counter("sim_rounds_total").inc(r, **labels)
     wire = reg.counter("sim_wire_bytes_total")
@@ -598,6 +658,6 @@ def trace_to_registry(
     hist = reg.histogram(
         "sim_coverage_latency_rounds", buckets=LATENCY_ROUND_BUCKETS
     )
-    for lat in coverage_latency_rounds(t, r):
+    for lat in coverage_latency_rounds(t, r, every):
         if lat >= 0:
             hist.observe(float(lat), **labels)

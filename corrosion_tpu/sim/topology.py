@@ -83,5 +83,32 @@ def edge_payload_drop(
         # loss ≈ 1.0: a severed channel must stay severed (u8 compare
         # can't express an always-true threshold)
         return jnp.ones((n_edges, n_payloads), jnp.bool_)
-    bits = jax.random.bits(key, (n_edges, n_payloads), dtype=jnp.uint8)
+    bits = aligned_u8_bits(key, (n_edges, n_payloads))
     return bits < jnp.uint8(threshold)
+
+
+def aligned_u8_bits(key, shape) -> jnp.ndarray:
+    """u8 threefry draw whose u32→u8 unpack stays WORD-ALIGNED per
+    shard (ISSUE 7).  jax lowers a u8 bits draw of flat size S through
+    a ceil(S/4) u32 intermediate; when a node-sharded consumer makes
+    GSPMD partition that production on a non-word-aligned boundary
+    (e.g. S = 1008 over 8 devices → 31.5 words per shard), this
+    jax/XLA version produces bit values that DIFFER from the
+    single-device draw — silently, and only at shard-unaligned sizes
+    (tests/sim/test_packed_sharded.py would catch the drift as a
+    sharded-vs-single mismatch in the loss masks).  Padding the flat
+    draw to a multiple of 128 bytes (32 words — word-aligned for every
+    power-of-two mesh up to 32 devices) and slicing keeps the unpack
+    word-aligned under any such partitioning.  Sizes already
+    128-aligned take the identical unpadded draw, so every storm-scale
+    [E, P] mask (P a multiple of 128) is byte-identical to prior
+    builds; only shard-unaligned shapes (small-N tests, non-128-aligned
+    clusters) re-roll."""
+    size = 1
+    for d in shape:
+        size *= int(d)
+    if size % 128 == 0:
+        return jax.random.bits(key, shape, dtype=jnp.uint8)
+    pad = -(-size // 128) * 128
+    flat = jax.random.bits(key, (pad,), dtype=jnp.uint8)
+    return flat[:size].reshape(shape)

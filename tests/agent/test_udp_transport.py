@@ -4,6 +4,8 @@
 import asyncio
 import tempfile
 
+import pytest
+
 from corrosion_tpu.agent.agent import Agent
 from corrosion_tpu.agent.config import Config
 from corrosion_tpu.agent.transport import UdpTcpTransport
@@ -116,6 +118,7 @@ def test_mtls_cluster_converges_and_encrypts_datagrams():
     """Two agents over mutual TLS: gossip converges, SWIM datagrams ride
     the encrypted stream, and an un-certified client is rejected
     (api/peer/mod.rs:149-339)."""
+    pytest.importorskip("cryptography")  # cert generation needs it
     from corrosion_tpu.agent.transport import transport_from_config
     from corrosion_tpu.utils import tls as tlsmod
 
@@ -343,6 +346,7 @@ def test_swim_detection_latency_tls_within_bounded_factor_of_udp():
     (transport.rs:79-104).  Pin the deviation: detection latency at the
     8-node tier must stay within a bounded factor of plaintext-UDP mode
     (doc/transport.md 'SWIM under TLS')."""
+    pytest.importorskip("cryptography")  # cert generation needs it
 
     async def body(tmp):
         import os

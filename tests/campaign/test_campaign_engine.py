@@ -269,3 +269,23 @@ def test_nightly_seed_swept_parity_plan(tmp_path):
     rep = json.loads(r.stdout)
     assert rep["verdict"] == "pass" and not rep["regressions"]
     assert rep["identical_results"]
+
+
+@pytest.mark.campaign
+def test_sharded_cells_keep_digest_and_record_mesh(tmp_path):
+    """mesh_devices (ISSUE 7): a sharded campaign run is a RUN-CONFIG —
+    the result digest is byte-identical to the unsharded run of the
+    same spec (sharding partitions the math, never changes it), the
+    spec hash is untouched, and each cell records the realized mesh.
+    The 3-node quick spec degrades to a 3-device mesh (the largest
+    divisor of the node axis — cells never pad)."""
+    spec = _quick_spec()
+    plain = run_campaign(spec)
+    sharded = run_campaign(spec, mesh_devices=8, resume=False)
+    assert sharded["spec_hash"] == plain["spec_hash"]
+    assert sharded["result_digest"] == plain["result_digest"]
+    cell = sharded["cells"][0]
+    assert cell["n_devices"] == 3
+    assert cell["mesh"]["axes"] == {"nodes": 3}
+    assert cell["round_path"] in ("dense", "packed")
+    assert plain["cells"][0]["mesh"] is None

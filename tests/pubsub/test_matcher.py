@@ -290,3 +290,58 @@ def test_manager_schedules_trailing_flush():
         assert not handle.matcher._rerun_dirty
 
     asyncio.run(run())
+
+
+def test_subscription_using_store_custom_function_compiles():
+    """Table discovery runs on a throwaway schema clone (the live
+    connection must never carry an authorizer — broken None-clear +
+    executor-thread deadlock on some CPython 3.10 sqlite3 builds), so
+    the store's custom SQL functions (corro_json_contains, crdt_*) must
+    be stubbed onto the clone or valid subscriptions using them would
+    be rejected as invalid queries."""
+    store = make_store()
+    apply_local(
+        store,
+        "INSERT INTO sandwiches (name, filling) "
+        "VALUES ('blt', '{\"a\": 1, \"b\": 2}')",
+    )
+    m = Matcher(
+        "sfn",
+        "SELECT name FROM sandwiches "
+        "WHERE corro_json_contains('{\"a\": 1}', filling)",
+        (),
+        store.conn,
+        crr_tables(store),
+    )
+    assert set(m.tables) == {"sandwiches"}
+    m.run_initial()  # executes on the REAL connection, real function
+
+
+def test_discovery_leaves_no_authorizer_on_live_connection():
+    """After building a Matcher, the shared connection must still run
+    PRAGMAs and reads freely — the maintenance loop's PRAGMAs died
+    "not authorized" when discovery left a hook behind."""
+    store = make_store()
+    Matcher("sa", "SELECT name FROM sandwiches", (), store.conn,
+            crr_tables(store))
+    (mode,) = store.conn.execute("PRAGMA auto_vacuum").fetchone()
+    assert mode in (0, 1, 2)
+    store.conn.execute("SELECT count(*) FROM sandwiches").fetchone()
+
+
+def test_generated_column_table_survives_schema_clone():
+    """The scratch clone's function stubs must be DETERMINISTIC and
+    registered BEFORE the DDL replay: a generated column referencing a
+    custom function is rejected at CREATE time otherwise, the table
+    silently never exists on the clone, and a valid subscription on it
+    dies 'no such table'."""
+    store = make_store()
+    store.conn.execute(
+        "CREATE TABLE g (a TEXT PRIMARY KEY NOT NULL, "
+        "b AS (corro_json_contains('{}', a)) VIRTUAL)"
+    )
+    m = Matcher(
+        "g1", "SELECT a FROM g", (), store.conn,
+        {**crr_tables(store), "g": ("a",)},
+    )
+    assert set(m.tables) == {"g"}

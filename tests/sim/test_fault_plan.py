@@ -277,3 +277,114 @@ def test_chaos_smoke_sim_tier():
     assert int(final.t) >= plan.horizon  # no early exit inside the schedule
     assert (np.asarray(final.have) > 0).all()
     assert (np.asarray(final.heads)[:, 0] == cfg.n_versions).all()
+
+
+def test_range_link_epochs_match_pairwise_exactly():
+    """The range-atom walk (ISSUE 7 satellite) is byte-equivalent to the
+    pairwise link_epochs expansion: every directed pair lands in exactly
+    one atom, and its change list — rounds, parameters, and epoch
+    indices (the derive_seed anchor) — is identical."""
+    from corrosion_tpu.faults import demo_plan
+
+    plans = [
+        demo_plan(),
+        demo_plan(n_nodes=7, seed=3),
+        FaultPlan(
+            n_nodes=64, seed=5,
+            events=(
+                FaultEvent("loss", 0, 20, p=0.3),
+                FaultEvent("partition", 5, 15, src="0:32", dst="32:64"),
+                FaultEvent(
+                    "partition", 8, 12, src="16:48", dst="0:16",
+                    symmetric=True,
+                ),
+                FaultEvent("delay", 2, 18, src="0:8", dst="*",
+                           delay_rounds=2),
+                FaultEvent("jitter", 3, 10, src="*", dst="60:64",
+                           delay_rounds=1),
+                FaultEvent("duplicate", 0, 6, src=1, dst="2:40", p=0.2),
+                FaultEvent("crash", 10, 20, node=5, wipe=True),
+            ),
+        ),
+    ]
+    for plan in plans:
+        pairwise = plan.link_epochs()
+        expanded = {}
+        for src_r, dst_r, changes in plan.range_link_epochs():
+            for s in src_r:
+                for d in dst_r:
+                    if s != d:
+                        assert (s, d) not in expanded, "atoms overlap"
+                        expanded[(s, d)] = list(changes)
+        assert set(pairwise) == set(expanded)
+        for pair in pairwise:
+            assert pairwise[pair] == expanded[pair], pair
+
+
+def test_range_schedule_helpers_match_pairwise():
+    """active_kinds_at / blocked_pairs_at — the drivers' O(events)
+    per-round views — equal the pairwise RoundSchedule's answers at
+    every round of the plan."""
+    from corrosion_tpu.faults import demo_plan
+
+    plan = demo_plan(n_nodes=6, seed=2)
+    for r in range(plan.horizon + 2):
+        sched = plan.schedule_at(r)
+        assert plan.active_kinds_at(r) == sched.active_kinds(), r
+        blocked = {p for p, f in sched.links.items() if f.blocked}
+        assert blocked == set(plan.blocked_pairs_at(r)), r
+        # the node-fault-only view skips the pairwise expansion but
+        # keeps crash/restart/skew identical
+        slim = plan.schedule_at(r, include_links=False)
+        assert slim.links == {}
+        assert slim.down == sched.down
+        assert slim.restart == sched.restart
+        assert slim.wipe == sched.wipe
+        assert slim.skews == sched.skews
+
+
+def test_advance_range_epochs_installs_match_pairwise():
+    """The two epoch walkers hand identical (src, dst, epoch, params)
+    install streams to a driver — per round, as sets (install order
+    within a round is not part of the contract; LinkModel installs are
+    keyed per edge)."""
+    from corrosion_tpu.faults import (
+        advance_link_epochs,
+        advance_range_epochs,
+        demo_plan,
+    )
+
+    plan = demo_plan(n_nodes=5, seed=9)
+    pw_epochs = plan.link_epochs()
+    atoms = plan.range_link_epochs()
+    pw_idx, ra_idx = {}, {}
+    for r in range(plan.horizon + 1):
+        pw_installs, ra_installs = set(), set()
+        advance_link_epochs(
+            pw_epochs, pw_idx, r,
+            lambda s, d, i, p: pw_installs.add((s, d, i, p)),
+        )
+        advance_range_epochs(
+            atoms, ra_idx, r,
+            lambda s, d, i, p: ra_installs.add((s, d, i, p)),
+        )
+        assert pw_installs == ra_installs, r
+
+
+def test_range_machinery_is_storm_scale():
+    """A 100k-node storm-shaped plan ("lo:hi" half-split + "*" loss)
+    must never expand pairwise: the atom walk is O(events²), which is
+    what lets host-tier drivers replay storm-shaped plans (the carried
+    edge from PR 4)."""
+    import time
+
+    from corrosion_tpu.sim.runner import storm_fault_plan
+
+    plan = storm_fault_plan(100_000, 1)
+    t0 = time.monotonic()
+    atoms = plan.range_link_epochs()
+    kinds = plan.active_kinds_at(5)
+    wall = time.monotonic() - t0
+    assert len(atoms) <= 8
+    assert "loss" in kinds and "partition" in kinds
+    assert wall < 1.0, f"range walk took {wall:.3f}s — pairwise leak?"

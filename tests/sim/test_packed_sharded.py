@@ -1,0 +1,194 @@
+"""Sharded-vs-single-device bit-equality for the PACKED envelope
+(ISSUE 7): splitting the node-word axis of the bitpacked state across a
+``nodes`` mesh partitions the math without changing it — final packed
+state, RunMetrics, AND every RoundTrace telemetry channel must equal the
+single-device run bit-for-bit, because the per-round coverage/delivery
+reductions are exact integer folds whatever the layout.
+
+Runs on the virtual 8-device CPU mesh the conftest arms
+(``--xla_force_host_platform_device_count=8``), parametrized over mesh
+sizes 1/2/8 and a NON-divisible node count (explicit NamedSharding
+placement needs even shards, so a non-divisible cluster pads its node
+axis and marks the tail permanently DOWN — `parallel.mesh.down_padding`;
+the padding rows must never leak into coverage counts)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from corrosion_tpu.parallel.mesh import (
+    down_padding,
+    make_mesh,
+    padded_node_count,
+    replicate_meta,
+    shard_fault_plan,
+    shard_state,
+)
+from corrosion_tpu.sim.faults import compile_plan, run_fault_plan
+from corrosion_tpu.sim.packed import packed_supported
+from corrosion_tpu.sim.round import new_sim, run_to_convergence
+from corrosion_tpu.sim.runner import _write_storm, storm_fault_plan
+from corrosion_tpu.sim.state import ALIVE
+from corrosion_tpu.sim.topology import Topology
+
+N_NODES = 512  # storm payload structure, scaled to the tier-1 budget
+SEED = 7
+
+
+def _storm(n_nodes=N_NODES, n_payloads=256):
+    cfg, meta = _write_storm(n_nodes, n_payloads)
+    # force the packed envelope open at test scale (the bench shape
+    # clears the gate naturally at 100k × 512)
+    cfg = dataclasses.replace(cfg, packed_min_cells=0)
+    assert packed_supported(cfg, Topology())
+    return cfg, meta
+
+
+def _storm_fplan(cfg):
+    # force the FACTORED form below its 1024-node auto threshold: the
+    # sharded fault tensors under test are the rank-1 storm-scale ones
+    return compile_plan(
+        storm_fault_plan(cfg.n_nodes, SEED), cfg, Topology(),
+        factored=True,
+    )
+
+
+def _assert_bit_identical(single, sharded, labels=("state", "metrics", "trace")):
+    for label, a, b in zip(labels, single, sharded):
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y),
+                err_msg=f"sharded diverged from single-device in {label}",
+            )
+
+
+@pytest.fixture(scope="module")
+def fault_reference():
+    """Single-device fault-storm run with telemetry — the bit-equality
+    anchor every mesh size compares against (one compile, one run)."""
+    cfg, meta = _storm()
+    fplan = _storm_fplan(cfg)
+    out = run_fault_plan(
+        new_sim(cfg, SEED), meta, cfg, Topology(), fplan,
+        max_rounds=600, telemetry=True,
+    )
+    jax.block_until_ready(out)
+    return cfg, meta, fplan, out
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 8])
+def test_sharded_fault_storm_bit_identical(fault_reference, n_devices):
+    """The tentpole contract: the storm fault schedule on the packed
+    round path, node-axis-sharded, with the flight recorder on — state,
+    metrics, and every telemetry channel equal single-device exactly,
+    at every mesh size (1 exercises the mesh code path degenerately)."""
+    cfg, meta, fplan, single = fault_reference
+    mesh = make_mesh(n_devices)
+    sharded = run_fault_plan(
+        shard_state(new_sim(cfg, SEED), mesh),
+        replicate_meta(meta, mesh),
+        cfg, Topology(), shard_fault_plan(fplan, mesh),
+        max_rounds=600, telemetry=True, mesh=mesh,
+    )
+    jax.block_until_ready(sharded)
+    _assert_bit_identical(single, sharded)
+
+
+def test_sharded_faultless_packed_bit_identical():
+    """run_to_convergence (the faultless storm entry) sharded over the
+    full mesh, telemetry on: same contract, no fault seam in the loop."""
+    cfg, meta = _storm()
+    single = run_to_convergence(
+        new_sim(cfg, SEED), meta, cfg, Topology(), 600, telemetry=True
+    )
+    mesh = make_mesh()
+    sharded = run_to_convergence(
+        shard_state(new_sim(cfg, SEED), mesh),
+        replicate_meta(meta, mesh),
+        cfg, Topology(), 600, telemetry=True, mesh=mesh,
+    )
+    _assert_bit_identical(single, sharded)
+    assert int(single[0].t) > 0  # the loop actually ran
+
+
+def test_non_divisible_nodes_pad_down_without_leaking():
+    """A cluster whose node count doesn't divide the mesh pads its node
+    axis to the next multiple and marks the tail permanently DOWN: the
+    padded run is bit-identical sharded-vs-single, the padding rows end
+    the run with zero chunk bits and no convergence stamp, and the
+    telemetry up-node counts never exceed the real population — padding
+    can never leak into coverage."""
+    n_real = 497
+    n_pad = padded_node_count(n_real, 8)
+    assert n_pad == 504 and n_pad % 8 == 0
+    # 504 is NOT a multiple of 128: this shape is also the canary for
+    # the shard-unaligned u8-draw bug aligned_u8_bits exists to fix
+    cfg, meta = _storm(n_pad)
+    fplan = _storm_fplan(cfg)
+
+    def initial():
+        return down_padding(new_sim(cfg, SEED), n_real)
+
+    single = run_fault_plan(
+        initial(), meta, cfg, Topology(), fplan, max_rounds=600,
+        telemetry=True,
+    )
+    mesh = make_mesh(8)
+    sharded = run_fault_plan(
+        shard_state(initial(), mesh), replicate_meta(meta, mesh),
+        cfg, Topology(), shard_fault_plan(fplan, mesh),
+        max_rounds=600, telemetry=True, mesh=mesh,
+    )
+    _assert_bit_identical(single, sharded)
+
+    final, metrics, trace = sharded
+    alive = np.asarray(final.alive)
+    have = np.asarray(final.have)
+    conv = np.asarray(metrics.converged_at)
+    rounds = int(final.t)
+    # padding rows: permanently DOWN, zero knowledge, never converged
+    assert (alive[n_real:] != ALIVE).all()
+    assert have[n_real:].sum() == 0
+    assert (conv[n_real:] == -1).all()
+    # every real survivor converged (the padded storm still heals)
+    assert ((conv[:n_real] >= 0) | (alive[:n_real] != ALIVE)).all()
+    # telemetry coverage/up counts are bounded by the real population
+    up = np.asarray(trace.up_nodes)[:rounds]
+    assert up.max() <= n_real
+    cov = np.asarray(trace.coverage)[:rounds]
+    assert cov.max() <= n_real
+
+
+def test_sharded_rung_config_smoke():
+    """`config_packed_fault_storm_sharded` (the bench rung) end-to-end
+    at smoke scale: the in-record single-device bit-equality check must
+    pass and the record must carry the mesh + round_path."""
+    from corrosion_tpu.sim.runner import config_packed_fault_storm_sharded
+
+    m = config_packed_fault_storm_sharded(
+        seed=1, n_nodes=256, n_payloads=64, microbench_rounds=2,
+        n_devices=8,
+    )
+    assert m["n_devices"] == 8
+    assert m["mesh"]["axes"] == {"nodes": 8}
+    assert m["sharded_matches_single"] is True
+    assert m["mismatched_keys"] == []
+    assert m["converged"]
+
+
+def test_ensemble_mesh_picks_largest_divisor():
+    """Campaign cells never pad (padding would change trajectories):
+    `ensemble_mesh` degrades to the largest dividing device count."""
+    from corrosion_tpu.campaign.ensemble import ensemble_mesh
+
+    cfg, _ = _storm(1024)
+    mesh = ensemble_mesh(cfg, 8)
+    assert len(mesh.devices.flat) == 8
+    cfg6, _ = _storm(96)  # 96 % 8 == 0 → still 8
+    assert len(ensemble_mesh(cfg6, 8).devices.flat) == 8
+    cfg3 = dataclasses.replace(cfg, n_nodes=1023)  # 1023 = 3 × 341
+    assert len(ensemble_mesh(cfg3, 8).devices.flat) == 3
+    assert ensemble_mesh(cfg, 1) is None
+    assert ensemble_mesh(cfg, None) is None

@@ -343,3 +343,75 @@ def test_perf_overhead_pair_interleaved():
         cfg, meta, seed=1, k_rounds=2, reps=1, fplan=fplan
     )
     assert pr_plain > 0 and pr_tel > 0
+
+
+def test_trace_every_decimation_samples_rows():
+    """The decimated recorder (ISSUE 7 satellite): ``trace_every=k``
+    allocates ceil(R/k)+1 rows (sampled rows + one scratch row the
+    predicated non-sample writes land in), records exactly the rounds
+    t ≡ 0 (mod k) with the SAME values the exact recorder writes, and
+    never changes the run itself."""
+    from corrosion_tpu.sim.telemetry import (
+        trace_rows,
+        trace_rows_for,
+        trace_summary,
+    )
+
+    cfg = _cfg()
+    cfg3 = dataclasses.replace(cfg, trace_every=3)
+    meta = uniform_payloads(cfg, inject_every=1)
+    topo = Topology()
+    full = run_to_convergence(
+        new_sim(cfg, 3), meta, cfg, topo, 60, telemetry=True
+    )
+    dec = run_to_convergence(
+        new_sim(cfg3, 3), meta, cfg3, topo, 60, telemetry=True
+    )
+    # the run itself is untouched: trace_every only changes the recorder
+    for x, y in zip(jax.tree.leaves(full[0]), jax.tree.leaves(dec[0])):
+        assert (np.asarray(x) == np.asarray(y)).all()
+    rounds = int(full[0].t)
+    sampled = trace_rows_for(rounds, 3)
+    assert sampled == -(-rounds // 3)
+    # buffer allocation: sampled rows + 1 scratch
+    assert dec[2].up_nodes.shape[0] == trace_rows_for(60, 3) + 1
+    # every sampled row equals the exact recorder's row at t = 3·i
+    for name in RoundTrace._fields:
+        x = np.asarray(getattr(full[2], name))[:rounds:3]
+        y = np.asarray(getattr(dec[2], name))[:sampled]
+        assert (x == y).all(), name
+    # exporters label rows with the REAL round they recorded
+    rows = trace_rows(dec[2], rounds, cfg3)
+    assert [r["t"] for r in rows] == [3 * i for i in range(sampled)]
+    # the summary self-describes only when the knob is on
+    s_full = trace_summary(full[2], rounds, cfg)
+    s_dec = trace_summary(dec[2], rounds, cfg3)
+    assert "trace_every" not in s_full
+    assert s_dec["trace_every"] == 3
+
+
+def test_trace_every_coverage_latency_upper_bound():
+    """Decimated coverage latency reports the first SAMPLED round —
+    an upper bound within one stride of the exact latency."""
+    from corrosion_tpu.sim.telemetry import coverage_latency_rounds
+
+    cfg = _cfg()
+    cfg2 = dataclasses.replace(cfg, trace_every=2)
+    meta = uniform_payloads(cfg, inject_every=1)
+    full = run_to_convergence(
+        new_sim(cfg, 5), meta, cfg, Topology(), 60, telemetry=True
+    )
+    dec = run_to_convergence(
+        new_sim(cfg2, 5), meta, cfg2, Topology(), 60, telemetry=True
+    )
+    rounds = int(full[0].t)
+    exact = coverage_latency_rounds(full[2], rounds)
+    coarse = coverage_latency_rounds(dec[2], rounds, every=2)
+    covered = (exact >= 0) & (coarse >= 0)
+    assert (coarse[covered] >= exact[covered]).all()
+    assert (coarse[covered] - exact[covered] < 2).all()
+
+
+def test_trace_every_validates():
+    with pytest.raises(ValueError, match="trace_every"):
+        _cfg(trace_every=0)
