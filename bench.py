@@ -55,6 +55,7 @@ _best: dict | None = None
 _secondary: dict | None = None
 _fault_storm: dict | None = None
 _tier_1m: dict | None = None
+_serving: dict | None = None
 _printed = False
 _diag: dict = {"attempts": [], "preflight": None, "started_unix": time.time()}
 
@@ -92,6 +93,12 @@ def _emit_and_exit(code: int = 0) -> None:
     # users" scale claim as a measured number
     if _tier_1m is not None:
         out["fault_storm_1m"] = _tier_1m
+    # host-serving rung (ISSUE 8): publish→subscriber-visible latency
+    # through the real serving path (HTTP → broadcast → apply →
+    # subscription fan-out), faultless + FaultPlan, with the
+    # instrumentation-overhead fraction recorded like the sim rung's
+    if _serving is not None:
+        out["serving_loadgen"] = _serving
     print(json.dumps(out), flush=True)
     _write_diag()
     os._exit(code)
@@ -386,6 +393,54 @@ def main() -> int:
                 "gap_overflow_frac_max": m.get("gap_overflow_frac_max"),
             }
             _diag["gapstress"] = {"nodes": gs_nodes, **m}
+        _write_diag()
+
+    # host-serving rung (ISSUE 8): the serving path under load — an
+    # in-process 3-node cluster flooded by the measured loadgen driver,
+    # recording publish→subscriber-visible p50/p95/p99 (faultless AND
+    # under the serving FaultPlan) plus the instrumentation-overhead
+    # fraction (interleaved per-variant-min A/B, the sim telemetry
+    # rung's discipline).  Cheap (~15 s) and pure-host, but still its
+    # own child so a hang can never eat the storm budget.
+    global _serving
+    if os.environ.get("BENCH_SERVING", "1") != "0" and _remaining() > 120:
+        sv_nodes = int(os.environ.get("BENCH_SERVING_NODES", "3"))
+        sv_writes = int(os.environ.get("BENCH_SERVING_WRITES", "192"))
+        res = run_child(
+            {
+                "mode": "aux",
+                "platform": "cpu",  # pure host path: never wake the chip
+                "fn": "config_serving_loadgen",
+                "seed": 1,
+                "kwargs": {"n_nodes": sv_nodes, "n_writes": sv_writes},
+            },
+            timeout=min(_remaining() - 30, 300.0),
+        )
+        _diag["attempts"].append(
+            {"phase": "serving_loadgen", "nodes": sv_nodes, **res}
+        )
+        m = res.get("metrics") or {}
+        if res.get("ok") and m.get("converged"):
+            vl = m.get("publish_visible_s") or {}
+            _serving = {
+                "metric": (
+                    f"serving_loadgen_{sv_nodes}node_"
+                    "publish_visible_p99"
+                ),
+                "value": vl.get("p99"),
+                "unit": "s",
+                "p50": vl.get("p50"),
+                "p95": vl.get("p95"),
+                "throughput_wps": m.get("throughput_wps"),
+                "consistent": m.get("consistent"),
+                "instrumentation_overhead_frac": m.get(
+                    "instrumentation_overhead_frac"
+                ),
+                "faulted_p99_s": (m.get("faulted") or {})
+                .get("publish_visible_s", {})
+                .get("p99"),
+            }
+            _diag["serving_loadgen"] = {"nodes": sv_nodes, **m}
         _write_diag()
 
     # fault-storm rung (ISSUE 4): the headline storm shape under a

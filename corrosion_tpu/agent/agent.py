@@ -76,12 +76,13 @@ class AdaptiveSender:
     MIN_CHANGES_BYTE_SIZE from a dead constant into live behavior
     (VERDICT r1 item 6)."""
 
-    def __init__(self, perf):
+    def __init__(self, perf, telemetry=None):
         self.chunk_size = perf.max_changes_byte_size
         self.min_size = perf.min_changes_byte_size
         self.slow_send_s = perf.sync_slow_send_s
         self.abort_send_s = perf.sync_stall_abort_s
         self.shrinks = 0
+        self.telemetry = telemetry
 
     async def send(self, bi: "BiStream", frame: bytes) -> None:
         t0 = time.monotonic()
@@ -91,6 +92,8 @@ class AdaptiveSender:
             raise SlowPeerAbort(
                 f"send stalled > {self.abort_send_s}s"
             ) from None
+        if self.telemetry is not None:
+            self.telemetry.wire("sync_out", len(frame))
         if (
             time.monotonic() - t0 >= self.slow_send_s
             and self.chunk_size > self.min_size
@@ -113,6 +116,11 @@ class _PendingBroadcast:
     frame: bytes
     send_count: int = 0
     is_local: bool = True
+    # replication identity of LOCAL frames (flight-recorder stage key);
+    # relayed frames leave these unset — their broadcast_out belongs to
+    # the origin node's record
+    actor_id: Optional[ActorId] = None
+    version: int = -1
 
 
 class _WriterLock(asyncio.Lock):
@@ -167,6 +175,11 @@ class Agent:
         self.write_sema = _WriterLock()
         self._rng = random.Random(self.actor_id.bytes_)
         self.swim = None  # attached by SwimRuntime.attach()
+        # host-tier flight recorder + serving metric families (ISSUE 8):
+        # None = off, and every hook site below is a single attribute
+        # test — the uninstrumented serving path is a measured no-op
+        # (telemetry.attach_host_telemetry arms it)
+        self.telemetry = None
         # labeled critical-section registry + watchdog (agent.rs:830-1055)
         self.locks = LockRegistry()
         # pubsub engine (L9): SQL subscriptions + per-table updates
@@ -344,12 +357,23 @@ class Agent:
                 self.actor_id, snap, RangeSet([(info.db_version, info.db_version)])
             )
 
+        _t0 = time.monotonic()
         with self.locks.track("make_broadcastable_changes"):
             cursors, info = self.store.transact(statements, pre_commit=pre_commit)
         if info is None:
             return cursors, None
         booked.commit_snapshot(snap)
         self.stats["changes_committed"] += info.last_seq + 1
+        tel = self.telemetry
+        if tel is not None:
+            # the PUBLISH stamp: the write is durable locally and about
+            # to enter dissemination — publish→visible is measured from
+            # here (doc/telemetry/host.md)
+            tel.commit(time.monotonic() - _t0)
+            tel.publish(
+                self.actor_id, info.db_version, info.ts,
+                n_changes=info.last_seq + 1,
+            )
         self._queue_local_broadcast(info)
         return cursors, info
 
@@ -405,7 +429,12 @@ class Agent:
                 "bcast", codec.encode_changeset(cs), ts=self.clock.now(),
                 cid=self.config.cluster_id,
             )
-            self._bcast_q.append(_PendingBroadcast(frame=frame, is_local=True))
+            self._bcast_q.append(
+                _PendingBroadcast(
+                    frame=frame, is_local=True,
+                    actor_id=self.actor_id, version=info.db_version,
+                )
+            )
         sometimes(True, "broadcasts-happen")
 
     # -- broadcast dissemination (L6) ------------------------------------
@@ -419,6 +448,11 @@ class Agent:
         while not self._stopped.is_set():
             await asyncio.sleep(interval)
             self.flush_tick += 1
+            tel = self.telemetry
+            if tel is not None:
+                # queue depths sampled once per flush tick (the scrape
+                # cadence that matters), never per frame
+                tel.queue_depths(self._ingest_q.qsize(), len(self._bcast_q))
             budget = perf.broadcast_rate_limit_bytes_s * interval
             requeue = []
             # one O(members) derivation per flush tick, not per item —
@@ -427,13 +461,27 @@ class Agent:
             while self._bcast_q and budget > 0:
                 item = self._bcast_q.popleft()
                 targets = self._choose_targets(item, max_tx)
+                sent_any = False
                 for st in targets:
                     try:
                         await self.transport.send_uni(st.addr, item.frame)
                         self.stats["broadcasts_sent"] += 1
+                        sent_any = True
                         budget -= len(item.frame)
+                        if tel is not None:
+                            tel.wire("broadcast_out", len(item.frame))
                     except (ConnectionError, OSError):
                         continue
+                if (
+                    tel is not None
+                    and sent_any
+                    and item.actor_id is not None
+                ):
+                    # the version's first SUCCESSFUL frame hit the wire:
+                    # the broadcast_out stamp.  Not gated on send_count —
+                    # a pass whose sends all failed must not eat the
+                    # stamp forever; the recorder dedupes re-sends
+                    tel.broadcast_out(item.actor_id, item.version)
                 item.send_count += 1
                 if targets and item.send_count < max_tx:
                     requeue.append(item)
@@ -488,6 +536,8 @@ class Agent:
                 return
         cs = codec.decode_changeset(body)
         self.stats["broadcasts_recv"] += 1
+        if self.telemetry is not None:
+            self.telemetry.wire("broadcast_in", len(data))
         await self._enqueue_changeset(cs, ChangeSource.BROADCAST, raw=data)
 
     async def _enqueue_changeset(
@@ -673,6 +723,8 @@ class Agent:
                     self._clear_buffered(cs.actor_id, cs.version)
                     self.stats["changes_applied"] += impacted
                     self._record_apply_tick(cs.actor_id, cs.version)
+                    if self.telemetry is not None:
+                        self.telemetry.apply(cs.actor_id, cs.version)
                     matched.extend(cs.changes)
                 else:
                     # version-level knowledge is recorded FIRST — and even
@@ -759,6 +811,8 @@ class Agent:
         booked.partials.pop(version, None)
         self.stats["changes_applied"] += impacted
         self._record_apply_tick(actor_id, version)
+        if self.telemetry is not None:
+            self.telemetry.apply(actor_id, version)
         self._match_changes(changes)
 
     async def _buffered_retry_loop(self):
@@ -807,6 +861,27 @@ class Agent:
             return
         self.subs.match_changes(changes)
         self.updates.match_changes(changes)
+        tel = self.telemetry
+        if tel is not None:
+            # the VISIBLE stamp: keyed matchers deliver synchronously
+            # (put_nowait inside match_changes), so the batch's versions
+            # are subscriber-visible NOW — but a fallback (non-keyed)
+            # matcher inside its re-run budget only marked itself dirty,
+            # and stamping now would antedate visibility by the whole
+            # defer window.  Those versions park in the SubsManager and
+            # stamp when the trailing flush actually delivers.  hlc_now
+            # is the node's LOCAL clock reading: the skew-surviving
+            # proxy column (doc/telemetry/host.md)
+            hlc_now = self.clock.peek()
+            pairs = list(dict.fromkeys(
+                (ch.site_id, ch.db_version) for ch in changes
+            ))
+            tables = {ch.table for ch in changes}
+            if self.subs.has_dirty(tables):
+                self.subs.defer_visible(pairs, hlc_now, tables)
+            else:
+                for actor_id, version in pairs:
+                    tel.visible(actor_id, version, hlc_now=hlc_now)
 
     def _clear_buffered(self, actor_id: ActorId, version: int):
         self.store.conn.execute(
@@ -928,6 +1003,8 @@ class Agent:
                 frame = await bi.recv(timeout)
                 if not frame:
                     break
+                if self.telemetry is not None:
+                    self.telemetry.wire("sync_in", len(frame))
                 kind, body, _ = codec.decode_message(frame)
                 if kind == "sync_done" or kind == "":
                     break
@@ -999,7 +1076,7 @@ class Agent:
         if kind != "sync_request" or not body:
             return
         needs = codec.decode_needs(body)
-        sender = AdaptiveSender(self.config.perf)
+        sender = AdaptiveSender(self.config.perf, telemetry=self.telemetry)
         try:
             for actor_id, need_list in needs.items():
                 for need in need_list:
@@ -1180,6 +1257,13 @@ class InteractiveTx:
         if info is not None:
             self._booked.commit_snapshot(snap)
             agent.stats["changes_committed"] += info.last_seq + 1
+            if agent.telemetry is not None:
+                # same publish stamp as the HTTP write path — the PG
+                # front-end's explicit transactions are publishes too
+                agent.telemetry.publish(
+                    agent.actor_id, info.db_version, info.ts,
+                    n_changes=info.last_seq + 1,
+                )
             agent._queue_local_broadcast(info)
         return info
 

@@ -163,6 +163,9 @@ class CrrStore:
     def __init__(self, path: str, site_id: ActorId, clock: Optional[HLC] = None):
         self.path = path
         self.clock = clock or HLC()
+        # serving telemetry handle (ISSUE 8): None = off, one attribute
+        # test per transact (telemetry.attach_host_telemetry arms it)
+        self.telemetry = None
         self.conn = sqlite3.connect(path, check_same_thread=False, isolation_level=None)
         self.conn.row_factory = sqlite3.Row
         # before any table exists (setup.rs:84-93); a pre-existing DB in
@@ -593,17 +596,29 @@ class CrrStore:
 
         ``pre_commit`` runs inside the transaction after changes exist —
         the agent uses it to persist bookkeeping atomically with the data
-        (insert_local_changes, change.rs:189-260)."""
+        (insert_local_changes, change.rs:189-260).
+
+        Serving telemetry (ISSUE 8): ``self.telemetry`` (attached by
+        `telemetry.attach_host_telemetry`, None otherwise — the
+        measured-no-op rule every hook site follows) observes the
+        whole-transaction wall on the sub-ms serving ladder
+        (corro_store_transact_seconds — local commits on an in-memory
+        store are ~100 µs, unresolvable on the default 1 ms+ ladder)."""
+        tel = self.telemetry
+        t0 = time.monotonic() if tel is not None else 0.0
         with self._lock:
             self.begin_interactive()
             try:
                 results = []
                 for sql, params in statements:
                     results.append(self.exec_interactive(sql, params))
-                return results, self.commit_interactive(pre_commit)
+                out = results, self.commit_interactive(pre_commit)
             except Exception:
                 self.rollback_interactive()
                 raise
+        if tel is not None:
+            tel.store_transact(time.monotonic() - t0)
+        return out
 
     # -- interactive write transaction ------------------------------------
     # The PG front-end holds one of these open across wire messages

@@ -304,6 +304,17 @@ class SwimRuntime:
 
     # -- merge rules ------------------------------------------------------
 
+    _STATUS_EVENT = {ALIVE: "alive", SUSPECT: "suspect", DOWN: "down"}
+
+    def _swim_event(self, event: str) -> None:
+        """Serving-telemetry counter for a membership event (ISSUE 8):
+        corro_serving_swim_events_total{event=...} — SWIM belief churn
+        alongside the write-path stages, the host twin of the sim
+        trace's swim_suspect/swim_down channels."""
+        tel = self.agent.telemetry
+        if tel is not None:
+            tel.swim_event(event)
+
     def _merge(self, info: MemberInfo):
         if info.actor_id == self.agent.actor_id:
             # refutation: someone thinks we're suspect/down
@@ -311,6 +322,7 @@ class SwimRuntime:
                 self.incarnation = info.incarnation + 1
                 me = _decode_member(self._self_member())
                 self._disseminate(me)
+                self._swim_event("refute")
             return
         cur = self.members.get(info.actor_id)
         if cur is not None and cur.key() >= info.key():
@@ -350,6 +362,12 @@ class SwimRuntime:
             self.down_tick.pop(info.actor_id, None)
         if info.status == DOWN:
             self._record_down_tick(info.actor_id)
+        if info.status != prev_status:
+            # .get: a wire status outside {ALIVE, SUSPECT, DOWN} (skewed
+            # or byzantine peer) must not crash the merge path
+            ev = self._STATUS_EVENT.get(info.status)
+            if ev is not None:
+                self._swim_event(ev)
         self.members[info.actor_id] = info
         self._apply_to_agent(info)
         self._disseminate(info)
@@ -448,6 +466,7 @@ class SwimRuntime:
                 target.status = SUSPECT
                 target.suspect_since = time.monotonic()
                 target.suspect_tick = self.probe_tick
+                self._swim_event("suspect")
                 self._disseminate(target)
 
     # -- cluster-size feedback (broadcast/mod.rs:236-256, 951-960) --------
@@ -511,6 +530,7 @@ class SwimRuntime:
                 m.status = DOWN
                 m.down_since = now
                 self._record_down_tick(m.actor_id)
+                self._swim_event("down")
                 self._apply_to_agent(m)
                 self._disseminate(m)
             elif m.status == DOWN:

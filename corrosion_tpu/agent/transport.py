@@ -786,6 +786,18 @@ class UdpTcpTransport(Transport):
             # delays session establishment like any other send
             await self.faults.apply_delay(addr)
         reader, writer = await self._connect(addr)
+        # re-check AFTER the dial: install_faults severs established
+        # conns, but a dial suspended inside _connect when the injector
+        # landed resumes with a socket that was in no sever list and —
+        # unlike uni frames, which re-check per send — a bi stream is
+        # never fault-checked again, so one racing sync session would
+        # replicate straight across a fresh partition
+        if self.faults is not None and self.faults.blocks(addr):
+            try:
+                writer.close()
+            except Exception:
+                pass
+            raise ConnectionError(f"fault injection: {addr} partitioned")
         writer.write(self.TAG_BI)
         await writer.drain()
         self._pstats(addr).bi_opened += 1

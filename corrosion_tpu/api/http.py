@@ -153,7 +153,9 @@ class ApiServer:
                 if method == "POST" and path == "/v1/transactions":
                     # single-writer lane: wait out any open PG explicit tx
                     async with self.agent.write_sema:
-                        resp = self._transactions(json.loads(body))
+                        resp = self._transactions(
+                            json.loads(body), body_len=len(body)
+                        )
                 elif method == "POST" and path == "/v1/queries":
                     await self._queries(json.loads(body), writer)
                     return True
@@ -178,7 +180,7 @@ class ApiServer:
 
     # -- handlers ---------------------------------------------------------
 
-    def _transactions(self, stmts) -> dict:
+    def _transactions(self, stmts, body_len: int = 0) -> dict:
         """api_v1_transactions (api/public/mod.rs:177): a JSON array of
         statements, each "sql" or ["sql", [params]] or {"query","params"}."""
         parsed = [_parse_statement(s) for s in stmts]
@@ -187,6 +189,11 @@ class ApiServer:
         t0 = time.monotonic()
         cursors, info = self.agent.exec_transaction_cursors(parsed)
         elapsed = time.monotonic() - t0
+        tel = self.agent.telemetry
+        if tel is not None:
+            # HTTP ingest stage of the serving flight path (ISSUE 8):
+            # handler latency on the sub-ms ladder + ingested wire bytes
+            tel.api_request("transactions", elapsed, body_len)
         return {
             "results": [{"rows_affected": max(c.rowcount, 0)} for c in cursors],
             "time": elapsed,

@@ -134,7 +134,17 @@ def _run_cell(
     so a non-divisible cell degrades to fewer devices rather than
     padding, which would change trajectories).  Sharding never changes a
     lane's result; the cell records the realized ``mesh`` shape so the
-    artifact says what actually ran."""
+    artifact says what actually ran.
+
+    Host-serving cells (``serving`` scenario key — ISSUE 8) never touch
+    the sim kernels: they dispatch to `_run_serving_cell`, which floods
+    an in-process agent cluster through the measured loadgen driver and
+    bands publish→subscriber-visible latency percentiles."""
+    if spec.serving(cell):
+        return _run_serving_cell(
+            spec, cell, cell_index=cell_index, telemetry=telemetry,
+            trace_dir=trace_dir,
+        )
     import jax
 
     from ..parallel.mesh import mesh_record, mesh_size
@@ -288,10 +298,182 @@ def _run_cell(
             spec, cell_index, traces, rounds, cfg, traceparent, trace_dir
         )
     if spec.host_parity and plan is not None:
-        result["host_parity"] = host_parity_point(
-            plan, cfg.n_versions, traceparent=traceparent
+        result["host_parity"] = host_parity_points(
+            spec, cell, cfg.n_versions, traceparent=traceparent
         )
     return result
+
+
+#: per-seed metrics a host-serving cell records and bands (ISSUE 8) —
+#: the latency ones are also in report.BAND_METRICS for compare
+_SERVING_SEED_METRICS = (
+    "publish_visible_p50_s", "publish_visible_p95_s",
+    "publish_visible_p99_s",
+)
+
+
+def _run_serving_cell(
+    spec: CampaignSpec,
+    cell: Dict[str, object],
+    cell_index: int = 0,
+    telemetry: bool = False,
+    trace_dir: Optional[str] = None,
+) -> Dict[str, object]:
+    """One host-serving parameter point (ISSUE 8): per seed, boot an
+    in-process ``n_nodes`` cluster, flood it through the measured
+    loadgen driver — with the spec's FaultPlan replayed underneath when
+    the cell's ``use_faults`` says so — and band the lanes'
+    publish→subscriber-visible latency percentiles.
+
+    The cell's ``all_converged`` is every lane's ``consistent`` (zero
+    lost writes, checker attached throughout), so `report.compare`
+    regresses on a consistency violation exactly as a sim cell
+    regresses on a convergence loss — the CI serving-smoke gate's
+    teeth.  Lanes are wall-clock measurements: the replay digest covers
+    only the cell's experiment identity
+    (`report._SERVING_MEASURED_KEYS`).
+
+    ``telemetry`` arms the host flight recorder on every agent; each
+    lane's summary lands under ``telemetry.per_seed`` and, with
+    ``trace_dir``, a host flight JSONL per (cell, lane) — the same
+    naming scheme sim lanes use (`_lane_trace_path`)."""
+    import asyncio
+
+    from ..loadgen import run_serving_cluster_load
+    from ..tracing import span
+
+    n_nodes = int(cell.get("n_nodes", spec.scenario["n_nodes"]))
+    use_faults = spec.serving_faults(cell)
+    params = spec.serving_params(cell)
+    k = len(spec.seeds)
+    per_seed: Dict[str, List] = {
+        "consistent": [], "writes_ok": [], "throughput_wps": [],
+        **{m: [] for m in _SERVING_SEED_METRICS},
+    }
+    summaries: List[Optional[dict]] = []
+    plan_horizon = 0
+    with span(
+        "campaign_cell",
+        campaign=spec.name,
+        cell_index=cell_index,
+        params=dict(cell),
+        seeds=k,
+        kind="host-serving",
+    ) as cell_span:
+        traceparent = cell_span.context.traceparent()
+        t0 = time.monotonic()
+        for seed in spec.seeds:
+            plan = (
+                spec.fault_plan(cell, seed=seed) if use_faults else None
+            )
+            if plan is not None:
+                plan_horizon = plan.horizon
+            trace_path = None
+            if telemetry and trace_dir:
+                os.makedirs(trace_dir, exist_ok=True)
+                trace_path = _lane_trace_path(
+                    trace_dir, spec, cell_index, seed
+                )
+            # serving lanes run sequentially in real time, so each gets
+            # a real per-lane span WRAPPING the run (unlike vmapped sim
+            # lanes, whose spans are host-synthesized afterwards); the
+            # loadgen's serving_loadgen span parents under it, giving
+            # cell → lane → serving_loadgen in one trace
+            with span("serving_lane", seed=int(seed)) as lane_span:
+                out = asyncio.run(
+                    run_serving_cluster_load(
+                        n_nodes=n_nodes, seed=int(seed), plan=plan,
+                        telemetry=telemetry, trace_path=trace_path,
+                        traceparent=lane_span.context.traceparent(),
+                        header={
+                            "campaign": spec.name,
+                            "spec_hash": spec.spec_hash(),
+                            "cell_index": cell_index,
+                            "seed": int(seed),
+                        },
+                        **params,
+                    )
+                )
+                lane_span.set_attribute(
+                    "consistent", bool(out["consistent"])
+                )
+                lane_span.set_attribute(
+                    "writes_ok", int(out["writes_ok"])
+                )
+            vl = out.get("visible_latency_s") or {}
+            per_seed["consistent"].append(bool(out["consistent"]))
+            per_seed["writes_ok"].append(int(out["writes_ok"]))
+            per_seed["throughput_wps"].append(
+                float(out["throughput_wps"])
+            )
+            per_seed["publish_visible_p50_s"].append(vl.get("p50"))
+            per_seed["publish_visible_p95_s"].append(vl.get("p95"))
+            per_seed["publish_visible_p99_s"].append(vl.get("p99"))
+            summaries.append(out.get("telemetry"))
+        wall = time.monotonic() - t0
+
+    result = {
+        "params": dict(cell),
+        "kind": "host-serving",
+        "n_nodes": n_nodes,
+        "use_faults": bool(use_faults),
+        "plan_horizon": plan_horizon,
+        "seeds": list(spec.seeds),
+        "per_seed": per_seed,
+        "bands": {
+            m: bands(per_seed[m])
+            for m in _SERVING_SEED_METRICS + ("throughput_wps",)
+        },
+        "all_converged": bool(all(per_seed["consistent"])),
+        "wall_clock_s": round(wall, 4),
+        # host walls are real time by construction — no HBM floor applies
+        "wall_defensible_s": round(wall, 4),
+        "wall_verdict": WALL_OK,
+        "traceparent": traceparent,
+    }
+    if telemetry:
+        result["telemetry"] = {"per_seed": summaries}
+    return result
+
+
+def host_parity_points(
+    spec: CampaignSpec,
+    cell: Dict[str, object],
+    n_versions: int,
+    traceparent: Optional[str] = None,
+) -> Dict[str, object]:
+    """Budgeted multi-lane host parity (ISSUE 8 satellite): replay up to
+    ``spec.parity_seeds`` of the cell's seed lanes against the
+    in-process host cluster, stopping once ``spec.parity_budget_s`` of
+    wall has been spent — the FIRST lane always runs (the pre-knob
+    behavior), the budget bounds the extras.  Records how many lanes
+    actually ran, and keeps the legacy single-point keys at top level
+    (first lane) so existing artifact consumers read unchanged —
+    except ``heads_match``, which becomes the ALL-lanes conjunction
+    (the honest aggregate a multi-lane point must report)."""
+    requested = max(1, min(int(spec.parity_seeds), len(spec.seeds)))
+    budget = float(spec.parity_budget_s)
+    t0 = time.monotonic()
+    lanes: List[Dict[str, object]] = []
+    for seed in spec.seeds[:requested]:
+        if lanes and time.monotonic() - t0 > budget:
+            break
+        plan = spec.fault_plan(cell, seed=seed)
+        lanes.append(
+            host_parity_point(plan, n_versions, traceparent=traceparent)
+        )
+    out = dict(lanes[0])
+    out.update(
+        {
+            "lanes": lanes,
+            "lanes_requested": requested,
+            "lanes_run": len(lanes),
+            "budget_s": budget,
+            "wall_clock_s": round(time.monotonic() - t0, 3),
+            "heads_match": bool(all(l["heads_match"] for l in lanes)),
+        }
+    )
+    return out
 
 
 def _cell_telemetry(

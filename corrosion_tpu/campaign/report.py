@@ -21,9 +21,15 @@ from .spec import canonical_json, content_hash
 
 #: per-seed metrics that band + regression-compare (higher = worse).
 #: ``detect_round`` exists only on membership cells (detect_membership
-#: scenarios — runner configs #2/#2b through the engine); `compare`
+#: scenarios — runner configs #2/#2b through the engine); the
+#: ``publish_visible_*`` latency metrics only on host-serving cells
+#: (ISSUE 8 — each lane's loadgen percentiles, in seconds); `compare`
 #: skips bands a cell doesn't carry.
-BAND_METRICS = ("rounds", "p99_node_convergence_round", "detect_round")
+BAND_METRICS = (
+    "rounds", "p99_node_convergence_round", "detect_round",
+    "publish_visible_p50_s", "publish_visible_p95_s",
+    "publish_visible_p99_s",
+)
 #: artifact keys excluded from the result digest (vary run to run —
 #: or run-CONFIG to run-config — without changing the campaign's
 #: *outcome*: walls are measurements, host-tier parity points ride real
@@ -65,10 +71,19 @@ def bands(values) -> Dict[str, float]:
     }
 
 
+#: host-serving cells (ISSUE 8) measure WALL-CLOCK latencies: every
+#: per-seed value is a real-time measurement, so the whole measured
+#: payload leaves the replay digest — the digest certifies the
+#: experiment's identity (params, seeds, shape), never its timings.
+#: Sim cells keep their full deterministic payload in the digest.
+_SERVING_MEASURED_KEYS = ("per_seed", "bands", "all_converged")
+
+
 def _strip_nondeterministic(cell: Dict) -> Dict:
-    return {
-        k: v for k, v in cell.items() if k not in NONDETERMINISTIC_KEYS
-    }
+    drop = set(NONDETERMINISTIC_KEYS)
+    if cell.get("kind") == "host-serving":
+        drop.update(_SERVING_MEASURED_KEYS)
+    return {k: v for k, v in cell.items() if k not in drop}
 
 
 def artifact_digest(cells: List[Dict]) -> str:

@@ -42,8 +42,25 @@ _TOPOLOGY_KEYS = ("n_regions", "intra_delay", "inter_delay", "loss")
 #:   ``detect_round`` (ROADMAP "detect-round bands");
 #: - ``kill_every`` — kill every k-th node at t=0 on every lane (the
 #:   churn configs' mutator, 0 = none).
+#: - ``serving`` — the cell is a HOST-SERVING cell (ISSUE 8): instead of
+#:   the sim kernels, each lane boots an in-process ``n_nodes`` agent
+#:   cluster with an ApiServer per node and floods it through the
+#:   measured loadgen driver (`loadgen.run_serving_cluster_load`),
+#:   banding publish→subscriber-visible latency percentiles per seed;
+#: - ``n_writes``/``n_writers``/``n_watchers``/``rate_hz``/
+#:   ``settle_timeout_s`` — the serving cell's workload shape;
+#: - ``use_faults`` — whether a serving cell replays the spec's events
+#:   through `HostFaultDriver` during the flood (a grid axis over
+#:   [0, 1] runs the same workload faultless AND faulted).
 _SCENARIO_META_KEYS = (
     "inject_every", "detect_membership", "kill_every",
+    "serving", "n_writes", "n_writers", "n_watchers", "rate_hz",
+    "settle_timeout_s", "use_faults",
+)
+
+#: serving-cell workload knobs → run_serving_cluster_load kwarg names
+_SERVING_PARAM_KEYS = (
+    "n_writes", "n_writers", "n_watchers", "rate_hz", "settle_timeout_s",
 )
 
 
@@ -111,6 +128,14 @@ class CampaignSpec:
     host_parity: bool = False
     round_s: float = 0.05  # host-tier wall-clock per round
     telemetry: bool = False
+    # host-parity lane budget (ISSUE 8 satellite): replay up to
+    # ``parity_seeds`` of the seed set against the host tier, stopping
+    # once ``parity_budget_s`` of wall has been spent (the FIRST lane
+    # always runs) — the engine records how many lanes actually ran.
+    # Both serialize only when non-default, so existing spec hashes and
+    # committed baselines are untouched.
+    parity_seeds: int = 1
+    parity_budget_s: float = 120.0
 
     def __post_init__(self):
         object.__setattr__(self, "events", tuple(self.events))
@@ -140,6 +165,11 @@ class CampaignSpec:
         # existing spec hash (committed baselines included) for nothing
         if self.telemetry:
             d["telemetry"] = True
+        # same only-when-non-default rule for the parity-lane budget
+        if self.parity_seeds != 1:
+            d["parity_seeds"] = self.parity_seeds
+        if self.parity_budget_s != 120.0:
+            d["parity_budget_s"] = self.parity_budget_s
         return d
 
     @classmethod
@@ -155,6 +185,8 @@ class CampaignSpec:
             host_parity=bool(d.get("host_parity", False)),
             round_s=float(d.get("round_s", 0.05)),
             telemetry=bool(d.get("telemetry", False)),
+            parity_seeds=int(d.get("parity_seeds", 1)),
+            parity_budget_s=float(d.get("parity_budget_s", 120.0)),
         )
 
     def spec_hash(self) -> str:
@@ -227,6 +259,38 @@ class CampaignSpec:
     def kill_every(self, cell: Dict[str, object]) -> int:
         return int(
             cell.get("kill_every", self.scenario.get("kill_every", 0))
+        )
+
+    # -- host-serving cells (ISSUE 8) ---------------------------------------
+
+    def serving(self, cell: Dict[str, object]) -> bool:
+        """True when the cell is a host-serving cell: the engine runs
+        the measured loadgen driver over an in-process cluster instead
+        of the sim kernels, and bands latency percentiles."""
+        return bool(
+            cell.get("serving", self.scenario.get("serving", False))
+        )
+
+    def serving_params(self, cell: Dict[str, object]) -> Dict[str, object]:
+        """The serving cell's workload shape as
+        `loadgen.run_serving_cluster_load` kwargs (only keys the spec or
+        cell actually set — the driver owns the defaults)."""
+        out: Dict[str, object] = {}
+        for k in _SERVING_PARAM_KEYS:
+            if k in cell:
+                out[k] = cell[k]
+            elif k in self.scenario:
+                out[k] = self.scenario[k]
+        return out
+
+    def serving_faults(self, cell: Dict[str, object]) -> bool:
+        """Whether this serving cell replays the spec's events through
+        the host fault driver (default: yes iff the spec has events)."""
+        return bool(
+            cell.get(
+                "use_faults",
+                self.scenario.get("use_faults", bool(self.events)),
+            )
         )
 
     def fault_plan(
@@ -339,11 +403,45 @@ def swim_churn_partial_spec(
     )
 
 
+def serving_3node_spec(
+    seeds: Sequence[int] = (0, 1),
+    n: int = 3,
+    n_writes: int = 48,
+    rate_hz: float = 120.0,
+) -> CampaignSpec:
+    """The host-serving rung (ISSUE 8) as a campaign: a 3-node
+    in-process cluster flooded by 2 writers × 2 watchers, one cell
+    faultless and one with a loss burst + asymmetric partition + delay
+    replayed underneath (`use_faults` grid axis) — banding
+    publish→subscriber-visible p50/p95/p99 per seed and failing the
+    compare gate on any lost write (``all_converged`` ≡ every lane
+    ``consistent``).  The committed baseline lives at
+    doc/experiments/CAMPAIGN_BASELINE_serving-3node.json (CI
+    ``serving-smoke``)."""
+    return CampaignSpec(
+        name="serving-3node",
+        scenario={
+            "n_nodes": n, "serving": True,
+            "n_writes": n_writes, "n_writers": 2, "n_watchers": 2,
+            "rate_hz": rate_hz, "settle_timeout_s": 30.0,
+        },
+        events=(
+            FaultEvent("loss", 0, 16, p=0.3),
+            FaultEvent("partition", 4, 12, src=2, dst=0),
+            FaultEvent("delay", 2, 14, src=0, dst=1, delay_rounds=1),
+        ),
+        grid={"use_faults": [0, 1]},
+        seeds=tuple(seeds),
+        round_s=0.05,
+    )
+
+
 BUILTIN_SPECS = {
     "fault-parity-3node": fault_parity_3node_spec,
     "fault-campaign-3node": fault_campaign_3node_spec,
     "swim-churn-64": swim_churn_64_spec,
     "swim-churn-partial": swim_churn_partial_spec,
+    "serving-3node": serving_3node_spec,
 }
 
 

@@ -370,6 +370,11 @@ _SIM_SCENARIOS = {
     # the 1M-node tier (ISSUE 7): the storm schedule at a million nodes,
     # sharded, ground-truth membership, defensible-wall verified
     "fault-storm-1m": "config_fault_storm_1m",
+    # the HOST-SERVING rung (ISSUE 8): flood an in-process agent cluster
+    # through the measured loadgen driver — publish→subscriber-visible
+    # latency percentiles, instrumentation-overhead A/B, faultless AND
+    # FaultPlan conditions, host flight JSONL via --trace-out
+    "serving-loadgen": "config_serving_loadgen",
 }
 
 
@@ -538,8 +543,11 @@ def _run_sim_scenario(args) -> int:
 
 def cmd_trace(args) -> int:
     """`sim trace show --in FILE`: render a flight-recorder JSONL
-    artifact (header summary + a compact per-round table) without
-    touching jax — the artifact is plain JSON lines."""
+    artifact (header summary + a compact table) without touching jax —
+    the artifact is plain JSON lines.  Both tiers share one schema
+    (``kind: flight_recorder``): sim files carry per-ROUND rows, host
+    files (``tier: host`` — ISSUE 8) per-WRITE rows with the
+    publish→broadcast-out→apply→visible stage latencies."""
     if args.campaign_cmd != "show":
         raise SystemExit("usage: sim trace show --in FILE [--json]")
     if not args.in_path:
@@ -552,24 +560,52 @@ def cmd_trace(args) -> int:
     if args.json:
         _print_json({"header": head, "rounds": rows})
         return 0
-    print(
-        f"flight recorder v{head.get('version')}: "
-        f"{head['n_nodes']} nodes × {head['n_payloads']} payloads, "
-        f"{head['rounds']} rounds"
-    )
-    for k in ("campaign", "cell_index", "seed", "scenario", "traceparent"):
+    host_tier = head.get("tier") == "host"
+    if host_tier:
+        print(
+            f"flight recorder v{head.get('version')} (host tier): "
+            f"{head.get('n_nodes', '?')} nodes, "
+            f"{head.get('writes', len(rows))} writes"
+        )
+    else:
+        print(
+            f"flight recorder v{head.get('version')}: "
+            f"{head['n_nodes']} nodes × {head['n_payloads']} payloads, "
+            f"{head['rounds']} rounds"
+        )
+    for k in (
+        "campaign", "cell_index", "seed", "scenario", "writers",
+        "watchers", "traceparent",
+    ):
         if k in head:
             print(f"  {k}: {head[k]}")
     _print_json(head.get("summary", {}))
-    cols = (
-        "t", "coverage_frac", "delivered", "bcast_bytes", "sync_bytes",
-        "sync_sessions", "bcast_dropped", "bcast_cut", "swim_down",
-        "crashes", "wipes", "gap_overflow",
-    )
+    if host_tier:
+        cols = (
+            "t", "actor", "version", "node", "n_changes",
+            "broadcast_out_ms", "publish_to_visible_ms", "hlc_lag_ms",
+        )
+    else:
+        cols = (
+            "t", "coverage_frac", "delivered", "bcast_bytes", "sync_bytes",
+            "sync_sessions", "bcast_dropped", "bcast_cut", "swim_down",
+            "crashes", "wipes", "gap_overflow",
+        )
     print("  ".join(f"{c:>13}" for c in cols))
     for row in rows:
         print("  ".join(f"{row.get(c, ''):>13}" for c in cols))
     return 0
+
+
+def _cell_round_path(c: dict) -> str:
+    """Which execution path a campaign cell ran: a round kernel
+    ("packed" | "dense"), the HOST serving path (ISSUE 8 cells), or
+    "unknown" for cells resumed from pre-round_path artifacts — ONE
+    mapping shared by the report table and the run summary's
+    kernel_paths."""
+    if c.get("kind") == "host-serving":
+        return "host"
+    return c.get("round_path", "unknown")
 
 
 def cmd_campaign(args) -> int:
@@ -606,9 +642,13 @@ def cmd_campaign(args) -> int:
             "cells": [],
         }
         for c in art.get("cells", []):
+            serving = c.get("kind") == "host-serving"
             entry = {
                 "params": c.get("params", {}),
-                "round_path": c.get("round_path", "unknown"),
+                # host-serving cells (ISSUE 8) ran the serving path, not
+                # a round kernel — report them in the SAME table, their
+                # latency bands alongside the sim cells' round bands
+                "round_path": _cell_round_path(c),
                 # the realized mesh per cell (ISSUE 7): which devices the
                 # round_path above actually partitioned over — None /
                 # absent = unsharded (or a pre-sharding artifact)
@@ -616,6 +656,12 @@ def cmd_campaign(args) -> int:
                 "all_converged": c.get("all_converged"),
                 "bands": c.get("bands", {}),
             }
+            if serving:
+                entry["kind"] = "host-serving"
+                entry["consistent"] = c.get("per_seed", {}).get(
+                    "consistent"
+                )
+                entry["use_faults"] = c.get("use_faults")
             if c.get("traceparent"):
                 entry["traceparent"] = c["traceparent"]
             if args.telemetry and "telemetry" in c:
@@ -687,10 +733,13 @@ def cmd_campaign(args) -> int:
         "all_converged": all(
             c.get("all_converged", False) for c in artifact["cells"]
         ),
+        # serving cells band latency seconds, sim cells band rounds —
+        # one summary table either way (ISSUE 8)
         "bands": {
-            json.dumps(c.get("params", {}), sort_keys=True): c["bands"][
-                "rounds"
-            ]
+            json.dumps(c.get("params", {}), sort_keys=True): (
+                c["bands"].get("rounds")
+                or c["bands"].get("publish_visible_p99_s")
+            )
             for c in artifact["cells"]
         },
         # which round kernels each grid point ran (ISSUE 4): dense
@@ -702,7 +751,7 @@ def cmd_campaign(args) -> int:
         # ran node-split over 8 devices; no suffix = unsharded.
         "kernel_paths": {
             json.dumps(c.get("params", {}), sort_keys=True): (
-                c.get("round_path", "unknown")
+                _cell_round_path(c)
                 + (
                     "@nodes={}".format(c["mesh"]["axes"]["nodes"])
                     if c.get("mesh")
@@ -947,14 +996,31 @@ def build_parser() -> argparse.ArgumentParser:
     dc.set_defaults(fn=cmd_devcluster)
 
     lgn = sp.add_parser(
-        "loadgen", help="flood writes + validate subscription consistency"
+        "loadgen",
+        help="flood writes + validate subscription consistency "
+        "(measured driver: N writers × M watchers, latency percentiles)",
     )
-    lgn.add_argument("--write-addr", required=True, help="API addr written to")
-    lgn.add_argument("--read-addr", default=None, help="API addr watched (default: write addr)")
+    lgn.add_argument(
+        "--write-addr", required=True, action="append",
+        help="API addr written to (repeatable: writers round-robin)",
+    )
+    lgn.add_argument(
+        "--read-addr", default=None, action="append",
+        help="API addr watched (repeatable; default: write addrs)",
+    )
     lgn.add_argument("--table", default="tests")
     lgn.add_argument("--writes", type=int, default=100)
+    lgn.add_argument("--writers", type=int, default=1)
+    lgn.add_argument("--watchers", type=int, default=1)
     lgn.add_argument("--rate", type=float, default=200.0)
     lgn.add_argument("--settle-timeout", type=float, default=30.0)
+    lgn.add_argument(
+        "--base-id", type=int, default=None,
+        help="first row id (default: microsecond-derived, so repeated "
+        "runs against a live cluster don't collide with their own "
+        "stale rows — a fixed base would re-see run N-1's rows in the "
+        "subscription snapshot and mask lost writes)",
+    )
     lgn.set_defaults(fn=cmd_loadgen)
 
     return p
@@ -984,15 +1050,28 @@ def cmd_devcluster(args) -> int:
 
 def cmd_loadgen(args) -> int:
     """Workload driver (.antithesis/client/src/main.rs:65-308): exit 0
-    iff every committed write surfaced on the watched subscription."""
+    iff every committed write surfaced on every watched subscription.
+    The report carries publish→visible latency percentiles (ISSUE 8)."""
     from ..loadgen import LoadGenerator
 
-    gen = LoadGenerator(args.write_addr, args.read_addr, table=args.table)
+    gen = LoadGenerator(
+        args.write_addr, args.read_addr, table=args.table,
+        n_writers=args.writers, n_watchers=args.watchers,
+    )
+    # microsecond resolution: two scripted runs collide only if they
+    # start in the same µs (second-granularity left same-second runs —
+    # and >1000-write runs 1 s apart — overlapping their id ranges)
+    base_id = (
+        args.base_id
+        if args.base_id is not None
+        else 1_000_000 + time.time_ns() // 1_000 % 10**12
+    )
     report = asyncio.run(
         gen.run(
             n_writes=args.writes,
             rate_hz=args.rate,
             settle_timeout_s=args.settle_timeout,
+            base_id=base_id,
         )
     )
     _print_json(report.to_dict())

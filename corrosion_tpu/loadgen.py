@@ -1,4 +1,4 @@
-"""Workload generator + stream-consistency checker.
+"""Workload generator + stream-consistency checker + measured driver.
 
 Rebuild of the Antithesis rust-load-generator
 (.antithesis/client/src/main.rs:65-308): flood ``/v1/transactions`` with
@@ -6,6 +6,27 @@ inserts, follow the same table through a SQL subscription and the
 ``/v1/updates`` feed, and validate that every write eventually appears on
 every watched stream — the "no lost writes" property the reference's
 ``eventually_check_db.sh`` / ``check_bookkeeping.py`` checkers assert.
+
+Since ISSUE 8 this is also the host tier's MEASURED workload driver:
+
+- **N writers × M watchers** — writers round-robin across the write
+  addresses with disjoint id ranges; every watcher follows its own
+  subscription stream, and consistency means every write surfaced on
+  every HEALTHY watcher (a dead stream reads as "checker broken", never
+  as "writes lost" — the two are classified separately).
+- **publish→subscriber-visible latency** — each write's client-observed
+  ``execute()`` completion is stamped; each watcher stamps first sight
+  of each row; `LoadReport.visible_latency_s` carries the cross-sample
+  p50/p95/p99 (the SWARM metric of record, regression-banded by the
+  campaign engine's host-serving cells).
+- **FaultPlan underneath** — `run_serving_cluster_load` drives an
+  in-process cluster with the host fault drivers running during the
+  flood, then heals everything before the settle check.
+- **flight recording** — with telemetry on, every agent gets a
+  `telemetry.HostTelemetry` feeding one shared `HostFlightRecorder`;
+  the per-write stage stamps land in a host flight JSONL
+  (`sim trace show` renders it) and serving metric families land on a
+  `metrics.Registry`.
 """
 
 from __future__ import annotations
@@ -14,7 +35,7 @@ import asyncio
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Sequence, Set, Union
 
 from .api.client import ApiClient
 
@@ -29,6 +50,13 @@ class LoadReport:
     missing_on_sub: List[int] = field(default_factory=list)
     stream_errors: List[str] = field(default_factory=list)
     elapsed_s: float = 0.0
+    # -- measured-driver fields (ISSUE 8) ------------------------------
+    writers: int = 1
+    watchers: int = 1
+    flood_s: float = 0.0       # wall for the write flood alone
+    stream_deaths: int = 0     # watcher streams that died (checker loss)
+    visible_latency_s: Optional[dict] = None  # publish→visible block
+    write_latency_s: Optional[dict] = None    # client execute() latency
 
     @property
     def consistent(self) -> bool:
@@ -40,6 +68,29 @@ class LoadReport:
             and not self.stream_errors
         )
 
+    @property
+    def checker_broken(self) -> bool:
+        """A watch stream died or never attached: the consistency verdict
+        is INCONCLUSIVE, not a replication failure."""
+        return bool(self.stream_errors)
+
+    @property
+    def lost_writes(self) -> bool:
+        """Writes committed but never surfaced on a HEALTHY watcher: the
+        actual replication failure the checker exists to catch.
+        ``missing_on_sub`` is computed ONLY from watchers that stayed
+        attached to the end, so it convicts regardless of whether some
+        OTHER stream also died — a dead stream elsewhere must not grant
+        amnesty to a verified loss on a healthy one."""
+        return bool(self.missing_on_sub)
+
+    @property
+    def throughput_wps(self) -> float:
+        """Committed writes per second of flood wall."""
+        if self.flood_s <= 0:
+            return 0.0
+        return self.writes_ok / self.flood_s
+
     def to_dict(self) -> dict:
         return {
             "writes_attempted": self.writes_attempted,
@@ -50,37 +101,86 @@ class LoadReport:
             "missing_on_sub": len(self.missing_on_sub),
             "stream_errors": list(self.stream_errors),
             "consistent": self.consistent,
+            "checker_broken": self.checker_broken,
+            "lost_writes": self.lost_writes,
             "elapsed_s": round(self.elapsed_s, 3),
+            "writers": self.writers,
+            "watchers": self.watchers,
+            "flood_s": round(self.flood_s, 3),
+            "throughput_wps": round(self.throughput_wps, 1),
+            "stream_deaths": self.stream_deaths,
+            "visible_latency_s": self.visible_latency_s,
+            "write_latency_s": self.write_latency_s,
         }
 
 
 class LoadGenerator:
-    """Drives one table (default the test schema's ``tests``) on a write
-    address while watching a read address (same node or a different one —
-    cross-node watching also validates convergence)."""
+    """Drives one table (default the test schema's ``tests``) with N
+    writer lanes over the write addresses while M watchers follow the
+    read addresses (same node or different ones — cross-node watching
+    also validates convergence).  The single-addr single-lane form is
+    the original Antithesis shape and stays the default."""
 
     def __init__(
         self,
-        write_addr: str,
-        read_addr: Optional[str] = None,
+        write_addr: Union[str, Sequence[str]],
+        read_addr: Union[str, Sequence[str], None] = None,
         table: str = "tests",
         seed: int = 0,
+        n_writers: int = 1,
+        n_watchers: int = 1,
     ):
-        self.write_client = ApiClient(write_addr)
-        self.read_client = ApiClient(read_addr or write_addr)
+        write_addrs = (
+            [write_addr] if isinstance(write_addr, str) else list(write_addr)
+        )
+        if read_addr is None:
+            read_addrs = list(write_addrs)
+        elif isinstance(read_addr, str):
+            read_addrs = [read_addr]
+        else:
+            read_addrs = list(read_addr)
+        self.write_clients = [ApiClient(a) for a in write_addrs]
+        self.read_clients = [ApiClient(a) for a in read_addrs]
+        # original single-lane attribute names kept for callers/tests
+        self.write_client = self.write_clients[0]
+        self.read_client = self.read_clients[0]
         self.table = table
         self._rng = random.Random(seed)
+        self.n_writers = max(1, int(n_writers))
+        self.n_watchers = max(1, int(n_watchers))
         self._written: Set[int] = set()
+        self._write_ok_at: Dict[int, float] = {}
+        self._write_lat: List[float] = []
+        # per-watcher first-sight stamps; _sub_seen stays the union (the
+        # events-flowed signal); consistency intersects HEALTHY watchers
+        self._seen_at: List[Dict[int, float]] = []
+        self._watcher_ok: List[bool] = []
+        # a watcher KNOWN dead (attach failure, stream death, early
+        # EOF): the settle loop stops waiting on it — its rows can
+        # never arrive, and the death is already in stream_errors
+        self._watcher_dead: List[bool] = []
+        # snapshot rows, per watcher: they prove VISIBILITY (a
+        # reconnecting watcher recovers missed writes as snapshot rows)
+        # but carry no latency truth — a stale pre-run row against a
+        # live cluster would read as ~0 ms and poison the percentiles,
+        # so only live "change" events stamp _seen_at
+        self._snap_seen: List[Set[int]] = []
         self._sub_seen: Set[int] = set()
-        self.report = LoadReport()
+        self.report = LoadReport(
+            writers=self.n_writers, watchers=self.n_watchers
+        )
 
-    async def _writer(self, n_writes: int, rate_hz: float, base_id: int):
+    async def _writer(
+        self, w: int, n_writes: int, rate_hz: float, base_id: int
+    ):
+        client = self.write_clients[w % len(self.write_clients)]
         interval = 1.0 / rate_hz if rate_hz > 0 else 0.0
         for i in range(n_writes):
             rowid = base_id + i
             self.report.writes_attempted += 1
+            t0 = time.monotonic()
             try:
-                await self.write_client.execute(
+                await client.execute(
                     [
                         [
                             f"INSERT OR REPLACE INTO {self.table} (id, text) "
@@ -89,43 +189,105 @@ class LoadGenerator:
                         ]
                     ]
                 )
+                now = time.monotonic()
                 self.report.writes_ok += 1
                 self._written.add(rowid)
+                self._write_ok_at[rowid] = now
+                self._write_lat.append(now - t0)
             except Exception:
                 self.report.write_errors += 1
             if interval:
                 await asyncio.sleep(interval * self._rng.uniform(0.5, 1.5))
 
-    async def _subscriber(self, stop: asyncio.Event):
+    def _saw(self, j: int, rowid, snapshot: bool = False) -> None:
+        if not isinstance(rowid, int):
+            return
+        self._sub_seen.add(rowid)
+        if snapshot:
+            self._snap_seen[j].add(rowid)
+        else:
+            self._seen_at[j].setdefault(rowid, time.monotonic())
+        self.report.sub_rows_seen += 1
+
+    def _watcher_rows(self, j: int) -> Set[int]:
+        """Everything watcher j has PROOF of seeing: live change events
+        (latency-stamped) plus snapshot rows (visibility only)."""
+        return set(self._seen_at[j]) | self._snap_seen[j]
+
+    #: watch-stream attach budget: a black-holed read address must
+    #: become a RECORDED checker death, not a silently hung task that
+    #: the settle loop waits out (subscribe has no transport timeout)
+    ATTACH_TIMEOUT_S = 10.0
+
+    async def _subscriber(self, j: int, stop: asyncio.Event):
+        client = self.read_clients[j % len(self.read_clients)]
         try:
-            sub = await self.read_client.subscribe(
-                [f"SELECT id, text FROM {self.table}", []]
+            sub = await asyncio.wait_for(
+                client.subscribe(
+                    [f"SELECT id, text FROM {self.table}", []]
+                ),
+                self.ATTACH_TIMEOUT_S,
             )
+        except asyncio.CancelledError:
+            # cancelled before ever attaching (run ended while this
+            # watcher was still dialing): it verified NOTHING — record
+            # the death so the verdict can't silently shrink to the
+            # watchers that did attach
+            self.report.stream_errors.append(
+                f"subscribe[{j}]: cancelled before attach"
+            )
+            self.report.stream_deaths += 1
+            self._watcher_dead[j] = True
+            raise
         except Exception as e:
-            self.report.stream_errors.append(f"subscribe: {e!r}")
+            self.report.stream_errors.append(f"subscribe[{j}]: {e!r}")
+            self.report.stream_deaths += 1
+            self._watcher_dead[j] = True
             return
         try:
             async for event in sub:
                 if stop.is_set():
                     break
                 if "row" in event:
-                    self._sub_seen.add(event["row"][1][0])
-                    self.report.sub_rows_seen += 1
+                    # initial-snapshot (or reconnect-snapshot) row
+                    self._saw(j, event["row"][1][0], snapshot=True)
                 elif "change" in event:
-                    self._sub_seen.add(event["change"][2][0])
-                    self.report.sub_rows_seen += 1
+                    self._saw(j, event["change"][2][0])
+            if stop.is_set():
+                self._watcher_ok[j] = True
+            else:
+                # subscriptions are infinite: a "clean" EOF before we
+                # asked means the serving node died (server close reads
+                # as EOF, not an error) — checker broken, not lost writes
+                self.report.stream_errors.append(
+                    f"subscription[{j}]: stream ended early"
+                )
+                self.report.stream_deaths += 1
+                self._watcher_dead[j] = True
         except asyncio.CancelledError:
-            pass
+            self._watcher_ok[j] = True  # stopped by us, not dead
         except Exception as e:
-            self.report.stream_errors.append(f"subscription: {e!r}")
+            self.report.stream_errors.append(f"subscription[{j}]: {e!r}")
+            self.report.stream_deaths += 1
+            self._watcher_dead[j] = True
         finally:
             sub.close()
 
     async def _updates_watcher(self, stop: asyncio.Event):
         try:
-            stream = await self.read_client.updates(self.table)
+            stream = await asyncio.wait_for(
+                self.read_client.updates(self.table),
+                self.ATTACH_TIMEOUT_S,
+            )
+        except asyncio.CancelledError:
+            self.report.stream_errors.append(
+                "updates attach: cancelled before attach"
+            )
+            self.report.stream_deaths += 1
+            raise
         except Exception as e:
             self.report.stream_errors.append(f"updates attach: {e!r}")
+            self.report.stream_deaths += 1
             return
         try:
             async for _event in stream:
@@ -136,8 +298,45 @@ class LoadGenerator:
             pass
         except Exception as e:
             self.report.stream_errors.append(f"updates: {e!r}")
+            self.report.stream_deaths += 1
         finally:
             stream.close()
+
+    def _finalize_latency(self) -> None:
+        from .telemetry import latency_block
+
+        samples: List[float] = []
+        for seen in self._seen_at:
+            for rowid, seen_s in seen.items():
+                ok_s = self._write_ok_at.get(rowid)
+                if ok_s is not None:
+                    # an event can beat the writer's HTTP response by a
+                    # task-scheduling hair; clamp, don't record negatives
+                    samples.append(max(0.0, seen_s - ok_s))
+        self.report.visible_latency_s = latency_block(samples)
+        self.report.write_latency_s = latency_block(self._write_lat)
+
+    def _finalize_missing(self) -> None:
+        missing: Set[int] = set()
+        healthy = [
+            self._watcher_rows(j)
+            for j in range(self.n_watchers)
+            if self._watcher_ok[j]
+        ]
+        for seen in healthy:
+            missing |= self._written - seen
+        if not healthy:
+            # every watcher died or never settled: nothing to certify
+            # against.  Ensure the checker reads BROKEN even if no
+            # watcher got far enough to record an error (e.g. all hung
+            # in attach until cancelled) — a run with zero visibility
+            # evidence must never report consistent=True
+            missing = set()
+            if self._written and not self.report.stream_errors:
+                self.report.stream_errors.append(
+                    "no watcher settled: consistency unverified"
+                )
+        self.report.missing_on_sub = sorted(missing)
 
     async def run(
         self,
@@ -145,23 +344,215 @@ class LoadGenerator:
         rate_hz: float = 200.0,
         settle_timeout_s: float = 30.0,
         base_id: int = 1_000_000,
+        settle_gate=None,
     ) -> LoadReport:
+        """Flood ``n_writes`` total writes across the writer lanes, then
+        wait until every healthy watcher saw every committed write (or
+        ``settle_timeout_s``).  ``settle_gate`` (an awaitable) runs
+        between the flood and the settle loop — the serving harness
+        parks the fault driver's heal-everything completion there."""
         t0 = time.monotonic()
         stop = asyncio.Event()
-        sub_task = asyncio.create_task(self._subscriber(stop))
+        self._seen_at = [dict() for _ in range(self.n_watchers)]
+        self._snap_seen = [set() for _ in range(self.n_watchers)]
+        self._watcher_ok = [False] * self.n_watchers
+        self._watcher_dead = [False] * self.n_watchers
+        watch_tasks = [
+            asyncio.create_task(self._subscriber(j, stop))
+            for j in range(self.n_watchers)
+        ]
         upd_task = asyncio.create_task(self._updates_watcher(stop))
         await asyncio.sleep(0.2)  # streams attached before the flood
-        await self._writer(n_writes, rate_hz, base_id)
-        # eventually: every committed write visible on the subscription
+        per = -(-n_writes // self.n_writers)  # ceil split, disjoint ids
+        flood_t0 = time.monotonic()
+        await asyncio.gather(
+            *(
+                self._writer(
+                    w, min(per, n_writes - w * per), rate_hz,
+                    base_id + w * per,
+                )
+                for w in range(self.n_writers)
+                if n_writes - w * per > 0
+            )
+        )
+        self.report.flood_s = time.monotonic() - flood_t0
+        if settle_gate is not None:
+            await settle_gate
+        # eventually: every committed write visible on every LIVE
+        # watcher's stream — known-dead watchers can never catch up, so
+        # waiting on them would just burn the whole timeout (their
+        # death is already recorded in stream_errors)
         deadline = time.monotonic() + settle_timeout_s
         while time.monotonic() < deadline:
-            if self._written <= self._sub_seen:
+            if all(
+                self._written <= self._watcher_rows(j)
+                for j in range(self.n_watchers)
+                if not self._watcher_dead[j]
+            ):
                 break
             await asyncio.sleep(0.2)
-        self.report.missing_on_sub = sorted(self._written - self._sub_seen)
         stop.set()
-        for t in (sub_task, upd_task):
+        for t in watch_tasks + [upd_task]:
             t.cancel()
-        await asyncio.gather(sub_task, upd_task, return_exceptions=True)
+        await asyncio.gather(*watch_tasks, upd_task, return_exceptions=True)
+        self._finalize_missing()
+        self._finalize_latency()
         self.report.elapsed_s = time.monotonic() - t0
         return self.report
+
+
+async def run_serving_cluster_load(
+    n_nodes: int = 3,
+    n_writes: int = 60,
+    n_writers: int = 2,
+    n_watchers: int = 2,
+    rate_hz: float = 0.0,
+    settle_timeout_s: float = 30.0,
+    seed: int = 0,
+    plan=None,
+    telemetry: bool = False,
+    registry=None,
+    recorder=None,
+    trace_path: Optional[str] = None,
+    header: Optional[dict] = None,
+    traceparent: Optional[str] = None,
+    table: str = "tests",
+) -> dict:
+    """One measured serving run: boot an in-process ``n_nodes`` cluster
+    with an ApiServer per node, flood it through `LoadGenerator`
+    (writers round-robin the nodes; watchers follow the OTHER nodes, so
+    visibility requires replication), optionally with ``plan`` (a
+    `faults.FaultPlan`) replayed by `HostFaultDriver` during the flood,
+    and return the LoadReport dict.
+
+    ``telemetry`` arms the host flight recorder on every agent
+    (`telemetry.attach_host_telemetry`): the result gains a
+    ``telemetry`` summary block, ``trace_path`` writes the host flight
+    JSONL, and serving metric families land on ``registry`` (a private
+    `metrics.Registry` by default so runs don't bleed into each other —
+    pass `metrics.REGISTRY` to scrape them from a live MetricsServer).
+
+    The whole run executes inside a ``serving_loadgen`` span;
+    ``traceparent`` (W3C) parents it — the campaign engine passes its
+    cell span so serving runs join the existing trace tree."""
+    from .api.http import ApiServer
+    from .testing import Cluster
+    from .tracing import extract, span
+
+    cluster = Cluster(n_nodes, use_swim=False, seed=seed)
+    await cluster.start()
+    servers: List[ApiServer] = []
+    rec = recorder
+    reg = registry
+    try:
+        for agent in cluster.agents:
+            srv = ApiServer(agent)
+            await srv.start()
+            servers.append(srv)
+        if telemetry:
+            from .metrics import Registry
+            from .telemetry import (
+                HostFlightRecorder,
+                attach_host_telemetry,
+            )
+
+            rec = rec or HostFlightRecorder()
+            reg = reg if reg is not None else Registry()
+            for agent in cluster.agents:
+                attach_host_telemetry(agent, recorder=rec, registry=reg)
+        write_addrs = [s.addr for s in servers]
+        # watchers read ONLY nodes writers do not write to (writer w
+        # hits node w % n): publish→visible then always crosses the
+        # gossip path.  When every node is a writer (n_writers ≥ n) the
+        # overlap is unavoidable — rotate so each watcher at least
+        # avoids its like-indexed writer; single-node clusters
+        # self-watch.
+        writer_nodes = {w % n_nodes for w in range(n_writers)}
+        non_writers = [
+            a for i, a in enumerate(write_addrs) if i not in writer_nodes
+        ]
+        read_addrs = non_writers or (
+            # every node is a writer: rotate by one so watcher j still
+            # avoids its like-indexed writer's node (reversed() would
+            # map the middle watcher of an odd cluster onto itself)
+            [write_addrs[(i + 1) % n_nodes] for i in range(n_nodes)]
+            if n_nodes > 1
+            else write_addrs
+        )
+        gen = LoadGenerator(
+            write_addrs, read_addrs, table=table, seed=seed,
+            n_writers=n_writers, n_watchers=n_watchers,
+        )
+        gate = None
+        fault_error: List[str] = []
+        if plan is not None:
+            from .faults import HostFaultDriver
+
+            driver = HostFaultDriver(plan, cluster)
+
+            # the driver heals everything by the end of its schedule;
+            # the loadgen's settle loop starts only after that, so a
+            # consistent=False can never be "the partition was still
+            # up".  A driver failure is RECORDED, never raised — one
+            # broken lane must not crash a whole campaign — and the
+            # gate is cancelled+consumed on any exit path so an
+            # aborted run can't leave an orphaned task injecting
+            # faults into the teardown.
+            async def _drive():
+                try:
+                    await driver.run()
+                except Exception as e:  # noqa: BLE001
+                    fault_error.append(f"{type(e).__name__}: {e}")
+
+            gate = asyncio.ensure_future(_drive())
+        try:
+            with span(
+                "serving_loadgen",
+                parent=extract(traceparent),
+                nodes=n_nodes, writers=n_writers, watchers=n_watchers,
+                writes=n_writes, faults=plan is not None,
+            ) as sp:
+                report = await gen.run(
+                    n_writes=n_writes, rate_hz=rate_hz,
+                    settle_timeout_s=settle_timeout_s, settle_gate=gate,
+                )
+                sp.set_attribute("consistent", report.consistent)
+                sp.set_attribute("writes_ok", report.writes_ok)
+                if report.visible_latency_s:
+                    sp.set_attribute(
+                        "publish_visible_p99_s",
+                        report.visible_latency_s["p99"],
+                    )
+        finally:
+            if gate is not None:
+                gate.cancel()
+                await asyncio.gather(gate, return_exceptions=True)
+        out = report.to_dict()
+        out["n_nodes"] = n_nodes
+        out["faults"] = plan is not None
+        if plan is not None:
+            out["plan_horizon"] = plan.horizon
+            if fault_error:
+                # the schedule did not fully replay: the lane's numbers
+                # stand, but the record says the faults were partial
+                out["fault_driver_error"] = fault_error[0]
+        if telemetry and rec is not None:
+            out["telemetry"] = rec.summary()
+            if trace_path:
+                from .telemetry import write_host_flight_jsonl
+
+                head = {
+                    "n_nodes": n_nodes,
+                    "writers": n_writers,
+                    "watchers": n_watchers,
+                    "seed": seed,
+                    "traceparent": sp.context.traceparent(),
+                }
+                if header:
+                    head.update(header)
+                write_host_flight_jsonl(trace_path, rec, header=head)
+        return out
+    finally:
+        for srv in servers:
+            await srv.stop()
+        await cluster.stop()

@@ -25,6 +25,17 @@ DEFAULT_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+#: serving-latency ladder (ISSUE 8): the default ladder starts at 1 ms,
+#: which buckets every sub-ms in-process serving stage into the first
+#: bin — publish→visible on a 3-node loopback cluster is ~100 µs-10 ms.
+#: Log-spaced 100 µs … 10 s (~2 buckets/decade + intermediates), used by
+#: every corro_serving_* histogram; existing families keep their
+#: buckets (their scrape continuity matters more than resolution).
+LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.010, 0.025,
+    0.050, 0.100, 0.250, 0.500, 1.0, 2.5, 5.0, 10.0,
+)
+
 _LabelKey = Tuple[Tuple[str, str], ...]
 
 

@@ -29,11 +29,18 @@ class SubHandle:
         self.matcher = matcher
         self.id = matcher.id
         self.queues: List[asyncio.Queue] = []
+        # events fanned out to attached queues since creation; the
+        # serving-telemetry counter advances a per-handle watermark
+        # (`_fanout_reported`) so deliveries from the DEFERRED flush
+        # path count too, not just the synchronous handle_changes ones
+        self.delivered = 0
+        self._fanout_reported = 0
         matcher.subscribe(self._on_event)
 
     def _on_event(self, event: dict):
         for q in list(self.queues):
             q.put_nowait(event)
+        self.delivered += len(self.queues)
 
     def attach(self) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
@@ -56,6 +63,19 @@ class SubsManager:
             os.makedirs(state_dir, exist_ok=True)
         self.by_id: Dict[str, SubHandle] = {}
         self.by_hash: Dict[str, str] = {}  # sql hash -> sub id
+        # serving telemetry handle (ISSUE 8, set by
+        # telemetry.attach_host_telemetry): fan-out event counters +
+        # subscriber-queue depth gauge; None = off, one attribute test
+        self.telemetry = None
+        # visible-stamp parking lot: (pairs, hlc, waiting-handle-ids)
+        # entries whose fan-out was DEFERRED by a fallback matcher's
+        # re-run budget — each entry stamps when the SPECIFIC handles it
+        # waited on have flushed (an unrelated table's perpetually-dirty
+        # matcher must not postpone, and thus inflate, other tables'
+        # publish→visible stamps), and is DROPPED if its only deliverer
+        # failed (a fabricated visibility moment is worse than a counted
+        # gap).  See Agent._match_changes / _drain_visible.
+        self._deferred_visible: List[Tuple[List, object, set]] = []
 
     def _crr_tables(self) -> Dict[str, Tuple[str, ...]]:
         return {name: info.pk_cols for name, info in self.store._tables.items()}
@@ -168,6 +188,85 @@ class SubsManager:
                 import traceback
 
                 traceback.print_exc()
+        self._report_fanout()
+
+    def has_dirty(self, tables=None) -> bool:
+        """True while a fallback matcher holds a coalesced re-run it
+        has not flushed yet — events for the last batch may not have
+        been delivered to subscriber queues.  ``tables`` narrows the
+        question to matchers watching those tables: a dirty sub on an
+        UNRELATED table must not defer visible stamps for a batch it
+        never matched (keyed subs for that batch delivered
+        synchronously)."""
+        for h in self.by_id.values():
+            if not h.matcher._rerun_dirty:
+                continue
+            if tables is None or tables & set(h.matcher.tables):
+                return True
+        return False
+
+    def defer_visible(self, pairs, hlc_now, tables) -> None:
+        """Park (actor, version) visible stamps until the dirty
+        matchers watching ``tables`` actually flush — stamping at match
+        time would record a visibility moment up to a whole re-run
+        window before the events reached any subscriber queue."""
+        waiting = {
+            h.id
+            for h in self.by_id.values()
+            if h.matcher._rerun_dirty and tables & set(h.matcher.tables)
+        }
+        self._deferred_visible.append((list(pairs), hlc_now, waiting))
+
+    def _drain_visible(self, failed_id: Optional[str] = None) -> None:
+        """Stamp parked entries whose waited-on handles have all
+        flushed (the drain runs right after each flush, so the stamp
+        time IS the delivery time).  ``failed_id`` marks a handle whose
+        flush errored: entries left waiting only on it are dropped with
+        a counter — those deliveries never happened."""
+        if not self._deferred_visible:
+            return
+        tel = self.telemetry
+        if tel is None:
+            self._deferred_visible = []
+            return
+        dirty = {
+            h.id for h in self.by_id.values() if h.matcher._rerun_dirty
+        }
+        keep = []
+        for pairs, hlc_now, waiting in self._deferred_visible:
+            if failed_id is not None and failed_id in waiting:
+                waiting = waiting - {failed_id}
+                if not waiting:
+                    tel.visible_dropped(len(pairs))
+                    continue
+            # handles that flushed (or were removed) are no longer dirty
+            waiting = waiting & dirty
+            if waiting:
+                keep.append((pairs, hlc_now, waiting))
+            else:
+                for actor_id, version in pairs:
+                    tel.visible(actor_id, version, hlc_now=hlc_now)
+        self._deferred_visible = keep
+
+    def _report_fanout(self) -> None:
+        """Advance the serving fan-out counter + subscriber-queue-depth
+        gauge (one pass per committed batch / trailing flush, never per
+        event).  Watermark-based: deliveries that happened via the
+        deferred flush path since the last report count here too.  Also
+        drains parked visible stamps whose waited-on matchers flushed."""
+        tel = self.telemetry
+        if tel is None:
+            self._deferred_visible.clear()
+            return
+        fanned = 0
+        depth = 0
+        for h in self.by_id.values():
+            fanned += h.delivered - h._fanout_reported
+            h._fanout_reported = h.delivered
+            for q in h.queues:
+                depth = max(depth, q.qsize())
+        tel.sub_fanout(fanned, depth)
+        self._drain_visible()
 
     def _schedule_flush(self, loop, handle):
         """One pending trailing flush per dirty fallback sub."""
@@ -183,14 +282,21 @@ class SubsManager:
                 return  # sub removed while the flush was pending
             try:
                 matcher.flush_if_due()
+                self._report_fanout()
             except Exception:
                 import traceback
 
                 traceback.print_exc()
                 # give up on this coalesced state: retrying a broken
                 # matcher forever would spam a traceback per window; the
-                # next committed batch re-marks it dirty
+                # next committed batch re-marks it dirty.  Parked stamps
+                # waiting only on THIS handle are dropped (their
+                # delivery never happened — a fabricated visibility
+                # moment would corrupt the publish→visible metric); the
+                # rest re-check their remaining deliverers
                 matcher._rerun_dirty = False
+                self._drain_visible(failed_id=handle.id)
+                self._report_fanout()
                 return
             # a batch may have landed between the due-check and now
             if matcher._rerun_dirty:

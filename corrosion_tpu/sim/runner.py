@@ -747,6 +747,137 @@ def config_fault_storm_telemetry(
     }
 
 
+def serving_fault_plan(n_nodes: int, seed: int = 0):
+    """The serving rung's FaultPlan: the `serving-3node` builtin
+    campaign's schedule (loss burst + asymmetric partition + delay) at
+    ``n_nodes`` ≥ 3 — ONE schedule shared by the rung, the campaign
+    cells, and the chaos tests, so their numbers compare.  The events
+    name node indices up to 2, so smaller clusters are refused UP
+    FRONT — before a rung spends its flood time — rather than dying in
+    FaultPlan validation mid-run."""
+    if n_nodes < 3:
+        raise ValueError(
+            "serving_fault_plan needs n_nodes >= 3 (its partition/delay "
+            "events target node 2); run the serving rung faultless "
+            "(use_faults=False) at smaller sizes"
+        )
+    from ..campaign.spec import serving_3node_spec
+    from ..faults import FaultPlan
+
+    ref = serving_3node_spec()
+    return FaultPlan(
+        n_nodes=n_nodes, seed=int(seed), events=ref.events,
+        round_s=ref.round_s,
+    )
+
+
+def config_serving_loadgen(
+    seed: int = 0,
+    n_nodes: int = 3,
+    n_writes: int = 96,
+    n_writers: int = 2,
+    n_watchers: int = 2,
+    overhead_passes: int = 2,
+    use_faults: bool = True,
+    telemetry: bool = True,
+    trace_path: Optional[str] = None,
+) -> Dict[str, object]:
+    """The HOST-SERVING rung (ISSUE 8): flood an in-process ``n_nodes``
+    agent cluster through the measured loadgen driver and record
+    publish→subscriber-visible latency percentiles — the host twin of
+    the storm rungs' convergence walls.  Three measurements:
+
+    - **instrumentation overhead** — interleaved A/B flood pairs
+      (telemetry OFF, telemetry ON, repeated ``overhead_passes``
+      times), per-variant-MIN flood walls, exactly the discipline the
+      sim telemetry rung uses (`measure_overhead_pair`): box walls are
+      bimodal, sequential blocks lie.  Recorded as
+      ``instrumentation_overhead_frac`` — the ≤5% acceptance form;
+    - **faultless serving run** — telemetry on, flight JSONL at
+      ``trace_path``, latency percentiles + throughput;
+    - **faulted serving run** — the same workload with
+      `serving_fault_plan` replayed by the host fault drivers
+      underneath (``use_faults``), its own latency percentiles.
+
+    ``converged`` is every run's ``consistent`` (zero lost writes with
+    the checker attached) — the record a lost write can never pass."""
+    import asyncio as _asyncio
+
+    from ..loadgen import run_serving_cluster_load
+
+    if use_faults and n_nodes < 3:
+        # validate BEFORE the floods: a mid-run FaultPlan refusal would
+        # discard the A/B and faultless measurements already paid for
+        serving_fault_plan(n_nodes, seed)
+    t0 = time.monotonic()
+    rate = 0.0  # flood form: the overhead A/B must not hide in sleeps
+
+    def one(telemetry_on: bool, plan=None, path=None, s=0):
+        return _asyncio.run(
+            run_serving_cluster_load(
+                n_nodes=n_nodes, n_writes=n_writes,
+                n_writers=n_writers, n_watchers=n_watchers,
+                rate_hz=rate, settle_timeout_s=30.0, seed=seed + s,
+                plan=plan, telemetry=telemetry_on, trace_path=path,
+                header={"scenario": "serving_loadgen", "seed": seed},
+            )
+        )
+
+    # -- interleaved overhead pairs (per-variant min) -------------------
+    off_walls, on_walls = [], []
+    reports = []
+    for i in range(max(1, overhead_passes)):
+        off = one(False, s=1000 + i)
+        on = one(True, s=2000 + i)
+        off_walls.append(off["flood_s"])
+        on_walls.append(on["flood_s"])
+        reports += [off, on]
+    overhead = (
+        min(on_walls) / min(off_walls) - 1.0 if min(off_walls) > 0 else None
+    )
+
+    # -- the measured runs ---------------------------------------------
+    faultless = one(telemetry, path=trace_path if telemetry else None)
+    reports.append(faultless)
+    faulted = None
+    if use_faults:
+        faulted = one(telemetry, plan=serving_fault_plan(n_nodes, seed))
+        reports.append(faulted)
+
+    consistent = all(r["consistent"] for r in reports)
+    out = {
+        "n_nodes": n_nodes,
+        "round_path": "host",
+        "writes": n_writes,
+        "writers": n_writers,
+        "watchers": n_watchers,
+        "seed": seed,
+        "converged": consistent,
+        "consistent": consistent,
+        "lost_writes": any(r["lost_writes"] for r in reports),
+        "checker_broken": any(r["checker_broken"] for r in reports),
+        "publish_visible_s": faultless["visible_latency_s"],
+        "write_latency_s": faultless["write_latency_s"],
+        "throughput_wps": faultless["throughput_wps"],
+        # the measured-no-op acceptance number, per-variant-min form
+        "instrumentation_overhead_frac": (
+            round(overhead, 4) if overhead is not None else None
+        ),
+        "overhead_passes": max(1, overhead_passes),
+        "wall_clock_s": round(time.monotonic() - t0, 3),
+    }
+    if faulted is not None:
+        out["faulted"] = {
+            "publish_visible_s": faulted["visible_latency_s"],
+            "throughput_wps": faulted["throughput_wps"],
+            "consistent": faulted["consistent"],
+            "plan_horizon": faulted.get("plan_horizon"),
+        }
+    if telemetry and "telemetry" in faultless:
+        out["telemetry"] = faultless["telemetry"]
+    return out
+
+
 def _gapstress_cfg(n_nodes: int, gap_slots: int) -> SimConfig:
     return SimConfig.wan_tuned(
         n_nodes,

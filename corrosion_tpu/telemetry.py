@@ -1,0 +1,464 @@
+"""Host-tier flight recorder: per-write serving-path telemetry.
+
+The sim tier has had a flight recorder since ISSUE 5
+(`sim/telemetry.py`): every round of the jitted kernels lands in a
+preallocated trace, exported as Prometheus families, span trees, and
+flight-recorder JSONL.  The HOST tier — the path "heavy traffic from
+millions of users" actually rides (`api/http.py` → `agent/agent.py`
+broadcast/sync → `pubsub/manager.py` fan-out) — had a
+`metrics.Registry` nobody exercised under load and no record of where
+a write's end-to-end latency went.  This module is the host twin:
+
+- :class:`HostFlightRecorder` — per-write stage stamps, keyed by the
+  write's replication identity ``(actor, db_version)``:
+
+  * ``publish``        — local commit on the writer (wall + HLC ts);
+  * ``broadcast_out``  — the version's first frame hit the wire;
+  * ``apply``          — the version committed on an observing node;
+  * ``visible``        — the node's matcher fanned the change out to
+    attached subscriber queues (the server-side "subscriber-visible"
+    moment; the client-observed moment is the loadgen's own clock).
+
+  ``publish → visible`` is SWARM's metric of record for a replicated
+  store, and the one the campaign bands regression-track
+  (`campaign/spec.py` host-serving cells).
+
+- :class:`HostTelemetry` — the per-agent instrumentation handle: stage
+  methods feed the recorder AND the serving metric families
+  (histograms on `metrics.LATENCY_BUCKETS`, queue-depth gauges,
+  wire-byte counters) on a `metrics.Registry`.  Agents carry
+  ``agent.telemetry = None`` by default; every hook site is a single
+  attribute check when off, so the uninstrumented serving path stays a
+  measured no-op (the `config_serving_loadgen` rung records the
+  realized overhead fraction every bench run).
+
+- :func:`write_host_flight_jsonl` — the host flight artifact, sharing
+  the PR 5 schema: line 1 a ``{"kind": "flight_recorder", ...}``
+  header (with ``"tier": "host"``) + summary, then one JSON line per
+  write record.  `sim trace show` renders both tiers.
+
+Clocking across HLC skew (doc/telemetry/host.md): stage stamps come
+from ONE process `time.monotonic` (NTP steps must not corrupt sub-ms
+stage latencies; the JSONL rows are t0-relative offsets, so no
+absolute time is needed), making deltas true latencies in-process;
+each stage also records the local HLC reading, so `hlc_lag_s`
+survives skewed clocks as the causal (skew-inclusive) proxy — under a
+`clock_skew` FaultPlan the monotonic and HLC columns disagree by
+exactly the injected offset.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .core.hlc import ntp64_to_unix_ns
+
+HOST_FLIGHT_TIER = "host"
+#: shared with sim/telemetry.py — one flight-record schema, two tiers
+FLIGHT_VERSION = 1
+
+#: per-write stage names, in causal order
+STAGES = ("publish", "broadcast_out", "apply", "visible")
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    """'lower'-interpolation percentile of a pre-sorted list — rank
+    floor(q/100 · (n-1)), the SAME rule as numpy's method='lower' that
+    `campaign.report.bands` uses, so a lane's p50 and the band that
+    summarizes it are computed identically."""
+    if not sorted_vals:
+        return None
+    return sorted_vals[int((len(sorted_vals) - 1) * q / 100.0)]
+
+
+def latency_block(values: List[float]) -> Optional[dict]:
+    """p50/p95/p99/max/mean summary of a latency sample list (seconds),
+    None when empty — the shape LoadReport and the campaign serving
+    cells both record."""
+    if not values:
+        return None
+    vals = sorted(values)
+    return {
+        "p50": round(_pct(vals, 50), 6),
+        "p95": round(_pct(vals, 95), 6),
+        "p99": round(_pct(vals, 99), 6),
+        "max": round(vals[-1], 6),
+        "mean": round(sum(vals) / len(vals), 6),
+        "samples": len(vals),
+    }
+
+
+class _WriteRecord:
+    """One write's stage stamps.  ``apply``/``visible`` are per-node
+    maps — a 3-node cluster yields up to 3 visibility stamps per
+    write; the record's end-to-end latency is the LAST node's."""
+
+    __slots__ = (
+        "actor", "version", "node", "publish_s", "publish_hlc",
+        "n_changes", "broadcast_out_s", "apply_s", "visible_s",
+        "visible_hlc",
+    )
+
+    def __init__(self, actor: str, version: int):
+        self.actor = actor
+        self.version = version
+        self.node = ""
+        self.publish_s: Optional[float] = None
+        self.publish_hlc: Optional[int] = None
+        self.n_changes = 0
+        self.broadcast_out_s: Optional[float] = None
+        self.apply_s: Dict[str, float] = {}
+        self.visible_s: Dict[str, float] = {}
+        self.visible_hlc: Dict[str, int] = {}
+
+    def to_dict(self, t0: float) -> dict:
+        """JSONL row: offsets relative to ``t0`` (the first publish) so
+        rows are small and self-aligned; per-stage latencies in ms."""
+        out = {
+            "actor": self.actor,
+            "version": self.version,
+            "node": self.node,
+            "t": round((self.publish_s or t0) - t0, 6),
+            "n_changes": self.n_changes,
+        }
+        p = self.publish_s
+        if p is not None and self.broadcast_out_s is not None:
+            out["broadcast_out_ms"] = round(
+                (self.broadcast_out_s - p) * 1e3, 3
+            )
+        if p is not None and self.apply_s:
+            out["apply_ms"] = {
+                n: round((s - p) * 1e3, 3)
+                for n, s in sorted(self.apply_s.items())
+            }
+        if p is not None and self.visible_s:
+            out["visible_ms"] = {
+                n: round((s - p) * 1e3, 3)
+                for n, s in sorted(self.visible_s.items())
+            }
+            out["publish_to_visible_ms"] = round(
+                (max(self.visible_s.values()) - p) * 1e3, 3
+            )
+        if self.publish_hlc is not None and self.visible_hlc:
+            # the causal proxy: survives skewed wall clocks (NTP64
+            # difference → seconds); negative under backward skew
+            lag_ns = ntp64_to_unix_ns(
+                max(self.visible_hlc.values())
+            ) - ntp64_to_unix_ns(self.publish_hlc)
+            out["hlc_lag_ms"] = round(lag_ns / 1e6, 3)
+        return out
+
+
+class HostFlightRecorder:
+    """Bounded per-write stage-stamp collector, shared by every agent
+    of an in-process cluster (each agent's :class:`HostTelemetry` feeds
+    it under its own node label).  Thread-safe — the metrics scrape
+    path and the event loop may both read it."""
+
+    def __init__(self, cap: int = 65536, clock=time.monotonic):
+        self._records: Dict[Tuple[str, int], _WriteRecord] = {}
+        self._lock = threading.Lock()
+        self.cap = cap
+        self.clock = clock
+        self.dropped = 0
+
+    def _rec(self, actor: str, version: int) -> Optional[_WriteRecord]:
+        key = (actor, version)
+        rec = self._records.get(key)
+        if rec is None:
+            if len(self._records) >= self.cap:
+                # drop-oldest keeps the recorder bounded under a flood
+                # the consumer never drains; the drop is COUNTED so a
+                # truncated summary says so
+                self._records.pop(next(iter(self._records)))
+                self.dropped += 1
+            rec = _WriteRecord(actor, version)
+            self._records[key] = rec
+        return rec
+
+    # -- stage stamps (called by HostTelemetry; every method is one
+    # dict update under the lock — safe from loop or thread) ----------
+
+    def publish(
+        self, node: str, actor: str, version: int,
+        hlc_ts: Optional[int] = None, n_changes: int = 0,
+    ) -> float:
+        now = self.clock()
+        with self._lock:
+            rec = self._rec(actor, version)
+            rec.node = node
+            rec.publish_s = now
+            rec.publish_hlc = hlc_ts
+            rec.n_changes = n_changes
+        return now
+
+    def broadcast_out(self, node: str, actor: str, version: int) -> Optional[float]:
+        """Returns the record's publish stamp ONLY when this call newly
+        stamped broadcast_out (None on re-sends), so callers observe the
+        publish→wire histogram exactly once per version however many
+        flush passes retransmit the frame."""
+        now = self.clock()
+        with self._lock:
+            rec = self._rec(actor, version)
+            if rec.broadcast_out_s is not None:
+                return None
+            rec.broadcast_out_s = now
+            return rec.publish_s
+
+    def apply(self, node: str, actor: str, version: int) -> Optional[float]:
+        """Publish stamp ONLY on this node's first apply of the version
+        (None on retries) — same once-per-stage histogram contract as
+        `broadcast_out`."""
+        now = self.clock()
+        with self._lock:
+            rec = self._rec(actor, version)
+            if node in rec.apply_s:
+                return None
+            rec.apply_s[node] = now
+            return rec.publish_s
+
+    def visible(
+        self, node: str, actor: str, version: int,
+        hlc_now: Optional[int] = None,
+    ) -> Optional[float]:
+        now = self.clock()
+        with self._lock:
+            rec = self._rec(actor, version)
+            if node in rec.visible_s:
+                return None
+            rec.visible_s[node] = now
+            if hlc_now is not None:
+                rec.visible_hlc.setdefault(node, hlc_now)
+            return rec.publish_s
+
+    # -- exports ------------------------------------------------------
+
+    def records(self) -> List[_WriteRecord]:
+        with self._lock:
+            return list(self._records.values())
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def summary(self) -> dict:
+        """Deterministic-shape summary block (values are measured wall
+        clock): stage coverage counts + publish→{broadcast_out, apply,
+        visible} latency percentiles across every recorded write."""
+        recs = self.records()
+        pub = [r for r in recs if r.publish_s is not None]
+        bcast, apply_, vis, hlc = [], [], [], []
+        for r in pub:
+            if r.broadcast_out_s is not None:
+                bcast.append(r.broadcast_out_s - r.publish_s)
+            if r.apply_s:
+                apply_.append(max(r.apply_s.values()) - r.publish_s)
+            if r.visible_s:
+                vis.append(max(r.visible_s.values()) - r.publish_s)
+            if r.publish_hlc is not None and r.visible_hlc:
+                hlc.append(
+                    (
+                        ntp64_to_unix_ns(max(r.visible_hlc.values()))
+                        - ntp64_to_unix_ns(r.publish_hlc)
+                    )
+                    / 1e9
+                )
+        return {
+            "writes": len(pub),
+            "records": len(recs),
+            "dropped_records": self.dropped,
+            "stages": {
+                "broadcast_out": len(bcast),
+                "apply": len(apply_),
+                "visible": len(vis),
+            },
+            "publish_to_broadcast_out_s": latency_block(bcast),
+            "publish_to_apply_s": latency_block(apply_),
+            "publish_to_visible_s": latency_block(vis),
+            "hlc_lag_s": latency_block(hlc),
+        }
+
+
+class HostTelemetry:
+    """One agent's serving instrumentation handle: stage methods feed
+    the shared :class:`HostFlightRecorder` and the ``corro_serving_*``
+    metric families.  Construction registers the families once per
+    registry (the `metrics.Registry` dedupes by name); every hook call
+    is a couple of dict updates — and the OFF state is
+    ``agent.telemetry is None``, a single attribute test."""
+
+    def __init__(
+        self,
+        node: str,
+        recorder: Optional[HostFlightRecorder] = None,
+        registry=None,
+    ):
+        from .metrics import LATENCY_BUCKETS, REGISTRY
+
+        reg = registry if registry is not None else REGISTRY
+        self.node = node
+        self.recorder = recorder
+        self.registry = reg
+        lb = LATENCY_BUCKETS
+        # per-stage latency histograms (the sub-ms serving ladder)
+        self.h_api = reg.histogram("corro_api_request_seconds", lb)
+        self.h_commit = reg.histogram("corro_serving_commit_seconds", lb)
+        self.h_store = reg.histogram("corro_store_transact_seconds", lb)
+        self.h_bcast = reg.histogram(
+            "corro_serving_publish_broadcast_seconds", lb
+        )
+        self.h_apply = reg.histogram(
+            "corro_serving_publish_apply_seconds", lb
+        )
+        self.h_visible = reg.histogram(
+            "corro_serving_publish_visible_seconds", lb
+        )
+        # queue depths
+        self.g_ingest_q = reg.gauge("corro_serving_ingest_queue_depth")
+        self.g_bcast_q = reg.gauge("corro_serving_bcast_queue_depth")
+        self.g_sub_q = reg.gauge("corro_serving_sub_queue_depth")
+        # wire bytes / frames by path
+        self.c_wire_bytes = reg.counter("corro_serving_wire_bytes_total")
+        self.c_wire_frames = reg.counter("corro_serving_wire_frames_total")
+        # pubsub fan-out + SWIM membership events
+        self.c_fanout = reg.counter("corro_serving_fanout_events_total")
+        self.c_swim = reg.counter("corro_serving_swim_events_total")
+        # visible stamps dropped because their only deliverer (a
+        # fallback matcher) failed its flush — a counted gap, never a
+        # fabricated visibility moment
+        self.c_vis_dropped = reg.counter(
+            "corro_serving_visible_stamps_dropped_total"
+        )
+
+    # -- flight-record stages -----------------------------------------
+
+    def publish(self, actor_id, version: int, hlc_ts: int, n_changes: int):
+        if self.recorder is not None:
+            self.recorder.publish(
+                self.node, actor_id.hex()[:12], version,
+                hlc_ts=hlc_ts, n_changes=n_changes,
+            )
+
+    def broadcast_out(self, actor_id, version: int):
+        if self.recorder is not None:
+            pub = self.recorder.broadcast_out(
+                self.node, actor_id.hex()[:12], version
+            )
+            if pub is not None:
+                self.h_bcast.observe(self.recorder.clock() - pub)
+
+    def apply(self, actor_id, version: int):
+        if self.recorder is not None:
+            pub = self.recorder.apply(
+                self.node, actor_id.hex()[:12], version
+            )
+            if pub is not None:
+                self.h_apply.observe(
+                    self.recorder.clock() - pub, node=self.node
+                )
+
+    def visible(self, actor_id, version: int, hlc_now: Optional[int] = None):
+        if self.recorder is not None:
+            pub = self.recorder.visible(
+                self.node, actor_id.hex()[:12], version, hlc_now=hlc_now
+            )
+            if pub is not None:
+                self.h_visible.observe(
+                    self.recorder.clock() - pub, node=self.node
+                )
+
+    # -- metric-only hooks ---------------------------------------------
+
+    def api_request(self, route: str, seconds: float, bytes_in: int):
+        self.h_api.observe(seconds, route=route)
+        self.c_wire_bytes.inc(bytes_in, path="api_in", node=self.node)
+
+    def commit(self, seconds: float):
+        self.h_commit.observe(seconds, node=self.node)
+
+    def store_transact(self, seconds: float):
+        """Whole-store-transaction wall (CrrStore.transact — PG and
+        interactive paths included, unlike `commit` which is the
+        agent's HTTP write lane)."""
+        self.h_store.observe(seconds, node=self.node)
+
+    def wire(self, path: str, nbytes: int):
+        """One frame transmitted/received on ``path`` (broadcast_out,
+        broadcast_in, sync_out, sync_in)."""
+        self.c_wire_bytes.inc(nbytes, path=path, node=self.node)
+        self.c_wire_frames.inc(1, path=path, node=self.node)
+
+    def queue_depths(self, ingest: int, bcast: int):
+        self.g_ingest_q.set(ingest, node=self.node)
+        self.g_bcast_q.set(bcast, node=self.node)
+
+    def sub_fanout(self, n_events: int, max_depth: int):
+        if n_events:
+            self.c_fanout.inc(n_events, node=self.node)
+        self.g_sub_q.set(max_depth, node=self.node)
+
+    def swim_event(self, event: str):
+        self.c_swim.inc(1, event=event, node=self.node)
+
+    def visible_dropped(self, n: int):
+        self.c_vis_dropped.inc(n, node=self.node)
+
+
+def attach_host_telemetry(
+    agent,
+    node: Optional[str] = None,
+    recorder: Optional[HostFlightRecorder] = None,
+    registry=None,
+) -> HostTelemetry:
+    """Arm one agent's serving instrumentation: sets
+    ``agent.telemetry`` (read by the api/agent/swim hook sites) and
+    threads the handle into the pubsub managers.  ``node`` defaults to
+    the agent's transport address; pass one shared ``recorder`` across
+    a cluster so cross-node stages land in the same write records."""
+    node = node or getattr(agent.transport, "addr", "") or agent.actor_id.hex()[:12]
+    tel = HostTelemetry(node, recorder=recorder, registry=registry)
+    agent.telemetry = tel
+    agent.subs.telemetry = tel
+    agent.store.telemetry = tel
+    return tel
+
+
+def detach_host_telemetry(agent) -> None:
+    agent.telemetry = None
+    agent.subs.telemetry = None
+    agent.store.telemetry = None
+
+
+def write_host_flight_jsonl(
+    path: str,
+    recorder: HostFlightRecorder,
+    header: Optional[dict] = None,
+) -> None:
+    """The host flight artifact, sharing the sim recorder's schema
+    (`sim/telemetry.write_flight_jsonl`): line 1 a header dict —
+    ``kind: flight_recorder``, ``version``, ``tier: host``, summary,
+    caller context — then one JSON line per write record, publish-time
+    ordered.  Atomic replace like every artifact writer in the tree."""
+    recs = sorted(
+        (r for r in recorder.records() if r.publish_s is not None),
+        key=lambda r: (r.publish_s, r.actor, r.version),
+    )
+    t0 = recs[0].publish_s if recs else 0.0
+    head = {
+        "kind": "flight_recorder",
+        "version": FLIGHT_VERSION,
+        "tier": HOST_FLIGHT_TIER,
+        "writes": len(recs),
+        "summary": recorder.summary(),
+    }
+    if header:
+        head.update(header)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(head, sort_keys=True, default=float) + "\n")
+        for rec in recs:
+            f.write(json.dumps(rec.to_dict(t0), sort_keys=True) + "\n")
+    os.replace(tmp, path)

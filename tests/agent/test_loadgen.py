@@ -46,3 +46,87 @@ def test_loadgen_cross_node_convergence():
         assert report.consistent, report.to_dict()
 
     asyncio.run(_with_api_cluster(2, body))
+
+
+def test_loadgen_multi_writer_watcher_latency():
+    """The measured driver (ISSUE 8): N writer lanes with disjoint ids,
+    M watchers each requiring full visibility, client-observed
+    publish→visible percentiles in the report."""
+
+    async def body(cluster, servers):
+        gen = LoadGenerator(
+            [s.addr for s in servers],
+            list(reversed([s.addr for s in servers])),
+            n_writers=3, n_watchers=2,
+        )
+        report = await gen.run(
+            n_writes=30, rate_hz=0.0, settle_timeout_s=30.0
+        )
+        assert report.writes_ok == 30
+        assert report.consistent, report.to_dict()
+        assert report.writers == 3 and report.watchers == 2
+        vl = report.visible_latency_s
+        assert vl is not None and vl["samples"] >= 30
+        assert 0 <= vl["p50"] <= vl["p99"] <= vl["max"]
+        assert report.write_latency_s["samples"] == 30
+        assert report.throughput_wps > 0
+        d = report.to_dict()
+        assert d["lost_writes"] is False
+        assert d["checker_broken"] is False
+
+    asyncio.run(_with_api_cluster(2, body))
+
+
+def test_loadgen_stream_death_reads_checker_broken():
+    """Satellite (ISSUE 8): a watch stream whose serving node dies is a
+    BROKEN CHECKER — missing rows on a dead stream must never classify
+    as lost writes."""
+
+    async def body(cluster, servers):
+        gen = LoadGenerator(servers[0].addr, servers[1].addr)
+
+        async def kill_reader():
+            await asyncio.sleep(0.4)
+            await servers[1].stop()
+
+        killer = asyncio.create_task(kill_reader())
+        # settle long enough for the stream's capped reconnect chain to
+        # exhaust against the dead node and surface the root cause
+        report = await gen.run(
+            n_writes=15, rate_hz=100.0, settle_timeout_s=15.0
+        )
+        await killer
+        assert report.stream_errors, report.to_dict()
+        assert report.checker_broken
+        assert not report.lost_writes
+        assert not report.consistent
+
+    asyncio.run(_with_api_cluster(2, body))
+
+
+def test_load_report_classification_matrix():
+    """The stream-death vs lost-write distinction as a truth table."""
+    from corrosion_tpu.loadgen import LoadReport
+
+    healthy = LoadReport(writes_ok=5)
+    assert healthy.consistent
+    assert not healthy.lost_writes and not healthy.checker_broken
+
+    lost = LoadReport(writes_ok=5, missing_on_sub=[3])
+    assert lost.lost_writes
+    assert not lost.checker_broken
+    assert not lost.consistent
+
+    dead = LoadReport(writes_ok=5, stream_errors=["subscription[0]: gone"])
+    assert dead.checker_broken
+    assert not dead.lost_writes  # inconclusive, not a replication bug
+    assert not dead.consistent
+
+    # both at once: missing_on_sub only ever holds HEALTHY watchers'
+    # losses, so a dead stream elsewhere does not grant amnesty — this
+    # is a real loss AND a broken checker
+    both = LoadReport(
+        writes_ok=5, missing_on_sub=[1],
+        stream_errors=["subscription[0]: gone"],
+    )
+    assert both.checker_broken and both.lost_writes

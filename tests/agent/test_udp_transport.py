@@ -93,6 +93,49 @@ def test_uni_connection_cache_reuses_conns():
     asyncio.run(body())
 
 
+def test_open_bi_rechecks_faults_after_dial():
+    """A FaultInjector installed WHILE a bi dial is suspended inside
+    _connect must still block the stream: the socket is in no sever
+    list at install time and bi streams are never fault-checked per
+    frame, so without the post-dial re-check one racing sync session
+    replicates straight across a fresh partition (the
+    test_partition_heal_on_real_sockets full-suite flake)."""
+
+    async def body():
+        from corrosion_tpu.agent.transport import FaultInjector
+
+        a, b = UdpTcpTransport(), UdpTcpTransport()
+        for t in (a, b):
+            t.set_handlers(None, None, None)
+        await a.start()
+        addr_b = await b.start()
+        try:
+            orig_connect = a._connect
+            fi = FaultInjector()
+            fi.partition(addr_b)
+
+            async def racing_connect(addr):
+                reader, writer = await orig_connect(addr)
+                # the injector lands exactly between the dial completing
+                # and open_bi registering/using the stream
+                a.install_faults(fi)
+                return reader, writer
+
+            a._connect = racing_connect
+            try:
+                import pytest
+
+                with pytest.raises(ConnectionError):
+                    await a.open_bi(addr_b)
+            finally:
+                a._connect = orig_connect
+        finally:
+            await a.close()
+            await b.close()
+
+    asyncio.run(body())
+
+
 def test_rtt_callback_sampled():
     async def body():
         samples = []

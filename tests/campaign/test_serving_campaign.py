@@ -1,0 +1,189 @@
+"""Host-serving campaign cells + the budgeted parity-lanes knob
+(ISSUE 8): latency bands through the band machinery, skeleton-only
+replay digests, per-lane host flight artifacts, and the parity_seeds
+satellite."""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from corrosion_tpu.campaign.engine import (
+    _lane_trace_path,
+    run_campaign,
+)
+from corrosion_tpu.campaign.report import BAND_METRICS, compare
+from corrosion_tpu.campaign.spec import (
+    CampaignSpec,
+    builtin_spec,
+    fault_parity_3node_spec,
+    serving_3node_spec,
+)
+from corrosion_tpu.faults import FaultEvent
+
+
+def _small_serving_spec(seeds=(0,), **scenario_over):
+    base = serving_3node_spec(seeds=seeds)
+    scenario = {**base.scenario, "n_writes": 12, "rate_hz": 0.0}
+    scenario.update(scenario_over)
+    return dataclasses.replace(base, scenario=scenario)
+
+
+@pytest.mark.campaign
+def test_spec_hash_stability_and_serialization():
+    """New fields never shift existing spec hashes: parity defaults and
+    serving keys serialize only when set."""
+    d = fault_parity_3node_spec().to_dict()
+    assert "parity_seeds" not in d and "parity_budget_s" not in d
+    # the committed parity baseline still matches its spec
+    base = json.load(
+        open("doc/experiments/CAMPAIGN_BASELINE_fault-parity-3node.json")
+    )
+    assert fault_parity_3node_spec().spec_hash() == base["spec_hash"]
+
+    tuned = dataclasses.replace(
+        fault_parity_3node_spec(), parity_seeds=3, parity_budget_s=5.0
+    )
+    d2 = tuned.to_dict()
+    assert d2["parity_seeds"] == 3 and d2["parity_budget_s"] == 5.0
+    rt = CampaignSpec.from_dict(d2)
+    assert rt.parity_seeds == 3 and rt.parity_budget_s == 5.0
+
+    sv = builtin_spec("serving-3node")
+    assert sv.serving({}) and sv.spec_hash() == serving_3node_spec().spec_hash()
+    assert sv.serving_faults({"use_faults": 0}) is False
+    assert sv.serving_faults({"use_faults": 1}) is True
+    assert "n_writes" in sv.serving_params({})
+
+
+@pytest.mark.campaign
+def test_serving_cells_band_latency_and_stay_consistent(tmp_path):
+    spec = _small_serving_spec()
+    trace_dir = str(tmp_path / "flight")
+    art = run_campaign(
+        spec, out_path=str(tmp_path / "art.json"), telemetry=True,
+        trace_dir=trace_dir,
+    )
+    assert len(art["cells"]) == 2  # use_faults ∈ {0, 1}
+    for cell in art["cells"]:
+        assert cell["kind"] == "host-serving"
+        assert cell["all_converged"], cell
+        assert all(cell["per_seed"]["consistent"])
+        for m in (
+            "publish_visible_p50_s", "publish_visible_p95_s",
+            "publish_visible_p99_s",
+        ):
+            assert m in BAND_METRICS
+            band = cell["bands"][m]
+            assert band["p99"] is not None and band["p99"] > 0
+        assert cell["bands"]["throughput_wps"]["p50"] > 0
+        # per-lane host flight artifact, sim naming scheme
+        for seed in spec.seeds:
+            path = _lane_trace_path(
+                trace_dir, spec, cell["cell_index"], seed
+            )
+            assert os.path.exists(path)
+            head = json.loads(open(path).readline())
+            assert head["tier"] == "host"
+            assert head["campaign"] == spec.name
+        # the telemetry summary rode into the artifact
+        assert cell["telemetry"]["per_seed"][0]["stages"]["visible"] > 0
+    faulted = next(
+        c for c in art["cells"] if c["params"]["use_faults"] == 1
+    )
+    assert faulted["use_faults"] and faulted["plan_horizon"] > 0
+
+    # the serving runs joined the cell's trace tree: serving_loadgen
+    # spans share the campaign_cell span's trace id (ISSUE 8 acceptance)
+    from corrosion_tpu.tracing import TRACER, extract
+
+    ctx = extract(art["cells"][0]["traceparent"])
+    serving_spans = TRACER.find(
+        name="serving_loadgen", trace_id=ctx.trace_id
+    )
+    assert serving_spans, "serving spans must parent under the cell span"
+    assert all(
+        s.parent_span_id is not None for s in serving_spans
+    )
+
+    # serving lanes are wall-clock measurements: the digest covers only
+    # the experiment identity, so a re-run replays it exactly and
+    # compare certifies identical_results
+    art2 = run_campaign(spec, out_path=None)
+    assert art2["result_digest"] == art["result_digest"]
+    rep = compare(art, art2)
+    assert rep["verdict"] == "pass", rep["regressions"]
+    assert rep["identical_results"]
+
+
+@pytest.mark.campaign
+def test_serving_report_cli_includes_latency_bands(tmp_path, capsys):
+    from corrosion_tpu.cli.main import main
+
+    spec = _small_serving_spec()
+    out = str(tmp_path / "art.json")
+    run_campaign(spec, out_path=out, telemetry=True)
+    rc = main(
+        ["sim", "campaign", "report", "--in", out, "--telemetry"]
+    )
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    for cell in rep["cells"]:
+        assert cell["kind"] == "host-serving"
+        assert cell["round_path"] == "host"
+        assert cell["consistent"] == [True]
+        assert cell["bands"]["publish_visible_p99_s"]["p99"] > 0
+        assert "telemetry" in cell
+
+
+def _quick_parity_spec(seeds, **kw):
+    return CampaignSpec(
+        name="parity-lanes-smoke",
+        scenario={
+            "n_nodes": 3, "n_payloads": 4, "fanout": 2,
+            "sync_interval_rounds": 4, "n_delay_slots": 4,
+            "inject_every": 1,
+        },
+        events=(
+            FaultEvent("loss", 0, 8, p=0.3),
+            FaultEvent("partition", 2, 6, src=1, dst=0),
+        ),
+        seeds=tuple(seeds),
+        max_rounds=200,
+        host_parity=True,
+        **kw,
+    )
+
+
+@pytest.mark.campaign
+def test_parity_seeds_replays_k_lanes():
+    """Satellite: parity_seeds=2 replays two seed lanes and records the
+    lane count; legacy top-level keys stay readable."""
+    art = run_campaign(
+        _quick_parity_spec((0, 1), parity_seeds=2, parity_budget_s=120.0),
+        out_path=None,
+    )
+    hp = art["cells"][0]["host_parity"]
+    assert hp["lanes_requested"] == 2
+    assert hp["lanes_run"] == 2
+    assert len(hp["lanes"]) == 2
+    assert {l["plan_seed"] for l in hp["lanes"]} == {0, 1}
+    assert hp["heads_match"] == all(l["heads_match"] for l in hp["lanes"])
+    # legacy single-point keys = first lane
+    assert hp["plan_seed"] == hp["lanes"][0]["plan_seed"]
+    assert "heads" in hp and "converged" in hp
+
+
+@pytest.mark.campaign
+def test_parity_budget_bounds_extra_lanes():
+    """A zero budget still runs the FIRST lane (the pre-knob contract);
+    the budget bounds only the extras — and the truncation is visible."""
+    art = run_campaign(
+        _quick_parity_spec((0, 1, 2), parity_seeds=3, parity_budget_s=0.0),
+        out_path=None,
+    )
+    hp = art["cells"][0]["host_parity"]
+    assert hp["lanes_requested"] == 3
+    assert hp["lanes_run"] == 1
+    assert len(hp["lanes"]) == 1
