@@ -56,6 +56,7 @@ _secondary: dict | None = None
 _fault_storm: dict | None = None
 _tier_1m: dict | None = None
 _serving: dict | None = None
+_topo_frontier: dict | None = None
 _printed = False
 _diag: dict = {"attempts": [], "preflight": None, "started_unix": time.time()}
 
@@ -99,6 +100,11 @@ def _emit_and_exit(code: int = 0) -> None:
     # instrumentation-overhead fraction recorded like the sim rung's
     if _serving is not None:
         out["serving_loadgen"] = _serving
+    # peer-sampler frontier rung (ISSUE 9): uniform vs PeerSwap
+    # convergence-rounds × wire-bytes across two topology families —
+    # the paper-grounded sampler comparison, tracked per bench run
+    if _topo_frontier is not None:
+        out["peer_sampler_frontier"] = _topo_frontier
     print(json.dumps(out), flush=True)
     _write_diag()
     os._exit(code)
@@ -441,6 +447,39 @@ def main() -> int:
                 .get("p99"),
             }
             _diag["serving_loadgen"] = {"nodes": sv_nodes, **m}
+        _write_diag()
+
+    # peer-sampler frontier rung (ISSUE 9): the uniform-vs-PeerSwap
+    # campaign (both samplers × wan-3x2 × hetero-degree, wire bytes
+    # banded) reduced to per-family rounds/wire ratios.  A small dense
+    # CPU campaign (~96 nodes) — never wakes the chip, its own child so
+    # a hang can't eat the storm budget.
+    global _topo_frontier
+    if os.environ.get("BENCH_TOPO", "1") != "0" and _remaining() > 180:
+        tf_nodes = int(os.environ.get("BENCH_TOPO_NODES", "96"))
+        res = run_child(
+            {
+                "mode": "aux",
+                "platform": "cpu",
+                "fn": "config_peer_sampler_frontier",
+                "seed": 1,
+                "kwargs": {"n_nodes": tf_nodes},
+            },
+            timeout=min(_remaining() - 60, 600.0),
+        )
+        _diag["attempts"].append(
+            {"phase": "peer_sampler_frontier", "nodes": tf_nodes, **res}
+        )
+        m = res.get("metrics") or {}
+        if res.get("ok") and m.get("converged"):
+            _topo_frontier = {
+                "metric": f"peer_sampler_frontier_{tf_nodes}node",
+                "families": m.get("families"),
+                "spec_hash": m.get("spec_hash"),
+                "result_digest": m.get("result_digest"),
+                "wall_clock_s": m.get("wall_clock_s"),
+            }
+            _diag["peer_sampler_frontier"] = {"nodes": tf_nodes, **m}
         _write_diag()
 
     # fault-storm rung (ISSUE 4): the headline storm shape under a

@@ -141,6 +141,23 @@ def _run_cell(
     an in-process agent cluster through the measured loadgen driver and
     bands publish→subscriber-visible latency percentiles."""
     if spec.serving(cell):
+        # the ISSUE 9 axes are sim-cell concepts: a serving cell that
+        # names one would silently measure nothing — refuse loudly (the
+        # same rule as the CLI's axis flags).  The raw geo-tier keys
+        # count too: a serving grid sweeping inter_loss would report
+        # different params over the identical workload.
+        from .spec import _TOPOLOGY_KEYS
+
+        for key in ("measure_wire", "churn", "topo_family") + _TOPOLOGY_KEYS:
+            if spec._meta(cell, key):
+                raise ValueError(
+                    f"{key!r} is not supported on host-serving cells"
+                )
+        if spec._meta(cell, "peer_sampler", "uniform") != "uniform":
+            raise ValueError(
+                "peer_sampler is not supported on host-serving cells "
+                "(the serving path never builds a SimConfig)"
+            )
         return _run_serving_cell(
             spec, cell, cell_index=cell_index, telemetry=telemetry,
             trace_dir=trace_dir,
@@ -162,6 +179,35 @@ def _run_cell(
     topo = spec.topo(cell)
     meta = uniform_payloads(cfg, inject_every=spec.inject_every(cell))
     detect = spec.detect_membership(cell)
+    # measure_wire (ISSUE 9) arms the recorder INTERNALLY: the per-lane
+    # wire-byte totals land in per_seed (digested, banded) whether or
+    # not --telemetry was given, so the frontier metric is part of the
+    # campaign's replay identity, not a run-config side effect
+    measure_wire = spec.measure_wire(cell)
+    if measure_wire and detect:
+        # a silently missing wire_bytes band would read as "regression-
+        # gated" when nothing is measured — same loud-refusal rule as
+        # the CLI's axis flags
+        raise ValueError(
+            "measure_wire is not supported on detect_membership cells "
+            "(the detection loop bands detect_round, not wire cost)"
+        )
+    if measure_wire and cfg.trace_every > 1:
+        # a decimated trace sums stride SAMPLES; banding them as wire
+        # totals would deterministically undercount — and CI would
+        # never notice, because the digest carries the wrong number
+        raise ValueError(
+            "measure_wire needs trace_every == 1 (wire totals are "
+            "exact per-round sums, not stride samples)"
+        )
+    if detect and spec._meta(cell, "churn"):
+        # detect cells run plan-free (spec.fault_plan is skipped), so a
+        # churn key would silently measure a churn-free cluster
+        raise ValueError(
+            "churn schedules are not supported on detect_membership "
+            "cells (the detection ensemble runs without a FaultPlan)"
+        )
+    run_telemetry = bool(telemetry or measure_wire)
     plan = (
         None if detect else spec.fault_plan(cell, seed=spec.seeds[0])
     )
@@ -188,20 +234,20 @@ def _run_cell(
             out = run_detect_ensemble(
                 cfg, topo, meta, spec.seeds,
                 kill_every=spec.kill_every(cell),
-                max_rounds=spec.max_rounds, telemetry=telemetry,
+                max_rounds=spec.max_rounds, telemetry=run_telemetry,
                 mesh=mesh,
             )
             finals, metrics, detect_rounds = out[0], out[1], out[2]
-            if telemetry:
+            if run_telemetry:
                 traces = out[3]
         else:
             out = run_seed_ensemble(
                 plan, cfg, topo, meta, spec.seeds,
-                max_rounds=spec.max_rounds, telemetry=telemetry,
+                max_rounds=spec.max_rounds, telemetry=run_telemetry,
                 mesh=mesh,
             )
             finals, metrics = out[0], out[1]
-            if telemetry:
+            if run_telemetry:
                 traces = out[2]
         jax.block_until_ready(out)
         np.asarray(finals.have[0, 0, 0])  # force a real host read
@@ -240,6 +286,31 @@ def _run_cell(
                     _percentile_lower(node_conv[i], 99) for i in range(k)
                 ],
             }
+            if measure_wire:
+                # deterministic per-lane wire totals (broadcast + sync
+                # bytes) from the internally-armed recorder — the
+                # frontier's cost axis, banded below like any metric.
+                # The materialized host dicts replace `traces` so the
+                # telemetry export below reuses them (trace_host is
+                # idempotent on dicts — one device-to-host copy per
+                # lane, the PR 5 discipline)
+                from ..sim.telemetry import trace_host
+
+                every = max(int(cfg.trace_every), 1)
+                wb, lane_hosts = [], []
+                for i in range(k):
+                    lane = jax.tree.map(lambda x, i=i: x[i], traces)
+                    h = trace_host(lane, int(rounds[i]), every)
+                    lane_hosts.append(h)
+                    wb.append(
+                        round(
+                            float(h["bcast_bytes"].sum())
+                            + float(h["sync_bytes"].sum()),
+                            1,
+                        )
+                    )
+                per_seed["wire_bytes"] = wb
+                traces = lane_hosts
         # the lane → convergence span tree (host-synthesized after the
         # vmapped run — lanes execute as ONE program, so their spans
         # carry outcomes, not per-lane walls)
@@ -293,7 +364,10 @@ def _run_cell(
         # ids are random unless CORRO_CAMPAIGN_SEED pins the stream
         "traceparent": traceparent,
     }
-    if traces is not None:
+    if traces is not None and telemetry:
+        # the observability block stays tied to the --telemetry flag (a
+        # run-config, digest-excluded); a measure_wire-only run armed
+        # the recorder just for the banded per_seed metric above
         result["telemetry"] = _cell_telemetry(
             spec, cell_index, traces, rounds, cfg, traceparent, trace_dir
         )
@@ -488,7 +562,14 @@ def _cell_telemetry(
 
     summaries = []
     for i, seed in enumerate(spec.seeds):
-        lane = jax.tree.map(lambda x: x[i], traces)
+        # ``traces`` is either the stacked device RoundTrace or (on
+        # measure_wire cells) the already-materialized per-lane host
+        # dicts — trace_host is idempotent on the latter
+        lane = (
+            traces[i]
+            if isinstance(traces, list)
+            else jax.tree.map(lambda x: x[i], traces)
+        )
         r = int(rounds[i])
         host = trace_host(lane, r)
         summaries.append(trace_summary(host, r, cfg))

@@ -23,12 +23,15 @@ from .spec import canonical_json, content_hash
 #: ``detect_round`` exists only on membership cells (detect_membership
 #: scenarios — runner configs #2/#2b through the engine); the
 #: ``publish_visible_*`` latency metrics only on host-serving cells
-#: (ISSUE 8 — each lane's loadgen percentiles, in seconds); `compare`
-#: skips bands a cell doesn't carry.
+#: (ISSUE 8 — each lane's loadgen percentiles, in seconds);
+#: ``wire_bytes`` only on ``measure_wire`` cells (ISSUE 9 — the
+#: convergence-rounds × wire-bytes frontier's cost axis, deterministic
+#: integer-derived totals); `compare` skips bands a cell doesn't carry.
 BAND_METRICS = (
     "rounds", "p99_node_convergence_round", "detect_round",
     "publish_visible_p50_s", "publish_visible_p95_s",
     "publish_visible_p99_s",
+    "wire_bytes",
 )
 #: artifact keys excluded from the result digest (vary run to run —
 #: or run-CONFIG to run-config — without changing the campaign's

@@ -30,8 +30,16 @@ from ..faults import FaultEvent, FaultPlan
 
 #: spec fields that route to Topology rather than SimConfig when they
 #: appear in ``scenario`` or ``grid`` (``topo()`` reads them from either
-#: place; ``sim_config()`` strips them)
-_TOPOLOGY_KEYS = ("n_regions", "intra_delay", "inter_delay", "loss")
+#: place; ``sim_config()`` strips them).  The geo-tier and degree keys
+#: (ISSUE 9) ride the same rule, so ``grid={"inter_loss": [...]}`` or a
+#: ``degree_classes`` sweep is a campaign axis like any other.
+_TOPOLOGY_KEYS = (
+    "n_regions", "intra_delay", "inter_delay", "loss",
+    "n_azs", "az_delay", "az_loss", "inter_loss", "degree_classes",
+)
+#: named-topology axis (ISSUE 9): resolves through
+#: `corrosion_tpu.topo.family_topology` before explicit keys overlay it
+_TOPO_FAMILY_KEY = "topo_family"
 #: spec-level (non-SimConfig) scenario keys:
 #: - ``inject_every`` — payload injection cadence;
 #: - ``wan_tuned`` — build the cell's SimConfig via `SimConfig.wan_tuned`
@@ -52,10 +60,23 @@ _TOPOLOGY_KEYS = ("n_regions", "intra_delay", "inter_delay", "loss")
 #: - ``use_faults`` — whether a serving cell replays the spec's events
 #:   through `HostFaultDriver` during the flood (a grid axis over
 #:   [0, 1] runs the same workload faultless AND faulted).
+#: - ``topo_family`` — named topology family (ISSUE 9;
+#:   `corrosion_tpu.topo.FAMILIES`), resolved by ``topo()``;
+#: - ``churn``/``churn_frac``/``churn_round``/``churn_seed`` — churn
+#:   schedule family + knobs (`corrosion_tpu.topo.churn_events`); the
+#:   generated range-selector crash events merge into every lane's
+#:   FaultPlan (seed-independent, so the ensemble's shared-schedule
+#:   contract holds);
+#: - ``measure_wire`` — record per-lane wire-byte totals (broadcast +
+#:   sync) into ``per_seed.wire_bytes`` and band them: the engine arms
+#:   the flight recorder internally, so the metric is deterministic and
+#:   part of the replay digest whether or not ``--telemetry`` was given.
 _SCENARIO_META_KEYS = (
     "inject_every", "detect_membership", "kill_every",
     "serving", "n_writes", "n_writers", "n_watchers", "rate_hz",
     "settle_timeout_s", "use_faults",
+    "topo_family", "churn", "churn_frac", "churn_round", "churn_seed",
+    "measure_wire",
 )
 
 #: serving-cell workload knobs → run_serving_cluster_load kwarg names
@@ -215,8 +236,14 @@ class CampaignSpec:
         kw = dict(self.scenario)
         kw.update(cell)
         wan = bool(kw.pop("wan_tuned", False))
-        for k in _TOPOLOGY_KEYS + _SCENARIO_META_KEYS:
-            kw.pop(k, None)
+        # strip topology/meta keys — EXCEPT keys that are also real
+        # SimConfig fields (``n_writers`` doubles as a serving-cell
+        # workload knob in _SCENARIO_META_KEYS; a sim cell's
+        # n_writers must reach SimConfig, not vanish silently)
+        fields = SimConfig.__dataclass_fields__
+        for k in _TOPOLOGY_KEYS + _SCENARIO_META_KEYS + (_TOPO_FAMILY_KEY,):
+            if k not in fields:
+                kw.pop(k, None)
         if wan:
             # the runner configs' cluster-size-adaptive SWIM timing —
             # a spec routing one of them through the engine must build
@@ -231,14 +258,32 @@ class CampaignSpec:
         # topology keys may ride `scenario` (one flat dict in a spec
         # file); they route here, and sim_config pops them — a key in
         # both places is a spec bug, not a silent precedence question
-        for k in _TOPOLOGY_KEYS:
+        for k in _TOPOLOGY_KEYS + (_TOPO_FAMILY_KEY,):
             if k in self.scenario:
                 if k in self.topology:
                     raise ValueError(
                         f"{k!r} appears in both scenario and topology"
                     )
                 kw[k] = self.scenario[k]
-        kw.update({k: cell[k] for k in _TOPOLOGY_KEYS if k in cell})
+        kw.update(
+            {
+                k: cell[k]
+                for k in _TOPOLOGY_KEYS + (_TOPO_FAMILY_KEY,)
+                if k in cell
+            }
+        )
+        # named family (ISSUE 9): the family supplies the BASE kwargs,
+        # explicit keys overlay it — a grid can sweep families and still
+        # pin one knob across all of them
+        fam = kw.pop(_TOPO_FAMILY_KEY, None)
+        if fam:
+            from ..topo import family_topology
+
+            base = family_topology(str(fam))
+            base.update(kw)
+            kw = base
+        # JSON round-trips degree_classes as a list; Topology's
+        # __post_init__ coerces it back to a hashable tuple
         return Topology(**kw)
 
     def inject_every(self, cell: Dict[str, object]) -> int:
@@ -259,6 +304,35 @@ class CampaignSpec:
     def kill_every(self, cell: Dict[str, object]) -> int:
         return int(
             cell.get("kill_every", self.scenario.get("kill_every", 0))
+        )
+
+    # -- topology & churn axes (ISSUE 9) ------------------------------------
+
+    def _meta(self, cell: Dict[str, object], key: str, default=None):
+        return cell.get(key, self.scenario.get(key, default))
+
+    def measure_wire(self, cell: Dict[str, object]) -> bool:
+        """True when the cell bands per-lane wire-byte totals (the
+        convergence-rounds × wire-bytes frontier axis): the engine arms
+        the flight recorder internally and records
+        ``per_seed.wire_bytes`` deterministically."""
+        return bool(self._meta(cell, "measure_wire", False))
+
+    def churn_events_for(self, cell: Dict[str, object], n_nodes: int):
+        """The cell's churn schedule as FaultPlan events (empty when no
+        ``churn`` key).  Derived from SPEC values only — never the lane
+        seed — so every lane shares one schedule tensor set (the
+        ensemble's shared-schedule contract)."""
+        name = self._meta(cell, "churn")
+        if not name:
+            return ()
+        from ..topo import churn_events
+
+        return churn_events(
+            str(name), n_nodes,
+            frac=float(self._meta(cell, "churn_frac", 0.25)),
+            round_knob=int(self._meta(cell, "churn_round", 8)),
+            seed=int(self._meta(cell, "churn_seed", 0)),
         )
 
     # -- host-serving cells (ISSUE 8) ---------------------------------------
@@ -296,12 +370,17 @@ class CampaignSpec:
     def fault_plan(
         self, cell: Dict[str, object], seed: int
     ) -> Optional[FaultPlan]:
-        """The cell's plan at a given lane seed (None = fault-free)."""
-        if not self.events:
-            return None
+        """The cell's plan at a given lane seed (None = fault-free).
+        A ``churn`` axis (ISSUE 9) appends its generated range-selector
+        crash events to the spec's own — one merged schedule riding the
+        existing compilers on every tier."""
         n = int(cell.get("n_nodes", self.scenario["n_nodes"]))
+        churn = self.churn_events_for(cell, n)
+        if not self.events and not churn:
+            return None
         return FaultPlan(
-            n_nodes=n, seed=int(seed), events=self.events,
+            n_nodes=n, seed=int(seed),
+            events=tuple(self.events) + tuple(churn),
             round_s=self.round_s,
         )
 
@@ -436,12 +515,44 @@ def serving_3node_spec(
     )
 
 
+def peer_sampler_frontier_spec(
+    seeds: Sequence[int] = (0, 1, 2, 3),
+    n: int = 96,
+    max_rounds: int = 400,
+) -> CampaignSpec:
+    """The uniform-vs-PeerSwap frontier (ISSUE 9): band convergence
+    rounds AND wire bytes for both samplers across two topology
+    families — the geo-tiered WAN shape (``wan-3x2``) and the
+    heterogeneous-degree shape (``hetero-degree``) — so the PeerSwap
+    paper's randomness/convergence claim is a measured trade-off
+    (rounds × bytes), not folklore.  ``measure_wire`` makes the
+    wire-byte bands deterministic parts of the replay digest; the
+    committed baseline lives at
+    doc/experiments/CAMPAIGN_BASELINE_peer-sampler-frontier.json (CI
+    ``topo-smoke``)."""
+    return CampaignSpec(
+        name="peer-sampler-frontier",
+        scenario={
+            "n_nodes": n, "n_payloads": 64, "n_writers": 4, "fanout": 3,
+            "sync_interval_rounds": 6, "n_delay_slots": 4,
+            "inject_every": 1, "measure_wire": 1,
+        },
+        grid={
+            "peer_sampler": ["uniform", "peerswap"],
+            "topo_family": ["wan-3x2", "hetero-degree"],
+        },
+        seeds=tuple(seeds),
+        max_rounds=max_rounds,
+    )
+
+
 BUILTIN_SPECS = {
     "fault-parity-3node": fault_parity_3node_spec,
     "fault-campaign-3node": fault_campaign_3node_spec,
     "swim-churn-64": swim_churn_64_spec,
     "swim-churn-partial": swim_churn_partial_spec,
     "serving-3node": serving_3node_spec,
+    "peer-sampler-frontier": peer_sampler_frontier_spec,
 }
 
 

@@ -375,6 +375,10 @@ _SIM_SCENARIOS = {
     # latency percentiles, instrumentation-overhead A/B, faultless AND
     # FaultPlan conditions, host flight JSONL via --trace-out
     "serving-loadgen": "config_serving_loadgen",
+    # the uniform-vs-PeerSwap frontier (ISSUE 9): both samplers × two
+    # topology families as a campaign, reduced to per-family rounds ×
+    # wire-bytes ratios (the paper-grounded sampler comparison)
+    "peer-sampler-frontier": "config_peer_sampler_frontier",
 }
 
 
@@ -386,6 +390,11 @@ def cmd_sim(args) -> int:
         # pure host-side artifact rendering — dispatched before the
         # platform setup below so it never pays the jax import
         return cmd_trace(args)
+    if args.scenario == "topo":
+        # topology-family introspection (ISSUE 9): the listing is
+        # jax-free; a tier table imports jax for the Topology dataclass
+        # only (no op runs, so no backend/tunnel is touched)
+        return cmd_topo(args)
     # honor JAX_PLATFORMS even when an accelerator plugin would win over
     # the env var (jax.config takes precedence) — tests set cpu to keep
     # subprocess sims off the contended real chip
@@ -466,6 +475,29 @@ def _run_sim_scenario(args) -> int:
             )
             return 2
         kwargs["n_devices"] = args.devices
+    # topology/sampler axes (ISSUE 9): only scenarios whose config fn
+    # exposes the axis accept the flag — a silently ignored topology
+    # would fake a WAN measurement
+    if args.topology:
+        if "topo_family" not in params:
+            print(
+                f"error: scenario {args.scenario!r} does not take "
+                "--topology (axis-aware scenarios: broadcast-1k, "
+                "write-storm-100k; `sim topo show` lists families)",
+                file=sys.stderr,
+            )
+            return 2
+        kwargs["topo_family"] = args.topology
+    if args.sampler:
+        if "sampler" not in params:
+            print(
+                f"error: scenario {args.scenario!r} does not take "
+                "--sampler (axis-aware scenarios: broadcast-1k, "
+                "write-storm-100k)",
+                file=sys.stderr,
+            )
+            return 2
+        kwargs["sampler"] = args.sampler
     # flight recorder (ISSUE 5): --telemetry adds the summary block to
     # the record; --trace-out also writes the per-round JSONL artifact.
     # A scenario supports the recorder if its config fn takes `telemetry`
@@ -538,6 +570,94 @@ def _run_sim_scenario(args) -> int:
         {"seeds": args.seeds, "summary": summary, "runs": runs},
         default=float,
     ))
+    return 0
+
+
+def cmd_topo(args) -> int:
+    """`sim topo show [--topology FAM] [--nodes N]`: render a topology
+    family's tier table — region/AZ blocks, delay/loss classes, degree
+    histogram, and the host-tier link-event count.  The family LISTING
+    is jax-free (`corrosion_tpu.topo` imports no accelerator runtime at
+    module level); rendering a tier table constructs a `Topology`
+    dataclass, which imports jax but touches no device or computation
+    (safe even before cmd_sim's platform setup — backend init happens
+    at first op, not import).  Without ``--topology``, list the
+    registry."""
+    from ..topo import (
+        FAMILIES,
+        az_blocks,
+        family_topology,
+        topology_link_events,
+    )
+
+    if args.campaign_cmd != "show":
+        raise SystemExit("usage: sim topo show [--topology FAM] [--nodes N]")
+    if not args.topology:
+        out = {name: dict(kw) for name, kw in sorted(FAMILIES.items())}
+        if args.json:
+            _print_json({"families": out})
+        else:
+            print("topology families (sim topo show --topology NAME):")
+            for name, kw in out.items():
+                print(f"  {name}: {json.dumps(kw, sort_keys=True)}")
+        return 0
+    try:
+        kw = family_topology(args.topology)
+    except KeyError:
+        print(
+            f"error: unknown topology family {args.topology!r} "
+            f"(have {sorted(FAMILIES)})",
+            file=sys.stderr,
+        )
+        return 2
+    n = args.nodes or 96
+    from ..sim.topology import Topology, loss_tiers
+
+    topo = Topology(**kw)  # __post_init__ coerces degree_classes
+    blocks = az_blocks(n, topo.n_regions, topo.n_azs)
+    base, az_t, inter_t = loss_tiers(topo)
+    tiers = {
+        "same-az": {"delay_rounds": topo.intra_delay, "loss": base / 256.0},
+        "cross-az": {"delay_rounds": topo.az_delay, "loss": az_t / 256.0},
+        "cross-region": {
+            "delay_rounds": topo.inter_delay, "loss": inter_t / 256.0,
+        },
+    }
+    degrees = {}
+    if topo.degree_classes:
+        k = len(topo.degree_classes)
+        for i, d in enumerate(topo.degree_classes):
+            share = len(range(i, n, k))
+            degrees[str(d)] = degrees.get(str(d), 0) + share
+    # the host-tier compilation this family rides for parity points
+    events = topology_link_events(topo, n, end=1)
+    out = {
+        "family": args.topology,
+        "topology": kw,
+        "n_nodes": n,
+        "az_blocks": [
+            {"region": r, "range": f"{lo}:{hi}"} for r, lo, hi in blocks
+        ],
+        "tiers": tiers,
+        "degree_histogram": degrees or None,
+        "host_link_events": len(events),
+    }
+    if args.json:
+        _print_json(out)
+        return 0
+    print(f"topology family {args.topology!r} at {n} nodes:")
+    print(f"  {json.dumps(kw, sort_keys=True)}")
+    print(f"  az blocks: " + ", ".join(
+        f"r{r}[{lo}:{hi}]" for r, lo, hi in blocks
+    ))
+    for name, t in tiers.items():
+        print(
+            f"  {name:>13}: delay {t['delay_rounds']} rounds, "
+            f"loss {t['loss']:.3f}"
+        )
+    if degrees:
+        print(f"  degree histogram: {json.dumps(degrees, sort_keys=True)}")
+    print(f"  host-tier link events (range rectangles): {len(events)}")
     return 0
 
 
@@ -897,16 +1017,18 @@ def build_parser() -> argparse.ArgumentParser:
         "sim",
         help="run a TPU-simulator benchmark config, "
         "`sim campaign run|compare|report` for declarative seed-ensemble "
-        "campaigns, or `sim trace show` for flight-recorder artifacts",
+        "campaigns, `sim trace show` for flight-recorder artifacts, or "
+        "`sim topo show` for topology families",
     )
     sm.add_argument(
-        "scenario", choices=sorted(_SIM_SCENARIOS) + ["campaign", "trace"]
+        "scenario",
+        choices=sorted(_SIM_SCENARIOS) + ["campaign", "trace", "topo"],
     )
     sm.add_argument(
         "campaign_cmd", nargs="?",
         choices=["run", "compare", "report", "show"],
         help="campaign action (scenario=campaign), or `show` "
-        "(scenario=trace)",
+        "(scenario=trace | topo)",
     )
     # default None so "explicitly given" is detectable: campaign run
     # must distinguish `--seed 0` (override to one seed) from "no seed
@@ -929,6 +1051,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="campaign run: shard every cell's node axis over up to N "
         "devices (mesh × lane batching; results and digests are "
         "unchanged — the realized mesh is recorded per cell)",
+    )
+    sm.add_argument(
+        "--topology", metavar="FAMILY",
+        help="topology family (ISSUE 9): axis-aware scenario runs take "
+        "it as the cell topology; `sim topo show --topology F` renders "
+        "its tier table (omit to list families)",
+    )
+    sm.add_argument(
+        "--sampler", choices=["uniform", "peerswap"],
+        help="peer-selection seam (ISSUE 9) on axis-aware scenarios",
     )
     sm.add_argument(
         "--spec", help="campaign run: JSON spec file or builtin name"

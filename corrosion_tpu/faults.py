@@ -104,7 +104,10 @@ class FaultEvent:
     end: int
     src: NodeSel = "*"   # link faults: sending side ("*" = every node)
     dst: NodeSel = "*"   # link faults: receiving side
-    node: Optional[int] = None  # crash / clock_skew target
+    # crash / clock_skew target: a node index, or (ISSUE 9, crash) a
+    # "lo:hi" range / "*" selector — a 25k-node flash-crowd join must be
+    # ONE event, not 25k (`corrosion_tpu.topo.churn` relies on it)
+    node: Optional[NodeSel] = None
     p: float = 0.0       # loss / duplicate probability
     delay_rounds: int = 0  # delay magnitude (fixed for `delay`, max for `jitter`)
     wipe: bool = False   # crash: lose durable state at restart
@@ -218,8 +221,12 @@ class FaultPlan:
                 r = sel_indices(sel, self.n_nodes)
                 if len(r) == 0 or r.start < 0 or r.stop > self.n_nodes:
                     raise ValueError(f"node selector {sel} outside 0..{self.n_nodes - 1}")
-            if ev.node is not None and not 0 <= ev.node < self.n_nodes:
-                raise ValueError(f"node {ev.node} outside 0..{self.n_nodes - 1}")
+            if ev.node is not None:
+                r = sel_indices(ev.node, self.n_nodes)
+                if len(r) == 0 or r.start < 0 or r.stop > self.n_nodes:
+                    raise ValueError(
+                        f"node {ev.node} outside 0..{self.n_nodes - 1}"
+                    )
 
     # -- schedule expansion (pure; shared by both compilers) ---------------
 
@@ -255,17 +262,20 @@ class FaultPlan:
         skews: Dict[int, int] = {}
         for ev in self.events:
             if ev.kind == "crash":
+                # crash targets may be range selectors (ISSUE 9 churn)
+                sel = sel_indices(ev.node, self.n_nodes)
                 if ev.start <= r < ev.end:
-                    down.add(ev.node)
+                    down.update(sel)
                 elif r == ev.end:
-                    restart.add(ev.node)
+                    restart.update(sel)
                     if ev.wipe:
-                        wipe.add(ev.node)
+                        wipe.update(sel)
                 continue
             if not ev.start <= r < ev.end:
                 continue
             if ev.kind == "clock_skew":
-                skews[ev.node] = skews.get(ev.node, 0) + ev.skew_ns
+                for i in sel_indices(ev.node, self.n_nodes):
+                    skews[i] = skews.get(i, 0) + ev.skew_ns
                 continue
             if not include_links:
                 continue

@@ -82,6 +82,7 @@ def state_shardings(mesh: Mesh, swim_full_view: bool) -> SimState:
         converged_at=n0,
         heads=n0p, gap_lo=n0ak, gap_hi=n0ak,
         pid=n0p, pkey=n0p, psince=n0p,
+        pview=n0p,
     )
 
 

@@ -20,7 +20,13 @@ import jax.numpy as jnp
 
 from .state import ALIVE, PayloadMeta, SimConfig, SimState, budget_prefix_mask
 from .swim import sample_member_targets
-from .topology import Topology, edge_alive, edge_delay, edge_payload_drop
+from .topology import (
+    Topology,
+    apply_degree_caps,
+    edge_alive,
+    edge_delay,
+    edge_payload_drop,
+)
 
 
 def broadcast_step(
@@ -89,6 +95,9 @@ def broadcast_step(
         targets = targets.at[:, 0].set(
             jnp.where(ok_local, local, targets[:, 0])
         )
+    # heterogeneous fan-out (ISSUE 9): slots past a node's degree cap
+    # become the -1 sentinel — trace-time identity without classes
+    targets = apply_degree_caps(targets, topo)
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
     dst = targets.reshape(-1)  # [E]
     ok = dst >= 0
@@ -99,8 +108,11 @@ def broadcast_step(
     delay = edge_delay(topo, region, src, dst)  # [E]
 
     # loss is drawn per (edge, payload): each changeset is its own uni
-    # frame on the wire (see edge_payload_drop)
-    drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
+    # frame on the wire (see edge_payload_drop); geo-tiered topologies
+    # compare the same draw against per-edge tier thresholds
+    drop = edge_payload_drop(
+        topo, k_drop, src.shape[0], p, src=src, dst=dst, region=region
+    )
 
     delay_ep = None
     cut = jnp.int32(0)

@@ -376,7 +376,7 @@ def compile_plan(
         for i in sched.wipe:
             wipe[r, i] = True
 
-    base = max(topo.intra_delay, topo.inter_delay, 1)
+    base = max(topo.max_delay, 1)
     if base + max_extra >= cfg.n_delay_slots:
         raise ValueError(
             f"max edge delay {base + max_extra} rounds (topology {base} + "
@@ -448,15 +448,21 @@ def compile_plan_factored(
         a[ev.start:ev.end] = True
         return a
 
+    from ..faults import sel_indices
+
     crash_events = [ev for ev in plan.events if ev.kind == "crash"]
     # two passes mirror the matrix compiler's per-round down-then-restart
-    # write order (overlapping crash windows: the restart wins the round)
+    # write order (overlapping crash windows: the restart wins the round);
+    # crash targets may be "lo:hi" range selectors (ISSUE 9 churn) —
+    # sel_indices ranges are contiguous, so each event is one numpy slice
     for ev in crash_events:
-        alive[ev.start:ev.end, ev.node] = DOWN
+        sel = sel_indices(ev.node, n)
+        alive[ev.start:ev.end, sel.start:sel.stop] = DOWN
     for ev in crash_events:
-        alive[ev.end, ev.node] = ALIVE
+        sel = sel_indices(ev.node, n)
+        alive[ev.end, sel.start:sel.stop] = ALIVE
         if ev.wipe:
-            wipe[ev.end, ev.node] = True
+            wipe[ev.end, sel.start:sel.stop] = True
 
     for ev in plan.events:
         if ev.kind in ("crash", "clock_skew", "duplicate"):
@@ -518,7 +524,7 @@ def compile_plan_factored(
             default=0,
         )
         max_extra = max(max_extra, d + j)
-    base = max(topo.intra_delay, topo.inter_delay, 1)
+    base = max(topo.max_delay, 1)
     if base + max_extra >= cfg.n_delay_slots:
         raise ValueError(
             f"max edge delay {base + max_extra} rounds (topology {base} + "
@@ -627,6 +633,9 @@ def apply_node_faults(state: SimState, rf: RoundFaults) -> SimState:
             pkey=jnp.where(wn, -1, state.pkey),
             psince=jnp.where(wn, -1, state.psince),
         )
+    if state.pview.size:  # PeerSwap view (ISSUE 9): wiped to empty —
+        # the rejoiner repopulates via incoming swaps + staggered refill
+        state = state._replace(pview=jnp.where(wn, -1, state.pview))
     return state
 
 

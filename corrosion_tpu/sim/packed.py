@@ -71,7 +71,13 @@ import numpy as np
 
 from .state import ALIVE, PayloadMeta, SimConfig, SimState
 from .swim import sample_member_targets
-from .topology import Topology, edge_alive, edge_delay, edge_payload_drop
+from .topology import (
+    Topology,
+    apply_degree_caps,
+    edge_alive,
+    edge_delay,
+    edge_payload_drop,
+)
 
 U32 = jnp.uint32
 # a NUMPY scalar on purpose: a module-level jnp constant would be
@@ -416,6 +422,9 @@ def broadcast_packed(
         targets = targets.at[:, 0].set(
             jnp.where(ok_local, local, targets[:, 0])
         )
+    # heterogeneous fan-out (ISSUE 9) — identical masking to the dense
+    # kernel, applied before the edge list so both paths agree
+    targets = apply_degree_caps(targets, topo)
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
     dst = targets.reshape(-1)
     ok = dst >= 0
@@ -432,7 +441,9 @@ def broadcast_packed(
     # SAME per-(edge, payload) mask as the dense kernel — same key, same
     # shape, same bits (trace-time constant when loss == 0).
     p = cfg.n_payloads
-    drop = edge_payload_drop(topo, k_drop, src.shape[0], p)
+    drop = edge_payload_drop(
+        topo, k_drop, src.shape[0], p, src=src, dst=dst, region=region
+    )
     delay_ep = None
     cut = jnp.int32(0)
     if telem:
@@ -627,8 +638,20 @@ def packed_round_step(
     from .round import RunMetrics
     from .state import version_heads
 
-    key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
+    if cfg.peer_sampler == "peerswap":
+        key, k_bcast, k_sync, k_swim, k_swap = jax.random.split(
+            state.key, 5
+        )
+    else:
+        key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
     state = state._replace(key=key)
+    if cfg.peer_sampler == "peerswap":
+        # PeerSwap view mixing (ISSUE 9), same phase position as the
+        # dense round — pview rides the slim state, so the swap step is
+        # shared verbatim with round.round_step
+        from ..topo.sampler import peerswap_step
+
+        state = peerswap_step(state, cfg, topo, k_swap, faults)
 
     have0_w = carry.have  # pre-round holdings (delivered-count base)
     carry, injected_p = inject_packed(

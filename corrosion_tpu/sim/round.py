@@ -59,12 +59,21 @@ def new_metrics(cfg: SimConfig) -> RunMetrics:
 
 def validate(cfg: SimConfig, topo: Topology) -> None:
     """Trace-time sanity: the delay ring must be able to represent every
-    edge delay (a wrapped slot delivers EARLY, silently)."""
-    max_delay = max(topo.intra_delay, topo.inter_delay, 1)  # sync uses t+1
+    edge delay (a wrapped slot delivers EARLY, silently) — the AZ tier
+    included since ISSUE 9 — and the heterogeneous degree classes must
+    fit inside the fan-out slot count (a class above it would silently
+    clamp, not expand)."""
+    max_delay = max(topo.max_delay, 1)  # sync uses t+1
     if max_delay >= cfg.n_delay_slots:
         raise ValueError(
             f"max edge delay {max_delay} rounds needs n_delay_slots > "
             f"{max_delay}, got {cfg.n_delay_slots}"
+        )
+    if topo.degree_classes and max(topo.degree_classes) > cfg.fanout:
+        raise ValueError(
+            f"degree_classes {topo.degree_classes} exceed fanout="
+            f"{cfg.fanout}; degree caps mask fan-out slots, they cannot "
+            "add slots"
         )
 
 
@@ -93,8 +102,22 @@ def round_step(
     nothing back into the round, so the trace=None path compiles to
     exactly the pre-telemetry kernel."""
     validate(cfg, topo)
-    key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
+    if cfg.peer_sampler == "peerswap":
+        # the swap tick consumes its own key via a trace-time branch —
+        # uniform scenarios split exactly as before (byte-identity)
+        key, k_bcast, k_sync, k_swim, k_swap = jax.random.split(
+            state.key, 5
+        )
+    else:
+        key, k_bcast, k_sync, k_swim = jax.random.split(state.key, 4)
     state = state._replace(key=key)
+    if cfg.peer_sampler == "peerswap":
+        # PeerSwap view mixing (ISSUE 9) runs BEFORE the phases so this
+        # round's target draws sample the freshly-swapped views; the
+        # swap messages ride the same reachability/fault seam as probes
+        from ..topo.sampler import peerswap_step
+
+        state = peerswap_step(state, cfg, topo, k_swap, faults)
 
     have0 = state.have  # pre-round holdings (the delivered-count base)
     state = inject_step(state, meta, cfg)

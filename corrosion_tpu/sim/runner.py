@@ -261,16 +261,36 @@ def config_swim_churn_partial(
     return rec
 
 
+def _resolve_topo(topo_family: Optional[str]) -> Topology:
+    """Named topology family → Topology (ISSUE 9; None = flat default)."""
+    if not topo_family:
+        return Topology()
+    from ..topo import family_topology
+
+    return Topology(**family_topology(topo_family))
+
+
 def config_broadcast_1k(
-    seed: int = 0, telemetry: bool = False, trace_path: Optional[str] = None
+    seed: int = 0,
+    telemetry: bool = False,
+    trace_path: Optional[str] = None,
+    topo_family: Optional[str] = None,
+    sampler: Optional[str] = None,
 ) -> Dict[str, float]:
-    cfg = SimConfig(n_nodes=1000, n_payloads=256, n_writers=8, fanout=3)
+    """Config #3, with the ISSUE 9 axes exposed: ``--topology`` picks a
+    named family, ``--sampler`` the peer-selection seam."""
+    topo = _resolve_topo(topo_family)
+    cfg = SimConfig(
+        n_nodes=1000, n_payloads=256, n_writers=8, fanout=3,
+        n_delay_slots=max(4, topo.max_delay + 1),
+        peer_sampler=sampler or "uniform",
+    )
     meta = uniform_payloads(cfg, inject_every=2)
     # 256 × 8 KiB = 2 MiB ≤ both budgets ⇒ metering skipped (proof
     # derived from meta.nbytes in optimize_budgets)
     return run_scenario(
-        optimize_budgets(cfg, meta), meta, seed=seed, telemetry=telemetry,
-        trace_path=trace_path,
+        optimize_budgets(cfg, meta), meta, topo=topo, seed=seed,
+        telemetry=telemetry, trace_path=trace_path,
     )
 
 
@@ -326,13 +346,20 @@ def config_partition_heal_10k(seed: int = 0) -> Dict[str, float]:
     }
 
 
-def _write_storm(n_nodes: int, n_payloads: int):
+def _write_storm(
+    n_nodes: int,
+    n_payloads: int,
+    topo: Topology = Topology(),
+    sampler: Optional[str] = None,
+):
     # partial-view SWIM packs (belief, id) into one i32 scatter word —
     # 2^18 nodes max (SimConfig validation).  Beyond that cap (the 1M
     # tier) the storm runs ground-truth membership (alive mask only),
     # the scale regime state.py's layout doc already describes: at 1M
     # nodes the dissemination question doesn't need per-node beliefs.
-    partial = n_nodes <= 262144
+    # A PeerSwap storm (ISSUE 9) also runs ground-truth membership —
+    # the view IS the sampler, and two member-state systems would fight.
+    partial = n_nodes <= 262144 and (sampler or "uniform") != "peerswap"
     cfg = SimConfig.wan_tuned(
         n_nodes,
         n_payloads=n_payloads,
@@ -343,11 +370,13 @@ def _write_storm(n_nodes: int, n_payloads: int):
         sync_peers=3,
         swim_partial_view=partial,
         member_slots=64,
+        peer_sampler=sampler or "uniform",
         # the storm runs one region (intra delay 0) + sync's t+1 slot:
         # 2 ring slots suffice (validate() enforces it), and inflight is
         # the largest carry tensor — 4 slots wasted a third of the
-        # per-round HBM writes (sim/perf.py carry model)
-        n_delay_slots=2,
+        # per-round HBM writes (sim/perf.py carry model).  A WAN-tiered
+        # topology grows the ring just enough for its deepest class.
+        n_delay_slots=max(2, topo.max_delay + 1),
     )
     meta = uniform_payloads(cfg, inject_every=2)
     # 512 × 8 KiB = 4 MiB fits both budgets ⇒ metering skipped; derived
@@ -363,13 +392,21 @@ def config_write_storm_100k(
     mesh=None,
     telemetry: bool = False,
     trace_path: Optional[str] = None,
+    topo_family: Optional[str] = None,
+    sampler: Optional[str] = None,
 ) -> Optional[Dict[str, float]]:
     """Config #5: the north-star scale — 100k nodes, multi-writer chunked
-    write storm (consul-service style), p99 time-to-convergence."""
-    cfg, meta = _write_storm(n_nodes, n_payloads)
+    write storm (consul-service style), p99 time-to-convergence.
+    ``topo_family``/``sampler`` (ISSUE 9; CLI ``--topology``/
+    ``--sampler``) run the same storm over a named WAN topology and/or
+    the PeerSwap sampler — the scenario-diversity axes at the headline
+    scale."""
+    topo = _resolve_topo(topo_family)
+    cfg, meta = _write_storm(n_nodes, n_payloads, topo=topo, sampler=sampler)
     return run_scenario(
-        cfg, meta, seed=seed, max_rounds=3000, compile_only=compile_only,
-        mesh=mesh, telemetry=telemetry, trace_path=trace_path,
+        cfg, meta, topo=topo, seed=seed, max_rounds=3000,
+        compile_only=compile_only, mesh=mesh, telemetry=telemetry,
+        trace_path=trace_path,
     )
 
 
@@ -876,6 +913,60 @@ def config_serving_loadgen(
     if telemetry and "telemetry" in faultless:
         out["telemetry"] = faultless["telemetry"]
     return out
+
+
+def config_peer_sampler_frontier(
+    seed: int = 0,
+    n_nodes: int = 96,
+    n_seeds: int = 4,
+    max_rounds: int = 400,
+) -> Dict[str, object]:
+    """The uniform-vs-PeerSwap frontier rung (ISSUE 9): run the
+    `peer-sampler-frontier` builtin campaign — both samplers × two
+    topology families, wire bytes banded per lane — and reduce it to
+    the comparison record bench.py tracks: per family, convergence
+    rounds and wire bytes for each sampler plus their ratios
+    (peerswap / uniform; < 1.0 means PeerSwap wins that axis)."""
+    from ..campaign.engine import run_campaign
+    from ..campaign.spec import peer_sampler_frontier_spec
+
+    spec = peer_sampler_frontier_spec(
+        seeds=tuple(seed + i for i in range(n_seeds)), n=n_nodes,
+        max_rounds=max_rounds,
+    )
+    t0 = time.monotonic()
+    artifact = run_campaign(spec, out_path=None)
+    families: Dict[str, Dict[str, object]] = {}
+    for cell in artifact["cells"]:
+        fam = cell["params"]["topo_family"]
+        samp = cell["params"]["peer_sampler"]
+        families.setdefault(fam, {})[samp] = {
+            "rounds_p50": cell["bands"]["rounds"]["p50"],
+            "rounds_p99": cell["bands"]["rounds"]["p99"],
+            "wire_bytes_p50": cell["bands"]["wire_bytes"]["p50"],
+            "converged": cell["all_converged"],
+        }
+    for fam, d in families.items():
+        uni, ps = d.get("uniform"), d.get("peerswap")
+        if uni and ps and uni["rounds_p50"]:
+            d["rounds_ratio"] = round(
+                ps["rounds_p50"] / uni["rounds_p50"], 3
+            )
+        if uni and ps and uni["wire_bytes_p50"]:
+            d["wire_ratio"] = round(
+                ps["wire_bytes_p50"] / uni["wire_bytes_p50"], 3
+            )
+    return {
+        "n_nodes": n_nodes,
+        "seeds": n_seeds,
+        "converged": all(
+            c["all_converged"] for c in artifact["cells"]
+        ),
+        "families": families,
+        "spec_hash": artifact["spec_hash"],
+        "result_digest": artifact["result_digest"],
+        "wall_clock_s": round(time.monotonic() - t0, 3),
+    }
 
 
 def _gapstress_cfg(n_nodes: int, gap_slots: int) -> SimConfig:

@@ -141,6 +141,18 @@ class SimConfig:
     # byte-identical buffers, stable digests; >1 is a deliberate
     # sampling (summary totals become stride samples, labeled as such)
     trace_every: int = 1
+    # pluggable peer-selection seam (ISSUE 9): "uniform" draws every
+    # broadcast/sync/probe target uniformly at random (the legacy
+    # default — byte-identical programs, no extra state or RNG);
+    # "peerswap" maintains an on-device per-node view
+    # (`SimState.pview`, mixed by seeded pairwise entry swaps each
+    # round — PAPERS.md "PeerSwap: A Peer-Sampler with Randomness
+    # Guarantees") and draws targets from it.  A campaign axis: the
+    # field rides `CampaignSpec.scenario`/`grid` like any SimConfig key.
+    peer_sampler: str = "uniform"
+    # PeerSwap view width V (SimState.pview is i32[N, V]; 0-width when
+    # the sampler is uniform, the zero-cost off state)
+    view_slots: int = 16
 
     def __post_init__(self) -> None:
         if self.trace_every < 1:
@@ -159,6 +171,21 @@ class SimConfig:
             # pswim packs (belief_key, id) into one i32 scatter word:
             # id needs 18 bits (see pswim.py pack-bound asserts)
             raise ValueError("partial-view SWIM supports at most 2^18 nodes")
+        if self.peer_sampler not in ("uniform", "peerswap"):
+            raise ValueError(
+                f"unknown peer_sampler {self.peer_sampler!r} "
+                "(use 'uniform' or 'peerswap')"
+            )
+        if self.peer_sampler == "peerswap":
+            if self.view_slots < 2:
+                raise ValueError("peerswap needs view_slots >= 2")
+            if self.swim_partial_view:
+                # two competing member-state systems would fight over
+                # target selection; pick one sampler per scenario
+                raise ValueError(
+                    "peer_sampler='peerswap' is incompatible with "
+                    "swim_partial_view (the member tables ARE a sampler)"
+                )
 
     @classmethod
     def wan_tuned(cls, n_nodes: int, **kw) -> "SimConfig":
@@ -317,6 +344,12 @@ class SimState(NamedTuple):
     pid: jnp.ndarray  # i32[N, M] member id per bucket, -1 = empty
     pkey: jnp.ndarray  # i32[N, M] belief key inc*4 + state
     psince: jnp.ndarray  # i32[N, M] round the entry became SUSPECT/DOWN, -1 = n/a
+    # PeerSwap sampler view (ISSUE 9; [N, 0] when peer_sampler is
+    # "uniform" — the same zero-width off pattern as view/pid): slot
+    # entries are peer ids (-1 = empty), mixed by seeded pairwise swaps
+    # each round (topo/sampler.py) and sampled for every fan-out/sync/
+    # probe target draw
+    pview: jnp.ndarray  # i32[N, V] or [N, 0]
 
 
 def init_pview(cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
@@ -336,7 +369,16 @@ def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
     n, p = cfg.n_nodes, cfg.n_payloads
     swim_n = cfg.n_nodes if cfg.swim_full_view else 0
     pm = cfg.member_slots if cfg.swim_partial_view else 0
-    key, sub, kview = jax.random.split(key, 3)
+    if cfg.peer_sampler == "peerswap":
+        # the extra split rides a trace-time branch: uniform scenarios
+        # consume the exact pre-ISSUE-9 key stream (byte-identity)
+        from ..topo.sampler import init_peer_view
+
+        key, sub, kview, kpv = jax.random.split(key, 4)
+        pview = init_peer_view(cfg, kpv)
+    else:
+        key, sub, kview = jax.random.split(key, 3)
+        pview = jnp.zeros((n, 0), jnp.int32)
     pid = (
         init_pview(cfg, kview)
         if cfg.swim_partial_view
@@ -369,6 +411,7 @@ def init_state(cfg: SimConfig, key: jax.Array) -> SimState:
         if cfg.swim_partial_view
         else jnp.zeros((n, pm), jnp.int32),
         psince=jnp.full((n, pm), -1, jnp.int32),
+        pview=pview,
     )
 
 

@@ -76,6 +76,14 @@ def sample_member_targets(
         from .pswim import psample_member_targets
 
         return psample_member_targets(state, cfg, key, count)
+    if cfg.peer_sampler == "peerswap":
+        # the pluggable peer-selection seam (ISSUE 9): candidates come
+        # from the node's PeerSwap view instead of a uniform draw; the
+        # filters and compaction below are shared.  A trace-time branch
+        # — the uniform default compiles the exact legacy kernel.
+        from ..topo.sampler import psample_view_targets
+
+        return psample_view_targets(state, cfg, key, count)
     n = state.alive.shape[0]
     # 4× oversample: with fraction d of members believed DOWN, expected
     # filled slots ≈ 4·count·(1-d) — still ≥ count at d=0.75, so coupled
@@ -122,7 +130,24 @@ def _reachable(
         & (state.alive[src] == ALIVE)
         & (state.alive[dst] == ALIVE)
     )
-    if topo.loss > 0:
+    from .topology import loss_tiered
+
+    if loss_tiered(topo):
+        # geo-tiered loss (ISSUE 9): the probe draw compares the same
+        # aligned u8 stream against per-edge tier thresholds, so WAN
+        # trunks eat probes at their own rate.  Flat topologies keep
+        # the legacy bernoulli branch below, byte-identically.
+        from .topology import regions, tiered_edge_drop
+
+        n = state.alive.shape[0]
+        region = regions(n, topo.n_regions)
+        # the SAME three-step rule (clamped compare + certainty pin) as
+        # the per-payload wire path — one implementation, no drift
+        ok &= ~tiered_edge_drop(
+            topo, jax.random.fold_in(key, 104), region, src, dst,
+            src.shape,
+        )
+    elif topo.loss > 0:
         ok &= ~jax.random.bernoulli(key, topo.loss, src.shape)
     if faults is not None:
         from .faults import fault_edge_block, fault_edge_loss

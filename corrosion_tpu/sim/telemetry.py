@@ -257,8 +257,14 @@ def wire_loss_active(topo, faults) -> bool:
     """Trace-time fact: can the broadcast wire drop frames in this
     scenario?  False ⇒ the dropped channel is the constant 0 and the
     [E, P] drop-mask reduction is never emitted (the one telemetry
-    term that would otherwise cost a full edge×payload traversal)."""
-    if int(round(topo.loss * 256.0)) > 0:
+    term that would otherwise cost a full edge×payload traversal).
+    Geo-tiered topologies (ISSUE 9) drop on ANY applicable tier's
+    threshold — a WAN trunk's loss must not read as a constant-zero
+    channel.  (`loss_tiered` is exactly "some applicable tier differs",
+    which with thresholds ≥ 0 implies one is nonzero.)"""
+    from .topology import loss_tiered
+
+    if int(round(topo.loss * 256.0)) > 0 or loss_tiered(topo):
         return True
     if faults is None:
         return False

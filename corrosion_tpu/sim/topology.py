@@ -8,11 +8,25 @@ rings land in delay 1-2 rounds, ICI-local in 0).
 Nodes get a static ``region[N]`` label; the delay class of an edge is 0
 within a region and grows with region distance.  Partitions cut edges whose
 endpoints are in different ``group``s (healing resets groups to 0).
+
+Since ISSUE 9 the topology is **geo-tiered**: a region subdivides into
+``n_azs`` availability zones (the Fly.io deployment shape — region × AZ
+latency/loss classes), so an edge has THREE delay/loss classes: same-AZ
+(``intra_delay``/``loss``), cross-AZ within a region
+(``az_delay``/``az_loss``), and cross-region
+(``inter_delay``/``inter_loss``).  ``degree_classes`` assigns
+heterogeneous broadcast fan-out caps per node (hub/leaf shapes).  Every
+new field defaults to the legacy single-tier behavior and the kernels
+branch at trace time, so default-topology runs compile to byte-identical
+programs (tests/sim/test_topo.py pins the digests).  Named topology
+families live in `corrosion_tpu.topo.families`; churn schedules and the
+host-tier compilation of a tiered topology in `corrosion_tpu.topo`.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,12 +34,45 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """Static per-scenario topology parameters."""
+    """Static per-scenario topology parameters (hashable: jit key)."""
 
     n_regions: int = 1
-    intra_delay: int = 0  # rounds
-    inter_delay: int = 1  # rounds
-    loss: float = 0.0  # per-message drop probability
+    intra_delay: int = 0  # rounds, same-AZ (same-region pre-ISSUE 9)
+    inter_delay: int = 1  # rounds, cross-region
+    loss: float = 0.0  # per-message drop probability, same-AZ edges
+    # -- geo-tiered WAN (ISSUE 9); defaults = the legacy single tier ----
+    n_azs: int = 1  # availability zones per region (region × AZ grid)
+    az_delay: int = 0  # rounds, cross-AZ within a region
+    # cross-AZ / cross-region loss: 0.0 = inherit the base ``loss``
+    # (so a flat lossy topology stays ONE class and compiles to the
+    # legacy scalar-threshold kernel); > 0 overrides for that tier
+    az_loss: float = 0.0
+    inter_loss: float = 0.0
+    # heterogeneous broadcast fan-out: per-class degree caps assigned
+    # round-robin over node ids (node n sends to at most
+    # degree_classes[n % len] of its cfg.fanout slots); () = every node
+    # uses the full fanout.  Values are validated ≤ cfg.fanout by
+    # `round.validate` — a class above the slot count would silently
+    # clamp, not expand.
+    degree_classes: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "degree_classes",
+            tuple(int(d) for d in self.degree_classes),
+        )
+        if self.n_regions < 1 or self.n_azs < 1:
+            raise ValueError("n_regions and n_azs must be >= 1")
+        for name in ("loss", "az_loss", "inter_loss"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name}={p} outside [0, 1]")
+        if any(d < 1 for d in self.degree_classes):
+            raise ValueError("degree_classes entries must be >= 1")
+
+    @property
+    def max_delay(self) -> int:
+        return max(self.intra_delay, self.az_delay, self.inter_delay)
 
 
 def regions(n_nodes: int, n_regions: int) -> jnp.ndarray:
@@ -34,12 +81,61 @@ def regions(n_nodes: int, n_regions: int) -> jnp.ndarray:
     return jnp.minimum(jnp.arange(n_nodes, dtype=jnp.int32) // per, n_regions - 1)
 
 
+def azs(n_nodes: int, topo: Topology) -> jnp.ndarray:
+    """i32[N] global AZ id = region * n_azs + local AZ — contiguous AZ
+    blocks inside each contiguous region block (the same block rule as
+    `regions`, one level down), so range selectors cover an AZ exactly
+    (`corrosion_tpu.topo.topology_link_events` relies on it)."""
+    per_r = max(1, n_nodes // topo.n_regions)
+    reg = regions(n_nodes, topo.n_regions)
+    local = jnp.arange(n_nodes, dtype=jnp.int32) - reg * per_r
+    per_az = max(1, per_r // topo.n_azs)
+    az_local = jnp.minimum(local // per_az, topo.n_azs - 1)
+    return reg * topo.n_azs + az_local
+
+
+def node_degrees(n_nodes: int, topo: Topology) -> jnp.ndarray:
+    """i32[N] per-node broadcast fan-out caps from ``degree_classes``
+    (round-robin over node ids — deterministic, seed-free, and stable
+    under resharding).  Callers only reach here when the tuple is
+    non-empty (a trace-time fact)."""
+    classes = jnp.asarray(topo.degree_classes, jnp.int32)
+    return classes[jnp.arange(n_nodes, dtype=jnp.int32) % len(topo.degree_classes)]
+
+
+def apply_degree_caps(
+    targets: jnp.ndarray, topo: Topology
+) -> jnp.ndarray:
+    """Mask fan-out target slots past each node's degree cap to -1 (the
+    unfilled-slot sentinel every consumer already handles).  Trace-time
+    identity when ``degree_classes`` is empty — the legacy uniform
+    fan-out compiles unchanged."""
+    if not topo.degree_classes:
+        return targets
+    n, f = targets.shape
+    deg = node_degrees(n, topo)  # [N]
+    slot = jnp.arange(f, dtype=jnp.int32)[None, :]
+    return jnp.where(slot < deg[:, None], targets, -1)
+
+
 def edge_delay(
     topo: Topology, region: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray
 ) -> jnp.ndarray:
-    """Delay class (rounds) per edge, from region distance."""
-    same = region[src] == region[dst]
-    return jnp.where(same, topo.intra_delay, topo.inter_delay).astype(jnp.int32)
+    """Delay class (rounds) per edge, from region (and AZ) distance.
+    Single-AZ topologies compile the exact legacy two-class expression
+    (a trace-time branch — default runs stay byte-identical)."""
+    same_r = region[src] == region[dst]
+    if topo.n_azs <= 1:
+        return jnp.where(same_r, topo.intra_delay, topo.inter_delay).astype(
+            jnp.int32
+        )
+    az = azs(region.shape[0], topo)
+    same_az = az[src] == az[dst]
+    return jnp.where(
+        same_r,
+        jnp.where(same_az, topo.intra_delay, topo.az_delay),
+        topo.inter_delay,
+    ).astype(jnp.int32)
 
 
 def edge_alive(
@@ -55,8 +151,85 @@ def edge_alive(
     )
 
 
+def _thr(p: float) -> int:
+    """Loss probability → the u8 compare threshold (p·256, the repo-wide
+    8-bit loss quantization)."""
+    return int(round(p * 256.0))
+
+
+def loss_tiers(topo: Topology) -> Tuple[int, int, int]:
+    """(same-AZ, cross-AZ, cross-region) u8 drop thresholds.  A tier
+    loss of 0.0 inherits the base ``loss`` (see the field docs)."""
+    base = _thr(topo.loss)
+    az = _thr(topo.az_loss) if topo.az_loss > 0 else base
+    inter = _thr(topo.inter_loss) if topo.inter_loss > 0 else base
+    return base, az, inter
+
+
+def loss_tiered(topo: Topology) -> bool:
+    """Trace-time fact: do the loss tiers actually differ?  False keeps
+    the legacy single-threshold kernel (byte-identical draws)."""
+    base, az, inter = loss_tiers(topo)
+    tiers = {base}
+    if topo.n_azs > 1:
+        tiers.add(az)
+    if topo.n_regions > 1:
+        tiers.add(inter)
+    return len(tiers) > 1
+
+
+def edge_loss_thresholds(
+    topo: Topology,
+    region: jnp.ndarray,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+) -> jnp.ndarray:
+    """u8[E] per-edge drop thresholds from the geo tiers (callers gate
+    on `loss_tiered` — the flat case never builds this tensor).  The u8
+    compare saturates at 255: a certainty tier (p·256 ≥ 256) must ALSO
+    be pinned via `edge_loss_thresholds_raw` — there is exactly one
+    tier-selection expression (the raw form), so the two views cannot
+    drift."""
+    return jnp.minimum(
+        edge_loss_thresholds_raw(topo, region, src, dst), 255
+    ).astype(jnp.uint8)
+
+
+def tiered_edge_drop(
+    topo: Topology,
+    key: jax.Array,
+    region: jnp.ndarray,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    shape,
+) -> jnp.ndarray:
+    """bool[shape] tiered drop decisions — the ONE implementation of
+    the three-step rule (clamped-threshold compare on an aligned draw,
+    plus the raw ``>= 256`` certainty pin) shared by the per-payload
+    wire path (`edge_payload_drop`) and the probe/swap path
+    (`swim._reachable`), so the two loss seams cannot drift.  ``shape``
+    leads with the edge axis; per-edge thresholds broadcast over any
+    trailing axes (the per-payload grain)."""
+    thr = edge_loss_thresholds(topo, region, src, dst)  # u8[E]
+    extra = (1,) * (len(shape) - 1)
+    bits = aligned_u8_bits(key, shape)
+    drop = bits < thr.reshape(thr.shape + extra)
+    if max(loss_tiers(topo)) >= 256:
+        # a certainty tier saturates the u8 compare at 255/256 — pin
+        # those edges fully dropped (the legacy threshold>=256 rule)
+        raw = edge_loss_thresholds_raw(topo, region, src, dst)
+        drop = drop | (raw >= 256).reshape(raw.shape + extra)
+    return drop
+
+
 def edge_payload_drop(
-    topo: Topology, key: jax.Array, n_edges: int, n_payloads: int
+    topo: Topology,
+    key: jax.Array,
+    n_edges: int,
+    n_payloads: int,
+    src: jnp.ndarray = None,
+    dst: jnp.ndarray = None,
+    region: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Per-(edge, payload) Bernoulli loss for fire-and-forget traffic.
 
@@ -73,8 +246,18 @@ def edge_payload_drop(
     biggest per-round tensor (100M cells at the gapstress shape) and u8
     bits cost 4× less RNG + HBM traffic.  Loss probabilities quantize
     to 1/256 steps (0.3 → 0.30078) — three orders of magnitude below
-    the ×1.5 calibration bands."""
-    threshold = int(round(topo.loss * 256.0))
+    the ×1.5 calibration bands.
+
+    Geo-tiered topologies (ISSUE 9) pass ``src``/``dst``/``region``:
+    the SAME aligned draw is compared against per-edge tier thresholds
+    (`edge_loss_thresholds`), so a WAN graph's cross-region links drop
+    more without a second RNG stream.  Untied topologies ignore the
+    extra args and compile the exact legacy kernel."""
+    if loss_tiered(topo) and src is not None:
+        return tiered_edge_drop(
+            topo, key, region, src, dst, (n_edges, n_payloads)
+        )
+    threshold = _thr(topo.loss)
     if topo.loss <= 0.0 or threshold == 0:
         # loss below 1/512 quantizes to zero drops — return the free
         # constant mask rather than drawing a pointless all-False tensor
@@ -87,28 +270,66 @@ def edge_payload_drop(
     return bits < jnp.uint8(threshold)
 
 
+def edge_loss_thresholds_raw(
+    topo: Topology,
+    region: jnp.ndarray,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+) -> jnp.ndarray:
+    """i32[E] UNclamped tier thresholds — only consulted when some tier
+    sits at certainty (p·256 ≥ 256), to pin those edges fully dropped."""
+    base, az_t, inter_t = loss_tiers(topo)
+    same_r = region[src] == region[dst]
+    az = azs(region.shape[0], topo)
+    same_az = (az[src] == az[dst]) if topo.n_azs > 1 else same_r
+    return jnp.where(
+        same_r,
+        jnp.where(same_az, jnp.int32(base), jnp.int32(az_t)),
+        jnp.int32(inter_t),
+    )
+
+
 def aligned_u8_bits(key, shape) -> jnp.ndarray:
-    """u8 threefry draw whose u32→u8 unpack stays WORD-ALIGNED per
-    shard (ISSUE 7).  jax lowers a u8 bits draw of flat size S through
-    a ceil(S/4) u32 intermediate; when a node-sharded consumer makes
-    GSPMD partition that production on a non-word-aligned boundary
-    (e.g. S = 1008 over 8 devices → 31.5 words per shard), this
-    jax/XLA version produces bit values that DIFFER from the
-    single-device draw — silently, and only at shard-unaligned sizes
-    (tests/sim/test_packed_sharded.py would catch the drift as a
-    sharded-vs-single mismatch in the loss masks).  Padding the flat
-    draw to a multiple of 128 bytes (32 words — word-aligned for every
-    power-of-two mesh up to 32 devices) and slicing keeps the unpack
-    word-aligned under any such partitioning.  Sizes already
-    128-aligned take the identical unpadded draw, so every storm-scale
-    [E, P] mask (P a multiple of 128) is byte-identical to prior
-    builds; only shard-unaligned shapes (small-N tests, non-128-aligned
-    clusters) re-roll."""
+    """u8 threefry draw that is WORD-ALIGNED per shard on ANY mesh size.
+
+    jax lowers a u8 bits draw of flat size S through a ceil(S/4) u32
+    intermediate; when a node-sharded consumer makes GSPMD partition
+    that production on a non-word-aligned boundary (e.g. S = 1008 over
+    8 devices → 31.5 words per shard), this jax/XLA version produces
+    bit values that DIFFER from the single-device draw — silently, and
+    only at shard-unaligned sizes (ISSUE 7; tests/sim/test_packed_sharded
+    .py catches the drift as a sharded-vs-single mismatch in the loss
+    masks).
+
+    Two defenses, composed (ISSUE 9 generalized the second):
+
+    - the padding rule is unchanged from ISSUE 7 — sizes already a
+      multiple of 128 bytes take the unpadded draw, smaller sizes pad
+      the flat draw to the next 128-byte multiple and slice — so every
+      previously-drawn value is **byte-identical** (committed replay
+      digests and campaign baselines stand);
+    - the draw itself is now an explicit u32-word draw plus a manual
+      little-endian byte unpack — bit-for-bit what jax's u8 path
+      computes (pinned by tests/sim/test_topo.py), but with the RNG's
+      shardable atoms being whole u32 WORDS.  A shard boundary can then
+      never split a word, whatever the device count — including
+      odd-sized real meshes (e.g. 6 chips), where the previous
+      128-multiple pad was NOT a multiple of 4·d and the u8 unpack
+      could still land shard boundaries mid-word (the old rule was only
+      safe for power-of-two meshes ≤ 32; the closed carried edge asked
+      for lcm(4·d) padding, which the word-atom formulation subsumes
+      without re-rolling any existing draw)."""
     size = 1
     for d in shape:
         size *= int(d)
-    if size % 128 == 0:
-        return jax.random.bits(key, shape, dtype=jnp.uint8)
-    pad = -(-size // 128) * 128
-    flat = jax.random.bits(key, (pad,), dtype=jnp.uint8)
-    return flat[:size].reshape(shape)
+    pad = size if size % 128 == 0 else -(-size // 128) * 128
+    words = jax.random.bits(key, (pad // 4,), dtype=jnp.uint32)
+    shifts = jnp.arange(4, dtype=jnp.uint32) * jnp.uint32(8)
+    flat = (
+        ((words[:, None] >> shifts) & jnp.uint32(0xFF))
+        .astype(jnp.uint8)
+        .reshape(pad)
+    )
+    if pad != size:
+        flat = flat[:size]
+    return flat.reshape(shape)

@@ -1,0 +1,44 @@
+"""Topology & peer-sampling subsystem (ISSUE 9).
+
+Three legs, one vocabulary the campaign engine sweeps as axes:
+
+- **generators** (`families`, `sim.topology.Topology`'s geo-tier
+  fields): deterministic, seed-free topology tensors — geo-tiered WAN
+  graphs (region × AZ latency/loss classes) and heterogeneous degree
+  distributions — layered on the existing ``edge_delay``/``edge_alive``
+  machinery;
+- **churn schedules** (`churn`): flash-crowd joins and diurnal churn as
+  range-selector `FaultPlan` events, compiled by the existing
+  matrix/factored fault compilers so they ride the packed and
+  mesh-sharded kernels unchanged, and replayed on the host tier via
+  range-atom link epochs (`topology_link_events` gives a WAN-tiered
+  cell its host parity point);
+- **peer sampler** (`sampler`): the pluggable peer-selection seam —
+  uniform (the bit-identical default) vs a PeerSwap-style view sampler
+  maintained as on-device per-node state.
+
+See doc/topologies.md for the guide and the `peer-sampler-frontier`
+builtin campaign for the measured uniform-vs-PeerSwap comparison.
+"""
+
+from .churn import (
+    CHURN_FAMILIES,
+    az_blocks,
+    churn_events,
+    diurnal_events,
+    flash_crowd_events,
+    topology_link_events,
+)
+from .families import FAMILIES, family_topology, min_delay_slots
+
+__all__ = [
+    "CHURN_FAMILIES",
+    "FAMILIES",
+    "az_blocks",
+    "churn_events",
+    "diurnal_events",
+    "family_topology",
+    "flash_crowd_events",
+    "min_delay_slots",
+    "topology_link_events",
+]

@@ -1,0 +1,172 @@
+"""Churn schedules and host-tier topology compilation (ISSUE 9).
+
+Both halves lower into the existing `FaultPlan` event algebra — range
+selectors keep every schedule O(events), the factored sim compiler
+turns them into rank-1 tensors that ride the packed and mesh-sharded
+kernels unchanged, and the host fault drivers replay the SAME events
+through their range-atom link epochs (`FaultPlan.range_link_epochs`),
+so a topology family or churn shape is one artifact consumed by every
+tier.
+
+- `flash_crowd_events` — a cold-join wave: the tail ``frac`` of the id
+  space is down from round 0 and restarts (wiped) at ``join_round`` —
+  the flash-crowd join shape, recovered purely via anti-entropy;
+- `diurnal_events` — follow-the-sun churn: each cycle, a seed-derived
+  contiguous block (a "region asleep") crashes for the night window and
+  rejoins at dawn;
+- `churn_events` — the registry the campaign spec's ``churn`` scenario
+  key resolves through;
+- `topology_link_events` — compile a geo-tiered `sim.topology.Topology`
+  into per-tier delay/loss link events over the contiguous region/AZ
+  blocks, so a WAN-tiered cell has a HOST parity point: the host
+  drivers install the rectangles as seed-derived LinkModels without
+  ever expanding pairs (tests/cluster/test_fault_parity.py extends the
+  parity gate over it).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..faults import FaultEvent, derive_seed
+
+
+def flash_crowd_events(
+    n_nodes: int,
+    frac: float = 0.25,
+    join_round: int = 8,
+    wipe: bool = True,
+) -> Tuple[FaultEvent, ...]:
+    """The tail ``frac`` of the cluster joins cold at ``join_round``
+    (ONE range-selector crash event, O(1) at any scale)."""
+    k = max(1, min(n_nodes - 1, int(round(n_nodes * frac))))
+    lo = n_nodes - k
+    return (
+        FaultEvent(
+            "crash", 0, max(1, int(join_round)),
+            node=f"{lo}:{n_nodes}", wipe=wipe,
+        ),
+    )
+
+
+def diurnal_events(
+    n_nodes: int,
+    frac: float = 0.25,
+    day_rounds: int = 12,
+    night_rounds: int = 6,
+    cycles: int = 2,
+    seed: int = 0,
+    wipe: bool = False,
+) -> Tuple[FaultEvent, ...]:
+    """Follow-the-sun churn: per cycle, a seed-derived contiguous block
+    of ``frac``·N nodes sleeps for ``night_rounds`` after each
+    ``day_rounds`` window (contiguous blocks ARE geographic under the
+    contiguous-region rule, so this models a region going dark)."""
+    k = max(1, min(n_nodes - 1, int(round(n_nodes * frac))))
+    evs: List[FaultEvent] = []
+    for c in range(cycles):
+        start = day_rounds + c * (day_rounds + night_rounds)
+        lo = derive_seed(seed, "diurnal", c) % (n_nodes - k + 1)
+        evs.append(
+            FaultEvent(
+                "crash", start, start + night_rounds,
+                node=f"{lo}:{lo + k}", wipe=wipe,
+            )
+        )
+    return tuple(evs)
+
+
+#: churn family name → builder; the campaign spec's ``churn`` scenario
+#: key resolves here (`CampaignSpec.churn_events_for`)
+CHURN_FAMILIES = ("flash-crowd", "diurnal")
+
+
+def churn_events(
+    name: str,
+    n_nodes: int,
+    frac: float = 0.25,
+    round_knob: int = 8,
+    seed: int = 0,
+) -> Tuple[FaultEvent, ...]:
+    """Resolve a churn family by name.  ``round_knob`` is the family's
+    one timing knob (flash-crowd: the join round; diurnal: the day
+    length, with nights at half a day)."""
+    if name == "flash-crowd":
+        return flash_crowd_events(n_nodes, frac=frac, join_round=round_knob)
+    if name == "diurnal":
+        return diurnal_events(
+            n_nodes, frac=frac, day_rounds=max(2, int(round_knob)),
+            night_rounds=max(2, int(round_knob) // 2), seed=seed,
+        )
+    raise KeyError(
+        f"unknown churn family {name!r} (have {sorted(CHURN_FAMILIES)})"
+    )
+
+
+# -- host-tier compilation of a geo-tiered topology --------------------------
+
+
+def az_blocks(n_nodes: int, n_regions: int, n_azs: int) -> List[Tuple[int, int, int]]:
+    """(region, lo, hi) contiguous AZ blocks — byte-for-byte the block
+    rule of `sim.topology.regions`/`azs`, so the emitted range
+    selectors cover exactly the node sets the sim kernels tier."""
+    per_r = max(1, n_nodes // n_regions)
+    out: List[Tuple[int, int, int]] = []
+    for r in range(n_regions):
+        r_lo = r * per_r
+        r_hi = n_nodes if r == n_regions - 1 else (r + 1) * per_r
+        if r_lo >= n_nodes:
+            break
+        per_az = max(1, per_r // n_azs)
+        for a in range(n_azs):
+            a_lo = r_lo + a * per_az
+            a_hi = r_hi if a == n_azs - 1 else min(r_hi, r_lo + (a + 1) * per_az)
+            if a_lo >= r_hi:
+                break
+            out.append((r, a_lo, a_hi))
+    return out
+
+
+def topology_link_events(
+    topo, n_nodes: int, end: int, start: int = 0
+) -> Tuple[FaultEvent, ...]:
+    """Compile a geo-tiered Topology into FaultPlan link events active
+    over ``[start, end)``: per ordered AZ-block pair, a delay event for
+    the tier's delay class and a loss event for its drop probability —
+    range-selector rectangles the host drivers' range-atom link epochs
+    install without pair expansion, giving a WAN-tiered cell its host
+    parity point.  Rectangles of one kind are disjoint by construction,
+    so the factored sim compiler accepts the same events too."""
+    from ..sim.topology import Topology, loss_tiers
+
+    assert isinstance(topo, Topology)
+    base, az_t, inter_t = loss_tiers(topo)
+    blocks = az_blocks(n_nodes, topo.n_regions, topo.n_azs)
+    evs: List[FaultEvent] = []
+    for r_i, lo_i, hi_i in blocks:
+        for r_j, lo_j, hi_j in blocks:
+            same_block = (lo_i, hi_i) == (lo_j, hi_j)
+            if same_block:
+                delay, thr = topo.intra_delay, base
+            elif r_i == r_j:
+                delay, thr = topo.az_delay, az_t
+            else:
+                delay, thr = topo.inter_delay, inter_t
+            if same_block and hi_i - lo_i <= 1:
+                continue  # a single-node diagonal block has no pairs
+            src, dst = f"{lo_i}:{hi_i}", f"{lo_j}:{hi_j}"
+            if delay > 0:
+                evs.append(
+                    FaultEvent(
+                        "delay", start, end, src=src, dst=dst,
+                        delay_rounds=int(delay),
+                    )
+                )
+            if thr > 0:
+                evs.append(
+                    FaultEvent(
+                        "loss", start, end, src=src, dst=dst,
+                        p=min(1.0, thr / 256.0),
+                    )
+                )
+    return tuple(evs)

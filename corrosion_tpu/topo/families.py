@@ -1,0 +1,63 @@
+"""Named topology families (ISSUE 9): the campaign axis vocabulary.
+
+A family is a DICT of `sim.topology.Topology` kwargs — not an instance
+— so spec/cell keys can override individual fields (the same
+compose-then-construct rule every other campaign axis follows).  The
+`topo_family` key rides `CampaignSpec.scenario`/`topology`/`grid` and
+the CLI's ``--topology`` flag; `sim topo show` renders a family's tier
+table without touching jax.
+
+Families mirror deployment shapes the reference actually runs in:
+
+- ``flat``          — the legacy single tier (every default);
+- ``flat-lossy``    — one tier, 10% wire loss everywhere;
+- ``wan-3x2``       — 3 regions × 2 AZs, the Fly.io geo shape: free
+  same-AZ links, 1-round cross-AZ, 2-round cross-region, loss growing
+  with distance;
+- ``wan-2region``   — a two-region split with a long, lossy trunk;
+- ``hetero-degree`` — flat latency but hub/leaf fan-out classes
+  (3/2/1 round-robin), the heterogeneous-degree distribution axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+FAMILIES: Dict[str, Dict[str, object]] = {
+    "flat": {},
+    "flat-lossy": {"loss": 0.1},
+    "wan-3x2": {
+        "n_regions": 3, "n_azs": 2,
+        "intra_delay": 0, "az_delay": 1, "inter_delay": 2,
+        "loss": 0.0, "az_loss": 0.02, "inter_loss": 0.1,
+    },
+    "wan-2region": {
+        "n_regions": 2,
+        "intra_delay": 0, "inter_delay": 2,
+        "loss": 0.01, "inter_loss": 0.2,
+    },
+    "hetero-degree": {"degree_classes": (3, 2, 1)},
+}
+
+
+def family_topology(name: str) -> Dict[str, object]:
+    """Topology kwargs for a named family (a fresh dict — callers
+    overlay their overrides)."""
+    if name not in FAMILIES:
+        raise KeyError(
+            f"unknown topology family {name!r} (have {sorted(FAMILIES)})"
+        )
+    return dict(FAMILIES[name])
+
+
+def min_delay_slots(topo_kwargs: Dict[str, object]) -> int:
+    """Smallest ``n_delay_slots`` a family's delay classes fit in
+    (`round.validate`'s envelope: every delay, and sync's t+1 slot,
+    must be representable without ring wraparound)."""
+    d = max(
+        int(topo_kwargs.get("intra_delay", 0)),
+        int(topo_kwargs.get("az_delay", 0)),
+        int(topo_kwargs.get("inter_delay", 1)),
+        1,
+    )
+    return d + 1

@@ -1,0 +1,131 @@
+"""PeerSwap-style peer sampler: on-device per-node view state (ISSUE 9).
+
+The uniform sampler the kernels default to draws every broadcast/sync/
+probe target independently from [0, N) — a perfect oracle no real
+gossip layer has.  PeerSwap (PAPERS.md, arxiv 2408.03829) replaces the
+oracle with a small per-node **view** mixed by pairwise swaps at
+seeded clocks, and proves the sequence of peers a node observes stays
+close to uniform.  This module is the sim's round-grained analog:
+
+- ``pview[N, V] i32`` (`SimState.pview`): each node's view — V peer
+  ids, -1 marking empty slots.  Seeded at init (`init_peer_view`),
+  carried through the jitted round loops (dense AND packed — the field
+  rides the slim state, so `shrink_state` keeps it full-size), wiped to
+  empty on crash-with-wipe like the SWIM tables.
+- `peerswap_step` — one swap tick per round: every node picks a partner
+  from its view, the swap message rides the REAL wire (ground-truth
+  reachability plus the FaultPlan cut/loss seam via `swim._reachable`,
+  so partitions stall view mixing exactly as they stall gossip), and
+  the pair exchanges one view entry each way — i takes the partner's
+  rotating slot ``t % V``, the partner receives i's offered entry
+  (conflicts resolve by scatter-max: deterministic under vmap and mesh
+  sharding).  An announce-staggered refill re-seeds empty slots with a
+  uniform random id — the bootstrap re-resolution analog that lets a
+  wiped node rejoin the overlay.
+- `psample_view_targets` — the selection seam `swim.sample_member_targets`
+  dispatches to when ``cfg.peer_sampler == "peerswap"``: candidates are
+  gathered from the view (instead of drawn uniformly), then filtered
+  exactly like the uniform path (self, duplicates, believed-DOWN in
+  coupled full-view mode) and prefix-compacted.
+
+Everything is pure gather/scatter-max/elementwise on the node axis, so
+the sampler is bit-identical across solo, vmapped-lane, and
+mesh-sharded runs (tests/sim/test_packed_sharded.py extends its matrix
+over it).  The uniform default touches NONE of this: the kernels
+branch at trace time on ``cfg.peer_sampler`` and the pre-ISSUE-9
+programs compile byte-identically (tests/sim/test_topo.py pins the
+digests)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..sim.state import ALIVE, DOWN, SimConfig, SimState
+
+
+def init_peer_view(cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
+    """i32[N, V] seed-derived initial views: uniform random peer ids,
+    -1 where the draw landed on self (duplicates are allowed here — the
+    selection-side dup filter handles them, and swaps mix them away)."""
+    n, v = cfg.n_nodes, cfg.view_slots
+    pid = jax.random.randint(key, (n, v), 0, n, jnp.int32)
+    me = jnp.arange(n, dtype=jnp.int32)[:, None]
+    return jnp.where(pid != me, pid, -1)
+
+
+def psample_view_targets(
+    state: SimState, cfg: SimConfig, key: jax.Array, count: int
+) -> jnp.ndarray:
+    """i32[N, count] fan-out targets drawn from each node's PeerSwap
+    view; -1 marks unfilled slots.  The peerswap twin of the uniform
+    branch in `swim.sample_member_targets`: same transposed [over, N]
+    oversample layout, same self/dup/believed-DOWN filters, same
+    prefix compaction — only the candidate source differs."""
+    from ..sim.swim import _compact_targets, _dup_before
+
+    n, v = state.pview.shape
+    over = 4 * count
+    slots = jax.random.randint(key, (over, n), 0, v, jnp.int32)
+    me = jnp.arange(n, dtype=jnp.int32)[None, :]
+    # cand[o, i] = pview[i, slots[o, i]] — one gather per oversample row
+    cand = state.pview[me, slots]  # [over, N]
+    valid = (cand >= 0) & (cand != me)
+    safe = jnp.maximum(cand, 0)
+    if cfg.couple_membership and cfg.swim_full_view:
+        valid &= state.view[me, safe] != DOWN
+    valid &= ~_dup_before(cand, valid)
+    return _compact_targets(cand, valid, count)
+
+
+def peerswap_step(
+    state: SimState, cfg: SimConfig, topo, key: jax.Array, faults=None
+) -> SimState:
+    """One swap tick (see module doc).  Reads the OLD view for every
+    gather, then applies the three writes in a fixed order — take into
+    slot ``g``, incoming offers into slot ``t % V`` (scatter-max), then
+    the staggered empty-slot refill — so the result is a pure function
+    of (state, key) whatever the batching or sharding."""
+    from ..sim.swim import _reachable
+
+    pview = state.pview
+    n, v = pview.shape
+    k_slot, k_loss, k_rb, k_rid = jax.random.split(key, 4)
+    me = jnp.arange(n, dtype=jnp.int32)
+    up = state.alive == ALIVE
+    t = state.t
+
+    c = jax.random.randint(k_slot, (n,), 0, v, jnp.int32)  # partner slot
+    partner = pview[me, c]
+    pc = jnp.maximum(partner, 0)
+    ok = (partner >= 0) & (pc != me) & up
+    # the swap message rides the wire: ground-truth reachability (both
+    # endpoints up, same partition group, topology/fault loss and cuts)
+    ok &= _reachable(state, topo, k_loss, me, pc, faults)
+
+    g = (c + 1) % v  # the slot i replaces / offers from
+    offer = pview[me, g]
+    take = pview[pc, t % v]  # partner's rotating slot t % V
+
+    # -- i takes the partner's entry into its own slot g
+    take_ok = ok & (take >= 0) & (take != me)
+    out = pview.at[me, g].set(jnp.where(take_ok, take, pview[me, g]))
+
+    # -- i's offer lands in the partner's slot t % V; concurrent offers
+    # to one partner resolve by max (deterministic), and the slot is
+    # REPLACED (a swap, not an accumulate) only when an offer arrived
+    give = jnp.where(ok & (offer >= 0) & (offer != pc), offer, -1)
+    winner = jnp.full((n,), -1, jnp.int32).at[pc].max(give)
+    w = t % v
+    out = out.at[me, w].set(jnp.where(winner >= 0, winner, out[me, w]))
+
+    # -- staggered refill of empty slots (bootstrap re-resolution): a
+    # wiped/cold view repopulates even when nobody swaps into it
+    stagger = (t + me) % cfg.announce_interval_rounds == 0
+    rb = jax.random.randint(k_rb, (n,), 0, v, jnp.int32)
+    rid = jax.random.randint(k_rid, (n,), 0, n, jnp.int32)
+    cur = out[me, rb]
+    refill = stagger & up & (cur < 0) & (rid != me)
+    out = out.at[me, rb].set(jnp.where(refill, rid, cur))
+
+    return state._replace(pview=out)

@@ -165,10 +165,18 @@ def test_cell_span_tree_shape():
     lane child per seed, each with a convergence leaf."""
     from corrosion_tpu.tracing import TRACER
 
-    before = len(TRACER.finished)
+    # identity snapshot, not a length offset: TRACER.finished is a
+    # BOUNDED deque, so earlier campaign-heavy tests (ISSUE 9 added
+    # several) can evict entries and break positional slicing under
+    # randomized test order.  Holding the `before` LIST keeps the old
+    # spans alive for the test's duration, so a new span can never
+    # reuse an evicted span's id()
+    before = list(TRACER.finished)
+    before_ids = {id(s) for s in before}
     spec = _quick_spec(seeds=(0, 1))
     art = run_campaign(spec, out_path=None)
-    spans = list(TRACER.finished)[before:]
+    spans = [s for s in TRACER.finished if id(s) not in before_ids]
+    del before
     cells = [s for s in spans if s.name == "campaign_cell"]
     lanes = [s for s in spans if s.name == "lane"]
     convs = [s for s in spans if s.name == "convergence"]

@@ -1,0 +1,135 @@
+"""Topology/sampler campaign axes (ISSUE 9): the peer-sampler frontier
+through the engine — wire-byte bands recorded deterministically, the
+replay digest stable across runs AND across the --telemetry run-config,
+churn axes merging into every lane's plan, and the frontier rung's
+reduction record."""
+
+import dataclasses
+
+import pytest
+
+from corrosion_tpu.campaign.engine import run_campaign
+from corrosion_tpu.campaign.spec import (
+    CampaignSpec,
+    peer_sampler_frontier_spec,
+)
+
+pytestmark = pytest.mark.campaign
+
+
+def _mini_frontier():
+    """The builtin frontier shrunk to the tier-1 budget: 2 cells
+    (uniform vs peerswap on the WAN family), 2 seeds, 48 nodes."""
+    spec = peer_sampler_frontier_spec(seeds=(0, 1), n=48, max_rounds=300)
+    return dataclasses.replace(
+        spec, grid={
+            "peer_sampler": ["uniform", "peerswap"],
+            "topo_family": ["wan-3x2"],
+        },
+    )
+
+
+def test_frontier_cells_band_rounds_and_wire_bytes():
+    art = run_campaign(_mini_frontier(), out_path=None)
+    assert len(art["cells"]) == 2
+    for cell in art["cells"]:
+        assert cell["all_converged"], cell["params"]
+        ps = cell["per_seed"]
+        assert len(ps["wire_bytes"]) == 2
+        assert all(w > 0 for w in ps["wire_bytes"])
+        assert cell["bands"]["wire_bytes"]["p50"] > 0
+        assert cell["bands"]["rounds"]["p50"] > 0
+    samplers = {c["params"]["peer_sampler"] for c in art["cells"]}
+    assert samplers == {"uniform", "peerswap"}
+
+
+def test_frontier_digest_stable_and_telemetry_invariant():
+    """measure_wire makes wire bytes part of the replay identity: the
+    digest must reproduce across runs and must NOT move when the
+    --telemetry run-config is flipped (the ISSUE 5 contract extended
+    over the internally-armed recorder)."""
+    spec = _mini_frontier()
+    a = run_campaign(spec, out_path=None)
+    b = run_campaign(spec, out_path=None)
+    assert a["result_digest"] == b["result_digest"]
+    c = run_campaign(spec, out_path=None, telemetry=True)
+    assert c["result_digest"] == a["result_digest"]
+    # the telemetry block itself only appears under the flag
+    assert "telemetry" not in a["cells"][0]
+    assert "telemetry" in c["cells"][0]
+
+
+def test_churn_axis_runs_and_digests():
+    """A flash-crowd churn cell: the generated range-selector crash
+    events merge into the lane plans (plan_horizon covers the join) and
+    the ensemble converges after the cold join."""
+    spec = CampaignSpec(
+        name="churn-smoke",
+        scenario={
+            "n_nodes": 32, "n_payloads": 16, "fanout": 2,
+            "sync_interval_rounds": 4, "inject_every": 1,
+            "churn": "flash-crowd", "churn_frac": 0.25, "churn_round": 6,
+        },
+        seeds=(0, 1),
+        max_rounds=400,
+    )
+    art = run_campaign(spec, out_path=None)
+    cell = art["cells"][0]
+    assert cell["plan_horizon"] == 7  # join at 6 ⇒ horizon end+1
+    assert cell["all_converged"]
+    again = run_campaign(spec, out_path=None)
+    assert again["result_digest"] == art["result_digest"]
+
+
+def test_issue9_axes_refuse_unsupported_cells():
+    """The loud-refusal rule: an ISSUE 9 axis on a cell kind that can't
+    measure it must raise, never silently band nothing / the wrong
+    number."""
+    base = {"n_nodes": 8, "n_payloads": 1, "swim_full_view": True,
+            "detect_membership": 1, "kill_every": 3}
+    with pytest.raises(ValueError, match="measure_wire"):
+        run_campaign(
+            CampaignSpec(name="x", scenario={**base, "measure_wire": 1}),
+            out_path=None,
+        )
+    with pytest.raises(ValueError, match="churn"):
+        run_campaign(
+            CampaignSpec(
+                name="x", scenario={**base, "churn": "flash-crowd"}
+            ),
+            out_path=None,
+        )
+    with pytest.raises(ValueError, match="trace_every"):
+        run_campaign(
+            CampaignSpec(
+                name="x",
+                scenario={"n_nodes": 8, "n_payloads": 8,
+                          "measure_wire": 1, "trace_every": 2},
+            ),
+            out_path=None,
+        )
+    with pytest.raises(ValueError, match="host-serving"):
+        run_campaign(
+            CampaignSpec(
+                name="x",
+                scenario={"n_nodes": 3, "serving": 1, "measure_wire": 1},
+            ),
+            out_path=None,
+        )
+
+
+def test_frontier_rung_record_shape():
+    """`config_peer_sampler_frontier` (the bench rung) reduces the
+    campaign to per-family sampler comparisons with ratios."""
+    from corrosion_tpu.sim.runner import config_peer_sampler_frontier
+
+    m = config_peer_sampler_frontier(seed=0, n_nodes=48, n_seeds=2,
+                                     max_rounds=300)
+    assert m["converged"]
+    assert set(m["families"]) == {"wan-3x2", "hetero-degree"}
+    for fam in m["families"].values():
+        assert fam["uniform"]["rounds_p50"] > 0
+        assert fam["peerswap"]["wire_bytes_p50"] > 0
+        assert fam["rounds_ratio"] > 0
+        assert fam["wire_ratio"] > 0
+    assert m["spec_hash"] and m["result_digest"]

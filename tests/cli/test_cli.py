@@ -196,6 +196,38 @@ def test_sim_smoke():
     assert m.get("converged", 0) >= 1 or m.get("rounds", 0) > 0, m
 
 
+def test_sim_topo_show_cli():
+    """`sim topo show` (ISSUE 9): the family registry (jax-free) and a
+    tier table (imports the Topology dataclass; runs no jax op)."""
+    out = run_cli("sim", "topo", "show")
+    assert "wan-3x2" in out.stdout and "hetero-degree" in out.stdout
+
+    out = run_cli(
+        "sim", "topo", "show", "--topology", "wan-3x2", "--nodes", "96",
+        "--json",
+    )
+    m = json.loads(out.stdout)
+    assert m["n_nodes"] == 96
+    assert len(m["az_blocks"]) == 6  # 3 regions × 2 AZs
+    assert m["tiers"]["cross-region"]["delay_rounds"] == 2
+    assert m["host_link_events"] > 0
+
+    out = run_cli(
+        "sim", "topo", "show", "--topology", "no-such-family", check=False
+    )
+    assert out.returncode != 0
+
+
+def test_sim_topology_flag_refused_on_axisless_scenario():
+    """--topology/--sampler must refuse loudly on scenarios without the
+    axis (a silently ignored topology would fake a WAN measurement)."""
+    out = run_cli(
+        "sim", "swim-churn-64", "--topology", "wan-3x2", check=False
+    )
+    assert out.returncode == 2
+    assert "does not take" in out.stderr
+
+
 def test_sim_campaign_compare_cli(tmp_path):
     """`sim campaign compare` verdict + exit codes on synthetic
     artifacts (no jax in this path — the spec/report layer is plain

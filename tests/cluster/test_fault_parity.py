@@ -152,6 +152,39 @@ def test_fault_plan_parity_host_vs_sim():
 
 
 @pytest.mark.chaos
+def test_wan_tiered_topology_parity_host_vs_sim():
+    """ISSUE 9 host-tier parity for a TOPOLOGY FAMILY: a 3-node
+    geo-tiered WAN cell (one node per region: cross-region delay 1 +
+    10% trunk loss) compiles through `topo.topology_link_events` into
+    range-selector link events that BOTH tiers consume — the host
+    driver installs them via its range-atom link epochs (no pair
+    expansion), the sim via the standard fault compilers — and the
+    eventual writer heads must match on both.  Extends the existing
+    parity harness (`run_host_campaign`/`run_sim_campaign`) rather than
+    adding a new one."""
+    from corrosion_tpu.sim.topology import Topology
+    from corrosion_tpu.topo import topology_link_events
+
+    topo = Topology(n_regions=3, inter_delay=1, inter_loss=0.1)
+    events = topology_link_events(topo, 3, end=30)
+    # every selector is a range rectangle and the atoms stay tiny — the
+    # "range-atom link epochs" contract the satellite names
+    assert events and all(":" in e.src and ":" in e.dst for e in events)
+    plan = FaultPlan(n_nodes=3, seed=11, round_s=ROUND_S, events=events)
+    assert plan.range_link_epochs()  # the host drivers' install path
+
+    expected = plan.coverage_markers() + ["broadcasts-happen", "sync-happens"]
+    with CampaignCoverage(expected) as cov:
+        host = run_host_campaign(plan)
+        sim = run_sim_campaign(plan)
+
+    assert host["heads"] == [N_VERSIONS] * 3, host
+    assert sim["heads"] == [N_VERSIONS] * 3, sim
+    assert set(host["rows"]) == {N_VERSIONS}, host
+    cov.assert_covered()
+
+
+@pytest.mark.chaos
 def test_chaos_smoke_host_tier():
     """Tier-1-sized host smoke (3 nodes, ≤5 s): a loss burst + short
     asymmetric partition, then convergence — the in-default-selection

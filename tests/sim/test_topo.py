@@ -1,0 +1,458 @@
+"""Topology & peer-sampling subsystem (ISSUE 9).
+
+Three contracts under test:
+
+1. **No-axes byte-identity** — with default Topology and the uniform
+   sampler, every kernel compiles to the pre-ISSUE-9 program: final
+   states of seeded runs equal digests captured on the pre-change tree
+   (dense, packed, fault-seam, and topology-loss paths), and the
+   builtin campaign specs keep their hashes — so existing replay
+   digests, spec hashes, and committed baselines stand.
+2. **Generator correctness** — geo tiers (region × AZ delay/loss
+   classes), heterogeneous degree caps, churn schedules compiling to
+   range-selector crash events identical in matrix and factored form,
+   and the shard-safe `aligned_u8_bits` staying byte-identical to the
+   jax u8 draw it replaces.
+3. **PeerSwap sampler** — deterministic, self-free views, convergence
+   under the seam, and cold-rejoin via wipe + refill.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.faults import FaultEvent, FaultPlan
+from corrosion_tpu.sim.faults import compile_plan, run_fault_plan
+from corrosion_tpu.sim.round import new_sim, run_to_convergence
+from corrosion_tpu.sim.state import ALIVE, SimConfig, uniform_payloads
+from corrosion_tpu.sim.topology import (
+    Topology,
+    aligned_u8_bits,
+    apply_degree_caps,
+    azs,
+    edge_delay,
+    edge_loss_thresholds,
+    loss_tiered,
+    loss_tiers,
+    node_degrees,
+    regions,
+)
+from corrosion_tpu.topo import (
+    FAMILIES,
+    churn_events,
+    diurnal_events,
+    family_topology,
+    flash_crowd_events,
+    min_delay_slots,
+    topology_link_events,
+)
+
+
+def _digest(state, skip=("pview",)):
+    """blake2b over the PRE-ISSUE-9 state fields (pview is the one new
+    field; uniform runs carry it zero-width, so excluding it makes the
+    digest comparable to constants captured on the pre-change tree)."""
+    h = hashlib.blake2b(digest_size=8)
+    for f, v in zip(type(state)._fields, state):
+        if f in skip:
+            continue
+        h.update(f.encode())
+        h.update(np.ascontiguousarray(np.asarray(v)).tobytes())
+    return h.hexdigest()
+
+
+# -- 1. no-axes byte-identity ------------------------------------------------
+
+
+def test_default_dense_run_byte_identical_to_pre_topo_tree():
+    """Digest captured on the pre-ISSUE-9 tree: the default dense
+    kernels must not move a single bit."""
+    cfg = SimConfig(n_nodes=24, n_payloads=16, fanout=2, sync_interval_rounds=4)
+    meta = uniform_payloads(cfg, inject_every=1)
+    final, _ = run_to_convergence(new_sim(cfg, 3), meta, cfg, Topology(), 200)
+    assert int(final.t) == 20
+    assert _digest(final) == "c5d4e8bcd80cb0ef"
+
+
+def test_default_packed_run_byte_identical_to_pre_topo_tree():
+    cfg = dataclasses.replace(
+        SimConfig(n_nodes=64, n_payloads=64, fanout=3), packed_min_cells=0
+    )
+    meta = uniform_payloads(cfg, inject_every=1)
+    final, _ = run_to_convergence(new_sim(cfg, 5), meta, cfg, Topology(), 300)
+    assert _digest(final) == "e982c755a7e10cdc"
+
+
+def test_default_fault_run_byte_identical_to_pre_topo_tree():
+    """The fault seam (loss draws ride aligned_u8_bits' padded branch,
+    so this also pins the u32-word rewrite's value compatibility)."""
+    cfg = SimConfig(
+        n_nodes=12, n_payloads=12, fanout=2, sync_interval_rounds=4,
+        n_delay_slots=4,
+    )
+    meta = uniform_payloads(cfg, inject_every=1)
+    plan = FaultPlan(
+        n_nodes=12, seed=7,
+        events=(
+            FaultEvent("loss", 0, 12, p=0.3),
+            FaultEvent(
+                "partition", 2, 8, src="0:4", dst="8:12", symmetric=True
+            ),
+            FaultEvent("crash", 6, 10, node=1, wipe=True),
+        ),
+    )
+    fplan = compile_plan(plan, cfg, Topology())
+    final, _ = run_fault_plan(
+        new_sim(cfg, 7), meta, cfg, Topology(), fplan, 300
+    )
+    assert _digest(final) == "75f3dd63bffb6229"
+
+
+def test_default_topology_loss_run_byte_identical_to_pre_topo_tree():
+    """Flat lossy multi-region topology (the legacy scalar-threshold
+    loss kernel + full-view SWIM probes): still the exact old program."""
+    topo = Topology(n_regions=2, inter_delay=2, loss=0.2)
+    cfg = SimConfig(
+        n_nodes=24, n_payloads=16, fanout=2, n_delay_slots=4,
+        swim_full_view=True,
+    )
+    meta = uniform_payloads(cfg, inject_every=1)
+    final, _ = run_to_convergence(new_sim(cfg, 9), meta, cfg, topo, 400)
+    assert _digest(final) == "2db264c4fed9b337"
+
+
+def test_builtin_spec_hashes_unchanged():
+    """Adding the topo/churn/sampler axes must not move any existing
+    builtin's replay identity (hashes captured pre-change)."""
+    from corrosion_tpu.campaign.spec import BUILTIN_SPECS
+
+    pinned = {
+        "fault-campaign-3node": "b541e15a6f3bbb66",
+        "fault-parity-3node": "3f8f271fb5dbe3ec",
+        "serving-3node": "287f88dabcfa1791",
+        "swim-churn-64": "9d9d65cd293398f1",
+        "swim-churn-partial": "ce7b33791aa01fce",
+    }
+    for name, want in pinned.items():
+        assert BUILTIN_SPECS[name]().spec_hash() == want, name
+
+
+# -- 2. aligned_u8_bits (carried edge: word-atom draws) ----------------------
+
+
+@pytest.mark.parametrize(
+    "shape", [(128,), (3,), (72,), (6, 12), (510,), (96, 64), (1008,)]
+)
+def test_aligned_u8_bits_matches_jax_u8_draw(shape):
+    """The explicit u32-word draw + little-endian unpack must reproduce
+    jax's u8 draw byte-for-byte under the unchanged 128-pad rule — the
+    value-compat contract that keeps every committed replay digest and
+    campaign baseline standing while making the RNG's shardable atoms
+    whole words (safe on ANY mesh size, 6 chips included)."""
+    key = jax.random.PRNGKey(sum(shape) + 11)
+    size = int(np.prod(shape))
+    if size % 128 == 0:
+        ref = jax.random.bits(key, shape, dtype=jnp.uint8)
+    else:
+        pad = -(-size // 128) * 128
+        ref = jax.random.bits(key, (pad,), dtype=jnp.uint8)[:size].reshape(
+            shape
+        )
+    np.testing.assert_array_equal(
+        np.asarray(ref), np.asarray(aligned_u8_bits(key, shape))
+    )
+
+
+# -- 2. geo tiers, degrees, churn --------------------------------------------
+
+
+def test_az_blocks_and_edge_delay_classes():
+    topo = Topology(
+        n_regions=3, n_azs=2, intra_delay=0, az_delay=1, inter_delay=2
+    )
+    n = 96
+    reg = np.asarray(regions(n, topo.n_regions))
+    az = np.asarray(azs(n, topo))
+    # contiguous blocks: 3 regions × 2 AZs of 16 nodes each
+    assert (np.diff(az) >= 0).all()
+    assert [int((az == a).sum()) for a in range(6)] == [16] * 6
+    assert (az // topo.n_azs == reg).all()
+
+    region = regions(n, topo.n_regions)
+    src = jnp.asarray([0, 0, 0], jnp.int32)
+    dst = jnp.asarray([5, 20, 40], jnp.int32)  # same-az, cross-az, cross-reg
+    d = np.asarray(edge_delay(topo, region, src, dst))
+    assert list(d) == [0, 1, 2]
+
+
+def test_edge_loss_tiers_and_thresholds():
+    topo = Topology(
+        n_regions=2, n_azs=2, loss=0.0, az_loss=0.05, inter_loss=0.2
+    )
+    assert loss_tiered(topo)
+    base, az_t, inter_t = loss_tiers(topo)
+    assert (base, az_t, inter_t) == (0, round(0.05 * 256), round(0.2 * 256))
+    n = 32
+    region = regions(n, topo.n_regions)
+    src = jnp.asarray([0, 0, 0], jnp.int32)
+    dst = jnp.asarray([1, 10, 20], jnp.int32)
+    thr = np.asarray(edge_loss_thresholds(topo, region, src, dst))
+    assert list(thr) == [0, az_t, inter_t]
+    # tiers that collapse to one class stay on the legacy kernel
+    assert not loss_tiered(Topology(n_regions=2, loss=0.1))
+    assert not loss_tiered(Topology(loss=0.3))
+
+
+def test_certainty_tier_severs_probes_and_payloads():
+    """A p=1.0 tier saturates the u8 compare at 255/256 — BOTH loss
+    seams (per-payload drop and probe/swap reachability) must pin those
+    edges fully severed, not leak 1/256 of traffic."""
+    from corrosion_tpu.sim.swim import _reachable
+    from corrosion_tpu.sim.topology import edge_payload_drop
+
+    topo = Topology(n_regions=2, inter_loss=1.0, loss=0.01)
+    assert loss_tiered(topo)
+    n = 16
+    cfg = SimConfig(n_nodes=n, n_payloads=8, fanout=2)
+    state = new_sim(cfg, 0)
+    region = regions(n, topo.n_regions)
+    # every cross-region probe must fail, at any key
+    src = jnp.zeros((64,), jnp.int32)
+    dst = jnp.full((64,), 12, jnp.int32)  # other region
+    for k in range(3):
+        ok = np.asarray(
+            _reachable(state, topo, jax.random.PRNGKey(k), src, dst)
+        )
+        assert not ok.any()
+    # and every cross-region payload frame drops
+    drop = np.asarray(
+        edge_payload_drop(
+            topo, jax.random.PRNGKey(1), 64, 8, src=src, dst=dst,
+            region=region,
+        )
+    )
+    assert drop.all()
+
+
+def test_degree_classes_cap_fanout_slots():
+    topo = Topology(degree_classes=(3, 2, 1))
+    deg = np.asarray(node_degrees(9, topo))
+    assert list(deg) == [3, 2, 1] * 3
+    targets = jnp.ones((9, 3), jnp.int32) * 5
+    capped = np.asarray(apply_degree_caps(targets, topo))
+    assert (capped[0] == 5).all()          # degree 3: all slots live
+    assert list(capped[1]) == [5, 5, -1]   # degree 2
+    assert list(capped[2]) == [5, -1, -1]  # degree 1
+    # identity without classes
+    assert apply_degree_caps(targets, Topology()) is targets
+    # a class above the slot count refuses loudly at validate time
+    from corrosion_tpu.sim.round import validate
+
+    with pytest.raises(ValueError, match="degree_classes"):
+        validate(
+            SimConfig(n_nodes=8, n_payloads=8, fanout=2),
+            Topology(degree_classes=(3,)),
+        )
+
+
+def test_churn_schedules_compile_to_range_crash_events():
+    evs = flash_crowd_events(100, frac=0.25, join_round=8)
+    assert len(evs) == 1 and evs[0].node == "75:100" and evs[0].wipe
+    evs = diurnal_events(100, frac=0.2, day_rounds=10, night_rounds=4, cycles=2)
+    assert len(evs) == 2
+    assert evs[0].start == 10 and evs[0].end == 14
+    assert evs[1].start == 24 and evs[1].end == 28
+    with pytest.raises(KeyError):
+        churn_events("no-such-family", 10)
+
+    # matrix and factored compilers agree on the range-selector crash
+    cfg = SimConfig(n_nodes=24, n_payloads=16, fanout=2, n_delay_slots=4)
+    plan = FaultPlan(
+        n_nodes=24, seed=3,
+        events=flash_crowd_events(24, frac=0.25, join_round=6),
+    )
+    fm = compile_plan(plan, cfg, Topology(), factored=False)
+    ff = compile_plan(plan, cfg, Topology(), factored=True)
+    np.testing.assert_array_equal(np.asarray(fm.alive), np.asarray(ff.alive))
+    np.testing.assert_array_equal(np.asarray(fm.wipe), np.asarray(ff.wipe))
+    # the tail is down over the join window and wiped at the join round
+    alive = np.asarray(fm.alive)
+    assert (alive[0, 18:] == 2).all() and (alive[0, :18] == -1).all()
+    assert (alive[6, 18:] == 0).all()
+    assert np.asarray(fm.wipe)[6, 18:].all()
+
+
+def test_flash_crowd_converges_after_join():
+    cfg = SimConfig(
+        n_nodes=24, n_payloads=16, fanout=2, sync_interval_rounds=4
+    )
+    meta = uniform_payloads(cfg, inject_every=1)
+    plan = FaultPlan(
+        n_nodes=24, seed=3,
+        events=flash_crowd_events(24, frac=0.25, join_round=6),
+    )
+    fplan = compile_plan(plan, cfg, Topology())
+    final, metrics = run_fault_plan(
+        new_sim(cfg, 3), meta, cfg, Topology(), fplan, 400
+    )
+    conv = np.asarray(metrics.converged_at)
+    alive = np.asarray(final.alive)
+    assert ((conv >= 0) | (alive != ALIVE)).all()
+    assert (np.asarray(final.have) > 0).all()  # joiners recovered fully
+
+
+def test_topology_link_events_cover_the_tier_rectangles():
+    topo = Topology(**family_topology("wan-3x2"))
+    evs = topology_link_events(topo, 96, end=30)
+    kinds = {e.kind for e in evs}
+    assert kinds == {"delay", "loss"}
+    # 6 AZ blocks → 30 ordered off-diagonal pairs, each with a delay
+    # event; loss events only where the tier threshold is nonzero
+    delays = [e for e in evs if e.kind == "delay"]
+    assert len(delays) == 30
+    # every selector is a range over a contiguous AZ block
+    for e in evs:
+        assert ":" in e.src and ":" in e.dst
+    # the host driver's range atoms accept them without pair expansion
+    plan = FaultPlan(n_nodes=96, seed=0, events=evs)
+    atoms = plan.range_link_epochs()
+    assert 0 < len(atoms) <= 36
+    # and the sim's factored compiler takes the same events (disjoint
+    # loss rectangles — the non-overlap rule holds by construction)
+    cfg = SimConfig(
+        n_nodes=96, n_payloads=32, fanout=3,
+        n_delay_slots=min_delay_slots(family_topology("wan-3x2")) + 1,
+    )
+    compile_plan(plan, cfg, Topology(), factored=True)
+
+
+# -- 3. PeerSwap sampler -----------------------------------------------------
+
+
+def _pswap_cfg(**kw):
+    base = dict(
+        n_nodes=24, n_payloads=16, fanout=2, sync_interval_rounds=4,
+        peer_sampler="peerswap", view_slots=8,
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def test_peerswap_deterministic_and_self_free():
+    cfg = _pswap_cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    a, ma = run_to_convergence(new_sim(cfg, 3), meta, cfg, Topology(), 400)
+    b, _ = run_to_convergence(new_sim(cfg, 3), meta, cfg, Topology(), 400)
+    assert _digest(a, skip=()) == _digest(b, skip=())
+    pv = np.asarray(a.pview)
+    assert pv.shape == (24, 8)
+    assert (pv >= -1).all() and (pv < 24).all()
+    assert (pv != np.arange(24)[:, None]).all(), "self entry leaked"
+    conv = np.asarray(ma.converged_at)
+    assert (conv >= 0).all()
+
+
+def test_peerswap_views_actually_mix():
+    """The swap tick must move entries around: after a run, views differ
+    from the seeded initial views on most nodes."""
+    cfg = _pswap_cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    init = np.asarray(new_sim(cfg, 3).pview)
+    final, _ = run_to_convergence(new_sim(cfg, 3), meta, cfg, Topology(), 400)
+    moved = (np.asarray(final.pview) != init).any(axis=1)
+    assert moved.mean() > 0.5
+
+
+def test_peerswap_wipe_rejoins_via_refill():
+    """Crash-with-wipe empties the victim's view; incoming swaps plus
+    the staggered refill must repopulate it and the node reconverges."""
+    cfg = _pswap_cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    plan = FaultPlan(
+        n_nodes=24, seed=5,
+        events=(FaultEvent("crash", 4, 10, node=3, wipe=True),),
+    )
+    fplan = compile_plan(plan, cfg, Topology())
+    final, metrics = run_fault_plan(
+        new_sim(cfg, 5), meta, cfg, Topology(), fplan, 400
+    )
+    assert (np.asarray(metrics.converged_at) >= 0).all()
+    assert (np.asarray(final.pview)[3] >= 0).any(), "wiped view never refilled"
+
+
+def test_peerswap_packed_matches_dense():
+    """The packed round runs the identical swap step: bit-equal final
+    state (pview included) against the dense path on the same seed."""
+    cfg = dataclasses.replace(
+        SimConfig(
+            n_nodes=64, n_payloads=64, fanout=3,
+            peer_sampler="peerswap", view_slots=8,
+        ),
+        packed_min_cells=0,
+    )
+    dense_cfg = dataclasses.replace(cfg, allow_packed=False)
+    meta = uniform_payloads(cfg, inject_every=1)
+    packed, mp = run_to_convergence(
+        new_sim(cfg, 5), meta, cfg, Topology(), 600
+    )
+    dense, md = run_to_convergence(
+        new_sim(dense_cfg, 5), meta, dense_cfg, Topology(), 600
+    )
+    for x, y in zip(jax.tree.leaves(packed), jax.tree.leaves(dense)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(mp), jax.tree.leaves(md)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sampler_validation():
+    with pytest.raises(ValueError, match="peer_sampler"):
+        SimConfig(n_nodes=8, n_payloads=8, peer_sampler="nope")
+    with pytest.raises(ValueError, match="view_slots"):
+        SimConfig(n_nodes=8, n_payloads=8, peer_sampler="peerswap",
+                  view_slots=1)
+    with pytest.raises(ValueError, match="incompatible"):
+        SimConfig(
+            n_nodes=8, n_payloads=8, peer_sampler="peerswap",
+            swim_partial_view=True,
+        )
+
+
+# -- campaign-spec resolution ------------------------------------------------
+
+
+def test_spec_topo_family_resolution_and_churn_plan():
+    from corrosion_tpu.campaign.spec import CampaignSpec
+
+    spec = CampaignSpec(
+        name="t",
+        scenario={
+            "n_nodes": 48, "n_payloads": 16, "churn": "flash-crowd",
+            "churn_frac": 0.25, "churn_round": 6,
+        },
+        grid={"topo_family": ["wan-3x2", "hetero-degree"],
+              "inter_loss": [0.05]},
+    )
+    cells = spec.cells()
+    t0 = spec.topo(cells[0])  # hetero-degree first (sorted keys, product)
+    fams = {c["topo_family"]: spec.topo(c) for c in cells}
+    wan = fams["wan-3x2"]
+    assert wan.n_regions == 3 and wan.n_azs == 2
+    assert wan.inter_loss == 0.05  # explicit key overrides the family
+    het = fams["hetero-degree"]
+    assert het.degree_classes == (3, 2, 1)
+    assert isinstance(het.degree_classes, tuple)
+    # churn merges into every lane's plan
+    plan = spec.fault_plan(cells[0], seed=0)
+    assert plan is not None
+    assert any(e.kind == "crash" and e.node == "36:48" for e in plan.events)
+    assert t0 is not None
+
+
+def test_families_registry_complete():
+    for name in FAMILIES:
+        topo = Topology(**family_topology(name))
+        assert topo.max_delay < min_delay_slots(family_topology(name)) + 1
