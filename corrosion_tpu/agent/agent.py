@@ -908,6 +908,10 @@ class Agent:
                 if synced:
                     backoff.reset()
             except Exception:
+                # peers being down is routine (the backoff absorbs it),
+                # but a swallowed failure here once hid real sync bugs
+                # for whole flaky-suite hunts — leave a debug trace
+                log.debug("parallel sync pass failed", exc_info=True)
                 continue
 
     def _choose_sync_peers(self) -> List:

@@ -32,6 +32,21 @@ DatagramHandler = Callable[[str, bytes], Awaitable[None]]
 UniHandler = Callable[[str, bytes], Awaitable[None]]
 BiHandler = Callable[[str, "BiStream"], Awaitable[None]]
 
+_log = logging.getLogger("corrosion_tpu.transport")
+
+
+def _close_quietly(writer) -> None:
+    """Best-effort close of a (possibly already-dead) stream writer.
+    Closing a torn-down transport raises on some asyncio backends; the
+    sever/teardown paths must proceed regardless — but the failure is
+    still LOGGED (debug) rather than swallowed, per CT006
+    (doc/lint.md): a close that fails for an unexpected reason should
+    at least leave a trace for the flaky-suite hunts."""
+    try:
+        writer.close()
+    except Exception:
+        _log.debug("best-effort writer close failed", exc_info=True)
+
 
 class BiStream:
     """One side of a bidirectional message stream (QUIC bi analog):
@@ -401,7 +416,9 @@ class _TcpBiStream(BiStream):
         try:
             writer.transport.set_write_buffer_limits(high=self.WRITE_HIGH_WATER)
         except Exception:
-            pass
+            # transports without buffer limits (tests' in-memory pairs)
+            # keep the default high-water mark; note it for diagnosis
+            _log.debug("set_write_buffer_limits unsupported", exc_info=True)
 
     async def send(self, frame: bytes) -> None:
         self.writer.write(_frame(frame))
@@ -415,10 +432,7 @@ class _TcpBiStream(BiStream):
 
     def close(self) -> None:
         self.closed = True
-        try:
-            self.writer.close()
-        except Exception:
-            pass
+        _close_quietly(self.writer)
 
 
 class _CachedConn:
@@ -634,10 +648,7 @@ class UdpTcpTransport(Transport):
                     await self.on_bi(peer_addr, _TcpBiStream(reader, writer))
         finally:
             self._server_writers.discard(writer)
-            try:
-                writer.close()
-            except Exception:
-                pass
+            _close_quietly(writer)
 
     CONNECT_TIMEOUT_S = 5.0
 
@@ -685,10 +696,7 @@ class UdpTcpTransport(Transport):
     def _evict(self, addr: str) -> None:
         conn = self._conns.pop(addr, None)
         if conn is not None:
-            try:
-                conn.writer.close()
-            except Exception:
-                pass
+            _close_quietly(conn.writer)
 
     async def _send_frame(self, addr: str, kind: bytes, data: bytes) -> None:
         # liveness-checked reuse with one reconnect (the reference tests
@@ -793,10 +801,7 @@ class UdpTcpTransport(Transport):
         # never fault-checked again, so one racing sync session would
         # replicate straight across a fresh partition
         if self.faults is not None and self.faults.blocks(addr):
-            try:
-                writer.close()
-            except Exception:
-                pass
+            _close_quietly(writer)
             raise ConnectionError(f"fault injection: {addr} partitioned")
         writer.write(self.TAG_BI)
         await writer.drain()
@@ -826,10 +831,7 @@ class UdpTcpTransport(Transport):
         for addr in list(self._conns):
             self._evict(addr)
         for writer in list(self._server_writers) + list(self._client_streams):
-            try:
-                writer.close()
-            except Exception:
-                pass
+            _close_quietly(writer)
         self._client_streams.clear()
 
     def path_samples(self) -> str:
@@ -890,10 +892,7 @@ class UdpTcpTransport(Transport):
         for addr in list(self._conns):
             self._evict(addr)
         for w in list(self._server_writers):
-            try:
-                w.close()
-            except Exception:
-                pass
+            _close_quietly(w)
         for t in list(self._tasks):
             t.cancel()
         if self._udp:

@@ -1,0 +1,38 @@
+"""corrolint: repo-invariant static analysis (ISSUE 10).
+
+Every hard bug this repo has shipped and then fixed belongs to a
+mechanically detectable class — the GSPMD shard-unaligned u8 draw
+(ISSUE 7), the ``n_writers`` meta-key shadow (ISSUE 9 review round),
+the sqlite-authorizer GIL-vs-db-mutex deadlock (ISSUE 7 drive-by).
+This package encodes those classes as AST rules over the repo's own
+source, so the determinism / shard-alignment / async-discipline
+invariants the docs describe are *enforced*, not folklore:
+
+- :mod:`.core` — the framework: ``Finding``, the rule registry,
+  ``# corrolint: disable=CTxxx`` pragmas, the committed baseline
+  (accepted legacy findings), text + JSON rendering;
+- :mod:`.callgraph` — a lightweight module-level call graph over the
+  sim tier, seeded from ``jax.jit`` / ``functools.partial(jax.jit)``
+  call sites (CT002's jit-reachability);
+- :mod:`.rules` — the rule catalog, CT001–CT006 (doc/lint.md grounds
+  each in its originating incident);
+- :mod:`.specdrift` — CT007: recompute every committed campaign
+  baseline's spec hash against the current ``campaign/spec.py``.
+
+The whole package is importable **jax-free** (``campaign.spec`` already
+guarantees this for CT007's imports) and lints the repo in seconds:
+``sim lint`` / ``python -m corrosion_tpu.analysis`` are cheap enough
+for CI and pre-commit alike.
+"""
+
+from .core import (  # noqa: F401
+    BASELINE_NAME,
+    Finding,
+    LintContext,
+    LintResult,
+    load_baseline,
+    render_json,
+    render_text,
+    run_lint,
+    write_baseline,
+)

@@ -1,0 +1,133 @@
+"""``python -m corrosion_tpu.analysis`` — the corrolint CLI.
+
+The same implementation backs ``sim lint`` (cli/main.py); both are
+jax-free and exit:
+
+- **0** — no findings outside the committed baseline;
+- **1** — at least one non-baselined finding (the CI gate's red);
+- **2** — usage error (unknown flag, unreadable baseline path...).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .core import (
+    BASELINE_NAME,
+    load_baseline,
+    render_json,
+    render_text,
+    run_lint,
+    write_baseline,
+)
+
+
+def default_root() -> str:
+    """The repo root this package sits in (…/corrosion_tpu/analysis →
+    two levels up)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="corrolint",
+        description=(
+            "repo-invariant static analysis: determinism, "
+            "shard-alignment, async discipline (doc/lint.md)"
+        ),
+    )
+    p.add_argument(
+        "--root",
+        default=None,
+        help="repo root to lint (default: the checkout this package is in)",
+    )
+    p.add_argument(
+        "--format",
+        choices=["text", "json"],
+        default="text",
+        help="output format (json is what CI archives)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline file (default: <root>/{BASELINE_NAME})",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the committed baseline (report everything)",
+    )
+    p.add_argument(
+        "--baseline-write",
+        action="store_true",
+        help="regenerate the baseline from this run's findings "
+        "(deterministic: sorted, content-stable fingerprints) and exit 0",
+    )
+    p.add_argument(
+        "--verbose",
+        action="store_true",
+        help="text output also lists baselined findings",
+    )
+    return p
+
+
+def lint_main(argv: Optional[List[str]] = None) -> int:
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors and 0 on --help; keep both
+        return int(e.code or 0)
+    root = args.root or default_root()
+    if not os.path.isdir(os.path.join(root, "corrosion_tpu")):
+        print(
+            f"error: {root!r} does not contain a corrosion_tpu/ package",
+            file=sys.stderr,
+        )
+        return 2
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.baseline and not args.baseline_write and not os.path.exists(
+        args.baseline
+    ):
+        print(
+            f"error: baseline {args.baseline!r} does not exist "
+            "(use --baseline-write to create one)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.no_baseline or args.baseline_write:
+        baseline = {}
+    else:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, OSError, KeyError, TypeError, AttributeError) as e:
+            # a truncated / merge-conflicted baseline is a USAGE error
+            # (exit 2), not a findings red — triagers must see the
+            # corrupt file, not a fake CI gate failure
+            print(
+                f"error: unreadable baseline {baseline_path!r}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+    result = run_lint(root, baseline=baseline)
+    if args.baseline_write:
+        write_baseline(baseline_path, result)
+        print(
+            f"wrote {baseline_path}: "
+            f"{len(result.findings) + len(result.baselined)} accepted "
+            "finding(s)"
+        )
+        return 0
+    if args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(lint_main())

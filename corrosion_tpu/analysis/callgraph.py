@@ -1,0 +1,261 @@
+"""Lightweight call graph + name canonicalization for the lint rules.
+
+Two jobs, both pure-AST (nothing here imports the code it models):
+
+- :class:`ModuleIndex` canonicalizes dotted names through a module's
+  import table — ``np.asarray`` resolves to ``numpy.asarray``,
+  ``jrandom.bits`` (via ``from jax import random as jrandom``) to
+  ``jax.random.bits`` — so every rule matches *canonical* names and
+  aliasing can't dodge a rule;
+- :class:`CallGraph` builds a module-level call graph over a file set
+  and BFSes reachability from **jit seeds** (functions decorated with
+  ``jax.jit`` in any spelling this repo uses: ``@jax.jit``,
+  ``@functools.partial(jax.jit, ...)``, ``@partial(jax.jit, ...)``).
+  CT002 walks the reachable set for host-sync calls: a ``.item()``
+  three helpers down from ``run_to_convergence`` is exactly the
+  deadlock/perf class a grep can't see.
+
+Deliberate approximations (documented in doc/lint.md): resolution is
+by module-level name and import table — method calls (``self.f()``)
+and dynamically-built callables don't resolve; function *references*
+passed as arguments (``jax.lax.fori_loop(0, R, body, ...)``,
+``jax.vmap(fn)``) do create edges, which is what the round loops'
+body-function style needs.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import SourceFile
+
+
+def module_name(relpath: str) -> str:
+    """repo-relative path → dotted module name
+    (``corrosion_tpu/sim/round.py`` → ``corrosion_tpu.sim.round``)."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class ModuleIndex:
+    """Import table + canonical dotted-name resolution for one module."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.module = module_name(sf.relpath)
+        # alias (as bound in this module) -> canonical dotted prefix
+        self.aliases: Dict[str, str] = {}
+        if sf.tree is None:
+            return
+        # the containing package for relative-import resolution: a
+        # package __init__ IS its own package (module_name strips the
+        # ".__init__" suffix, so splitting off the last part would
+        # resolve `from .x import y` one level too high and silently
+        # drop call-graph edges)
+        if sf.relpath.endswith("/__init__.py"):
+            pkg_parts = self.module.split(".")
+        else:
+            pkg_parts = self.module.split(".")[:-1]
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    prefix = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    prefix = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = (
+                        f"{prefix}.{a.name}" if prefix else a.name
+                    )
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, resolving
+        the root through the import table; None when the root isn't an
+        imported name (locals, attributes of self, subscripts...)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id)
+        if root is None:
+            return None
+        return ".".join([root] + list(reversed(parts)))
+
+
+@dataclass
+class FuncInfo:
+    module: str
+    qualname: str  # e.g. "run_packed.k_rounds_fn" for nested defs
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    sf: SourceFile
+    parent: Optional[str] = None  # enclosing function qualname
+    is_jit_seed: bool = False
+    calls: Set[Tuple[str, str]] = field(default_factory=set)  # resolved edges
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.qualname)
+
+
+def _jit_seed(dec: ast.AST, idx: ModuleIndex) -> bool:
+    """True when a decorator expression references jax.jit anywhere —
+    covers ``@jax.jit``, ``@partial(jax.jit, ...)``,
+    ``@functools.partial(jax.jit, static_argnames=...)``."""
+    for node in ast.walk(dec):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if idx.canonical(node) == "jax.jit":
+                return True
+    return False
+
+
+def _own_body_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's AST *excluding* nested function/lambda bodies
+    (those are separate graph nodes with their own edges)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CallGraph:
+    """Module-level call graph over a file set (see module docstring)."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.indexes: Dict[str, ModuleIndex] = {}
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        # (module, parent qualname or None) -> {bare name -> key}
+        self.scopes: Dict[Tuple[str, Optional[str]], Dict[str, Tuple[str, str]]] = {}
+        for sf in files:
+            if sf.tree is None:
+                continue
+            idx = ModuleIndex(sf)
+            self.indexes[idx.module] = idx
+            self._collect(sf, idx)
+        for info in self.funcs.values():
+            self._extract_edges(info)
+
+    # -- construction ----------------------------------------------------
+
+    def _collect(self, sf: SourceFile, idx: ModuleIndex) -> None:
+        def visit(node: ast.AST, parent_qual: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = (
+                        f"{parent_qual}.{child.name}"
+                        if parent_qual
+                        else child.name
+                    )
+                    info = FuncInfo(
+                        module=idx.module,
+                        qualname=qual,
+                        node=child,
+                        sf=sf,
+                        parent=parent_qual,
+                        is_jit_seed=any(
+                            _jit_seed(d, idx) for d in child.decorator_list
+                        ),
+                    )
+                    self.funcs[info.key] = info
+                    self.scopes.setdefault(
+                        (idx.module, parent_qual), {}
+                    )[child.name] = info.key
+                    visit(child, qual)
+                elif isinstance(child, ast.ClassDef):
+                    qual = (
+                        f"{parent_qual}.{child.name}"
+                        if parent_qual
+                        else child.name
+                    )
+                    visit(child, qual)
+                else:
+                    visit(child, parent_qual)
+
+        visit(sf.tree, None)
+
+    def _resolve(
+        self, info: FuncInfo, node: ast.AST
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve a Name/Attribute reference to a known function key:
+        enclosing-scope nested defs first, then module level, then the
+        import table (cross-module)."""
+        if isinstance(node, ast.Name):
+            scope: Optional[str] = info.qualname
+            while True:
+                local = self.scopes.get((info.module, scope), {})
+                if node.id in local:
+                    return local[node.id]
+                if scope is None:
+                    break
+                scope = (
+                    scope.rsplit(".", 1)[0] if "." in scope else None
+                )
+        idx = self.indexes.get(info.module)
+        if idx is None:
+            return None
+        dotted = idx.canonical(node)
+        if dotted and "." in dotted:
+            mod, attr = dotted.rsplit(".", 1)
+            if (mod, attr) in self.funcs:
+                return (mod, attr)
+        return None
+
+    def _extract_edges(self, info: FuncInfo) -> None:
+        for node in _own_body_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._resolve(info, node.func)
+            if target is not None:
+                info.calls.add(target)
+            # function REFERENCES passed as arguments (fori_loop body,
+            # vmap(fn), cond branches) are edges too
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    ref = self._resolve(info, arg)
+                    if ref is not None:
+                        info.calls.add(ref)
+
+    # -- queries ---------------------------------------------------------
+
+    def seeds(self) -> List[FuncInfo]:
+        return [f for f in self.funcs.values() if f.is_jit_seed]
+
+    def reachable_from_jit(self) -> Set[Tuple[str, str]]:
+        """Function keys reachable from any jit seed (seeds included).
+        A nested def inside a seed is reachable by construction — its
+        body only exists inside the traced program."""
+        out: Set[Tuple[str, str]] = set()
+        stack = [f.key for f in self.seeds()]
+        # nested functions of a seed are part of its traced body even
+        # when only referenced implicitly (closures)
+        while stack:
+            key = stack.pop()
+            if key in out:
+                continue
+            out.add(key)
+            info = self.funcs.get(key)
+            if info is None:
+                continue
+            stack.extend(info.calls)
+            for (mod, parent), names in self.scopes.items():
+                if mod == info.module and parent == info.qualname:
+                    stack.extend(names.values())
+        return out

@@ -1,0 +1,330 @@
+"""corrolint framework: files, pragmas, findings, baseline, runner.
+
+Design constraints (ISSUE 10):
+
+- **jax-free**: the linter parses source with :mod:`ast`; it never
+  imports the modules it checks (CT004 reads ``SimConfig``'s fields
+  out of the AST of ``sim/state.py``, not the dataclass), so a lint
+  run costs seconds on a jax-less box and CI can gate on it without
+  an accelerator install step.
+- **pragmas**: ``# corrolint: disable=CT001`` on a finding's line (or
+  the line above, for multi-line statements) suppresses it.  Pragmas
+  are for *justified* exceptions — the comment next to one should say
+  why, the way ``# noqa`` is used in this repo.
+- **baseline**: accepted legacy findings live in a committed JSON file
+  (:data:`BASELINE_NAME` at the repo root).  A finding's identity is
+  content-stable — a blake2b fold over (rule, path, stripped source
+  line, occurrence index), never the line *number* — so unrelated
+  edits don't invalidate the baseline, while editing a flagged line
+  re-surfaces it for a fresh triage.
+- **determinism**: findings sort by (path, line, rule); the baseline
+  serializes sorted with a trailing newline; two ``--baseline-write``
+  runs over the same tree produce byte-identical files.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: the committed baseline's repo-root filename (kept alongside
+#: BASELINE.json / BENCH_*.json — repo-level contract artifacts)
+BASELINE_NAME = "LINT_BASELINE.json"
+
+#: directories under corrosion_tpu/ the file walk skips
+_SKIP_DIRS = {"__pycache__"}
+
+_PRAGMA_RE = re.compile(r"#\s*corrolint:\s*disable=([A-Z0-9*,\s]+)")
+
+
+def _fingerprint(rule: str, path: str, text: str, occurrence: int) -> str:
+    payload = json.dumps(
+        [rule, path, text, occurrence], separators=(",", ":")
+    )
+    return hashlib.blake2b(payload.encode(), digest_size=8).hexdigest()
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a repo-relative ``path:line``."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    fingerprint: str = ""
+
+    def ref(self) -> str:
+        """The clickable ``file:line`` reference the text output prints."""
+        return f"{self.path}:{self.line}"
+
+
+class SourceFile:
+    """One parsed source file: text, AST, and per-line pragma codes."""
+
+    def __init__(self, root: str, relpath: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.abspath = os.path.join(root, relpath)
+        with open(self.abspath, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.source, filename=self.relpath)
+        except SyntaxError as e:  # surfaced as a finding by the runner
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        # line number -> set of disabled rule codes ("*" = all)
+        self.pragmas: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                self.pragmas[i] = codes
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """A pragma suppresses a finding when it sits on the finding's
+        line, or anywhere in the contiguous run of comment-only lines
+        directly above it — the natural home of the *justification* a
+        pragma is supposed to carry (doc/lint.md)."""
+        codes = self.pragmas.get(line)
+        if codes and (rule in codes or "*" in codes):
+            return True
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) and self.lines[
+            ln - 1
+        ].strip().startswith("#"):
+            codes = self.pragmas.get(ln)
+            if codes and (rule in codes or "*" in codes):
+                return True
+            ln -= 1
+        return False
+
+
+class LintContext:
+    """The parsed repo a lint run sees: every ``corrosion_tpu/**/*.py``
+    plus the repo root (for the committed campaign baselines CT007
+    reads).  Rules receive this and yield findings."""
+
+    def __init__(self, root: str, files: Sequence[SourceFile]):
+        self.root = root
+        self.files = list(files)
+        self.by_path = {f.relpath: f for f in self.files}
+
+    def under(self, *prefixes: str) -> List[SourceFile]:
+        """Files whose repo-relative path starts with any prefix."""
+        return [
+            f
+            for f in self.files
+            if any(f.relpath.startswith(p) for p in prefixes)
+        ]
+
+    def get(self, relpath: str) -> Optional[SourceFile]:
+        return self.by_path.get(relpath)
+
+
+def collect_files(root: str, package: str = "corrosion_tpu") -> List[SourceFile]:
+    """Walk ``root/package`` for .py files, sorted for determinism."""
+    out: List[SourceFile] = []
+    base = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            out.append(SourceFile(root, rel))
+    return out
+
+
+# -- rule registry -----------------------------------------------------------
+
+
+class Rule:
+    """One lint rule.  Subclasses set ``code``/``name``/``incident`` and
+    implement :meth:`run` yielding ``(path, line, message)`` triples —
+    the runner owns pragma filtering, fingerprints, and sorting."""
+
+    code: str = "CT000"
+    name: str = ""
+    #: the shipped incident that motivates the rule (doc/lint.md)
+    incident: str = ""
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        raise NotImplementedError
+
+
+def all_rules() -> List[Rule]:
+    """The registered rule set, in code order (import-light: rules and
+    specdrift import nothing heavier than ast/json)."""
+    from .rules import RULES
+    from .specdrift import SpecHashDrift
+
+    return sorted(
+        [cls() for cls in RULES] + [SpecHashDrift()],
+        key=lambda r: r.code,
+    )
+
+
+# -- runner ------------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # not baselined
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0  # pragma-disabled count
+    checked_files: int = 0
+    rules: List[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _finalize(
+    ctx: LintContext, raw: List[Tuple[str, str, int, str]]
+) -> List[Finding]:
+    """Attach content-stable fingerprints: the occurrence index
+    disambiguates identical (rule, path, line-text) triples in line
+    order, so two textually identical findings in one file keep
+    distinct, stable identities."""
+    raw = sorted(raw, key=lambda r: (r[1], r[2], r[0]))
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[Finding] = []
+    for rule, path, line, message in raw:
+        sf = ctx.get(path)
+        text = sf.line_text(line) if sf else ""
+        key = (rule, path, text)
+        k = seen.get(key, 0)
+        seen[key] = k + 1
+        out.append(
+            Finding(
+                rule=rule,
+                path=path,
+                line=line,
+                message=message,
+                fingerprint=_fingerprint(rule, path, text, k),
+            )
+        )
+    return out
+
+
+def run_lint(
+    root: str,
+    rules: Optional[Sequence[Rule]] = None,
+    baseline: Optional[Dict[str, dict]] = None,
+) -> LintResult:
+    """Lint the repo at ``root``.  ``baseline`` maps fingerprint →
+    accepted-finding record (see :func:`load_baseline`); matched
+    findings are reported separately and don't fail the run."""
+    rules = list(rules) if rules is not None else all_rules()
+    ctx = LintContext(root, collect_files(root))
+    result = LintResult(
+        checked_files=len(ctx.files), rules=[r.code for r in rules]
+    )
+    raw: List[Tuple[str, str, int, str]] = []
+    for f in ctx.files:
+        if f.parse_error:
+            raw.append(("CT000", f.relpath, 1, f.parse_error))
+    for rule in rules:
+        for path, line, message in rule.run(ctx):
+            sf = ctx.get(path)
+            if sf is not None and sf.suppressed(line, rule.code):
+                result.suppressed += 1
+                continue
+            raw.append((rule.code, path, line, message))
+    baseline = baseline or {}
+    for finding in _finalize(ctx, raw):
+        if finding.fingerprint in baseline:
+            result.baselined.append(finding)
+        else:
+            result.findings.append(finding)
+    return result
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint → record.  A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {rec["fingerprint"]: rec for rec in data.get("findings", [])}
+
+
+def write_baseline(path: str, result: LintResult) -> None:
+    """Regenerate the baseline from a run's findings (new + already
+    baselined), deterministically: sorted by (path, line, rule), line
+    numbers included for humans but excluded from identity."""
+    records = sorted(
+        (
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+            }
+            for f in result.findings + result.baselined
+        ),
+        key=lambda r: (r["path"], r["line"], r["rule"], r["fingerprint"]),
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": records}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def render_text(result: LintResult, verbose: bool = False) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f"{f.ref()}: {f.rule} {f.message}")
+    if verbose:
+        for f in result.baselined:
+            lines.append(f"{f.ref()}: {f.rule} [baselined] {f.message}")
+    lines.append(
+        f"corrolint: {len(result.findings)} finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{result.suppressed} pragma-disabled "
+        f"({result.checked_files} files, rules {', '.join(result.rules)})"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    def rec(f: Finding) -> dict:
+        return {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "message": f.message,
+            "fingerprint": f.fingerprint,
+        }
+
+    return json.dumps(
+        {
+            "findings": [rec(f) for f in result.findings],
+            "baselined": [rec(f) for f in result.baselined],
+            "suppressed": result.suppressed,
+            "checked_files": result.checked_files,
+            "rules": result.rules,
+            "clean": result.clean,
+        },
+        indent=2,
+        sort_keys=True,
+    )

@@ -1,0 +1,474 @@
+"""The corrolint rule catalog, CT001–CT006.
+
+Every rule is distilled from a bug this repo actually shipped and then
+fixed (doc/lint.md carries the full incident write-ups):
+
+- CT001 — ISSUE 7's GSPMD silent-wrong-values bug: raw u8 threefry
+  draws diverge from single-device at shard-unaligned sizes;
+  ``topology.aligned_u8_bits`` is the repo-wide rule, this enforces it.
+- CT002 — host syncs inside jit-reachable code: a ``.item()`` three
+  helpers down from a round loop stalls the pipelined dispatch (and on
+  a real chip, the tunnel) — found via the jit-seeded call graph.
+- CT003 — nondeterminism in the sim/campaign digest paths: replay
+  identity (spec hashes, result digests) only holds when every
+  stochastic stream derives from ``faults.derive_seed`` and wall-clock
+  never feeds a digested value.
+- CT004 — ISSUE 9's ``n_writers`` incident: a campaign meta key that
+  shadows a real ``SimConfig`` field silently measured a 1-writer
+  workload for a whole PR.  Shadowing keys must be declared in
+  ``spec.FORWARDED_META_KEYS`` (whose runtime twin refuses them too).
+- CT005 — ISSUE 7's sqlite-authorizer GIL-vs-db-mutex deadlock:
+  blocking calls inside ``async def`` in the host tier.
+- CT006 — broad ``except Exception`` that neither logs nor re-raises:
+  the class that let every one of the above hide for a while.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, ModuleIndex, _own_body_nodes
+from .core import LintContext, Rule, SourceFile
+
+#: the jitted/traced tier: RNG + kernel + sharding code
+SIM_TIER = (
+    "corrosion_tpu/sim/",
+    "corrosion_tpu/topo/",
+    "corrosion_tpu/parallel/",
+)
+#: digest paths: everything whose outputs feed replay digests / spec
+#: hashes (the campaign layer serializes and hashes results)
+DIGEST_TIER = SIM_TIER + ("corrosion_tpu/campaign/",)
+
+#: the blessed draw site CT001 exempts — THE implementation of the
+#: repo-wide aligned-u8 rule
+ALIGNED_DRAW_FILE = "corrosion_tpu/sim/topology.py"
+ALIGNED_DRAW_FUNC = "aligned_u8_bits"
+
+
+def _host_tier(ctx: LintContext) -> List[SourceFile]:
+    """Everything under corrosion_tpu/ that is NOT the jitted sim tier
+    (agent, api, pubsub, pg, cli, utils, top-level modules...)."""
+    return [
+        f
+        for f in ctx.files
+        if not any(f.relpath.startswith(p) for p in SIM_TIER)
+    ]
+
+
+def _enclosing_funcs(tree: ast.AST) -> Dict[ast.AST, Optional[str]]:
+    """node -> name of the innermost enclosing function (None at module
+    level) — cheap parent tracking for per-function scoping."""
+    out: Dict[ast.AST, Optional[str]] = {}
+
+    def visit(node: ast.AST, fn: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[child] = fn
+                visit(child, child.name)
+            else:
+                out[child] = fn
+                visit(child, fn)
+
+    visit(tree, None)
+    return out
+
+
+class UnalignedU8Draw(Rule):
+    """CT001: every ``jax.random.bits`` draw in the sim tier must route
+    through ``topology.aligned_u8_bits`` — the u8 unpack of a raw draw
+    silently produces different values than single-device when GSPMD
+    partitions it on a non-word-aligned boundary (ISSUE 7)."""
+
+    code = "CT001"
+    name = "unaligned-u8-draw"
+    incident = (
+        "ISSUE 7: sharded fault-storm loss masks diverged bit-wise from "
+        "single-device at shard-unaligned sizes"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        for sf in ctx.under(*SIM_TIER):
+            if sf.tree is None:
+                continue
+            idx = ModuleIndex(sf)
+            enclosing = _enclosing_funcs(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if idx.canonical(node.func) != "jax.random.bits":
+                    continue
+                if (
+                    sf.relpath == ALIGNED_DRAW_FILE
+                    and enclosing.get(node) == ALIGNED_DRAW_FUNC
+                ):
+                    continue
+                yield (
+                    sf.relpath,
+                    node.lineno,
+                    "raw jax.random.bits draw outside "
+                    "topology.aligned_u8_bits — u8 unpacks of raw draws "
+                    "silently diverge from single-device at "
+                    "shard-unaligned sizes (route the draw through "
+                    "aligned_u8_bits)",
+                )
+
+
+#: canonical call names that force a device→host transfer / host sync
+_HOST_SYNC_CALLS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.frombuffer",
+}
+#: zero-arg method calls that do the same on an array
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+class HostSyncInKernel(Rule):
+    """CT002: host-sync calls inside functions jit-reachable from the
+    round loops, via a call graph seeded at jax.jit call sites."""
+
+    code = "CT002"
+    name = "host-sync-in-kernel"
+    incident = (
+        "class behind ISSUE 7's authorizer-adjacent stalls: one hidden "
+        "host sync in a traced path serializes the whole dispatch"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        files = [f for f in ctx.under(*SIM_TIER) if f.tree is not None]
+        graph = CallGraph(files)
+        reachable = graph.reachable_from_jit()
+        for key in sorted(reachable):
+            info = graph.funcs.get(key)
+            if info is None:
+                continue
+            idx = graph.indexes[info.module]
+            for node in _own_body_nodes(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = idx.canonical(node.func)
+                hit: Optional[str] = None
+                if dotted in _HOST_SYNC_CALLS:
+                    hit = dotted
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_SYNC_METHODS
+                    and not node.args
+                    and not node.keywords
+                ):
+                    hit = f".{node.func.attr}()"
+                if hit:
+                    yield (
+                        info.sf.relpath,
+                        node.lineno,
+                        f"host sync {hit} inside jit-reachable "
+                        f"{info.qualname} (reachable from the "
+                        "jax.jit-seeded call graph) — host transfers "
+                        "in traced code stall the dispatch pipeline",
+                    )
+
+
+#: canonical names that smuggle wall-clock / ambient randomness into
+#: digest paths.  time.monotonic/perf_counter are ALLOWED: walls are
+#: measured everywhere but digest-excluded by design (report.py).
+_NONDET_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "os.urandom",
+    "uuid.uuid4",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+_NONDET_PREFIXES = ("numpy.random.", "random.", "secrets.")
+
+
+class NondeterminismInSimTier(Rule):
+    """CT003: ambient randomness / wall-clock in sim+campaign digest
+    paths — seeds must flow through ``faults.derive_seed`` and replay
+    digests must be pure functions of the spec."""
+
+    code = "CT003"
+    name = "nondeterminism-in-sim-tier"
+    incident = (
+        "replay-identity contract (ISSUE 3): one ambient draw anywhere "
+        "in a digest path and `identical_results` certification dies"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        for sf in ctx.under(*DIGEST_TIER):
+            if sf.tree is None:
+                continue
+            idx = ModuleIndex(sf)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = idx.canonical(node.func)
+                if dotted is None:
+                    continue
+                if dotted in _NONDET_CALLS or any(
+                    dotted.startswith(p) for p in _NONDET_PREFIXES
+                ):
+                    yield (
+                        sf.relpath,
+                        node.lineno,
+                        f"nondeterministic {dotted} in a sim/campaign "
+                        "digest path — derive every stochastic stream "
+                        "from the plan seed via faults.derive_seed "
+                        "(wall measurement uses time.monotonic, which "
+                        "is digest-excluded and allowed)",
+                    )
+
+
+def _tuple_strs(node: ast.AST) -> List[Tuple[str, int]]:
+    """(value, lineno) for every string constant in a tuple/list/set
+    literal (possibly wrapped in frozenset(...)/tuple(...))."""
+    if isinstance(node, ast.Call) and node.args:
+        node = node.args[0]
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append((elt.value, elt.lineno))
+    return out
+
+
+SPEC_FILE = "corrosion_tpu/campaign/spec.py"
+SIMCONFIG_FILE = "corrosion_tpu/sim/state.py"
+
+
+def _module_assign(
+    sf: SourceFile, name: str
+) -> Optional[ast.AST]:
+    for node in sf.tree.body:  # module level only
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if (
+                isinstance(node.target, ast.Name)
+                and node.target.id == name
+                and node.value is not None
+            ):
+                return node.value
+    return None
+
+
+def simconfig_fields(ctx: LintContext) -> Set[str]:
+    """SimConfig's dataclass field names, read from the AST of
+    sim/state.py (annotated assignments in the class body) — never by
+    importing the jax-heavy module."""
+    sf = ctx.get(SIMCONFIG_FILE)
+    if sf is None or sf.tree is None:
+        return set()
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "SimConfig":
+            return {
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            }
+    return set()
+
+
+class MetaKeyShadow(Rule):
+    """CT004: campaign meta keys that collide with SimConfig dataclass
+    fields must be declared in ``spec.FORWARDED_META_KEYS`` — the
+    undeclared collision is exactly how ``n_writers`` silently measured
+    a 1-writer workload for all of ISSUE 9's frontier campaign."""
+
+    code = "CT004"
+    name = "meta-key-shadow"
+    incident = (
+        "ISSUE 9 review round: the `n_writers` meta key shadowed the "
+        "real SimConfig field and was stripped from sim cells — the "
+        "frontier campaign measured the wrong workload"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        sf = ctx.get(SPEC_FILE)
+        if sf is None or sf.tree is None:
+            return
+        fields = simconfig_fields(ctx)
+        if not fields:
+            return
+        forwarded_node = _module_assign(sf, "FORWARDED_META_KEYS")
+        forwarded = {
+            v for v, _ in _tuple_strs(forwarded_node)
+        } if forwarded_node is not None else set()
+        for const_name in ("_SCENARIO_META_KEYS", "_TOPOLOGY_KEYS"):
+            node = _module_assign(sf, const_name)
+            if node is None:
+                continue
+            for key, line in _tuple_strs(node):
+                if key in fields and key not in forwarded:
+                    yield (
+                        sf.relpath,
+                        line,
+                        f"meta key {key!r} in {const_name} shadows a "
+                        "real SimConfig field but is not declared in "
+                        "FORWARDED_META_KEYS — sim cells would "
+                        "silently strip it (the ISSUE 9 n_writers "
+                        "incident class)",
+                    )
+
+
+#: canonical names that block the event loop when awaited-around
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "sqlite3.connect",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "os.system",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "urllib.request.urlopen",
+}
+#: method names whose sync forms have bitten this repo inside async
+#: code (the sqlite authorizer deadlock class)
+_BLOCKING_METHODS = {"set_authorizer"}
+
+
+class BlockingCallInAsync(Rule):
+    """CT005: blocking calls lexically inside ``async def`` bodies in
+    the host tier (nested sync ``def``s are excluded — they may be
+    executor-bound; the rule is about code that runs ON the loop)."""
+
+    code = "CT005"
+    name = "blocking-call-in-async"
+    incident = (
+        "ISSUE 7 drive-by: a lingering sqlite authorizer deadlocked "
+        "GIL-vs-db-mutex against the wal-checkpoint executor thread — "
+        "a blocking call reachable from async code froze the tier-1 "
+        "suite wholesale"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        for sf in _host_tier(ctx):
+            if sf.tree is None:
+                continue
+            idx = ModuleIndex(sf)
+            for fn in ast.walk(sf.tree):
+                if not isinstance(fn, ast.AsyncFunctionDef):
+                    continue
+                for node in _own_body_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    dotted = idx.canonical(node.func)
+                    hit = None
+                    if dotted in _BLOCKING_CALLS:
+                        hit = dotted
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _BLOCKING_METHODS
+                    ):
+                        hit = f".{node.func.attr}(...)"
+                    if hit:
+                        yield (
+                            sf.relpath,
+                            node.lineno,
+                            f"blocking {hit} inside async def "
+                            f"{fn.name} — it stalls the event loop "
+                            "(and sqlite hooks can deadlock "
+                            "GIL-vs-db-mutex); await an async "
+                            "equivalent or move it to an executor",
+                        )
+
+
+_LOG_METHODS = {
+    "debug",
+    "info",
+    "warning",
+    "error",
+    "exception",
+    "critical",
+    "log",
+    "print_exc",
+}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handler_logs_or_raises(handler: ast.ExceptHandler) -> bool:
+    """A handler is NOT a swallow when it re-raises, logs, or binds the
+    exception (``as e``) and actually uses it — routing the error into
+    a response body, a report record, or an error string is handling,
+    just through a different channel than a logger."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _LOG_METHODS:
+                return True
+            if isinstance(fn, ast.Name) and fn.id in ("print",):
+                return True
+        if (
+            handler.name
+            and isinstance(node, ast.Name)
+            and node.id == handler.name
+        ):
+            return True
+    return False
+
+
+class BroadExceptSwallow(Rule):
+    """CT006: host-tier ``except Exception`` (or broader) that neither
+    logs nor re-raises — the silent-swallow class that let real faults
+    (lost frames, dead matchers, failed syncs) disappear without a
+    trace until a tier-1 run hung."""
+
+    code = "CT006"
+    name = "broad-except-swallow"
+    incident = (
+        "repeated: silent handlers hid the transport sever races and "
+        "sync failures behind ISSUE 7/8's flaky-suite hunts"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        for sf in _host_tier(ctx):
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not _handler_is_broad(handler):
+                        continue
+                    if _handler_logs_or_raises(handler):
+                        continue
+                    yield (
+                        sf.relpath,
+                        handler.lineno,
+                        "broad except swallows the error with neither "
+                        "log nor re-raise — log it (exc_info/debug is "
+                        "fine for best-effort cleanup) or let it "
+                        "propagate",
+                    )
+
+
+RULES = [
+    UnalignedU8Draw,
+    HostSyncInKernel,
+    NondeterminismInSimTier,
+    MetaKeyShadow,
+    BlockingCallInAsync,
+    BroadExceptSwallow,
+]

@@ -1,0 +1,99 @@
+"""CT007: spec-hash drift over the committed campaign baselines.
+
+The committed band artifacts under ``doc/experiments/`` are the CI
+gates' teeth — ``sim campaign compare`` holds every nightly candidate
+against them by band, but nothing re-checked that the *spec* a
+baseline embeds still hashes to the ``spec_hash`` it claims, or that
+the builtin spec of the same name still produces that hash.  Either
+drift silently un-anchors the gate:
+
+- **serialization drift**: an edit to ``campaign/spec.py``'s
+  ``to_dict``/``from_dict`` (a new default-serialized field, a type
+  change) moves every spec hash — candidates stop matching baselines
+  for reasons that have nothing to do with bands;
+- **builtin drift**: an edit to a builtin spec (seeds, grid, scenario
+  knobs) without regenerating its committed baseline leaves CI
+  comparing apples to last month's oranges.
+
+This check recomputes both, jax-free (``campaign.spec`` imports
+lazily by design).  A deliberate spec change is legal — regenerate the
+baseline in the same PR, as doc/campaigns.md already instructs.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Iterable, Tuple
+
+from .core import LintContext, Rule
+
+BASELINE_GLOB = os.path.join("doc", "experiments", "CAMPAIGN_BASELINE_*.json")
+
+
+class SpecHashDrift(Rule):
+    code = "CT007"
+    name = "spec-hash-drift"
+    incident = (
+        "preventive (ISSUE 10): the n_writers fix in ISSUE 9 moved a "
+        "baseline's workload shape — a drifted spec hash is how such a "
+        "change would ship unnoticed"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        from ..campaign.spec import BUILTIN_SPECS, CampaignSpec, builtin_spec
+
+        paths = sorted(glob.glob(os.path.join(ctx.root, BASELINE_GLOB)))
+        for path in paths:
+            rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    art = json.load(f)
+            except (OSError, ValueError) as e:
+                yield rel, 1, f"unreadable campaign baseline: {e}"
+                continue
+            embedded = art.get("spec")
+            claimed = art.get("spec_hash")
+            if not embedded or not claimed:
+                yield (
+                    rel,
+                    1,
+                    "campaign baseline lacks spec/spec_hash — not a "
+                    "replayable gate artifact",
+                )
+                continue
+            try:
+                spec = CampaignSpec.from_dict(embedded)
+            except Exception as e:  # noqa: BLE001 — the yielded finding IS the report
+                yield (
+                    rel,
+                    1,
+                    f"embedded spec no longer rebuilds under the "
+                    f"current campaign/spec.py: {e}",
+                )
+                continue
+            recomputed = spec.spec_hash()
+            if recomputed != claimed:
+                yield (
+                    rel,
+                    1,
+                    f"spec-hash drift: baseline claims {claimed} but "
+                    f"the current campaign/spec.py serializes its "
+                    f"embedded spec to {recomputed} — regenerate the "
+                    "baseline in the same PR as the spec change",
+                )
+                continue
+            name = spec.name
+            if name in BUILTIN_SPECS:
+                rebuilt = builtin_spec(name, seeds=spec.seeds)
+                if rebuilt.spec_hash() != claimed:
+                    yield (
+                        rel,
+                        1,
+                        f"builtin drift: builtin spec {name!r} now "
+                        f"hashes to {rebuilt.spec_hash()} but the "
+                        f"committed baseline pins {claimed} — the "
+                        "builtin changed without regenerating its "
+                        "baseline",
+                    )

@@ -15,10 +15,13 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 from ..agent.agent import Agent
 from .wire import decode_value, encode_value
+
+log = logging.getLogger("corrosion_tpu.api")
 
 MAX_BODY = 64 * 1024 * 1024
 
@@ -99,7 +102,8 @@ class ApiServer:
             try:
                 writer.close()
             except Exception:
-                pass
+                # best-effort close of a dead conn; trace it (CT006)
+                log.debug("api conn close failed", exc_info=True)
 
     async def _read_request(self, reader):
         line = await reader.readline()

@@ -84,6 +84,16 @@ _SERVING_PARAM_KEYS = (
     "n_writes", "n_writers", "n_watchers", "rate_hz", "settle_timeout_s",
 )
 
+#: meta keys that are ALSO real SimConfig fields — ON PURPOSE, declared.
+#: ``n_writers`` doubles as the serving-cell workload knob and the sim
+#: tier's payload-grid axis; a sim cell forwards it into SimConfig.
+#: Any OTHER collision between a meta key and a SimConfig field is the
+#: ISSUE 9 ``n_writers`` incident class (the key silently vanished from
+#: sim cells and a whole campaign measured a 1-writer workload):
+#: ``sim_config()`` refuses undeclared shadows loudly, and corrolint
+#: CT004 flags them statically (doc/lint.md).
+FORWARDED_META_KEYS = ("n_writers",)
+
 
 def canonical_json(obj) -> str:
     """Deterministic JSON: sorted keys, no whitespace drift — the byte
@@ -237,10 +247,25 @@ class CampaignSpec:
         kw.update(cell)
         wan = bool(kw.pop("wan_tuned", False))
         # strip topology/meta keys — EXCEPT keys that are also real
-        # SimConfig fields (``n_writers`` doubles as a serving-cell
-        # workload knob in _SCENARIO_META_KEYS; a sim cell's
-        # n_writers must reach SimConfig, not vanish silently)
+        # SimConfig fields AND declared in FORWARDED_META_KEYS
+        # (``n_writers`` doubles as a serving-cell workload knob; a sim
+        # cell's n_writers must reach SimConfig, not vanish silently).
+        # An UNDECLARED collision is refused loudly: that silence is
+        # exactly how the ISSUE 9 frontier campaign measured a 1-writer
+        # workload for a full PR (corrolint CT004's runtime twin).
         fields = SimConfig.__dataclass_fields__
+        shadowed = sorted(
+            k
+            for k in _TOPOLOGY_KEYS + _SCENARIO_META_KEYS
+            if k in fields and k not in FORWARDED_META_KEYS
+        )
+        if shadowed:
+            raise ValueError(
+                f"meta key(s) {shadowed} shadow real SimConfig fields "
+                "but are not declared in FORWARDED_META_KEYS — a sim "
+                "cell would silently strip them (declare the "
+                "forwarding, or rename the meta key)"
+            )
         for k in _TOPOLOGY_KEYS + _SCENARIO_META_KEYS + (_TOPO_FAMILY_KEY,):
             if k not in fields:
                 kw.pop(k, None)

@@ -386,6 +386,10 @@ def cmd_sim(args) -> int:
     """Run a TPU-simulator benchmark config (rebuild-specific; these are
     the BASELINE.md scenario tiers), or dispatch `sim campaign ...` /
     `sim trace show ...`."""
+    if args.scenario == "lint":
+        # corrolint (ISSUE 10): jax-free static analysis — dispatched
+        # before the platform setup so a CI lint gate never imports jax
+        return cmd_lint(args)
     if args.scenario == "trace":
         # pure host-side artifact rendering — dispatched before the
         # platform setup below so it never pays the jax import
@@ -571,6 +575,36 @@ def _run_sim_scenario(args) -> int:
         default=float,
     ))
     return 0
+
+
+def cmd_lint(args) -> int:
+    """`sim lint`: run corrolint (corrosion_tpu.analysis, doc/lint.md)
+    over the repo — determinism / shard-alignment / async-discipline
+    invariants as AST rules, jax-free, in seconds.
+
+    Exit codes: 0 = clean against the committed baseline, 1 = at least
+    one non-baselined finding (the CI gate's red), 2 = usage error.
+    ``--baseline-write`` regenerates LINT_BASELINE.json
+    deterministically (sorted, content-stable fingerprints) and exits 0.
+    Findings print as clickable ``file:line`` references."""
+    if args.campaign_cmd:
+        print(
+            "error: sim lint takes no subcommand "
+            "(flags: --format, --baseline, --no-baseline, "
+            "--baseline-write)",
+            file=sys.stderr,
+        )
+        return 2
+    from ..analysis.__main__ import lint_main
+
+    argv = ["--format", "json" if args.json else args.format]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.baseline_write:
+        argv.append("--baseline-write")
+    return lint_main(argv)
 
 
 def cmd_topo(args) -> int:
@@ -1017,12 +1051,13 @@ def build_parser() -> argparse.ArgumentParser:
         "sim",
         help="run a TPU-simulator benchmark config, "
         "`sim campaign run|compare|report` for declarative seed-ensemble "
-        "campaigns, `sim trace show` for flight-recorder artifacts, or "
-        "`sim topo show` for topology families",
+        "campaigns, `sim trace show` for flight-recorder artifacts, "
+        "`sim topo show` for topology families, or `sim lint` for the "
+        "corrolint static-analysis gate (doc/lint.md)",
     )
     sm.add_argument(
         "scenario",
-        choices=sorted(_SIM_SCENARIOS) + ["campaign", "trace", "topo"],
+        choices=sorted(_SIM_SCENARIOS) + ["campaign", "trace", "topo", "lint"],
     )
     sm.add_argument(
         "campaign_cmd", nargs="?",
@@ -1077,7 +1112,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-resume", action="store_true",
         help="campaign run: ignore an existing artifact",
     )
-    sm.add_argument("--baseline", help="campaign compare: baseline artifact")
+    sm.add_argument(
+        "--baseline",
+        help="campaign compare: baseline artifact; lint: baseline file "
+        "(default: <repo>/LINT_BASELINE.json)",
+    )
     sm.add_argument("--candidate", help="campaign compare: candidate artifact")
     sm.add_argument(
         "--telemetry", action="store_true",
@@ -1107,6 +1146,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--xla-profile", metavar="DIR",
         help="capture a jax.profiler (TensorBoard) trace of the run "
         "into DIR",
+    )
+    sm.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="lint: output format (CI archives the json form)",
+    )
+    sm.add_argument(
+        "--no-baseline", action="store_true",
+        help="lint: ignore the committed baseline (report everything)",
+    )
+    sm.add_argument(
+        "--baseline-write", action="store_true",
+        help="lint: regenerate the baseline from this run's findings "
+        "(deterministic: sorted, content-stable fingerprints)",
     )
     sm.add_argument(
         "--tol-frac", type=float, default=0.10,

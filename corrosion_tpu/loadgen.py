@@ -45,6 +45,9 @@ class LoadReport:
     writes_attempted: int = 0
     writes_ok: int = 0
     write_errors: int = 0
+    # why the LAST failed write failed (repr) — the count alone can't
+    # distinguish a dead node from a driver bug when a lane regresses
+    last_write_error: Optional[str] = None
     sub_rows_seen: int = 0
     update_events_seen: int = 0
     missing_on_sub: List[int] = field(default_factory=list)
@@ -96,6 +99,7 @@ class LoadReport:
             "writes_attempted": self.writes_attempted,
             "writes_ok": self.writes_ok,
             "write_errors": self.write_errors,
+            "last_write_error": self.last_write_error,
             "sub_rows_seen": self.sub_rows_seen,
             "update_events_seen": self.update_events_seen,
             "missing_on_sub": len(self.missing_on_sub),
@@ -194,8 +198,12 @@ class LoadGenerator:
                 self._written.add(rowid)
                 self._write_ok_at[rowid] = now
                 self._write_lat.append(now - t0)
-            except Exception:
+            except Exception as e:
+                # counted for the report's verdict AND kept: "why" is
+                # what distinguishes a dead node from a driver bug when
+                # a campaign lane comes back inconsistent
                 self.report.write_errors += 1
+                self.report.last_write_error = repr(e)
             if interval:
                 await asyncio.sleep(interval * self._rng.uniform(0.5, 1.5))
 

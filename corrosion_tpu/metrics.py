@@ -16,9 +16,12 @@ Histogram buckets default to the reference's latency ladder
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("corrosion_tpu.metrics")
 
 DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.010, 0.025, 0.050, 0.100, 0.250, 0.500,
@@ -273,6 +276,9 @@ class MetricsServer:
                     body = out.encode()
                     status = b"HTTP/1.1 200 OK\r\n"
                 except Exception:
+                    # the scraper sees a 500; the CAUSE goes to the log
+                    # (a silent scrape failure hid real DB races before)
+                    log.warning("metrics scrape failed", exc_info=True)
                     body = b"scrape failed"
                     status = b"HTTP/1.1 500 Internal Server Error\r\n"
                 writer.write(
@@ -287,7 +293,8 @@ class MetricsServer:
                 writer.close()
                 await writer.wait_closed()
             except Exception:
-                pass
+                # best-effort close of a dead scrape conn; trace it
+                log.debug("metrics conn close failed", exc_info=True)
 
     def render(self) -> str:
         """Full inline render (loop-context callers and tests)."""
@@ -402,5 +409,7 @@ class MetricsServer:
             ).fetchone()
             fam("corro_db_gaps_versions_total", "gauge", [f"corro_db_gaps_versions_total {gapsum}"])
         except Exception:
-            pass  # scrape must never fail on a racing schema change
+            # scrape must never fail on a racing schema change — but
+            # the race itself is worth a trace when diagnosing one
+            log.debug("db sample scrape raced a schema change", exc_info=True)
         return "\n".join(lines) + "\n"

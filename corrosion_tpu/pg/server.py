@@ -124,7 +124,8 @@ class PgServer:
                 writer.close()
                 await writer.wait_closed()
             except Exception:
-                pass
+                # best-effort close of a dead session conn; trace it
+                log.debug("pg conn close failed", exc_info=True)
 
 
 class _Session:

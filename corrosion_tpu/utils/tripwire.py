@@ -101,6 +101,9 @@ async def preemptible(aw: Awaitable, tripwire: Tripwire) -> Outcome:
         task.cancel()
         try:
             await task
+        # corrolint: disable=CT006 — the task is being preempted: its
+        # outcome (including any in-flight exception) is deliberately
+        # discarded in favor of the PREEMPTED verdict below
         except (asyncio.CancelledError, Exception):
             pass
         return Outcome.PREEMPTED

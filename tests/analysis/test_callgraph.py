@@ -1,0 +1,214 @@
+"""Unit tests for the jit-seeded call graph behind CT002 (ISSUE 10):
+seed detection across this repo's decorator spellings, cross-module
+edge resolution through relative imports, function-reference edges
+(loop bodies), and the nested-def reachability contract."""
+
+import textwrap
+
+from corrosion_tpu.analysis.callgraph import CallGraph, ModuleIndex, module_name
+from corrosion_tpu.analysis.core import SourceFile
+
+
+def sf(tmp_path, rel, source):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return SourceFile(str(tmp_path), rel)
+
+
+def test_module_name_mapping():
+    assert module_name("corrosion_tpu/sim/round.py") == "corrosion_tpu.sim.round"
+    assert module_name("corrosion_tpu/topo/__init__.py") == "corrosion_tpu.topo"
+
+
+def test_canonical_name_resolution(tmp_path):
+    f = sf(
+        tmp_path,
+        "corrosion_tpu/sim/m.py",
+        """
+        import numpy as np
+        from jax import random as jrandom
+
+        def f(key):
+            np.asarray(key)
+            jrandom.bits(key, (4,))
+        """,
+    )
+    idx = ModuleIndex(f)
+    import ast
+
+    calls = [n for n in ast.walk(f.tree) if isinstance(n, ast.Call)]
+    assert sorted(idx.canonical(c.func) for c in calls) == [
+        "jax.random.bits",
+        "numpy.asarray",
+    ]
+
+
+def test_seed_detection_all_decorator_spellings(tmp_path):
+    f = sf(
+        tmp_path,
+        "corrosion_tpu/sim/m.py",
+        """
+        import functools
+
+        import jax
+        from functools import partial
+
+        @jax.jit
+        def a(x):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("cfg",))
+        def b(x, cfg):
+            return x
+
+        @partial(jax.jit, static_argnames=("n",))
+        def c(x, n):
+            return x
+
+        def host(x):
+            return x
+        """,
+    )
+    g = CallGraph([f])
+    assert sorted(s.qualname for s in g.seeds()) == ["a", "b", "c"]
+
+
+def test_cross_module_reachability_via_relative_import(tmp_path):
+    helpers = sf(
+        tmp_path,
+        "corrosion_tpu/sim/helpers.py",
+        """
+        def inner(x):
+            return x
+
+        def outer(x):
+            return inner(x)
+        """,
+    )
+    kern = sf(
+        tmp_path,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import jax
+
+        from .helpers import outer
+
+        @jax.jit
+        def run(x):
+            return outer(x)
+
+        def host(x):
+            return outer(x)
+        """,
+    )
+    g = CallGraph([helpers, kern])
+    reach = g.reachable_from_jit()
+    assert ("corrosion_tpu.sim.helpers", "outer") in reach
+    assert ("corrosion_tpu.sim.helpers", "inner") in reach  # transitive
+    assert ("corrosion_tpu.sim.kern", "host") not in reach
+
+
+def test_function_reference_args_are_edges(tmp_path):
+    kern = sf(
+        tmp_path,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import jax
+
+        def body(i, c):
+            return c
+
+        @jax.jit
+        def run(x):
+            return jax.lax.fori_loop(0, 3, body, x)
+        """,
+    )
+    g = CallGraph([kern])
+    assert ("corrosion_tpu.sim.kern", "body") in g.reachable_from_jit()
+
+
+def test_nested_defs_of_seed_are_reachable(tmp_path):
+    kern = sf(
+        tmp_path,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import jax
+
+        @jax.jit
+        def run(x):
+            def body(i, c):
+                return c
+            return jax.lax.fori_loop(0, 3, body, x)
+
+        def host(x):
+            def local(y):
+                return y
+            return local(x)
+        """,
+    )
+    g = CallGraph([kern])
+    reach = g.reachable_from_jit()
+    assert ("corrosion_tpu.sim.kern", "run.body") in reach
+    # nested defs of NON-reachable hosts stay out
+    assert ("corrosion_tpu.sim.kern", "host.local") not in reach
+
+
+def test_package_init_relative_imports_resolve_at_package_level(tmp_path):
+    """Regression: a package __init__ IS its own package — its
+    `from .x import y` must resolve to corrosion_tpu.sim.x, not one
+    level too high (which silently dropped CT002 edges through
+    package re-exports)."""
+    helpers = sf(
+        tmp_path,
+        "corrosion_tpu/sim/helpers.py",
+        """
+        def fold(c):
+            return c.item()
+        """,
+    )
+    init = sf(
+        tmp_path,
+        "corrosion_tpu/sim/__init__.py",
+        """
+        import jax
+
+        from .helpers import fold
+
+        @jax.jit
+        def run(x):
+            return fold(x)
+        """,
+    )
+    idx = ModuleIndex(init)
+    assert idx.aliases["fold"] == "corrosion_tpu.sim.helpers.fold"
+    g = CallGraph([helpers, init])
+    assert ("corrosion_tpu.sim.helpers", "fold") in g.reachable_from_jit()
+
+
+def test_real_repo_round_loops_are_covered():
+    """The graph over the real sim tier must see the round kernels —
+    the CT002 'zero findings' verdict is only meaningful if the seeds
+    and the hot path actually resolve (a silently empty graph would
+    pass everything)."""
+    import os
+
+    from corrosion_tpu.analysis.core import LintContext, collect_files
+    from corrosion_tpu.analysis.rules import SIM_TIER
+
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    ctx = LintContext(root, collect_files(root))
+    files = [f for f in ctx.under(*SIM_TIER) if f.tree is not None]
+    g = CallGraph(files)
+    assert len(g.seeds()) >= 4  # run_to_convergence/run_fault_plan/...
+    reach = g.reachable_from_jit()
+    for key in [
+        ("corrosion_tpu.sim.round", "round_step"),
+        ("corrosion_tpu.sim.packed", "packed_round_step"),
+        ("corrosion_tpu.sim.broadcast", "broadcast_step"),
+        ("corrosion_tpu.sim.topology", "aligned_u8_bits"),
+        ("corrosion_tpu.topo.sampler", "peerswap_step"),
+    ]:
+        assert key in reach, key

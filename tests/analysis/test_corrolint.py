@@ -1,0 +1,591 @@
+"""Per-rule fixture tests for corrolint (ISSUE 10).
+
+Each rule gets the bad-snippet-flagged / good-snippet-clean /
+pragma-suppresses triple over a synthetic repo tree, so rule scope and
+pragma semantics are pinned independently of the real repo's state
+(tests/analysis/test_lint_cli.py pins THAT via the self-lint test).
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from corrosion_tpu.analysis import run_lint
+from corrosion_tpu.analysis.rules import (
+    BlockingCallInAsync,
+    BroadExceptSwallow,
+    HostSyncInKernel,
+    MetaKeyShadow,
+    NondeterminismInSimTier,
+    UnalignedU8Draw,
+)
+from corrosion_tpu.analysis.specdrift import SpecHashDrift
+
+
+def write(root, rel, source):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return path
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    """A minimal fixture repo: just the package dir the walker needs."""
+    (tmp_path / "corrosion_tpu").mkdir()
+    (tmp_path / "corrosion_tpu" / "__init__.py").write_text("")
+    return tmp_path
+
+
+def lint(repo, rule_cls):
+    return run_lint(str(repo), rules=[rule_cls()])
+
+
+# -- CT001 unaligned-u8-draw -------------------------------------------------
+
+
+def test_ct001_flags_raw_bits_draw(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/draws.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def loss_mask(key, shape):
+            return jax.random.bits(key, shape, dtype=jnp.uint8)
+        """,
+    )
+    res = lint(repo, UnalignedU8Draw)
+    assert [f.rule for f in res.findings] == ["CT001"]
+    assert "aligned_u8_bits" in res.findings[0].message
+
+
+def test_ct001_aliased_import_cannot_dodge(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/draws.py",
+        """
+        from jax import random as jrandom
+
+        def loss_mask(key, shape):
+            return jrandom.bits(key, shape)
+        """,
+    )
+    assert len(lint(repo, UnalignedU8Draw).findings) == 1
+
+
+def test_ct001_blessed_site_and_good_draws_clean(repo):
+    # the ONE blessed implementation is exempt...
+    write(
+        repo,
+        "corrosion_tpu/sim/topology.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def aligned_u8_bits(key, shape):
+            return jax.random.bits(key, (4,), dtype=jnp.uint32)
+        """,
+    )
+    # ...and non-bits draws (randint/uniform: word-atom dtypes) are fine
+    write(
+        repo,
+        "corrosion_tpu/sim/kernels.py",
+        """
+        import jax
+
+        def pick(key, n):
+            return jax.random.randint(key, (n,), 0, n)
+        """,
+    )
+    assert lint(repo, UnalignedU8Draw).clean
+
+
+def test_ct001_host_tier_out_of_scope(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/hosty.py",
+        """
+        import jax
+
+        def f(key):
+            return jax.random.bits(key, (4,))
+        """,
+    )
+    assert lint(repo, UnalignedU8Draw).clean
+
+
+def test_pragma_star_disables_all_rules(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/draws.py",
+        """
+        import jax
+
+        def f(key):
+            return jax.random.bits(key, (4,))  # corrolint: disable=*
+        """,
+    )
+    res = lint(repo, UnalignedU8Draw)
+    assert res.clean and res.suppressed == 1
+
+
+def test_ct001_pragma_suppresses(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/draws.py",
+        """
+        import jax
+
+        def f(key):
+            # corrolint: disable=CT001 — fixture-justified exception
+            return jax.random.bits(key, (4,))
+        """,
+    )
+    res = lint(repo, UnalignedU8Draw)
+    assert res.clean and res.suppressed == 1
+
+
+# -- CT002 host-sync-in-kernel ----------------------------------------------
+
+
+def test_ct002_flags_sync_reachable_from_jit(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import functools
+
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        def host_only(x):
+            return np.asarray(x)
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def run(x, n):
+            return helper(x)
+        """,
+    )
+    res = lint(repo, HostSyncInKernel)
+    assert len(res.findings) == 1
+    assert "helper" in res.findings[0].message  # host_only NOT flagged
+
+
+def test_ct002_cross_module_and_loop_body_reachability(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/helpers.py",
+        """
+        def fold(c):
+            return c.item()
+        """,
+    )
+    write(
+        repo,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import jax
+
+        from .helpers import fold
+
+        @jax.jit
+        def run(x):
+            def body(i, c):
+                return fold(c)
+            return jax.lax.fori_loop(0, 3, body, x)
+        """,
+    )
+    res = lint(repo, HostSyncInKernel)
+    assert [f.path for f in res.findings] == ["corrosion_tpu/sim/helpers.py"]
+    assert ".item()" in res.findings[0].message
+
+
+def test_ct002_unreachable_sync_clean(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/runner2.py",
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def run(x):
+            return x + 1
+
+        def measure(x):
+            out = run(x)
+            jax.block_until_ready(out)
+            return np.asarray(out)
+        """,
+    )
+    assert lint(repo, HostSyncInKernel).clean
+
+
+# -- CT003 nondeterminism-in-sim-tier ---------------------------------------
+
+
+def test_ct003_flags_ambient_entropy(repo):
+    write(
+        repo,
+        "corrosion_tpu/campaign/sched.py",
+        """
+        import os
+        import random
+        import time
+
+        import numpy as np
+
+        def jitter():
+            return time.time() + random.random() + np.random.rand()
+
+        def token():
+            return os.urandom(8)
+        """,
+    )
+    res = lint(repo, NondeterminismInSimTier)
+    assert sorted(
+        m for f in res.findings for m in [f.message.split()[1]]
+    ) == ["numpy.random.rand", "os.urandom", "random.random", "time.time"]
+
+
+def test_ct003_monotonic_wall_clock_allowed(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/walls.py",
+        """
+        import time
+
+        def wall():
+            t0 = time.monotonic()
+            return time.monotonic() - t0, time.perf_counter()
+        """,
+    )
+    assert lint(repo, NondeterminismInSimTier).clean
+
+
+def test_ct003_jax_random_not_confused_with_stdlib(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/rng.py",
+        """
+        from jax import random
+
+        def draw(key):
+            return random.uniform(key, (4,))
+        """,
+    )
+    assert lint(repo, NondeterminismInSimTier).clean
+
+
+def test_ct003_host_tier_out_of_scope(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/clocky.py",
+        """
+        import time
+
+        def now():
+            return time.time()
+        """,
+    )
+    assert lint(repo, NondeterminismInSimTier).clean
+
+
+# -- CT004 meta-key-shadow ---------------------------------------------------
+
+
+_SIMCONFIG_FIXTURE = """
+import dataclasses
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    n_nodes: int
+    n_writers: int = 1
+    fanout: int = 3
+"""
+
+
+def test_ct004_undeclared_shadow_flagged(repo):
+    write(repo, "corrosion_tpu/sim/state.py", _SIMCONFIG_FIXTURE)
+    write(
+        repo,
+        "corrosion_tpu/campaign/spec.py",
+        """
+        _SCENARIO_META_KEYS = (
+            "serving",
+            "n_writers",
+        )
+        _TOPOLOGY_KEYS = ("loss",)
+        """,
+    )
+    res = lint(repo, MetaKeyShadow)
+    assert len(res.findings) == 1
+    assert "n_writers" in res.findings[0].message
+    # anchored at the offending key's own line
+    assert res.findings[0].line == 4
+
+
+def test_ct004_forwarded_declaration_clean(repo):
+    write(repo, "corrosion_tpu/sim/state.py", _SIMCONFIG_FIXTURE)
+    write(
+        repo,
+        "corrosion_tpu/campaign/spec.py",
+        """
+        _SCENARIO_META_KEYS = ("serving", "n_writers")
+        _TOPOLOGY_KEYS = ("loss",)
+        FORWARDED_META_KEYS = ("n_writers",)
+        """,
+    )
+    assert lint(repo, MetaKeyShadow).clean
+
+
+def test_ct004_topology_keys_checked_too(repo):
+    write(repo, "corrosion_tpu/sim/state.py", _SIMCONFIG_FIXTURE)
+    write(
+        repo,
+        "corrosion_tpu/campaign/spec.py",
+        """
+        _SCENARIO_META_KEYS = ("serving",)
+        _TOPOLOGY_KEYS = ("fanout",)
+        FORWARDED_META_KEYS = ("n_writers",)
+        """,
+    )
+    res = lint(repo, MetaKeyShadow)
+    assert len(res.findings) == 1 and "fanout" in res.findings[0].message
+
+
+# -- CT005 blocking-call-in-async -------------------------------------------
+
+
+def test_ct005_flags_blocking_in_async(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/loopy.py",
+        """
+        import sqlite3
+        import time
+
+        async def tick(conn):
+            time.sleep(0.1)
+            conn.set_authorizer(None)
+            db = sqlite3.connect(":memory:")
+            return db
+        """,
+    )
+    res = lint(repo, BlockingCallInAsync)
+    hits = sorted(f.message.split()[1] for f in res.findings)
+    assert hits == [".set_authorizer(...)", "sqlite3.connect", "time.sleep"]
+
+
+def test_ct005_sync_def_and_executor_nested_clean(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/loopy.py",
+        """
+        import asyncio
+        import time
+
+        def sync_tick():
+            time.sleep(0.1)
+
+        async def tick():
+            def blocking():
+                time.sleep(0.1)  # runs on an executor thread
+            await asyncio.to_thread(blocking)
+            await asyncio.sleep(0.1)
+        """,
+    )
+    assert lint(repo, BlockingCallInAsync).clean
+
+
+def test_ct005_sim_tier_out_of_scope(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/async_util.py",
+        """
+        import time
+
+        async def tick():
+            time.sleep(0.1)
+        """,
+    )
+    assert lint(repo, BlockingCallInAsync).clean
+
+
+def test_ct005_pragma_suppresses(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/loopy.py",
+        """
+        import time
+
+        async def tick():
+            # corrolint: disable=CT005 — fixture-justified exception
+            time.sleep(0.1)
+        """,
+    )
+    res = lint(repo, BlockingCallInAsync)
+    assert res.clean and res.suppressed == 1
+
+
+# -- CT006 broad-except-swallow ---------------------------------------------
+
+
+def test_ct006_flags_silent_swallow(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/swallow.py",
+        """
+        def f(x):
+            try:
+                return x()
+            except Exception:
+                pass
+        """,
+    )
+    res = lint(repo, BroadExceptSwallow)
+    assert [f.rule for f in res.findings] == ["CT006"]
+
+
+def test_ct006_bare_except_flagged_narrow_clean(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/swallow.py",
+        """
+        def f(x):
+            try:
+                return x()
+            except:
+                pass
+
+        def g(x):
+            try:
+                return x()
+            except KeyError:
+                pass
+        """,
+    )
+    res = lint(repo, BroadExceptSwallow)
+    assert len(res.findings) == 1 and res.findings[0].line == 5
+
+
+def test_ct006_log_raise_or_bound_use_clean(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/handled.py",
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def logged(x):
+            try:
+                return x()
+            except Exception:
+                log.debug("failed", exc_info=True)
+
+        def reraised(x):
+            try:
+                return x()
+            except Exception:
+                raise
+
+        def routed(x, report):
+            try:
+                return x()
+            except Exception as e:
+                report.append(repr(e))
+        """,
+    )
+    assert lint(repo, BroadExceptSwallow).clean
+
+
+def test_ct006_pragma_in_comment_block_above(repo):
+    write(
+        repo,
+        "corrosion_tpu/agent/swallow.py",
+        """
+        def f(x):
+            try:
+                return x()
+            # corrolint: disable=CT006 — fixture: two-line justified
+            # comment directly above the handler
+            except Exception:
+                pass
+        """,
+    )
+    res = lint(repo, BroadExceptSwallow)
+    assert res.clean and res.suppressed == 1
+
+
+def test_ct006_sim_tier_out_of_scope(repo):
+    write(
+        repo,
+        "corrosion_tpu/sim/simmy.py",
+        """
+        def f(x):
+            try:
+                return x()
+            except Exception:
+                pass
+        """,
+    )
+    assert lint(repo, BroadExceptSwallow).clean
+
+
+# -- CT007 spec-hash drift ---------------------------------------------------
+
+
+def _spec_artifact():
+    from corrosion_tpu.campaign.spec import builtin_spec
+
+    spec = builtin_spec("fault-parity-3node")
+    return {"spec": spec.to_dict(), "spec_hash": spec.spec_hash()}
+
+
+def test_ct007_matching_baseline_clean(repo):
+    art = _spec_artifact()
+    write(
+        repo,
+        "doc/experiments/CAMPAIGN_BASELINE_fault-parity-3node.json",
+        json.dumps(art),
+    )
+    assert lint(repo, SpecHashDrift).clean
+
+
+def test_ct007_hash_drift_flagged(repo):
+    art = _spec_artifact()
+    art["spec_hash"] = "0" * 16
+    write(
+        repo,
+        "doc/experiments/CAMPAIGN_BASELINE_fault-parity-3node.json",
+        json.dumps(art),
+    )
+    res = lint(repo, SpecHashDrift)
+    assert len(res.findings) == 1
+    assert "spec-hash drift" in res.findings[0].message
+
+
+def test_ct007_builtin_drift_flagged(repo):
+    # the embedded spec self-hashes fine, but no longer matches the
+    # builtin of the same name — the changed-builtin-without-baseline-
+    # regeneration case
+    art = _spec_artifact()
+    art["spec"]["max_rounds"] = art["spec"]["max_rounds"] + 1
+    from corrosion_tpu.campaign.spec import CampaignSpec
+
+    art["spec_hash"] = CampaignSpec.from_dict(art["spec"]).spec_hash()
+    write(
+        repo,
+        "doc/experiments/CAMPAIGN_BASELINE_fault-parity-3node.json",
+        json.dumps(art),
+    )
+    res = lint(repo, SpecHashDrift)
+    assert len(res.findings) == 1
+    assert "builtin drift" in res.findings[0].message

@@ -1,0 +1,195 @@
+"""CLI hygiene + baseline workflow + the self-lint gate (ISSUE 10).
+
+- ``sim lint`` / ``python -m corrosion_tpu.analysis`` exit 0 clean,
+  1 on non-baselined findings, 2 on usage errors;
+- findings print as clickable ``file:line`` refs;
+- ``--baseline-write`` is deterministic (byte-identical reruns) and
+  content-stable (fingerprints survive line-number shifts);
+- the repo itself lints CLEAN against the committed baseline — the
+  acceptance gate CI runs (an injected violation turns it red).
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from corrosion_tpu.analysis import (
+    BASELINE_NAME,
+    load_baseline,
+    run_lint,
+)
+from corrosion_tpu.analysis.__main__ import lint_main
+from corrosion_tpu.analysis.core import write_baseline
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def write(root, rel, source):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return path
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    (tmp_path / "corrosion_tpu").mkdir()
+    (tmp_path / "corrosion_tpu" / "__init__.py").write_text("")
+    return tmp_path
+
+
+_VIOLATION = """
+def f(x):
+    try:
+        return x()
+    except Exception:
+        pass
+"""
+
+
+# -- exit codes --------------------------------------------------------------
+
+
+def test_exit_zero_on_clean_tree(repo, capsys):
+    assert lint_main(["--root", str(repo)]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_exit_one_on_injected_violation(repo, capsys):
+    """The CI gate's red: a fresh violation NOT in the baseline fails
+    the run (this is the injected-violation acceptance check)."""
+    write(repo, "corrosion_tpu/agent/bad.py", _VIOLATION)
+    assert lint_main(["--root", str(repo)]) == 1
+    out = capsys.readouterr().out
+    # clickable file:line ref, rule code attached
+    assert "corrosion_tpu/agent/bad.py:5: CT006" in out
+
+
+def test_exit_two_on_usage_errors(repo, capsys, tmp_path):
+    assert lint_main(["--frobnicate"]) == 2
+    assert lint_main(["--root", str(tmp_path / "nowhere")]) == 2
+    # explicit --baseline pointing nowhere is a usage error, not an
+    # empty baseline: CI must not silently pass on a typo'd path
+    assert (
+        lint_main(
+            ["--root", str(repo), "--baseline", str(tmp_path / "nope.json")]
+        )
+        == 2
+    )
+
+
+def test_exit_two_on_corrupt_baseline(repo, tmp_path, capsys):
+    """A truncated / merge-conflicted baseline must be a usage error
+    (exit 2), not a traceback and not a fake findings-red."""
+    bad = tmp_path / "corrupt.json"
+    bad.write_text("{not json")
+    assert lint_main(["--root", str(repo), "--baseline", str(bad)]) == 2
+    assert "unreadable baseline" in capsys.readouterr().err
+
+
+def test_cli_sim_lint_dispatch(capsys):
+    """`sim lint` routes to the same implementation jax-free (exit 0
+    against the committed repo baseline) and refuses subcommands."""
+    from corrosion_tpu.cli.main import main
+
+    assert main(["sim", "lint"]) == 0
+    assert main(["sim", "lint", "run"]) == 2
+
+
+def test_json_format(repo, capsys):
+    write(repo, "corrosion_tpu/agent/bad.py", _VIOLATION)
+    assert lint_main(["--root", str(repo), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["clean"] is False
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "CT006"
+    assert finding["path"] == "corrosion_tpu/agent/bad.py"
+    assert finding["fingerprint"]
+
+
+# -- baseline workflow -------------------------------------------------------
+
+
+def test_baseline_write_then_clean(repo, capsys):
+    write(repo, "corrosion_tpu/agent/bad.py", _VIOLATION)
+    bl = repo / BASELINE_NAME
+    assert lint_main(["--root", str(repo), "--baseline-write"]) == 0
+    assert bl.exists()
+    # the accepted finding no longer fails the gate...
+    assert lint_main(["--root", str(repo)]) == 0
+    # ...but --no-baseline still reports it
+    assert lint_main(["--root", str(repo), "--no-baseline"]) == 1
+
+
+def test_baseline_write_deterministic(repo, capsys):
+    write(repo, "corrosion_tpu/agent/bad.py", _VIOLATION)
+    write(repo, "corrosion_tpu/agent/worse.py", _VIOLATION + _VIOLATION)
+    bl = repo / BASELINE_NAME
+    assert lint_main(["--root", str(repo), "--baseline-write"]) == 0
+    first = bl.read_bytes()
+    assert lint_main(["--root", str(repo), "--baseline-write"]) == 0
+    assert bl.read_bytes() == first  # byte-identical regeneration
+
+
+def test_fingerprints_survive_line_shifts(repo):
+    path = write(repo, "corrosion_tpu/agent/bad.py", _VIOLATION)
+    res1 = run_lint(str(repo))
+    # prepend unrelated lines: line numbers move, identity must not
+    path.write_text("# a comment\n\nX = 1\n" + path.read_text())
+    res2 = run_lint(str(repo))
+    assert [f.fingerprint for f in res1.findings] == [
+        f.fingerprint for f in res2.findings
+    ]
+    assert res1.findings[0].line != res2.findings[0].line
+
+
+def test_identical_lines_get_distinct_stable_fingerprints(repo):
+    write(repo, "corrosion_tpu/agent/worse.py", _VIOLATION + _VIOLATION)
+    res = run_lint(str(repo))
+    prints = [f.fingerprint for f in res.findings]
+    assert len(prints) == 2 and len(set(prints)) == 2
+    # editing the FLAGGED line re-surfaces it (identity folds the text)
+    res2 = run_lint(str(repo))
+    assert [f.fingerprint for f in res2.findings] == prints
+
+
+def test_baseline_roundtrip(repo, tmp_path):
+    write(repo, "corrosion_tpu/agent/bad.py", _VIOLATION)
+    res = run_lint(str(repo))
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), res)
+    loaded = load_baseline(str(bl))
+    assert set(loaded) == {f.fingerprint for f in res.findings}
+    res2 = run_lint(str(repo), baseline=loaded)
+    assert res2.clean and len(res2.baselined) == 1
+
+
+# -- the self-lint gate ------------------------------------------------------
+
+
+def test_repo_is_clean_against_committed_baseline():
+    """THE acceptance gate: zero non-baselined findings at HEAD.  A new
+    violation anywhere in corrosion_tpu/ (or a drifted campaign
+    baseline) fails this test — and the CI lint job — until it is
+    fixed, pragma'd with a justification, or deliberately baselined."""
+    baseline = load_baseline(os.path.join(REPO_ROOT, BASELINE_NAME))
+    result = run_lint(REPO_ROOT, baseline=baseline)
+    assert result.findings == [], "\n".join(
+        f"{f.ref()}: {f.rule} {f.message}" for f in result.findings
+    )
+    # the framework actually looked at the repo
+    assert result.checked_files > 50
+
+
+def test_committed_baseline_is_current():
+    """Every committed baseline entry still matches a live finding —
+    stale entries (the finding was fixed but the baseline kept the
+    amnesty) would silently re-admit the bug class."""
+    baseline = load_baseline(os.path.join(REPO_ROOT, BASELINE_NAME))
+    result = run_lint(REPO_ROOT, baseline=baseline)
+    live = {f.fingerprint for f in result.baselined}
+    assert set(baseline) == live

@@ -1,0 +1,73 @@
+"""Runtime twin of corrolint CT004 (ISSUE 10 satellite): a campaign
+meta key that shadows a real SimConfig field must be DECLARED in
+``spec.FORWARDED_META_KEYS`` or ``sim_config()`` refuses loudly —
+reconstructing the ISSUE 9 ``n_writers`` incident, where the undeclared
+collision silently stripped the key from sim cells and the frontier
+campaign measured a 1-writer workload for a full PR."""
+
+import pytest
+
+import corrosion_tpu.campaign.spec as spec_mod
+from corrosion_tpu.campaign.spec import (
+    FORWARDED_META_KEYS,
+    CampaignSpec,
+    builtin_spec,
+)
+
+
+def test_n_writers_reaches_sim_config():
+    """The ISSUE 9 fix, now guarded: the frontier spec's declared
+    4-writer workload must land in the cell's SimConfig."""
+    spec = builtin_spec("peer-sampler-frontier")
+    cfg = spec.sim_config(spec.cells()[0])
+    assert cfg.n_writers == 4
+
+
+def test_forwarded_keys_are_real_meta_and_config_keys():
+    """The allowlist only makes sense for keys living in BOTH worlds —
+    an entry that stops being a meta key or a SimConfig field is stale
+    and should be removed."""
+    from corrosion_tpu.sim.state import SimConfig
+
+    fields = SimConfig.__dataclass_fields__
+    for k in FORWARDED_META_KEYS:
+        assert k in spec_mod._SCENARIO_META_KEYS + spec_mod._TOPOLOGY_KEYS
+        assert k in fields
+
+
+def test_undeclared_shadow_refused(monkeypatch):
+    """Incident reconstruction: introduce a meta key colliding with a
+    real SimConfig field WITHOUT declaring it forwarded — building any
+    sim cell's config must refuse, not silently strip (pre-guard, the
+    key would vanish and the cell would measure the wrong workload)."""
+    monkeypatch.setattr(
+        spec_mod,
+        "_SCENARIO_META_KEYS",
+        spec_mod._SCENARIO_META_KEYS + ("fanout",),
+    )
+    spec = CampaignSpec(
+        name="guard-test",
+        scenario={"n_nodes": 3, "n_payloads": 4, "fanout": 2},
+    )
+    with pytest.raises(ValueError, match="fanout.*FORWARDED_META_KEYS"):
+        spec.sim_config(spec.cells()[0])
+
+
+def test_declared_forwarding_heals_the_refusal(monkeypatch):
+    """Same collision, but DECLARED: the key must flow into SimConfig
+    (the allowlist is a forwarding contract, not a mute button)."""
+    monkeypatch.setattr(
+        spec_mod,
+        "_SCENARIO_META_KEYS",
+        spec_mod._SCENARIO_META_KEYS + ("fanout",),
+    )
+    monkeypatch.setattr(
+        spec_mod,
+        "FORWARDED_META_KEYS",
+        spec_mod.FORWARDED_META_KEYS + ("fanout",),
+    )
+    spec = CampaignSpec(
+        name="guard-test",
+        scenario={"n_nodes": 3, "n_payloads": 4, "fanout": 2},
+    )
+    assert spec.sim_config(spec.cells()[0]).fanout == 2
