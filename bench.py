@@ -57,6 +57,7 @@ _fault_storm: dict | None = None
 _tier_1m: dict | None = None
 _serving: dict | None = None
 _topo_frontier: dict | None = None
+_proto_frontier: dict | None = None
 _printed = False
 _diag: dict = {"attempts": [], "preflight": None, "started_unix": time.time()}
 
@@ -105,6 +106,12 @@ def _emit_and_exit(code: int = 0) -> None:
     # the paper-grounded sampler comparison, tracked per bench run
     if _topo_frontier is not None:
         out["peer_sampler_frontier"] = _topo_frontier
+    # protocol frontier rung (ISSUE 11): four named protocol variants ×
+    # two topologies reduced to per-family rounds/wire ratios vs the
+    # baseline point, plus the storm-scale PeerSwap sampler cell — the
+    # protocol-space Pareto, tracked per bench run
+    if _proto_frontier is not None:
+        out["protocol_frontier"] = _proto_frontier
     print(json.dumps(out), flush=True)
     _write_diag()
     os._exit(code)
@@ -480,6 +487,48 @@ def main() -> int:
                 "wall_clock_s": m.get("wall_clock_s"),
             }
             _diag["peer_sampler_frontier"] = {"nodes": tf_nodes, **m}
+        _write_diag()
+
+    # protocol frontier rung (ISSUE 11): the protocol-variant campaign
+    # (baseline / swarm-aggressive / push-pull / lab-ordered × wan-3x2 ×
+    # flat-lossy, wire bytes banded) reduced to per-family rounds/wire
+    # ratios vs baseline, PLUS a storm-scale (≥25k-node) PeerSwap
+    # sampler cell so the sampler frontier's 96-node rung stops being
+    # the only sampler number.  CPU-pinned like the sampler rung (the
+    # campaign is small-dense; the storm cell is the packed CPU shape
+    # the gapstress rung already budgets), its own child so a hang
+    # can't eat the storm budget.
+    global _proto_frontier
+    if os.environ.get("BENCH_PROTO", "1") != "0" and _remaining() > 300:
+        pf_nodes = int(os.environ.get("BENCH_PROTO_NODES", "96"))
+        pf_storm = int(os.environ.get("BENCH_PROTO_STORM_NODES", "25600"))
+        res = run_child(
+            {
+                "mode": "aux",
+                "platform": "cpu",
+                "fn": "config_protocol_frontier",
+                "seed": 1,
+                "kwargs": {
+                    "n_nodes": pf_nodes,
+                    "sampler_storm_nodes": pf_storm,
+                },
+            },
+            timeout=min(_remaining() - 60, 900.0),
+        )
+        _diag["attempts"].append(
+            {"phase": "protocol_frontier", "nodes": pf_nodes, **res}
+        )
+        m = res.get("metrics") or {}
+        if res.get("ok") and m.get("converged"):
+            _proto_frontier = {
+                "metric": f"protocol_frontier_{pf_nodes}node",
+                "families": m.get("families"),
+                "sampler_storm": m.get("sampler_storm"),
+                "spec_hash": m.get("spec_hash"),
+                "result_digest": m.get("result_digest"),
+                "wall_clock_s": m.get("wall_clock_s"),
+            }
+            _diag["protocol_frontier"] = {"nodes": pf_nodes, **m}
         _write_diag()
 
     # fault-storm rung (ISSUE 4): the headline storm shape under a

@@ -146,9 +146,13 @@ def _run_cell(
         # same rule as the CLI's axis flags).  The raw geo-tier keys
         # count too: a serving grid sweeping inter_loss would report
         # different params over the identical workload.
-        from .spec import _TOPOLOGY_KEYS
+        from .spec import _PROTO_KEYS, _TOPOLOGY_KEYS
 
-        for key in ("measure_wire", "churn", "topo_family") + _TOPOLOGY_KEYS:
+        for key in (
+            ("measure_wire", "churn", "topo_family", "proto_family")
+            + _TOPOLOGY_KEYS
+            + _PROTO_KEYS
+        ):
             if spec._meta(cell, key):
                 raise ValueError(
                     f"{key!r} is not supported on host-serving cells"
@@ -207,6 +211,19 @@ def _run_cell(
             "churn schedules are not supported on detect_membership "
             "cells (the detection ensemble runs without a FaultPlan)"
         )
+    if detect:
+        # the protocol axes (ISSUE 11) shape PAYLOAD dissemination; a
+        # detect cell bands detect_round and would silently measure
+        # nothing on that axis — same loud-refusal rule as measure_wire
+        from .spec import _PROTO_KEYS
+
+        for key in ("proto_family",) + _PROTO_KEYS:
+            if spec._meta(cell, key):
+                raise ValueError(
+                    f"{key!r} is not supported on detect_membership "
+                    "cells (the detection loop measures membership, "
+                    "not payload dissemination)"
+                )
     run_telemetry = bool(telemetry or measure_wire)
     plan = (
         None if detect else spec.fault_plan(cell, seed=spec.seeds[0])
@@ -311,6 +328,15 @@ def _run_cell(
                     )
                 per_seed["wire_bytes"] = wb
                 traces = lane_hosts
+            if cfg.ordering != "none":
+                # delivery-order invariant totals (ISSUE 11): counted
+                # on-device inside the jitted loop, surfaced per lane
+                # only on ordering cells — existing cells' payloads (and
+                # digests) are untouched.  Banded below via BAND_METRICS
+                # so a regression from 0 fails the nightly compare.
+                per_seed["order_violations"] = [
+                    int(v) for v in np.asarray(metrics.order_violations)
+                ]
         # the lane → convergence span tree (host-synthesized after the
         # vmapped run — lanes execute as ONE program, so their spans
         # carry outcomes, not per-lane walls)

@@ -26,12 +26,15 @@ from .spec import canonical_json, content_hash
 #: (ISSUE 8 — each lane's loadgen percentiles, in seconds);
 #: ``wire_bytes`` only on ``measure_wire`` cells (ISSUE 9 — the
 #: convergence-rounds × wire-bytes frontier's cost axis, deterministic
-#: integer-derived totals); `compare` skips bands a cell doesn't carry.
+#: integer-derived totals); ``order_violations`` only on ordering-
+#: variant cells (ISSUE 11 — the on-device delivery-order invariant's
+#: running total: 0 for the enforced discipline, so any regression
+#: pages); `compare` skips bands a cell doesn't carry.
 BAND_METRICS = (
     "rounds", "p99_node_convergence_round", "detect_round",
     "publish_visible_p50_s", "publish_visible_p95_s",
     "publish_visible_p99_s",
-    "wire_bytes",
+    "wire_bytes", "order_violations",
 )
 #: artifact keys excluded from the result digest (vary run to run —
 #: or run-CONFIG to run-config — without changing the campaign's
@@ -146,7 +149,16 @@ def compare(
             c = cand.get("bands", {}).get(m)
             if not b or not c:
                 continue
-            for q in quantiles:
+            # the delivery-order invariant additionally compares the
+            # MAX band: lower-method quantiles over a small seed set
+            # can all read 0 while one lane regressed to violations —
+            # "a violation count leaving zero pages" must mean ANY lane
+            qs = (
+                quantiles + ("max",)
+                if m == "order_violations"
+                else quantiles
+            )
+            for q in qs:
                 bv, cv = b.get(q), c.get(q)
                 if bv is None and cv is None:
                     worse, delta = False, None
@@ -156,6 +168,13 @@ def compare(
                     worse, delta = True, None
                 elif bv is None:
                     worse, delta = False, None  # candidate gained signal
+                elif m == "order_violations":
+                    # the delivery-order invariant is exact: an enforced
+                    # discipline's baseline is 0 and the round-wobble
+                    # tolerances must NOT let 1-2 violations pass — any
+                    # increase is a correctness regression, not noise
+                    delta = cv - bv
+                    worse = cv > bv
                 else:
                     delta = cv - bv
                     worse = cv > bv * (1.0 + tol_frac) + tol_abs

@@ -40,6 +40,20 @@ _TOPOLOGY_KEYS = (
 #: named-topology axis (ISSUE 9): resolves through
 #: `corrosion_tpu.topo.family_topology` before explicit keys overlay it
 _TOPO_FAMILY_KEY = "topo_family"
+#: named-protocol axis (ISSUE 11): resolves through
+#: `corrosion_tpu.proto.family_proto` before explicit keys overlay it
+_PROTO_FAMILY_KEY = "proto_family"
+#: the SimConfig protocol knobs a family bundles (ISSUE 11).  These are
+#: REAL SimConfig fields and deliberately NOT meta keys — they ride
+#: scenario/grid straight into SimConfig like `peer_sampler` does — so
+#: corrolint CT004 and the runtime shadow guard stay zero-entry
+#: (disjoint sets need no FORWARDED_META_KEYS declaration).  Listed
+#: here so the engine can refuse them loudly on cells that never build
+#: a SimConfig (serving) or ignore the payload path (detect).
+_PROTO_KEYS = (
+    "dissemination", "fanout_schedule", "fanout_decay_rounds",
+    "sync_cadence", "ordering",
+)
 #: spec-level (non-SimConfig) scenario keys:
 #: - ``inject_every`` — payload injection cadence;
 #: - ``wan_tuned`` — build the cell's SimConfig via `SimConfig.wan_tuned`
@@ -71,12 +85,16 @@ _TOPO_FAMILY_KEY = "topo_family"
 #:   sync) into ``per_seed.wire_bytes`` and band them: the engine arms
 #:   the flight recorder internally, so the metric is deterministic and
 #:   part of the replay digest whether or not ``--telemetry`` was given.
+#: - ``proto_family`` — named protocol-variant family (ISSUE 11;
+#:   `corrosion_tpu.proto.FAMILIES`), resolved by ``sim_config()`` into
+#:   SimConfig protocol knobs with explicit keys overlaying the family
+#:   (the `topo_family` compose rule applied to the protocol axis).
 _SCENARIO_META_KEYS = (
     "inject_every", "detect_membership", "kill_every",
     "serving", "n_writes", "n_writers", "n_watchers", "rate_hz",
     "settle_timeout_s", "use_faults",
     "topo_family", "churn", "churn_frac", "churn_round", "churn_seed",
-    "measure_wire",
+    "measure_wire", "proto_family",
 )
 
 #: serving-cell workload knobs → run_serving_cluster_load kwarg names
@@ -246,6 +264,10 @@ class CampaignSpec:
         kw = dict(self.scenario)
         kw.update(cell)
         wan = bool(kw.pop("wan_tuned", False))
+        # named protocol family (ISSUE 11): popped BEFORE the meta-key
+        # strip so its value survives; resolved AFTER it so the family's
+        # knobs land as SimConfig kwargs with explicit keys winning
+        proto_fam = kw.pop(_PROTO_FAMILY_KEY, None)
         # strip topology/meta keys — EXCEPT keys that are also real
         # SimConfig fields AND declared in FORWARDED_META_KEYS
         # (``n_writers`` doubles as a serving-cell workload knob; a sim
@@ -269,6 +291,15 @@ class CampaignSpec:
         for k in _TOPOLOGY_KEYS + _SCENARIO_META_KEYS + (_TOPO_FAMILY_KEY,):
             if k not in fields:
                 kw.pop(k, None)
+        if proto_fam:
+            # the family supplies the BASE protocol knobs, explicit
+            # scenario/cell keys overlay it — a grid can sweep families
+            # and still pin one knob across all of them (ISSUE 11; the
+            # `topo_family` compose-then-construct rule)
+            from ..proto import family_proto
+
+            for k, v in family_proto(str(proto_fam)).items():
+                kw.setdefault(k, v)
         if wan:
             # the runner configs' cluster-size-adaptive SWIM timing —
             # a spec routing one of them through the engine must build
@@ -342,6 +373,12 @@ class CampaignSpec:
         the flight recorder internally and records
         ``per_seed.wire_bytes`` deterministically."""
         return bool(self._meta(cell, "measure_wire", False))
+
+    def proto_family(self, cell: Dict[str, object]):
+        """The cell's named protocol family (ISSUE 11), or None —
+        `sim_config()` resolves it; the engine reads it for loud
+        refusals on cells that never run the dissemination kernels."""
+        return self._meta(cell, _PROTO_FAMILY_KEY)
 
     def churn_events_for(self, cell: Dict[str, object], n_nodes: int):
         """The cell's churn schedule as FaultPlan events (empty when no
@@ -571,6 +608,43 @@ def peer_sampler_frontier_spec(
     )
 
 
+def protocol_frontier_spec(
+    seeds: Sequence[int] = (0, 1, 2, 3),
+    n: int = 96,
+    max_rounds: int = 500,
+) -> CampaignSpec:
+    """The protocol-variant frontier (ISSUE 11): four named protocol
+    families — the legacy point, the SWARM-style eager-sync limit,
+    classic push-pull, and the leaderless-atomic-broadcast-shaped FIFO
+    ordering discipline — across two topology families (the geo-tiered
+    WAN grid and a flat lossy network), convergence rounds AND wire
+    bytes banded per lane.  The result is a measured convergence-rounds
+    × wire-bytes Pareto over the protocol design space: eager sync buys
+    rounds with wire, ordering pays both for delivery-order agreement
+    (its cells also band the on-device invariant's violation count,
+    which must sit at 0 for the enforced variant).  ``measure_wire``
+    makes the cost axis part of the replay digest; the committed
+    baseline lives at
+    doc/experiments/CAMPAIGN_BASELINE_protocol-frontier.json (CI
+    ``proto-smoke``)."""
+    return CampaignSpec(
+        name="protocol-frontier",
+        scenario={
+            "n_nodes": n, "n_payloads": 64, "n_writers": 4, "fanout": 3,
+            "sync_interval_rounds": 6, "n_delay_slots": 4,
+            "inject_every": 1, "measure_wire": 1,
+        },
+        grid={
+            "proto_family": [
+                "baseline", "swarm-aggressive", "push-pull", "lab-ordered",
+            ],
+            "topo_family": ["wan-3x2", "flat-lossy"],
+        },
+        seeds=tuple(seeds),
+        max_rounds=max_rounds,
+    )
+
+
 BUILTIN_SPECS = {
     "fault-parity-3node": fault_parity_3node_spec,
     "fault-campaign-3node": fault_campaign_3node_spec,
@@ -578,6 +652,7 @@ BUILTIN_SPECS = {
     "swim-churn-partial": swim_churn_partial_spec,
     "serving-3node": serving_3node_spec,
     "peer-sampler-frontier": peer_sampler_frontier_spec,
+    "protocol-frontier": protocol_frontier_spec,
 }
 
 

@@ -379,6 +379,11 @@ _SIM_SCENARIOS = {
     # topology families as a campaign, reduced to per-family rounds ×
     # wire-bytes ratios (the paper-grounded sampler comparison)
     "peer-sampler-frontier": "config_peer_sampler_frontier",
+    # the protocol-variant frontier (ISSUE 11): four named protocol
+    # families × two topologies as a campaign, reduced to per-family
+    # rounds/wire ratios vs the baseline point, plus a storm-scale
+    # PeerSwap sampler cell (the convergence × wire-bytes Pareto)
+    "protocol-frontier": "config_protocol_frontier",
 }
 
 
@@ -399,6 +404,11 @@ def cmd_sim(args) -> int:
         # jax-free; a tier table imports jax for the Topology dataclass
         # only (no op runs, so no backend/tunnel is touched)
         return cmd_topo(args)
+    if args.scenario == "proto":
+        # protocol-family introspection (ISSUE 11): entirely jax-free —
+        # the registry and its resolved-knob rendering are plain dicts
+        # (corrosion_tpu.proto imports no accelerator runtime)
+        return cmd_proto(args)
     # honor JAX_PLATFORMS even when an accelerator plugin would win over
     # the env var (jax.config takes precedence) — tests set cpu to keep
     # subprocess sims off the contended real chip
@@ -421,6 +431,16 @@ def cmd_sim(args) -> int:
         print(
             "error: --trace-dir is a campaign flag; scenario runs "
             "take --trace-out FILE",
+            file=sys.stderr,
+        )
+        return 2
+    if args.parity or args.round_s is not None:
+        # `trace` dispatched above — anything still here would silently
+        # ignore the join request (or its bucket width)
+        flag = "--parity" if args.parity else "--round-s"
+        print(
+            f"error: {flag} belongs to `sim trace show --parity` (it "
+            "joins a sim lane to its host-parity replay)",
             file=sys.stderr,
         )
         return 2
@@ -502,6 +522,28 @@ def _run_sim_scenario(args) -> int:
             )
             return 2
         kwargs["sampler"] = args.sampler
+    # protocol-variant axis (ISSUE 11): only scenarios whose config fn
+    # exposes it take the flag, and an unknown family exits 2 with the
+    # list (the PR 9 --topology rule) instead of a traceback
+    if args.proto:
+        if "proto_family" not in params:
+            print(
+                f"error: scenario {args.scenario!r} does not take "
+                "--proto (axis-aware scenarios: broadcast-1k, "
+                "write-storm-100k; `sim proto show` lists families)",
+                file=sys.stderr,
+            )
+            return 2
+        from ..proto import FAMILIES as _PROTO_FAMILIES
+
+        if args.proto not in _PROTO_FAMILIES:
+            print(
+                f"error: unknown protocol family {args.proto!r} "
+                f"(have {sorted(_PROTO_FAMILIES)})",
+                file=sys.stderr,
+            )
+            return 2
+        kwargs["proto_family"] = args.proto
     # flight recorder (ISSUE 5): --telemetry adds the summary block to
     # the record; --trace-out also writes the per-round JSONL artifact.
     # A scenario supports the recorder if its config fn takes `telemetry`
@@ -695,15 +737,70 @@ def cmd_topo(args) -> int:
     return 0
 
 
-def cmd_trace(args) -> int:
-    """`sim trace show --in FILE`: render a flight-recorder JSONL
-    artifact (header summary + a compact table) without touching jax —
-    the artifact is plain JSON lines.  Both tiers share one schema
-    (``kind: flight_recorder``): sim files carry per-ROUND rows, host
-    files (``tier: host`` — ISSUE 8) per-WRITE rows with the
-    publish→broadcast-out→apply→visible stage latencies."""
+def cmd_proto(args) -> int:
+    """`sim proto show [--proto FAM]`: render the protocol-variant
+    registry (ISSUE 11) — entirely jax-free (the families are plain
+    dicts of SimConfig protocol knobs; `corrosion_tpu.proto` imports no
+    accelerator runtime, mirroring `sim topo show`'s listing).  With
+    ``--proto``, print one family's knob overlay and its fully-resolved
+    protocol point (family over the documented defaults); without it,
+    list the registry."""
+    from ..proto import DEFAULTS, FAMILIES, family_proto
+
     if args.campaign_cmd != "show":
-        raise SystemExit("usage: sim trace show --in FILE [--json]")
+        raise SystemExit("usage: sim proto show [--proto FAM]")
+    if not args.proto:
+        out = {name: dict(kw) for name, kw in sorted(FAMILIES.items())}
+        if args.json:
+            _print_json({"families": out, "defaults": dict(DEFAULTS)})
+        else:
+            print("protocol families (sim proto show --proto NAME):")
+            for name, kw in out.items():
+                print(f"  {name}: {json.dumps(kw, sort_keys=True)}")
+            print(f"  defaults: {json.dumps(DEFAULTS, sort_keys=True)}")
+        return 0
+    try:
+        kw = family_proto(args.proto)
+    except KeyError:
+        print(
+            f"error: unknown protocol family {args.proto!r} "
+            f"(have {sorted(FAMILIES)})",
+            file=sys.stderr,
+        )
+        return 2
+    resolved = dict(DEFAULTS)
+    resolved.update(kw)
+    out = {
+        "family": args.proto,
+        "overlay": kw,
+        "resolved": resolved,
+    }
+    if args.json:
+        _print_json(out)
+        return 0
+    print(f"protocol family {args.proto!r}:")
+    print(f"  overlay:  {json.dumps(kw, sort_keys=True)}")
+    print(f"  resolved: {json.dumps(resolved, sort_keys=True)}")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """`sim trace show --in FILE [--parity HOST_FILE]`: render a
+    flight-recorder JSONL artifact (header summary + a compact table)
+    without touching jax — the artifact is plain JSON lines.  Both
+    tiers share one schema (``kind: flight_recorder``): sim files carry
+    per-ROUND rows, host files (``tier: host`` — ISSUE 8) per-WRITE
+    rows with the publish→broadcast-out→apply→visible stage latencies.
+
+    ``--parity`` (ISSUE 11 carried edge) JOINS a sim lane to its
+    host-parity replay side-by-side: the host tier's per-write rows are
+    bucketed onto sim rounds via ``--round-s`` (the host wall-clock per
+    round — the campaign spec's ``round_s``, default 0.05), so parity
+    drift reads off one table instead of two unaligned renders."""
+    if args.campaign_cmd != "show":
+        raise SystemExit(
+            "usage: sim trace show --in FILE [--parity HOST_FILE] [--json]"
+        )
     if not args.in_path:
         raise SystemExit("sim trace show needs --in FILE")
     with open(args.in_path) as f:
@@ -711,6 +808,18 @@ def cmd_trace(args) -> int:
         rows = [json.loads(line) for line in f if line.strip()]
     if head.get("kind") != "flight_recorder":
         raise SystemExit(f"{args.in_path} is not a flight-recorder artifact")
+    if args.parity:
+        return _trace_show_parity(args, head, rows)
+    if args.round_s is not None:
+        # the bucket width only exists for the parity join — dropping
+        # it silently would be the no-op class the --parity refusal
+        # above exists to prevent
+        print(
+            "error: --round-s needs --parity HOST_FILE (it sets the "
+            "join's bucket width)",
+            file=sys.stderr,
+        )
+        return 2
     if args.json:
         _print_json({"header": head, "rounds": rows})
         return 0
@@ -748,6 +857,112 @@ def cmd_trace(args) -> int:
     print("  ".join(f"{c:>13}" for c in cols))
     for row in rows:
         print("  ".join(f"{row.get(c, ''):>13}" for c in cols))
+    return 0
+
+
+def _trace_show_parity(args, head: dict, rows: list) -> int:
+    """The ``sim trace show --parity`` join (ISSUE 11 carried edge):
+    one table, sim-lane per-round columns on the left, the host-parity
+    replay's per-write evidence bucketed onto the same rounds on the
+    right.  Both tiers already rendered separately; nothing joined
+    them, so debugging parity drift meant eyeballing two artifacts
+    against a mental clock — this puts the publish→visible latencies
+    next to the round that should have carried them."""
+    if head.get("tier") == "host":
+        print(
+            "error: --in must be the SIM-tier artifact when --parity "
+            "is given (the host file goes to --parity)",
+            file=sys.stderr,
+        )
+        return 2
+    with open(args.parity) as f:
+        phead = json.loads(f.readline())
+        prows = [json.loads(line) for line in f if line.strip()]
+    if (
+        phead.get("kind") != "flight_recorder"
+        or phead.get("tier") != "host"
+    ):
+        print(
+            f"error: {args.parity} is not a HOST-tier flight-recorder "
+            "artifact (--parity joins a sim lane to its host replay)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.round_s is not None and args.round_s <= 0:
+        # a non-positive bucket width would drop every host write into
+        # rounds the table never renders — the operator would read
+        # "host recorded nothing" off a join artifact of their own flag
+        print(
+            f"error: --round-s must be > 0 (got {args.round_s})",
+            file=sys.stderr,
+        )
+        return 2
+    round_s = args.round_s if args.round_s is not None else 0.05
+    # bucket host writes by sim round: host row "t" is seconds since
+    # the first publish, one sim round ≈ round_s of host wall
+    buckets: dict = {}
+    for pr in prows:
+        t = int(float(pr.get("t", 0.0)) // round_s)
+        buckets.setdefault(t, []).append(pr)
+    n_rounds = max(
+        [len(rows)] + [t + 1 for t in buckets]
+    )
+    joined = []
+    for t in range(n_rounds):
+        sim = rows[t] if t < len(rows) else {}
+        host = buckets.get(t, [])
+        vis = [
+            h["publish_to_visible_ms"]
+            for h in host
+            if h.get("publish_to_visible_ms") is not None
+        ]
+        lag = [
+            h["hlc_lag_ms"] for h in host if h.get("hlc_lag_ms") is not None
+        ]
+        joined.append(
+            {
+                "t": t,
+                "coverage_frac": sim.get("coverage_frac"),
+                "delivered": sim.get("delivered"),
+                "bcast_bytes": sim.get("bcast_bytes"),
+                "sync_sessions": sim.get("sync_sessions"),
+                "host_writes": len(host),
+                "host_visible_ms_max": max(vis) if vis else None,
+                "host_hlc_lag_ms_max": max(lag) if lag else None,
+            }
+        )
+    if args.json:
+        _print_json(
+            {
+                "header": head,
+                "parity_header": phead,
+                "round_s": round_s,
+                "rounds": joined,
+            }
+        )
+        return 0
+    print(
+        f"sim lane ⋈ host parity replay (round_s={round_s}): "
+        f"{len(rows)} sim rounds, {len(prows)} host writes"
+    )
+    for k in ("campaign", "cell_index", "seed", "traceparent"):
+        if k in head:
+            print(f"  sim {k}: {head[k]}")
+        if k in phead:
+            print(f"  host {k}: {phead[k]}")
+    cols = (
+        "t", "coverage_frac", "delivered", "bcast_bytes",
+        "sync_sessions", "host_writes", "host_visible_ms_max",
+        "host_hlc_lag_ms_max",
+    )
+    print("  ".join(f"{c:>19}" for c in cols))
+    for row in joined:
+        print(
+            "  ".join(
+                f"{('' if row.get(c) is None else row.get(c)):>19}"
+                for c in cols
+            )
+        )
     return 0
 
 
@@ -848,6 +1063,21 @@ def cmd_campaign(args) -> int:
             "error: campaign runs shard via --mesh-devices N, "
             "not --devices"
         )
+    for flag, val in (
+        ("--proto", args.proto),
+        ("--topology", args.topology),
+        ("--sampler", args.sampler),
+    ):
+        if val:
+            # the scenario axis flags would be silently ignored here —
+            # a user would believe they swept a variant the spec never
+            # named; axes ride the spec (scenario/grid keys) on
+            # campaign runs
+            raise SystemExit(
+                f"error: {flag} is a scenario-run flag; campaign cells "
+                "take the axis as a spec scenario/grid key "
+                f"(e.g. proto_family / topo_family / peer_sampler)"
+            )
     if not args.spec:
         raise SystemExit(
             f"--spec required: a JSON spec file or one of "
@@ -1052,18 +1282,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="run a TPU-simulator benchmark config, "
         "`sim campaign run|compare|report` for declarative seed-ensemble "
         "campaigns, `sim trace show` for flight-recorder artifacts, "
-        "`sim topo show` for topology families, or `sim lint` for the "
-        "corrolint static-analysis gate (doc/lint.md)",
+        "`sim topo show` for topology families, `sim proto show` for "
+        "protocol-variant families, or `sim lint` for the corrolint "
+        "static-analysis gate (doc/lint.md)",
     )
     sm.add_argument(
         "scenario",
-        choices=sorted(_SIM_SCENARIOS) + ["campaign", "trace", "topo", "lint"],
+        choices=sorted(_SIM_SCENARIOS)
+        + ["campaign", "trace", "topo", "proto", "lint"],
     )
     sm.add_argument(
         "campaign_cmd", nargs="?",
         choices=["run", "compare", "report", "show"],
         help="campaign action (scenario=campaign), or `show` "
-        "(scenario=trace | topo)",
+        "(scenario=trace | topo | proto)",
     )
     # default None so "explicitly given" is detectable: campaign run
     # must distinguish `--seed 0` (override to one seed) from "no seed
@@ -1096,6 +1328,12 @@ def build_parser() -> argparse.ArgumentParser:
     sm.add_argument(
         "--sampler", choices=["uniform", "peerswap"],
         help="peer-selection seam (ISSUE 9) on axis-aware scenarios",
+    )
+    sm.add_argument(
+        "--proto", metavar="FAMILY",
+        help="protocol-variant family (ISSUE 11): axis-aware scenario "
+        "runs take it as the cell's protocol point; `sim proto show "
+        "--proto F` renders its resolved knobs (omit to list families)",
     )
     sm.add_argument(
         "--spec", help="campaign run: JSON spec file or builtin name"
@@ -1137,6 +1375,17 @@ def build_parser() -> argparse.ArgumentParser:
     sm.add_argument(
         "--in", dest="in_path",
         help="trace show / campaign report: input artifact path",
+    )
+    sm.add_argument(
+        "--parity", metavar="HOST_FILE",
+        help="trace show: join the sim lane (--in) to its HOST-tier "
+        "parity replay artifact side-by-side (ISSUE 11 — per-write "
+        "publish→visible evidence bucketed onto sim rounds)",
+    )
+    sm.add_argument(
+        "--round-s", type=float, default=None,
+        help="trace show --parity: host wall-clock seconds per sim "
+        "round for the join (default 0.05, the campaign spec round_s)",
     )
     sm.add_argument(
         "--json", action="store_true",

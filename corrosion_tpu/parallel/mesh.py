@@ -91,6 +91,9 @@ def metrics_shardings(mesh: Mesh) -> RunMetrics:
         coverage_at=NamedSharding(mesh, P()),
         converged_at=NamedSharding(mesh, P(NODE_AXIS)),
         overflow_frac=NamedSharding(mesh, P()),
+        # cross-shard fold result (ISSUE 11), replicated like every
+        # other finished reduction
+        order_violations=NamedSharding(mesh, P()),
     )
 
 

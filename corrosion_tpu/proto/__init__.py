@@ -1,0 +1,45 @@
+"""Protocol-variant subsystem (ISSUE 11).
+
+The simulator used to reproduce exactly one protocol point — SWIM
+membership + uniform/PeerSwap gossip + periodic anti-entropy.  This
+package turns the protocol itself into a campaign axis, the PR 9
+topology-subsystem shape applied to the protocol dimension:
+
+- **families** (`families`): the named-variant registry (``baseline``,
+  ``swarm-aggressive``, ``push-pull``, ``fanout-decay``,
+  ``lab-ordered``, …) — dicts of SimConfig protocol knobs resolved by
+  `CampaignSpec.sim_config` (the ``proto_family`` meta key) and the CLI
+  ``--proto`` flag, jax-free for ``sim proto show``;
+- **schedule** (`schedule`): trace-time-branched cadence/fanout
+  variants threaded through the dense AND packed round kernels — the
+  halving fanout schedule and the eager sync cadence;
+- **dissemination** (`dissemination`): the push-pull exchange — ONE
+  implementation of the pull response's wire loss and bidirectional cut
+  refusal, shared verbatim by both kernels so their bit-identity is
+  structural;
+- **ordering** (`ordering`): FIFO per-origin delivery ordering — the
+  admit masks both delivery seams gate on, and the ``prev_complete``
+  algebra the on-device delivery-order invariant
+  (`sim.invariants.order_violation_count`) checks inside the jitted
+  loops.
+
+The default point compiles byte-identically to the pre-ISSUE-11
+kernels (every variant is a trace-time branch, new RNG draws live only
+inside variant branches) — digest-pinned by tests/sim/test_topo.py and
+tests/sim/test_proto.py.  See doc/protocols.md and the
+``protocol-frontier`` builtin campaign for the measured
+convergence-rounds × wire-bytes Pareto.
+
+This ``__init__`` imports ONLY the jax-free registry; the kernel-side
+helpers (`schedule`/`ordering`/`dissemination`) import jax and are
+pulled lazily by the kernels that branch on a variant.
+"""
+
+from .families import DEFAULTS, FAMILIES, PROTO_KEYS, family_proto
+
+__all__ = [
+    "DEFAULTS",
+    "FAMILIES",
+    "PROTO_KEYS",
+    "family_proto",
+]

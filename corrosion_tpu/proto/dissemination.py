@@ -1,0 +1,93 @@
+"""Push-pull dissemination (ISSUE 11): the pull half of the exchange.
+
+Under ``dissemination="push-pull"`` every broadcast contact becomes a
+request/response exchange: the contacted node (``dst``) sends its own
+currently-eligible buffer back to the contacting node (``src``) over
+the same sampled edge.  Classic push-pull gossip — the response roughly
+doubles the wire per contact and roughly halves the rounds in the
+spread phase, which is exactly the trade the protocol-frontier Pareto
+measures.
+
+Semantics (documented contracts, shared verbatim by the dense and
+packed kernels so their bit-identity is structural):
+
+- the response set is the responder's ``sending`` buffer — the same
+  governor-metered, relay-budgeted eligible set it pushes, so the rate
+  limit meters both directions of the exchange;
+- the exchange is a round trip: a FaultPlan cut in EITHER direction
+  refuses the response (`pull_session_ok` — the sync-session rule),
+  while the forward push still flows in the hearing direction;
+- the response rides its own wire frames, so it draws its OWN loss —
+  reverse-direction topology tiers plus any reverse-direction FaultPlan
+  loss class (`pull_wire_drop`, one fold_in off the broadcast drop key:
+  default-path RNG is untouched);
+- the response lands at the SAME per-edge delay class as the push
+  (region distance is symmetric; FaultPlan jitter stays on the
+  fire-and-forget push — a response is request-paced, so only the
+  fixed delay floor shifts it, the `sync_step` latency rationale);
+- responses do NOT decay the responder's relay budget (they are
+  answers, not gossip sends — only the push spends, exactly as the
+  reference's decay happens at send); receivers re-arm relay on
+  delivery like any broadcast arrival, so pulled payloads keep
+  spreading.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..sim.topology import Topology, edge_payload_drop
+
+
+def pull_session_ok(ok: jnp.ndarray, faults, src, dst) -> jnp.ndarray:
+    """bool[E]: the pull response can flow — the push-side edge mask
+    (``ok``, already forward-cut-filtered) minus edges whose REVERSE
+    direction a FaultPlan cuts.  A pull is a round trip, so it refuses
+    across a one-way partition exactly like a sync session."""
+    if faults is None:
+        return ok
+    from ..sim.faults import fault_edge_block
+
+    blk_rev = fault_edge_block(faults, dst, src)
+    if blk_rev is None:
+        return ok
+    return ok & ~blk_rev
+
+
+def pull_wire_drop(
+    topo: Topology,
+    faults,
+    key: jax.Array,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    n_payloads: int,
+    region: jnp.ndarray,
+) -> jnp.ndarray:
+    """bool[E, P] wire loss on the pull responses: the reverse-direction
+    topology tiers (the response crosses the same trunk the other way)
+    OR'd with any reverse-direction FaultPlan loss class.  ``key`` is
+    fold_in-derived from the broadcast drop key INSIDE the push-pull
+    trace branch, so default-path runs consume the exact legacy RNG
+    stream; both kernels call this one implementation with the same key
+    and shapes, so their drop bits match by construction."""
+    e = src.shape[0]
+    k_pull = jax.random.fold_in(key, 1)
+    # reverse direction: src/dst swapped against the tier thresholds
+    drop = edge_payload_drop(
+        topo, k_pull, e, n_payloads, src=dst, dst=src, region=region
+    )
+    if faults is not None:
+        from ..sim.faults import fault_edge_loss
+        from ..sim.topology import aligned_u8_bits
+
+        thr_rev = fault_edge_loss(faults, dst, src)  # u8[E] | None
+        if thr_rev is not None:
+            # the same key discipline as faults.fault_wire_effects
+            # (fold_in plan seed, then the class tag) on the PULL key
+            k_floss = jax.random.fold_in(
+                jax.random.fold_in(k_pull, faults.seed), 101
+            )
+            fbits = aligned_u8_bits(k_floss, (e, n_payloads))
+            drop = drop | (fbits < thr_rev[:, None])
+    return drop

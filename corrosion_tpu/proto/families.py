@@ -1,0 +1,93 @@
+"""Named protocol-variant families (ISSUE 11): the campaign axis
+vocabulary for the DISSEMINATION PROTOCOL itself.
+
+A family is a DICT of `sim.state.SimConfig` protocol-knob kwargs — not
+a config instance — so spec/cell keys can override individual fields
+(the compose-then-construct rule every other campaign axis follows,
+`topo.families` being the template).  The ``proto_family`` key rides
+`CampaignSpec.scenario`/`grid` and the CLI's ``--proto`` flag;
+``sim proto show`` renders a family without touching jax.
+
+The knobs (all real SimConfig fields, each defaulting to the legacy
+point so the default protocol compiles byte-identically — digest-pinned
+by tests/sim/test_topo.py + test_proto.py):
+
+- ``dissemination``   — "push" (the reference's fire-and-forget fanout)
+  or "push-pull" (every broadcast contact also pulls the contacted
+  node's eligible buffer back over the same edge: a request/response
+  exchange, refused across a cut in either direction like a sync
+  session, costing extra wire for faster spread);
+- ``fanout_schedule`` — "flat" (every round uses all ``fanout`` slots)
+  or "decay" (the active slot count halves every
+  ``fanout_decay_rounds``, floored at 1 — front-load the flood, then
+  hand the tail to anti-entropy);
+- ``sync_cadence``    — "periodic" (the countdown/backoff loop of
+  config.rs:49-59) or "eager" (every node syncs every round — the
+  SWARM-style near-zero-round replication limit, arxiv 2409.16258);
+- ``ordering``        — "none" (gossip order), "fifo" (per-origin
+  delivery ordering ENFORCED at the delivery seam: a chunk of version v
+  is admitted only once version v-1 from the same origin is fully held,
+  out-of-order arrivals are discarded and re-served later — the
+  ordering-constrained scenario family of the dual-digraph leaderless
+  atomic broadcast paper, arxiv 1708.08309), or "fifo-unchecked" (the
+  NEGATIVE CONTROL: the same delivery-order invariant is measured
+  on-device but nothing enforces it, so gossip reorder trips it — the
+  variant the pinned violation test runs).
+
+Families:
+
+- ``baseline``           — the legacy point (every default);
+- ``swarm-aggressive``   — eager sync cadence: the aggressive end of
+  the cadence/fanout spectrum (most wire, fewest rounds);
+- ``push-pull``          — push-pull dissemination on the flat cadence;
+- ``fanout-decay``       — halving fanout schedule (least wire, the
+  lean end of the frontier);
+- ``lab-ordered``        — FIFO delivery ordering enforced (leaderless-
+  atomic-broadcast-shaped; the invariant must read ZERO violations);
+- ``lab-ordered-broken`` — the unchecked negative control (violations
+  must trip — see tests/sim/test_proto.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: SimConfig protocol knobs a family may set (the proto axis fields).
+PROTO_KEYS = (
+    "dissemination",
+    "fanout_schedule",
+    "fanout_decay_rounds",
+    "sync_cadence",
+    "ordering",
+)
+
+#: the legacy protocol point — MUST mirror the SimConfig field defaults
+#: (pinned by tests/sim/test_proto.py so the two cannot drift); kept
+#: here so `sim proto show` renders resolved families without importing
+#: jax through SimConfig.
+DEFAULTS: Dict[str, object] = {
+    "dissemination": "push",
+    "fanout_schedule": "flat",
+    "fanout_decay_rounds": 8,
+    "sync_cadence": "periodic",
+    "ordering": "none",
+}
+
+FAMILIES: Dict[str, Dict[str, object]] = {
+    "baseline": {},
+    "swarm-aggressive": {"sync_cadence": "eager"},
+    "push-pull": {"dissemination": "push-pull"},
+    "fanout-decay": {"fanout_schedule": "decay", "fanout_decay_rounds": 8},
+    "lab-ordered": {"ordering": "fifo"},
+    "lab-ordered-broken": {"ordering": "fifo-unchecked"},
+}
+
+
+def family_proto(name: str) -> Dict[str, object]:
+    """SimConfig protocol kwargs for a named family (a fresh dict —
+    callers overlay their overrides)."""
+    if name not in FAMILIES:
+        raise KeyError(
+            f"unknown protocol family {name!r} (have {sorted(FAMILIES)})"
+        )
+    return dict(FAMILIES[name])

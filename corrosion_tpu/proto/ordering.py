@@ -1,0 +1,73 @@
+"""Broadcast-ordering discipline (ISSUE 11): FIFO per-origin delivery.
+
+The ordering-constrained scenario family (the dual-digraph leaderless
+atomic broadcast paper, arxiv 1708.08309) demands that nodes agree on
+delivery order.  The tractable per-origin form on this state layout:
+a node may DELIVER (merge into ``have``) a chunk of version v from
+origin a only once version v-1 from a is COMPLETELY held — so every
+node applies each writer's versions in commit order, and the
+cluster-wide delivery-order agreement invariant is exactly "no node's
+touched-version set has a gap below its head" (`sim.invariants
+.order_violation_count` counts the violations on-device, inside the
+jitted loops).
+
+Enforcement is DROP-based at the delivery seam (both rings, both
+kernels): an out-of-order arrival is discarded, the sender's relay
+budget and the wire bytes are already spent, and the payload is
+re-served later by retransmission or anti-entropy — ordering costs
+convergence rounds and wire, which is what the protocol-frontier
+Pareto measures.  ``fifo-unchecked`` measures the invariant without
+enforcing it (the negative control the pinned violation test runs).
+
+Both admit masks are group-uniform version algebra, so the dense
+payload-domain and packed word-domain forms are the same bits by
+construction (tests/sim/test_proto.py holds dense==packed bit-equal
+under every ordering variant).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..sim.state import SimConfig, complete_versions, grid_to_payload
+
+
+def prev_complete(comp: jnp.ndarray) -> jnp.ndarray:
+    """bool[..., A, V]: version v's PREDECESSOR is completely held
+    (v == 1 has none, so its slot is always True) — the FIFO admit
+    predicate per (node, origin, version)."""
+    head = jnp.ones_like(comp[..., :1])
+    return jnp.concatenate([head, comp[..., :-1]], axis=-1)
+
+
+def order_enforced(cfg: SimConfig) -> bool:
+    """Trace-time fact: does this scenario GATE deliveries on order?
+    (``fifo-unchecked`` measures without gating.)"""
+    return cfg.ordering == "fifo"
+
+
+def order_checked(cfg: SimConfig) -> bool:
+    """Trace-time fact: does this scenario measure the delivery-order
+    invariant on-device?"""
+    return cfg.ordering in ("fifo", "fifo-unchecked")
+
+
+def admit_payload_mask(have: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """bool[N, P] dense-domain FIFO admit mask from current holdings:
+    payload p may be delivered iff its version's predecessor (same
+    origin) is complete in ``have`` BEFORE this round's merge.
+    Monotone in ``have``, so an admitted version can never retroactively
+    violate the invariant."""
+    comp = complete_versions(have, cfg)  # [N, A, V]
+    return grid_to_payload(prev_complete(comp), cfg)
+
+
+def admit_words(have_w: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """u32[N, W] packed-domain twin of `admit_payload_mask` — the same
+    predecessor predicate computed on the version grid and smeared back
+    to group-uniform words, so the two delivery seams gate identical
+    bits."""
+    from ..sim.packed import grid_to_words, group_grid
+
+    comp = group_grid(have_w, cfg, "all")  # [N, A, V]
+    return grid_to_words(prev_complete(comp), cfg)

@@ -1,0 +1,52 @@
+"""Cadence & fanout-schedule variants (ISSUE 11) — trace-time branches.
+
+Both helpers are identities on the default knobs, so the baseline
+protocol compiles to exactly the pre-ISSUE-11 program (no new RNG, no
+new tensors); the variant branches consume no randomness either — a
+schedule is a deterministic function of the round counter, which keeps
+every lane's PRNG stream byte-identical to its unscheduled twin's
+except where the masked targets change the trajectory itself.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..sim.state import SimConfig
+
+
+def active_fanout(cfg: SimConfig, t: jnp.ndarray) -> jnp.ndarray:
+    """i32 scalar: fan-out slots live at round ``t`` under the halving
+    schedule — ``fanout >> (t // fanout_decay_rounds)``, floored at 1.
+    The front-loaded flood: full fanout while the storm is young, a
+    single slot once anti-entropy should own the tail."""
+    steps = jnp.minimum(t // cfg.fanout_decay_rounds, 30)
+    return jnp.maximum(jnp.int32(cfg.fanout) >> steps, 1)
+
+
+def scheduled_fanout_targets(
+    targets: jnp.ndarray, cfg: SimConfig, t: jnp.ndarray
+) -> jnp.ndarray:
+    """Mask fan-out target slots beyond this round's scheduled count to
+    the -1 unfilled-slot sentinel (the same mask discipline as
+    `topology.apply_degree_caps` — schedules can only REMOVE slots,
+    never add them, and slot 0 survives longest so ring0-first tiering
+    keeps its local slot).  Trace-time identity on the flat schedule."""
+    if cfg.fanout_schedule == "flat":
+        return targets
+    f = targets.shape[1]
+    keep = jnp.arange(f, dtype=jnp.int32)[None, :] < active_fanout(cfg, t)
+    return jnp.where(keep, targets, -1)
+
+
+def cadence_due(due: jnp.ndarray, cfg: SimConfig) -> jnp.ndarray:
+    """The sync-due mask under the cadence variant: "periodic" passes
+    the countdown verdict through untouched (the legacy decorrelated
+    backoff loop); "eager" makes EVERY node due EVERY round — the
+    SWARM-style near-zero-round replication limit.  The countdown /
+    backoff state machinery keeps running (and keeps drawing its re-arm
+    randomness) either way, so the two cadences consume identical RNG
+    streams."""
+    if cfg.sync_cadence == "periodic":
+        return due
+    return jnp.ones_like(due)

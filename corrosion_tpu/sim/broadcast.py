@@ -98,6 +98,13 @@ def broadcast_step(
     # heterogeneous fan-out (ISSUE 9): slots past a node's degree cap
     # become the -1 sentinel — trace-time identity without classes
     targets = apply_degree_caps(targets, topo)
+    if cfg.fanout_schedule != "flat":
+        # fanout schedule (ISSUE 11): mask slots beyond this round's
+        # scheduled count — the same -1 discipline as degree caps, a
+        # trace-time branch (flat compiles the pre-change kernel)
+        from ..proto.schedule import scheduled_fanout_targets
+
+        targets = scheduled_fanout_targets(targets, cfg, state.t)
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
     dst = targets.reshape(-1)  # [E]
     ok = dst >= 0
@@ -177,6 +184,37 @@ def broadcast_step(
         inflight = inflight.at[flat_idx].max(sent)
         inflight = inflight.reshape(d_slots, n, p)
 
+    if cfg.dissemination == "push-pull":
+        # push-pull exchange (ISSUE 11): the contacted node answers with
+        # its OWN eligible buffer over the same edge — a round trip, so
+        # a cut in either direction refuses the response, the response
+        # draws its own (reverse-direction) wire loss, and it lands at
+        # the puller at the same per-edge delay class (the documented
+        # contracts live in proto/dissemination.py).  A trace-time
+        # branch: the default "push" compiles the pre-change kernel and
+        # the pull drop key is fold_in-derived inside the branch.
+        from ..proto.dissemination import pull_session_ok, pull_wire_drop
+
+        ok_pull = pull_session_ok(ok, faults, src, dst)
+        drop_pull = pull_wire_drop(
+            topo, faults, k_drop, src, dst, p, region
+        )
+        if telem and _tel_loss:
+            # same one-materialization rule as the push drop mask: the
+            # telemetry drop count below consumes it too
+            drop_pull = jax.lax.optimization_barrier(drop_pull)
+        resp = jnp.where(
+            ok_pull[:, None] & ~drop_pull, sending[dst], False
+        ).astype(payload)  # [E, P] — the dst gather is variant-only cost
+        slot_pull = (state.t + delay) % d_slots
+        flat_pull = slot_pull * n + src  # responses land at the PULLER
+        inflight = (
+            inflight.reshape(d_slots * n, p)
+            .at[flat_pull]
+            .max(resp)
+            .reshape(d_slots, n, p)
+        )
+
     # transmission budget decays once per flush that actually SENT —
     # i.e. handed datagrams to the transport.  A sender cannot know the
     # target is partitioned away or dead (that's what SWIM is for), so
@@ -234,11 +272,50 @@ def broadcast_step(
                 & sending[:, None, :],
                 dtype=jnp.int32,
             )
+    bytes_out = jnp.sum(
+        jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
+    )
+    if cfg.dissemination == "push-pull":
+        # the pull responses are wire traffic too (the exchange's cost
+        # side of the Pareto): same fold shapes as the push direction,
+        # responder-side per-node stats gathered by dst — the packed
+        # twin computes the identical integers on words, so the
+        # channels stay bit-equal across kernels
+        okpf = ok_pull.reshape(n, f)
+        frames = frames + jnp.sum(
+            jnp.where(okpf, send_frames[dst].reshape(n, f), 0),
+            dtype=jnp.int32,
+        )
+        bytes_out = bytes_out + jnp.sum(
+            jnp.where(
+                okpf,
+                send_bytes[dst].astype(jnp.float32).reshape(n, f),
+                0.0,
+            )
+        )
+        if _tel_loss:
+            if p % 32 == 0:
+                from .packed import pack_bits
+
+                w = p // 32
+                hitp = pack_bits(drop_pull).reshape(n, f, w) & pack_bits(
+                    sending
+                )[dst].reshape(n, f, w) & jnp.where(
+                    okpf[:, :, None], jnp.uint32(0xFFFFFFFF),
+                    jnp.uint32(0),
+                )
+                dropped = dropped + jnp.sum(
+                    jax.lax.population_count(hitp), dtype=jnp.int32
+                )
+            else:
+                dropped = dropped + jnp.sum(
+                    ok_pull.reshape(n, f, 1) & drop_pull.reshape(n, f, p)
+                    & sending[dst].reshape(n, f, p),
+                    dtype=jnp.int32,
+                )
     tel = WireTel(
         frames=frames,
-        bytes=jnp.sum(
-            jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
-        ),
+        bytes=bytes_out,
         dropped=dropped,
         cut=cut,
     )
@@ -256,6 +333,20 @@ def deliver_step(state: SimState, cfg: SimConfig) -> SimState:
     slot = state.t % d_slots
     arriving = state.inflight[slot]  # [N, P]
     sync_arrivals = state.sync_inflight[slot]  # [N, P]
+    if cfg.ordering == "fifo":
+        # FIFO ordering gate (ISSUE 11; proto/ordering.py): admit a
+        # chunk of version v only once v-1 from the same origin is
+        # completely held BEFORE this round's merge; rejected arrivals
+        # are discarded (the ring slot zeroes below) and re-served by
+        # retransmission or anti-entropy.  Both rings gate on the one
+        # mask — sync-pulled chunks obey the same delivery order.
+        from ..proto.ordering import admit_payload_mask
+
+        admit = admit_payload_mask(state.have, cfg)  # bool[N, P]
+        arriving = jnp.where(admit, arriving, jnp.zeros_like(arriving))
+        sync_arrivals = jnp.where(
+            admit, sync_arrivals, jnp.zeros_like(sync_arrivals)
+        )
     newly = (arriving > 0) & (state.have == 0)
     have = jnp.maximum(jnp.maximum(state.have, arriving), sync_arrivals)
     relay_init = max(cfg.max_transmissions - 1, 1)

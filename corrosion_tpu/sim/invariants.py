@@ -18,23 +18,82 @@ Checked properties:
 - **relay-budget** — ``relay_left ≤ max_transmissions``.
 - **dead-nodes-inert** — nodes down since round 0 hold nothing (their
   edges are masked at delivery).
+- **delivery-order agreement** (ISSUE 11; ordering variants only) —
+  under a FIFO broadcast-ordering discipline every node's touched
+  versions per origin form a gapless prefix: no node holds version v
+  without having completely delivered v-1 from the same origin first,
+  so all nodes agree on each writer's delivery order.  Unlike the
+  host-snapshot checks above, this one ALSO runs ON DEVICE inside the
+  jitted round loops (`order_violation_count`, accumulated into
+  `RunMetrics.order_violations` with zero host syncs — corrolint CT002
+  clean): an enforced ``ordering="fifo"`` run must end at 0, and the
+  ``fifo-unchecked`` negative control must trip it (pinned by
+  tests/sim/test_proto.py).
 """
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from .gaps import gaps_to_mask
-from .state import ALIVE, SimConfig, SimState, touched_versions, version_heads
+from .state import (
+    ALIVE,
+    PayloadMeta,
+    SimConfig,
+    SimState,
+    complete_versions,
+    touched_versions,
+    version_heads,
+)
+
+
+def order_violation_count(
+    touched: jnp.ndarray,
+    comp: jnp.ndarray,
+    meta: PayloadMeta,
+    cfg: SimConfig,
+) -> jnp.ndarray:
+    """i32 scalar, ON DEVICE: (node, origin, version) triples violating
+    the FIFO delivery-order agreement this round — version v touched
+    while v-1 from the same origin is not completely held.  Origin rows
+    are exempt: a writer's own injections are ordered by construction,
+    and a crash-WIPED origin legitimately re-injects past its lost
+    history (the gate never applies to local commits), so counting it
+    would page on every wipe-composed fault plan.
+
+    Pure version-grid algebra over tensors the round kernels already
+    materialize (``touched``/``comp`` are [N, A, V] grids) — no RNG, no
+    host syncs; called inside the jitted loops only when
+    ``cfg.ordering != "none"`` (a trace-time branch, so the default
+    protocol compiles without it).  Counted in the GRID domain so a
+    multi-chunk version is one triple, not chunks_per_version of them
+    (the payload-domain sum would inflate by C)."""
+    from ..proto.ordering import prev_complete
+
+    viol = touched & ~prev_complete(comp)  # [N, A, V]
+    n = touched.shape[0]
+    # per-actor origin node: actor a's first payload (v=0, c=0) sits at
+    # index a*C in the version-major layout (uniform_payloads)
+    a_idx = jnp.arange(cfg.n_writers, dtype=jnp.int32)
+    origin = meta.actor[a_idx * cfg.chunks_per_version]  # [A]
+    not_origin = (
+        jnp.arange(n, dtype=jnp.int32)[:, None] != origin[None, :]
+    )  # [N, A]
+    return jnp.sum(viol & not_origin[:, :, None], dtype=jnp.int32)
 
 
 def check_state(
     state: SimState,
     cfg: SimConfig,
     dead_since_start: np.ndarray | None = None,
+    meta: PayloadMeta | None = None,
 ) -> None:
     """Assert the always-properties on a (host-fetched) state snapshot.
-    Raises AssertionError with the violated property's name."""
+    Raises AssertionError with the violated property's name.
+    ``meta`` (optional) additionally arms the delivery-order check on
+    enforced-ordering configs — the host-snapshot twin of the on-device
+    `order_violation_count`."""
     have = np.asarray(state.have)
     injected = np.asarray(state.injected)
     assert (have <= injected[None, :]).all(), (
@@ -75,4 +134,17 @@ def check_state(
         dead = np.asarray(dead_since_start, bool)
         assert (have[dead] == 0).all(), (
             "dead-nodes-inert: a node down since round 0 holds data"
+        )
+
+    if meta is not None and cfg.ordering == "fifo":
+        viol = int(
+            np.asarray(order_violation_count(
+                touched_versions(state.have, cfg),
+                complete_versions(state.have, cfg),
+                meta, cfg,
+            ))
+        )
+        assert viol == 0, (
+            f"delivery-order: {viol} (node, origin, version) triples "
+            "hold a version whose predecessor was never delivered"
         )

@@ -425,6 +425,12 @@ def broadcast_packed(
     # heterogeneous fan-out (ISSUE 9) — identical masking to the dense
     # kernel, applied before the edge list so both paths agree
     targets = apply_degree_caps(targets, topo)
+    if cfg.fanout_schedule != "flat":
+        # fanout schedule (ISSUE 11) — the identical mask the dense
+        # kernel applies, so both paths' edge lists agree
+        from ..proto.schedule import scheduled_fanout_targets
+
+        targets = scheduled_fanout_targets(targets, cfg, state.t)
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), f)  # [E]
     dst = targets.reshape(-1)
     ok = dst >= 0
@@ -504,6 +510,33 @@ def broadcast_packed(
         inflight = inflight.at[flat_idx].max(sent)
         inflight = inflight.reshape(d_slots, n, p)
 
+    if cfg.dissemination == "push-pull":
+        # push-pull exchange (ISSUE 11) — the dense kernel's branch on
+        # the packed envelope: the shared proto/dissemination helpers
+        # draw the same keys and shapes, the response set is the
+        # responder's unpacked sending buffer (elig8 == the dense
+        # `sending` bools), and the scatter rides the dense u8 ring
+        # like every broadcast delivery — bit-identical across paths.
+        from ..proto.dissemination import pull_session_ok, pull_wire_drop
+
+        ok_pull = pull_session_ok(ok, faults, src, dst)
+        drop_pull = pull_wire_drop(
+            topo, faults, k_drop, src, dst, p, region
+        )
+        if telem and _tel_loss:
+            drop_pull = jax.lax.optimization_barrier(drop_pull)
+        resp = jnp.where(
+            ok_pull[:, None] & ~drop_pull, elig8[dst], jnp.uint8(0)
+        )  # [E, P]
+        slot_pull = (state.t + delay) % d_slots
+        flat_pull = slot_pull * n + src  # responses land at the PULLER
+        inflight = (
+            inflight.reshape(d_slots * n, p)
+            .at[flat_pull]
+            .max(resp)
+            .reshape(d_slots, n, p)
+        )
+
     # budget spends on the ATTEMPT (see broadcast.broadcast_step): a
     # sender can't observe partitions, dead targets, or wire loss —
     # only what the governor let through this round spends
@@ -538,11 +571,38 @@ def broadcast_packed(
             okf[:, :, None], ONES, U32(0)
         )
         dropped = jnp.sum(jax.lax.population_count(hit), dtype=jnp.int32)
+    bytes_out = jnp.sum(
+        jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
+    )
+    if cfg.dissemination == "push-pull":
+        # pull-direction wire accounting — the dense kernel's fold
+        # shapes on word-derived integers (send_frames/send_bytes are
+        # the identical values), so the channels stay bit-equal
+        okpf = ok_pull.reshape(n, f)
+        frames = frames + jnp.sum(
+            jnp.where(okpf, send_frames[dst].reshape(n, f), 0),
+            dtype=jnp.int32,
+        )
+        bytes_out = bytes_out + jnp.sum(
+            jnp.where(
+                okpf,
+                send_bytes[dst].astype(jnp.float32).reshape(n, f),
+                0.0,
+            )
+        )
+        if _tel_loss:
+            w = sending.shape[-1]
+            hitp = pack_bits(drop_pull).reshape(n, f, w) & sending[
+                dst
+            ].reshape(n, f, w) & jnp.where(
+                okpf[:, :, None], ONES, U32(0)
+            )
+            dropped = dropped + jnp.sum(
+                jax.lax.population_count(hitp), dtype=jnp.int32
+            )
     tel = WireTel(
         frames=frames,
-        bytes=jnp.sum(
-            jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
-        ),
+        bytes=bytes_out,
         dropped=dropped,
         cut=cut,
     )
@@ -574,6 +634,16 @@ def deliver_packed(
     slot = t % d_slots
     arriving = pack_bits(carry.inflight[slot])  # u8[N, P] → u32[N, W]
     pending_sync = carry.sync_buf[slot]  # u32[N, W]
+    if cfg.ordering == "fifo":
+        # FIFO ordering gate (ISSUE 11) — the word-domain twin of
+        # deliver_step's admit mask (proto/ordering.py): same
+        # predecessor predicate, same drop-and-reserve semantics, both
+        # rings gated on the one mask
+        from ..proto.ordering import admit_words
+
+        admit = admit_words(carry.have, cfg)  # u32[N, W]
+        arriving &= admit
+        pending_sync &= admit
     newly = arriving & ~carry.have
     have = carry.have | arriving | pending_sync
     relay = planes_set(carry.relay, newly, max(cfg.max_transmissions - 1, 1))
@@ -728,10 +798,24 @@ def packed_round_step(
         metrics.converged_at,
     )
 
+    # delivery-order invariant (ISSUE 11): the dense round's check on
+    # the packed path's version grids — `touched` is already
+    # materialized above; the completeness grid is variant-only cost
+    # (a trace-time branch, ordering="none" carries the constant 0)
+    order_violations = metrics.order_violations
+    if cfg.ordering != "none":
+        from .invariants import order_violation_count
+
+        comp_g = group_grid(carry.have, cfg, "all")  # [N, A, V]
+        order_violations = order_violations + order_violation_count(
+            touched, comp_g, meta, cfg
+        )
+
     out_metrics = RunMetrics(
         coverage_at=coverage_at,
         converged_at=converged_at,
         overflow_frac=overflow_frac,
+        order_violations=order_violations,
     )
     if trace is not None:
         from .telemetry import (
@@ -1056,6 +1140,13 @@ def sync_packed(
     k_peers, _k_drop, k_rearm = jax.random.split(key, 3)
 
     due = state.sync_countdown <= 0
+    if cfg.sync_cadence != "periodic":
+        # sync-cadence variant (ISSUE 11) — identical override to the
+        # dense kernel's, BEFORE the early-exit gate so a converged
+        # lane still pulls nothing under the eager cadence
+        from ..proto.schedule import cadence_due
+
+        due = cadence_due(due, cfg)
     if done is not None:
         # early-exit gate (see broadcast_packed): a converged lane pulls
         # nothing — identical semantics, the batched loop discards its

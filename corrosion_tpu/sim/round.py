@@ -47,6 +47,12 @@ class RunMetrics(NamedTuple):
     # gaps.py:78-85) — config #5b reports this so K-overflow distortion
     # is measured, not assumed away (VERDICT r2 weak #4)
     overflow_frac: jnp.ndarray
+    # i32 scalar: running total of delivery-order invariant violations
+    # (ISSUE 11; `invariants.order_violation_count`, accumulated inside
+    # the jitted loops on ordering variants — zero host syncs).  Stays
+    # the constant 0 on ordering="none" (a trace-time branch): the
+    # default protocol pays nothing and existing digests stand.
+    order_violations: jnp.ndarray
 
 
 def new_metrics(cfg: SimConfig) -> RunMetrics:
@@ -54,6 +60,7 @@ def new_metrics(cfg: SimConfig) -> RunMetrics:
         coverage_at=jnp.full((cfg.n_payloads,), -1, jnp.int32),
         converged_at=jnp.full((cfg.n_nodes,), -1, jnp.int32),
         overflow_frac=jnp.zeros((), jnp.float32),
+        order_violations=jnp.zeros((), jnp.int32),
     )
 
 
@@ -175,10 +182,24 @@ def round_step(
         metrics.converged_at,
     )
 
+    # delivery-order invariant (ISSUE 11): counted on-device every round
+    # of an ordering-variant run — `touched`/`comp` are already
+    # materialized above, so the check is pure grid algebra.  A
+    # trace-time branch: ordering="none" compiles the pre-change program
+    # and carries the constant 0.
+    order_violations = metrics.order_violations
+    if cfg.ordering != "none":
+        from .invariants import order_violation_count
+
+        order_violations = order_violations + order_violation_count(
+            touched, comp, meta, cfg
+        )
+
     out_metrics = RunMetrics(
         coverage_at=coverage_at,
         converged_at=converged_at,
         overflow_frac=overflow_frac,
+        order_violations=order_violations,
     )
     if trace is not None:
         from .telemetry import (

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -270,20 +270,33 @@ def _resolve_topo(topo_family: Optional[str]) -> Topology:
     return Topology(**family_topology(topo_family))
 
 
+def _resolve_proto(proto_family: Optional[str]) -> Dict[str, object]:
+    """Named protocol family → SimConfig protocol kwargs (ISSUE 11;
+    None = the baseline point, an empty overlay)."""
+    if not proto_family:
+        return {}
+    from ..proto import family_proto
+
+    return family_proto(proto_family)
+
+
 def config_broadcast_1k(
     seed: int = 0,
     telemetry: bool = False,
     trace_path: Optional[str] = None,
     topo_family: Optional[str] = None,
     sampler: Optional[str] = None,
+    proto_family: Optional[str] = None,
 ) -> Dict[str, float]:
-    """Config #3, with the ISSUE 9 axes exposed: ``--topology`` picks a
-    named family, ``--sampler`` the peer-selection seam."""
+    """Config #3, with the ISSUE 9/11 axes exposed: ``--topology`` picks
+    a named family, ``--sampler`` the peer-selection seam, ``--proto``
+    a named protocol variant."""
     topo = _resolve_topo(topo_family)
     cfg = SimConfig(
         n_nodes=1000, n_payloads=256, n_writers=8, fanout=3,
         n_delay_slots=max(4, topo.max_delay + 1),
         peer_sampler=sampler or "uniform",
+        **_resolve_proto(proto_family),
     )
     meta = uniform_payloads(cfg, inject_every=2)
     # 256 × 8 KiB = 2 MiB ≤ both budgets ⇒ metering skipped (proof
@@ -351,6 +364,7 @@ def _write_storm(
     n_payloads: int,
     topo: Topology = Topology(),
     sampler: Optional[str] = None,
+    proto_family: Optional[str] = None,
 ):
     # partial-view SWIM packs (belief, id) into one i32 scatter word —
     # 2^18 nodes max (SimConfig validation).  Beyond that cap (the 1M
@@ -377,6 +391,8 @@ def _write_storm(
         # per-round HBM writes (sim/perf.py carry model).  A WAN-tiered
         # topology grows the ring just enough for its deepest class.
         n_delay_slots=max(2, topo.max_delay + 1),
+        # protocol-variant overlay (ISSUE 11; CLI --proto)
+        **_resolve_proto(proto_family),
     )
     meta = uniform_payloads(cfg, inject_every=2)
     # 512 × 8 KiB = 4 MiB fits both budgets ⇒ metering skipped; derived
@@ -394,15 +410,19 @@ def config_write_storm_100k(
     trace_path: Optional[str] = None,
     topo_family: Optional[str] = None,
     sampler: Optional[str] = None,
+    proto_family: Optional[str] = None,
 ) -> Optional[Dict[str, float]]:
     """Config #5: the north-star scale — 100k nodes, multi-writer chunked
     write storm (consul-service style), p99 time-to-convergence.
-    ``topo_family``/``sampler`` (ISSUE 9; CLI ``--topology``/
-    ``--sampler``) run the same storm over a named WAN topology and/or
-    the PeerSwap sampler — the scenario-diversity axes at the headline
-    scale."""
+    ``topo_family``/``sampler``/``proto_family`` (ISSUE 9/11; CLI
+    ``--topology``/``--sampler``/``--proto``) run the same storm over a
+    named WAN topology, the PeerSwap sampler, and/or a named protocol
+    variant — the scenario-diversity axes at the headline scale."""
     topo = _resolve_topo(topo_family)
-    cfg, meta = _write_storm(n_nodes, n_payloads, topo=topo, sampler=sampler)
+    cfg, meta = _write_storm(
+        n_nodes, n_payloads, topo=topo, sampler=sampler,
+        proto_family=proto_family,
+    )
     return run_scenario(
         cfg, meta, topo=topo, seed=seed, max_rounds=3000,
         compile_only=compile_only, mesh=mesh, telemetry=telemetry,
@@ -967,6 +987,120 @@ def config_peer_sampler_frontier(
         "result_digest": artifact["result_digest"],
         "wall_clock_s": round(time.monotonic() - t0, 3),
     }
+
+
+def config_protocol_frontier(
+    seed: int = 0,
+    n_nodes: int = 96,
+    n_seeds: int = 4,
+    max_rounds: int = 500,
+    sampler_storm_nodes: int = 25_600,
+    sampler_storm_payloads: int = 512,
+    proto_families: Optional[Sequence[str]] = None,
+    topo_families: Optional[Sequence[str]] = None,
+) -> Dict[str, object]:
+    """The protocol-variant frontier rung (ISSUE 11): run the
+    `protocol-frontier` builtin campaign — four named protocol families
+    × two topology families, wire bytes banded per lane — and reduce it
+    to the comparison record bench.py tracks: per topology family, each
+    variant's convergence rounds and wire bytes plus their ratios
+    against the ``baseline`` family (rounds_ratio < 1.0 means the
+    variant converges faster; wire_ratio > 1.0 means it pays more
+    wire — the two axes of the Pareto).  Ordering cells also report
+    their banded on-device delivery-order violation totals (must be 0
+    for the enforced discipline).
+
+    ``sampler_storm_nodes`` > 0 additionally folds a STORM-SCALE
+    sampler cell into the record (ISSUE 11 carried edge: the sampler
+    frontier's 96-node CPU rung must not stay the only sampler
+    number) — the packed write storm at ≥25k nodes under the PeerSwap
+    sampler, reported alongside the proto families.
+
+    ``proto_families``/``topo_families`` shrink the grid for smoke runs
+    (None = the builtin's canonical 4 × 2 grid, which the bench rung
+    and the committed baseline always use)."""
+    import dataclasses as _dc
+
+    from ..campaign.engine import run_campaign
+    from ..campaign.spec import protocol_frontier_spec
+
+    spec = protocol_frontier_spec(
+        seeds=tuple(seed + i for i in range(n_seeds)), n=n_nodes,
+        max_rounds=max_rounds,
+    )
+    if proto_families is not None or topo_families is not None:
+        grid = dict(spec.grid)
+        if proto_families is not None:
+            grid["proto_family"] = list(proto_families)
+        if topo_families is not None:
+            grid["topo_family"] = list(topo_families)
+        spec = _dc.replace(spec, grid=grid)
+    t0 = time.monotonic()
+    artifact = run_campaign(spec, out_path=None)
+    families: Dict[str, Dict[str, object]] = {}
+    for cell in artifact["cells"]:
+        fam = cell["params"]["topo_family"]
+        proto = cell["params"]["proto_family"]
+        entry = {
+            "rounds_p50": cell["bands"]["rounds"]["p50"],
+            "rounds_p99": cell["bands"]["rounds"]["p99"],
+            "wire_bytes_p50": cell["bands"]["wire_bytes"]["p50"],
+            "converged": cell["all_converged"],
+        }
+        if "order_violations" in cell["bands"]:
+            entry["order_violations_max"] = cell["bands"][
+                "order_violations"
+            ]["max"]
+        families.setdefault(fam, {})[proto] = entry
+    for fam, d in families.items():
+        base = d.get("baseline")
+        if not base:
+            continue
+        for proto, entry in list(d.items()):
+            if proto == "baseline" or not isinstance(entry, dict):
+                continue
+            if base["rounds_p50"]:
+                entry["rounds_ratio"] = round(
+                    entry["rounds_p50"] / base["rounds_p50"], 3
+                )
+            if base["wire_bytes_p50"]:
+                entry["wire_ratio"] = round(
+                    entry["wire_bytes_p50"] / base["wire_bytes_p50"], 3
+                )
+    converged = all(c["all_converged"] for c in artifact["cells"])
+
+    sampler_storm = None
+    if sampler_storm_nodes:
+        storm = config_write_storm_100k(
+            seed=seed, n_nodes=sampler_storm_nodes,
+            n_payloads=sampler_storm_payloads, sampler="peerswap",
+        )
+        sampler_storm = {
+            "sampler": "peerswap",
+            "n_nodes": sampler_storm_nodes,
+            "n_payloads": sampler_storm_payloads,
+            "round_path": storm["round_path"],
+            "rounds": storm["rounds"],
+            "wall_clock_s": storm["wall_clock_s"],
+            "converged": storm["converged"],
+            "p99_node_convergence_round": storm[
+                "p99_node_convergence_round"
+            ],
+        }
+        converged = converged and bool(storm["converged"])
+
+    out = {
+        "n_nodes": n_nodes,
+        "seeds": n_seeds,
+        "converged": converged,
+        "families": families,
+        "spec_hash": artifact["spec_hash"],
+        "result_digest": artifact["result_digest"],
+        "wall_clock_s": round(time.monotonic() - t0, 3),
+    }
+    if sampler_storm is not None:
+        out["sampler_storm"] = sampler_storm
+    return out
 
 
 def _gapstress_cfg(n_nodes: int, gap_slots: int) -> SimConfig:

@@ -153,6 +153,33 @@ class SimConfig:
     # PeerSwap view width V (SimState.pview is i32[N, V]; 0-width when
     # the sampler is uniform, the zero-cost off state)
     view_slots: int = 16
+    # -- protocol-variant knobs (ISSUE 11; corrosion_tpu/proto) --------
+    # Each defaults to the legacy protocol point and rides a trace-time
+    # branch, so the default compiles byte-identically to pre-ISSUE-11
+    # kernels (digest-pinned).  Named bundles live in
+    # proto.families.FAMILIES (the `proto_family` campaign meta key);
+    # doc/protocols.md is the catalog.
+    # "push" = the reference's fire-and-forget fanout; "push-pull" =
+    # every broadcast contact also pulls the contacted node's eligible
+    # buffer back (a round-trip exchange, refused across a cut in
+    # either direction — proto/dissemination.py)
+    dissemination: str = "push"
+    # "flat" = all fanout slots every round; "decay" = the active slot
+    # count halves every fanout_decay_rounds, floored at 1
+    # (proto/schedule.py — front-load the flood)
+    fanout_schedule: str = "flat"
+    fanout_decay_rounds: int = 8
+    # "periodic" = the countdown/backoff sync loop (config.rs:49-59);
+    # "eager" = every node syncs every round (the SWARM-style
+    # near-zero-round replication limit)
+    sync_cadence: str = "periodic"
+    # "none" = gossip order; "fifo" = per-origin FIFO delivery ordering
+    # ENFORCED at the delivery seam (out-of-order arrivals discarded,
+    # re-served later — proto/ordering.py) with the delivery-order
+    # invariant counted on-device (sim/invariants.py,
+    # RunMetrics.order_violations); "fifo-unchecked" = the invariant is
+    # measured but NOT enforced (the negative control that must trip it)
+    ordering: str = "none"
 
     def __post_init__(self) -> None:
         if self.trace_every < 1:
@@ -186,6 +213,44 @@ class SimConfig:
                     "peer_sampler='peerswap' is incompatible with "
                     "swim_partial_view (the member tables ARE a sampler)"
                 )
+        # protocol-variant knobs (ISSUE 11): loud refusals — an unknown
+        # or unsupported combination must never silently measure the
+        # baseline protocol under a variant's name
+        if self.dissemination not in ("push", "push-pull"):
+            raise ValueError(
+                f"unknown dissemination {self.dissemination!r} "
+                "(use 'push' or 'push-pull')"
+            )
+        if self.fanout_schedule not in ("flat", "decay"):
+            raise ValueError(
+                f"unknown fanout_schedule {self.fanout_schedule!r} "
+                "(use 'flat' or 'decay')"
+            )
+        if self.fanout_decay_rounds < 1:
+            raise ValueError(
+                f"fanout_decay_rounds must be >= 1, got "
+                f"{self.fanout_decay_rounds}"
+            )
+        if self.sync_cadence not in ("periodic", "eager"):
+            raise ValueError(
+                f"unknown sync_cadence {self.sync_cadence!r} "
+                "(use 'periodic' or 'eager')"
+            )
+        if self.ordering not in ("none", "fifo", "fifo-unchecked"):
+            raise ValueError(
+                f"unknown ordering {self.ordering!r} "
+                "(use 'none', 'fifo', or 'fifo-unchecked')"
+            )
+        if self.ordering != "none" and self.n_versions < 2:
+            # a single version per writer has no order to impose; a
+            # membership/detect scenario naming an ordering variant
+            # would otherwise silently measure nothing on that axis
+            raise ValueError(
+                "ordering variants need >= 2 versions per writer "
+                f"(n_payloads={self.n_payloads}, n_writers="
+                f"{self.n_writers}, chunks_per_version="
+                f"{self.chunks_per_version} gives {self.n_versions})"
+            )
 
     @classmethod
     def wan_tuned(cls, n_nodes: int, **kw) -> "SimConfig":

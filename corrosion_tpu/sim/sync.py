@@ -135,6 +135,15 @@ def sync_step(
     k_peers, k_drop, k_rearm = jax.random.split(key, 3)
 
     due = state.sync_countdown <= 0  # [N]
+    if cfg.sync_cadence != "periodic":
+        # sync-cadence variant (ISSUE 11): "eager" makes every node due
+        # every round (the SWARM-style near-zero-round limit); the
+        # countdown/backoff machinery below keeps running — and keeps
+        # drawing its re-arm randomness — so both cadences consume the
+        # identical RNG stream (proto/schedule.py)
+        from ..proto.schedule import cadence_due
+
+        due = cadence_due(due, cfg)
 
     # sync peers come from the believed member list (handle_sync chooses
     # candidates from Members.states, handlers.rs:808-863)

@@ -1,0 +1,143 @@
+"""Protocol-variant campaign axis (ISSUE 11): the protocol-frontier
+through the engine — wire-byte AND ordering-invariant bands recorded
+deterministically, loud refusals on cells that can't measure the axis,
+and the frontier rung's reduction record."""
+
+import dataclasses
+
+import pytest
+
+from corrosion_tpu.campaign.engine import run_campaign
+from corrosion_tpu.campaign.spec import (
+    CampaignSpec,
+    protocol_frontier_spec,
+)
+
+pytestmark = pytest.mark.campaign
+
+
+def _mini_frontier(protos=("baseline", "push-pull")):
+    """The builtin frontier shrunk to the tier-1 budget: 2 cells on the
+    flat-lossy family, 2 seeds, 48 nodes."""
+    spec = protocol_frontier_spec(seeds=(0, 1), n=48, max_rounds=400)
+    return dataclasses.replace(
+        spec, grid={
+            "proto_family": list(protos),
+            "topo_family": ["flat-lossy"],
+        },
+    )
+
+
+def test_frontier_cells_band_rounds_and_wire_bytes():
+    art = run_campaign(_mini_frontier(), out_path=None)
+    assert len(art["cells"]) == 2
+    by_proto = {}
+    for cell in art["cells"]:
+        assert cell["all_converged"], cell["params"]
+        ps = cell["per_seed"]
+        assert len(ps["wire_bytes"]) == 2
+        assert all(w > 0 for w in ps["wire_bytes"])
+        assert cell["bands"]["rounds"]["p50"] > 0
+        by_proto[cell["params"]["proto_family"]] = cell
+    assert set(by_proto) == {"baseline", "push-pull"}
+    # the exchange's cost axis: push-pull transmits more wire
+    assert (
+        by_proto["push-pull"]["bands"]["wire_bytes"]["p50"]
+        > by_proto["baseline"]["bands"]["wire_bytes"]["p50"]
+    )
+    # non-ordering cells carry no violation band (digest compatibility)
+    assert "order_violations" not in by_proto["baseline"]["per_seed"]
+
+
+def test_frontier_digest_stable_across_runs_and_telemetry():
+    spec = _mini_frontier()
+    a = run_campaign(spec, out_path=None)
+    b = run_campaign(spec, out_path=None)
+    assert a["result_digest"] == b["result_digest"]
+    c = run_campaign(spec, out_path=None, telemetry=True)
+    assert c["result_digest"] == a["result_digest"]
+
+
+def test_ordering_cells_band_the_invariant():
+    """An enforced-ordering cell records the on-device delivery-order
+    violation totals per lane (all zero) and bands them; the unchecked
+    negative control records NONZERO totals — the invariant is a
+    first-class campaign metric, regression-gated like any band."""
+    art = run_campaign(
+        _mini_frontier(("lab-ordered", "lab-ordered-broken")),
+        out_path=None,
+    )
+    cells = {c["params"]["proto_family"]: c for c in art["cells"]}
+    enforced = cells["lab-ordered"]
+    assert enforced["all_converged"]
+    assert enforced["per_seed"]["order_violations"] == [0, 0]
+    assert enforced["bands"]["order_violations"]["max"] == 0.0
+    broken = cells["lab-ordered-broken"]
+    assert all(v > 0 for v in broken["per_seed"]["order_violations"])
+    assert broken["bands"]["order_violations"]["min"] > 0
+
+
+def test_proto_keys_refused_on_serving_cells():
+    spec = CampaignSpec(
+        name="t",
+        scenario={"n_nodes": 3, "serving": True,
+                  "proto_family": "push-pull"},
+    )
+    with pytest.raises(ValueError, match="proto_family"):
+        run_campaign(spec, out_path=None)
+    spec2 = CampaignSpec(
+        name="t",
+        scenario={"n_nodes": 3, "serving": True, "ordering": "fifo"},
+    )
+    with pytest.raises(ValueError, match="ordering"):
+        run_campaign(spec2, out_path=None)
+
+
+def test_proto_keys_refused_on_detect_cells():
+    spec = CampaignSpec(
+        name="t",
+        scenario={
+            "n_nodes": 16, "n_payloads": 8, "swim_full_view": True,
+            "detect_membership": True, "kill_every": 3,
+            "proto_family": "push-pull",
+        },
+    )
+    with pytest.raises(ValueError, match="proto_family"):
+        run_campaign(spec, out_path=None)
+    spec2 = CampaignSpec(
+        name="t",
+        scenario={
+            "n_nodes": 16, "n_payloads": 8, "swim_full_view": True,
+            "detect_membership": True, "kill_every": 3,
+            "sync_cadence": "eager",
+        },
+    )
+    with pytest.raises(ValueError, match="sync_cadence"):
+        run_campaign(spec2, out_path=None)
+
+
+def test_frontier_rung_reduction_record():
+    """`config_protocol_frontier` reduces the campaign to the bench
+    record: per (topology, protocol family) rounds/wire plus ratios vs
+    the baseline family (the storm-scale sampler cell is exercised at a
+    tier-1-sized shape)."""
+    from corrosion_tpu.sim.runner import config_protocol_frontier
+
+    rec = config_protocol_frontier(
+        seed=0, n_nodes=48, n_seeds=2, max_rounds=400,
+        # tier-1 budget: one topology, two variants, and a small packed
+        # storm still exercise every path of the rung end-to-end
+        proto_families=("baseline", "lab-ordered"),
+        topo_families=("flat-lossy",),
+        sampler_storm_nodes=512, sampler_storm_payloads=64,
+    )
+    assert rec["converged"]
+    for fam, d in rec["families"].items():
+        assert "baseline" in d, fam
+        assert "rounds_ratio" in d["lab-ordered"], fam
+        assert "wire_ratio" in d["lab-ordered"], fam
+        assert d["lab-ordered"]["order_violations_max"] == 0.0
+    storm = rec["sampler_storm"]
+    assert storm["sampler"] == "peerswap"
+    assert storm["converged"]
+    assert storm["n_nodes"] == 512

@@ -228,6 +228,112 @@ def test_sim_topology_flag_refused_on_axisless_scenario():
     assert "does not take" in out.stderr
 
 
+def test_sim_proto_show_cli():
+    """`sim proto show` (ISSUE 11): the protocol-family registry —
+    entirely jax-free — plus a resolved family view, and the exit-2
+    refusal with the family list on an unknown name."""
+    out = run_cli("sim", "proto", "show")
+    assert "swarm-aggressive" in out.stdout
+    assert "lab-ordered" in out.stdout
+
+    out = run_cli("sim", "proto", "show", "--proto", "push-pull", "--json")
+    m = json.loads(out.stdout)
+    assert m["overlay"] == {"dissemination": "push-pull"}
+    assert m["resolved"]["dissemination"] == "push-pull"
+    assert m["resolved"]["sync_cadence"] == "periodic"
+
+    out = run_cli(
+        "sim", "proto", "show", "--proto", "no-such-family", check=False
+    )
+    assert out.returncode == 2
+    assert "baseline" in out.stderr  # the family list rides the error
+
+
+def test_sim_proto_flag_validation():
+    """--proto on scenario runs (ISSUE 11): refused on axis-less
+    scenarios, and an UNKNOWN family exits 2 with the list instead of a
+    traceback (the PR 9 --topology rule)."""
+    out = run_cli(
+        "sim", "swim-churn-64", "--proto", "push-pull", check=False
+    )
+    assert out.returncode == 2
+    assert "does not take" in out.stderr
+
+    out = run_cli(
+        "sim", "broadcast-1k", "--proto", "no-such-family", check=False
+    )
+    assert out.returncode == 2
+    assert "unknown protocol family" in out.stderr
+    assert "baseline" in out.stderr
+
+
+def test_sim_trace_show_parity_join(tmp_path):
+    """`sim trace show --parity` (ISSUE 11 carried edge): a sim lane
+    and its host-parity replay render as ONE joined table — host
+    per-write rows bucketed onto sim rounds via --round-s."""
+    sim_path = tmp_path / "sim.jsonl"
+    host_path = tmp_path / "host.jsonl"
+    sim_head = {
+        "kind": "flight_recorder", "version": 1, "n_nodes": 3,
+        "n_payloads": 4, "rounds": 2, "summary": {},
+    }
+    sim_rows = [
+        {"t": 0, "coverage_frac": 0.5, "delivered": 2, "bcast_bytes": 64.0,
+         "sync_sessions": 0},
+        {"t": 1, "coverage_frac": 1.0, "delivered": 2, "bcast_bytes": 32.0,
+         "sync_sessions": 1},
+    ]
+    sim_path.write_text(
+        "\n".join(json.dumps(r) for r in [sim_head] + sim_rows) + "\n"
+    )
+    host_head = {
+        "kind": "flight_recorder", "version": 1, "tier": "host",
+        "n_nodes": 3, "writes": 2, "summary": {},
+    }
+    host_rows = [
+        {"t": 0.01, "actor": "a", "version": 1, "node": 0,
+         "publish_to_visible_ms": 12.5, "hlc_lag_ms": 1.0},
+        {"t": 0.06, "actor": "a", "version": 2, "node": 0,
+         "publish_to_visible_ms": 20.0},
+    ]
+    host_path.write_text(
+        "\n".join(json.dumps(r) for r in [host_head] + host_rows) + "\n"
+    )
+
+    out = run_cli(
+        "sim", "trace", "show", "--in", str(sim_path),
+        "--parity", str(host_path), "--round-s", "0.05", "--json",
+    )
+    m = json.loads(out.stdout)
+    assert m["round_s"] == 0.05
+    rounds = m["rounds"]
+    assert len(rounds) == 2
+    assert rounds[0]["host_writes"] == 1
+    assert rounds[0]["host_visible_ms_max"] == 12.5
+    assert rounds[0]["coverage_frac"] == 0.5
+    assert rounds[1]["host_writes"] == 1
+    assert rounds[1]["host_visible_ms_max"] == 20.0
+
+    # the table form renders too
+    out = run_cli(
+        "sim", "trace", "show", "--in", str(sim_path),
+        "--parity", str(host_path),
+    )
+    assert "host_writes" in out.stdout
+
+    # tier mix-ups refuse loudly instead of joining garbage
+    out = run_cli(
+        "sim", "trace", "show", "--in", str(host_path),
+        "--parity", str(host_path), check=False,
+    )
+    assert out.returncode == 2
+    out = run_cli(
+        "sim", "trace", "show", "--in", str(sim_path),
+        "--parity", str(sim_path), check=False,
+    )
+    assert out.returncode == 2
+
+
 def test_sim_campaign_compare_cli(tmp_path):
     """`sim campaign compare` verdict + exit codes on synthetic
     artifacts (no jax in this path — the spec/report layer is plain

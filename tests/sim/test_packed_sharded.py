@@ -270,6 +270,79 @@ def test_odd_mesh_6_devices_fault_storm_bit_identical():
     _assert_bit_identical(single, sharded)
 
 
+@pytest.mark.parametrize("proto_family", [None, "baseline"])
+def test_proto_default_point_solo_vmapped_sharded_bit_identical(
+    proto_family,
+):
+    """ISSUE 11 byte-identity matrix for the DEFAULT protocol point,
+    with ``proto_family`` unset AND explicitly "baseline": dense==packed
+    bit-equal, and solo == vmapped-lane == mesh-sharded byte-identity on
+    the packed path — the same matrix PR 9 pinned for topologies,
+    extended over the protocol axis (an explicitly-resolved baseline
+    family must compile the IDENTICAL program)."""
+    from corrosion_tpu.campaign.ensemble import run_seed_ensemble
+    from corrosion_tpu.campaign.spec import CampaignSpec
+
+    scenario = {"n_nodes": 96, "n_payloads": 64, "n_writers": 4,
+                "fanout": 3}
+    if proto_family is not None:
+        scenario["proto_family"] = proto_family
+    spec = CampaignSpec(name="t", scenario=scenario)
+    cfg = dataclasses.replace(spec.sim_config({}), packed_min_cells=0)
+    meta = _write_storm(96, 64)[1]
+    topo = Topology()
+    assert packed_supported(cfg, topo)
+
+    solo = run_to_convergence(new_sim(cfg, SEED), meta, cfg, topo, 600)
+    jax.block_until_ready(solo)
+
+    # dense == packed bit-equal at the default point
+    dense_cfg = dataclasses.replace(cfg, allow_packed=False)
+    dense = run_to_convergence(
+        new_sim(dense_cfg, SEED), meta, dense_cfg, topo, 600
+    )
+    _assert_bit_identical(solo, dense, labels=("state", "metrics"))
+
+    # vmapped lane 0 of a 2-seed ensemble == the solo run
+    lanes = run_seed_ensemble(
+        None, cfg, topo, meta, (SEED, SEED + 1), max_rounds=600
+    )
+    lane0 = jax.tree.map(lambda x: x[0], lanes)
+    _assert_bit_identical(solo, lane0, labels=("state", "metrics"))
+
+    # mesh-sharded == solo (96 % 8 == 0)
+    mesh = make_mesh(8)
+    sharded = run_to_convergence(
+        shard_state(new_sim(cfg, SEED), mesh),
+        replicate_meta(meta, mesh),
+        cfg, topo, 600, mesh=mesh,
+    )
+    _assert_bit_identical(solo, sharded, labels=("state", "metrics"))
+
+
+def test_proto_variant_sharded_bit_identical():
+    """A NON-default protocol point through the sharded matrix: the
+    push-pull exchange on the packed path, node-axis-split over the
+    full virtual mesh, telemetry on — state, metrics, and every wire
+    channel (the pull direction included) equal single-device
+    exactly."""
+    from corrosion_tpu.proto import family_proto
+
+    cfg, meta = _storm(96, 64)
+    cfg = dataclasses.replace(cfg, **family_proto("push-pull"))
+    assert packed_supported(cfg, Topology())
+    single = run_to_convergence(
+        new_sim(cfg, SEED), meta, cfg, Topology(), 600, telemetry=True
+    )
+    mesh = make_mesh(8)
+    sharded = run_to_convergence(
+        shard_state(new_sim(cfg, SEED), mesh),
+        replicate_meta(meta, mesh),
+        cfg, Topology(), 600, telemetry=True, mesh=mesh,
+    )
+    _assert_bit_identical(single, sharded)
+
+
 def test_ensemble_mesh_picks_largest_divisor():
     """Campaign cells never pad (padding would change trajectories):
     `ensemble_mesh` degrades to the largest dividing device count."""

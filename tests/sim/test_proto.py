@@ -1,0 +1,356 @@
+"""Protocol-variant subsystem (ISSUE 11).
+
+Four contracts under test:
+
+1. **Default byte-identity** — the default protocol point (every proto
+   knob at its legacy value, `proto_family` unset AND explicitly
+   ``"baseline"``) compiles to the pre-ISSUE-11 program: the digest
+   constants captured on the pre-change tree (tests/sim/test_topo.py's
+   pins) reproduce, and the jax-free `proto.DEFAULTS` table mirrors the
+   SimConfig field defaults exactly.
+2. **Variant correctness** — every named family builds a valid config,
+   converges, and runs dense==packed bit-equal (telemetry included);
+   unknown knob values and unsupported combos refuse loudly.
+3. **Ordering invariant** — the enforced FIFO discipline ends every run
+   at ZERO on-device delivery-order violations (and the host-snapshot
+   twin agrees), while the ``fifo-unchecked`` negative control MUST
+   trip it (the pinned violation test); both compose with FaultPlans.
+4. **Campaign-spec resolution** — `proto_family` resolves through the
+   registry with explicit keys overlaying the family, and the
+   protocol-frontier builtin expands to the 4 × 2 variant grid.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.faults import FaultEvent, FaultPlan
+from corrosion_tpu.proto import DEFAULTS, FAMILIES, PROTO_KEYS, family_proto
+from corrosion_tpu.sim.faults import compile_plan, run_fault_plan
+from corrosion_tpu.sim.round import new_sim, run_to_convergence
+from corrosion_tpu.sim.state import ALIVE, SimConfig, uniform_payloads
+from corrosion_tpu.sim.topology import Topology
+
+VARIANT_FAMILIES = sorted(set(FAMILIES) - {"baseline"})
+
+
+def _digest(state, skip=("pview",)):
+    """The test_topo.py digest (pre-ISSUE-9 fields) so pins captured on
+    the pre-change trees stay comparable."""
+    h = hashlib.blake2b(digest_size=8)
+    for f, v in zip(type(state)._fields, state):
+        if f in skip:
+            continue
+        h.update(f.encode())
+        h.update(np.ascontiguousarray(np.asarray(v)).tobytes())
+    return h.hexdigest()
+
+
+def _cfg(fam=None, **kw):
+    base = dict(
+        n_nodes=48, n_payloads=32, n_writers=2, fanout=3,
+        sync_interval_rounds=4,
+    )
+    if fam:
+        base.update(family_proto(fam))
+    base.update(kw)  # explicit knobs overlay the family (the spec rule)
+    return SimConfig(**base)
+
+
+# -- 1. default byte-identity ------------------------------------------------
+
+
+def test_defaults_table_mirrors_simconfig_fields():
+    """`proto.DEFAULTS` is the jax-free copy `sim proto show` renders;
+    it must mirror the SimConfig field defaults exactly (the drift
+    guard the registry docstring promises)."""
+    fields = SimConfig.__dataclass_fields__
+    assert set(DEFAULTS) == set(PROTO_KEYS)
+    for k, v in DEFAULTS.items():
+        assert fields[k].default == v, k
+
+
+def test_explicit_baseline_family_is_byte_identical_to_unset():
+    """proto_family="baseline" resolved through the spec must build the
+    IDENTICAL SimConfig — and its run must reproduce the digest pinned
+    on the pre-ISSUE-11 tree (test_topo.py's constant)."""
+    from corrosion_tpu.campaign.spec import CampaignSpec
+
+    scenario = {
+        "n_nodes": 24, "n_payloads": 16, "fanout": 2,
+        "sync_interval_rounds": 4,
+    }
+    unset = CampaignSpec(name="t", scenario=dict(scenario))
+    explicit = CampaignSpec(
+        name="t", scenario=dict(scenario, proto_family="baseline")
+    )
+    cfg_unset = unset.sim_config({})
+    cfg_explicit = explicit.sim_config({})
+    assert cfg_unset == cfg_explicit
+    meta = uniform_payloads(cfg_explicit, inject_every=1)
+    final, _ = run_to_convergence(
+        new_sim(cfg_explicit, 3), meta, cfg_explicit, Topology(), 200
+    )
+    assert int(final.t) == 20
+    assert _digest(final) == "c5d4e8bcd80cb0ef"  # the pre-change pin
+
+
+def test_default_metrics_carry_zero_order_violations():
+    cfg = _cfg()
+    meta = uniform_payloads(cfg, inject_every=1)
+    _, m = run_to_convergence(new_sim(cfg, 3), meta, cfg, Topology(), 300)
+    assert int(m.order_violations) == 0
+
+
+# -- 2. variant correctness --------------------------------------------------
+
+
+def test_simconfig_refuses_unknown_proto_values():
+    with pytest.raises(ValueError, match="dissemination"):
+        _cfg(dissemination="pull")
+    with pytest.raises(ValueError, match="fanout_schedule"):
+        _cfg(fanout_schedule="ramp")
+    with pytest.raises(ValueError, match="fanout_decay_rounds"):
+        _cfg(fanout_schedule="decay", fanout_decay_rounds=0)
+    with pytest.raises(ValueError, match="sync_cadence"):
+        _cfg(sync_cadence="lazy")
+    with pytest.raises(ValueError, match="ordering"):
+        _cfg(ordering="total")
+    # ordering over a single version per writer has no order to impose
+    with pytest.raises(ValueError, match="versions"):
+        SimConfig(n_nodes=8, n_payloads=1, ordering="fifo")
+    with pytest.raises(KeyError, match="unknown protocol family"):
+        family_proto("no-such-family")
+
+
+def test_every_family_builds_and_converges():
+    topo = Topology(loss=0.2)
+    for fam in FAMILIES:
+        cfg = _cfg(fam)
+        meta = uniform_payloads(cfg, inject_every=1)
+        final, m = run_to_convergence(
+            new_sim(cfg, 3), meta, cfg, topo, 600
+        )
+        conv = np.asarray(m.converged_at)
+        assert (conv >= 0).all(), fam
+        assert (np.asarray(final.have) > 0).all(), fam
+
+
+@pytest.mark.parametrize(
+    "fam",
+    [
+        # tier-1 keeps the variants with UNIQUE kernel seams: the pull
+        # exchange, the enforced delivery gate, and the unchecked
+        # violation counter; the schedule/cadence variants (pure mask /
+        # due overrides on shared machinery) ride the nightly slow tier
+        "push-pull",
+        "lab-ordered",
+        "lab-ordered-broken",
+        pytest.param("swarm-aggressive", marks=pytest.mark.slow),
+        pytest.param("fanout-decay", marks=pytest.mark.slow),
+    ],
+)
+def test_variant_packed_matches_dense(fam):
+    """Every variant family runs the packed round bit-identical to the
+    dense one — state, metrics (order_violations included), and every
+    telemetry channel — under a lossy topology so the pull/drop seams
+    actually fire."""
+    kw = dict(n_nodes=64, n_payloads=64, n_writers=4, fanout=3)
+    kw.update(family_proto(fam))
+    cfg = dataclasses.replace(SimConfig(**kw), packed_min_cells=0)
+    dense_cfg = dataclasses.replace(cfg, allow_packed=False)
+    meta = uniform_payloads(cfg, inject_every=1)
+    topo = Topology(loss=0.1)
+    packed = run_to_convergence(
+        new_sim(cfg, 5), meta, cfg, topo, 600, telemetry=True
+    )
+    dense = run_to_convergence(
+        new_sim(dense_cfg, 5), meta, dense_cfg, topo, 600, telemetry=True
+    )
+    for x, y in zip(jax.tree.leaves(packed), jax.tree.leaves(dense)):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y),
+            err_msg=f"packed diverged from dense under {fam}",
+        )
+
+
+def test_variant_runs_are_deterministic():
+    cfg = _cfg("push-pull")
+    meta = uniform_payloads(cfg, inject_every=1)
+    a, _ = run_to_convergence(new_sim(cfg, 7), meta, cfg, Topology(), 300)
+    b, _ = run_to_convergence(new_sim(cfg, 7), meta, cfg, Topology(), 300)
+    assert _digest(a, skip=()) == _digest(b, skip=())
+
+
+def test_push_pull_pays_wire_for_rounds():
+    """The exchange's trade on a lossy topology: push-pull must not be
+    slower than push, and must transmit MORE wire bytes (the responses
+    are real frames — the Pareto's cost axis)."""
+    topo = Topology(loss=0.2)
+    out = {}
+    for fam in ("baseline", "push-pull"):
+        cfg = _cfg(fam)
+        meta = uniform_payloads(cfg, inject_every=1)
+        final, m, trace = run_to_convergence(
+            new_sim(cfg, 3), meta, cfg, topo, 400, telemetry=True
+        )
+        r = int(final.t)
+        out[fam] = (
+            r, float(np.asarray(trace.bcast_bytes)[:r].sum())
+        )
+    assert out["push-pull"][0] <= out["baseline"][0]
+    assert out["push-pull"][1] > out["baseline"][1]
+
+
+def test_fanout_decay_caps_active_slots():
+    from corrosion_tpu.proto.schedule import active_fanout
+
+    cfg = _cfg("fanout-decay", fanout=4, fanout_decay_rounds=4)
+    f = [int(active_fanout(cfg, jnp.int32(t))) for t in (0, 3, 4, 8, 100)]
+    assert f == [4, 4, 2, 1, 1]
+
+
+# -- 3. the delivery-order invariant ----------------------------------------
+
+
+def _lossy_order_run(fam, seed=3):
+    cfg = _cfg(fam)
+    meta = uniform_payloads(cfg, inject_every=1)
+    topo = Topology(loss=0.3)  # per-payload loss reorders deliveries
+    final, m = run_to_convergence(new_sim(cfg, seed), meta, cfg, topo, 800)
+    return cfg, meta, final, m
+
+
+def test_enforced_ordering_holds_the_invariant_at_zero():
+    cfg, meta, final, m = _lossy_order_run("lab-ordered")
+    assert (np.asarray(m.converged_at) >= 0).all()
+    assert int(m.order_violations) == 0
+    # the host-snapshot twin agrees (sim/invariants.py check_state)
+    from corrosion_tpu.sim.invariants import check_state
+
+    check_state(final, cfg, meta=meta)
+
+
+def test_broken_ordering_trips_the_invariant():
+    """The pinned violation test: the unchecked negative control runs
+    the same on-device check without the delivery gate — gossip reorder
+    under loss MUST trip it (deterministic for the pinned seed)."""
+    _, _, _, m = _lossy_order_run("lab-ordered-broken")
+    assert int(m.order_violations) > 0
+
+
+def test_ordering_composes_with_fault_plans():
+    """FIFO ordering under a loss + partition + crash-with-wipe plan:
+    the cluster still converges and the enforced invariant still ends
+    at zero (origin rows are exempt by design, so the wipe cannot
+    page)."""
+    cfg = dataclasses.replace(_cfg("lab-ordered"), n_delay_slots=4)
+    meta = uniform_payloads(cfg, inject_every=1)
+    plan = FaultPlan(
+        n_nodes=cfg.n_nodes, seed=7,
+        events=(
+            FaultEvent("loss", 0, 12, p=0.3),
+            FaultEvent("partition", 2, 8, src="0:8", dst="24:32",
+                       symmetric=True),
+            FaultEvent("crash", 6, 10, node=1, wipe=True),
+        ),
+    )
+    fplan = compile_plan(plan, cfg, Topology())
+    final, m = run_fault_plan(
+        new_sim(cfg, 7), meta, cfg, Topology(), fplan, 800
+    )
+    conv = np.asarray(m.converged_at)
+    alive = np.asarray(final.alive)
+    assert ((conv >= 0) | (alive != ALIVE)).all()
+    assert int(m.order_violations) == 0
+
+
+def test_order_violation_count_counts_the_gap():
+    """Unit form: a node holding v2 without v1 complete is exactly one
+    violating (node, origin, version) triple; the origin row is
+    exempt."""
+    from corrosion_tpu.sim.invariants import order_violation_count
+    from corrosion_tpu.sim.state import (
+        complete_versions,
+        touched_versions,
+    )
+
+    cfg = SimConfig(n_nodes=4, n_payloads=4, ordering="fifo-unchecked")
+    meta = uniform_payloads(cfg, inject_every=1)
+    state = new_sim(cfg, 0)
+    have = state.have
+    origin = int(np.asarray(meta.actor)[1])
+    holder = (origin + 1) % cfg.n_nodes
+    have = have.at[holder, 1].set(1)  # v2 without v1
+    have = have.at[origin, 1].set(1)  # origin row: exempt
+    touched = touched_versions(have, cfg)
+    comp = complete_versions(have, cfg)
+    assert int(order_violation_count(touched, comp, meta, cfg)) == 1
+
+    # multi-chunk versions count ONE triple, not chunks_per_version of
+    # them (the grid-domain counting contract): one chunk of v2 held
+    # while v1 is incomplete is still exactly one violation
+    cfg2 = SimConfig(
+        n_nodes=4, n_payloads=8, chunks_per_version=2,
+        ordering="fifo-unchecked",
+    )
+    meta2 = uniform_payloads(cfg2, inject_every=1)
+    have2 = new_sim(cfg2, 0).have
+    origin2 = int(np.asarray(meta2.actor)[0])
+    holder2 = (origin2 + 1) % cfg2.n_nodes
+    have2 = have2.at[holder2, 2].set(1)  # first chunk of v2, no v1
+    assert int(order_violation_count(
+        touched_versions(have2, cfg2),
+        complete_versions(have2, cfg2),
+        meta2, cfg2,
+    )) == 1
+
+
+# -- 4. campaign-spec resolution ---------------------------------------------
+
+
+def test_spec_proto_family_resolution_and_overlay():
+    from corrosion_tpu.campaign.spec import CampaignSpec
+
+    spec = CampaignSpec(
+        name="t",
+        scenario={
+            "n_nodes": 48, "n_payloads": 16,
+            # the explicit key must OVERLAY the family's bundle
+            "fanout_decay_rounds": 3,
+        },
+        grid={"proto_family": ["fanout-decay", "swarm-aggressive"]},
+    )
+    cells = spec.cells()
+    cfgs = {c["proto_family"]: spec.sim_config(c) for c in cells}
+    decay = cfgs["fanout-decay"]
+    assert decay.fanout_schedule == "decay"
+    assert decay.fanout_decay_rounds == 3  # explicit key wins
+    swarm = cfgs["swarm-aggressive"]
+    assert swarm.sync_cadence == "eager"
+    assert swarm.fanout_schedule == "flat"
+    with pytest.raises(KeyError, match="unknown protocol family"):
+        spec.sim_config({"proto_family": "nope"})
+
+
+def test_protocol_frontier_builtin_shape():
+    from corrosion_tpu.campaign.spec import BUILTIN_SPECS
+
+    spec = BUILTIN_SPECS["protocol-frontier"]()
+    cells = spec.cells()
+    assert len(cells) == 8  # 4 protocol families × 2 topologies
+    protos = {c["proto_family"] for c in cells}
+    assert protos == {
+        "baseline", "swarm-aggressive", "push-pull", "lab-ordered",
+    }
+    assert {c["topo_family"] for c in cells} == {"wan-3x2", "flat-lossy"}
+    assert spec.measure_wire(cells[0])
+    # every cell builds a legal config/topology pair
+    for c in cells:
+        cfg = spec.sim_config(c)
+        assert cfg.n_nodes == 96
+        spec.topo(c)
