@@ -56,6 +56,7 @@ _secondary: dict | None = None
 _fault_storm: dict | None = None
 _tier_1m: dict | None = None
 _serving: dict | None = None
+_serving_mp: dict | None = None
 _topo_frontier: dict | None = None
 _proto_frontier: dict | None = None
 _printed = False
@@ -101,6 +102,12 @@ def _emit_and_exit(code: int = 0) -> None:
     # instrumentation-overhead fraction recorded like the sim rung's
     if _serving is not None:
         out["serving_loadgen"] = _serving
+    # multi-process serving rung (ISSUE 13): ≥1000 writer lanes sharded
+    # across loadgen worker processes against a real devcluster —
+    # faultless p99, kill+restart with zero acked writes lost, and an
+    # overload condition whose 429 counts prove graceful degradation
+    if _serving_mp is not None:
+        out["serving_loadgen_mp"] = _serving_mp
     # peer-sampler frontier rung (ISSUE 9): uniform vs PeerSwap
     # convergence-rounds × wire-bytes across two topology families —
     # the paper-grounded sampler comparison, tracked per bench run
@@ -454,6 +461,62 @@ def main() -> int:
                 .get("p99"),
             }
             _diag["serving_loadgen"] = {"nodes": sv_nodes, **m}
+        _write_diag()
+
+    # multi-process serving rung (ISSUE 13): the ≥1000-writer form over
+    # REAL processes (devcluster agents + sharded loadgen workers) with
+    # a kill+restart FaultPlan and an overload (429) condition.  Pure
+    # host path, its own child so a wedged devcluster can never eat the
+    # storm budget.
+    global _serving_mp
+    if os.environ.get("BENCH_SERVING_MP", "1") != "0" and _remaining() > 180:
+        mp_writers = int(os.environ.get("BENCH_SERVING_MP_WRITERS", "1024"))
+        mp_workers = int(os.environ.get("BENCH_SERVING_MP_WORKERS", "8"))
+        res = run_child(
+            {
+                "mode": "aux",
+                "platform": "cpu",  # pure host path: never wake the chip
+                "fn": "config_serving_loadgen_mp",
+                "seed": 1,
+                "kwargs": {
+                    "n_writers": mp_writers,
+                    "n_workers": mp_workers,
+                    "n_writes": 2 * mp_writers,
+                },
+            },
+            timeout=min(_remaining() - 30, 600.0),
+        )
+        _diag["attempts"].append(
+            {"phase": "serving_loadgen_mp", "writers": mp_writers, **res}
+        )
+        m = res.get("metrics") or {}
+        if res.get("ok") and m.get("converged"):
+            vl = m.get("publish_visible_s") or {}
+            _serving_mp = {
+                "metric": (
+                    f"serving_loadgen_mp_{mp_writers}writers_"
+                    "publish_visible_p99"
+                ),
+                "value": vl.get("p99"),
+                "unit": "s",
+                "p50": vl.get("p50"),
+                "p95": vl.get("p95"),
+                "writers": mp_writers,
+                "workers": mp_workers,
+                "throughput_wps": m.get("throughput_wps"),
+                "lost_writes": m.get("lost_writes"),
+                "crash_consistent": (m.get("crash") or {}).get("consistent"),
+                "crash_p99_s": (m.get("crash") or {})
+                .get("publish_visible_s", {})
+                .get("p99"),
+                "overload_retries_429": (m.get("overload") or {}).get(
+                    "retries_429"
+                ),
+                "overload_rejected": (m.get("overload") or {}).get(
+                    "admission_rejected_total"
+                ),
+            }
+            _diag["serving_loadgen_mp"] = {"writers": mp_writers, **m}
         _write_diag()
 
     # peer-sampler frontier rung (ISSUE 9): the uniform-vs-PeerSwap

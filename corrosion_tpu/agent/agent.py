@@ -163,7 +163,16 @@ class Agent:
         if getattr(transport, "on_rtt", "absent") is None:
             transport.on_rtt = self._on_transport_rtt
 
+        # bounded by the flush tick's drop-most-sent-oldest trim to
+        # perf.broadcast_max_inflight (_broadcast_loop) — a maxlen here
+        # would drop NEWEST-first, the wrong end of the epidemic
+        # corrolint: disable=CT008
         self._bcast_q: deque = deque()  # _PendingBroadcast
+        # bounded by the counted drop-OLDEST policy at enqueue
+        # (perf.changes_queue_cap in _enqueue_changeset, the reference's
+        # handlers.rs:729-749 overflow rule) — Queue(maxsize) would
+        # BLOCK the receive path instead of shedding
+        # corrolint: disable=CT008
         self._ingest_q: asyncio.Queue = asyncio.Queue()
         self._seen: OrderedDict = OrderedDict()  # dedup cache (handlers.rs:671)
         self._sync_inbound = 0
@@ -188,8 +197,10 @@ class Agent:
         subs_dir = (
             None if config.db_path in (":memory:", "") else config.db_path + ".subs"
         )
-        self.subs = SubsManager(self.store, subs_dir)
-        self.updates = UpdatesManager()
+        self.subs = SubsManager(
+            self.store, subs_dir, queue_cap=config.perf.sub_queue_cap
+        )
+        self.updates = UpdatesManager(queue_cap=config.perf.sub_queue_cap)
         # metrics counters (metrics facade analog)
         self.stats = {
             "changes_committed": 0, "changes_applied": 0, "changes_deduped": 0,

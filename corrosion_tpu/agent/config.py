@@ -64,6 +64,20 @@ class PerfConfig:
     # statement interruption (sqlite-pool/src/lib.rs:116)
     statement_timeout_s: float = 30.0
     slow_query_warn_s: float = 1.0
+    # serving-tier backpressure (ISSUE 13, doc/serving.md).  Every
+    # bound here surfaces as a saturation counter / queue-depth gauge
+    # through the host flight recorder — a limit the operator can't see
+    # is a silent drop waiting to happen.
+    # per-subscriber event queue bound: a consumer that falls this many
+    # events behind is DISCONNECTED with an explicit reason (never a
+    # silent drop; it re-syncs via the snapshot/?from= path on reconnect)
+    sub_queue_cap: int = 1024
+    # admission control on /v1/transactions: writes admitted beyond this
+    # in-flight count get 429 + Retry-After instead of queueing unbounded
+    api_max_inflight_tx: int = 256
+    # write-lane batching: how many admitted writes one write_sema hold
+    # drains back-to-back before yielding the lane
+    api_write_batch: int = 32
 
 
 @dataclass
@@ -84,6 +98,13 @@ class Config:
     # batch pipeline, corrosion/src/main.rs:57-150); "" disables
     otlp_endpoint: str = ""  # collector base URL or full /v1/traces path
     otlp_service_name: str = "corrosion-tpu"
+    # [telemetry] host flight recorder (ISSUE 13): a path arms
+    # `attach_host_telemetry` on the agent and periodically writes the
+    # per-write stage stamps + saturation gauges as host flight JSONL
+    # (atomic replace, so a kill -9'd node leaves its last snapshot) —
+    # what makes a devcluster node's backpressure visible from outside
+    # the process; "" disables
+    telemetry_flight_path: str = ""
     # [gossip.tls] — (m)TLS on the gossip transport (config.rs:170-193,
     # api/peer/mod.rs:149-339).  Keys: cert_file, key_file, ca_file,
     # insecure (bool), client.cert_file/key_file (mTLS),
@@ -137,6 +158,7 @@ class Config:
                 tel.get("otlp_endpoint", "") or tel_otel.get("endpoint", "")
             ),
             otlp_service_name=tel.get("service_name", "corrosion-tpu"),
+            telemetry_flight_path=tel.get("flight_path", ""),
         )
         for k, v in perf_raw.items():
             if hasattr(cfg.perf, k):

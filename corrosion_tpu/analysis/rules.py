@@ -21,6 +21,10 @@ fixed (doc/lint.md carries the full incident write-ups):
   blocking calls inside ``async def`` in the host tier.
 - CT006 — broad ``except Exception`` that neither logs nor re-raises:
   the class that let every one of the above hide for a while.
+- CT008 — ISSUE 13's backpressure incident class: an unbounded
+  ``asyncio.Queue()``/``deque()`` in a host-tier serving path turns a
+  flood (or one slow consumer) into unbounded memory instead of an
+  explicit 429 / disconnect-with-reason policy.
 """
 
 from __future__ import annotations
@@ -464,6 +468,89 @@ class BroadExceptSwallow(Rule):
                     )
 
 
+#: the serving-path tier CT008 patrols: every queue between a client
+#: and a commit/fan-out lives here (api ingress, agent broadcast/ingest,
+#: pubsub fan-out).  The cli/pg/consul dirs are operator tooling, not
+#: the flood path.
+SERVING_TIER = (
+    "corrosion_tpu/agent/",
+    "corrosion_tpu/api/",
+    "corrosion_tpu/pubsub/",
+)
+
+
+def _int_literal(node) -> Optional[int]:
+    """The int value of a literal expression, unary minus included
+    (``-1`` parses as UnaryOp(USub, Constant)); None for anything
+    non-literal."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _int_literal(node.operand)
+        return -inner if inner is not None else None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+class UnboundedQueueInHostTier(Rule):
+    """CT008: ``asyncio.Queue()`` / ``collections.deque()`` constructed
+    WITHOUT a bound in the host-tier serving paths.  ISSUE 13's incident
+    class: a subscriber queue with no maxsize turns one slow consumer
+    into unbounded server memory under a flood — the serving tier's
+    rule is every queue carries a bound, and overflow is an EXPLICIT
+    policy (429, disconnect-with-reason, counted drop-oldest), never
+    silent growth.  A deliberately-elsewhere-bounded queue documents
+    itself with a pragma naming the bound."""
+
+    code = "CT008"
+    name = "unbounded-queue-in-host-tier"
+    incident = (
+        "ISSUE 13: pre-backpressure, every per-subscriber fan-out queue "
+        "and the write path queued unboundedly — 1000 writers of load "
+        "became silent memory growth instead of 429s"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        for sf in ctx.under(*SERVING_TIER):
+            if sf.tree is None:
+                continue
+            idx = ModuleIndex(sf)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = idx.canonical(node.func)
+                kws = {k.arg: k.value for k in node.keywords}
+                if dotted == "asyncio.Queue":
+                    bound = (
+                        node.args[0] if node.args else kws.get("maxsize")
+                    )
+                    lit = _int_literal(bound)
+                    if bound is None:
+                        what = "asyncio.Queue() without maxsize"
+                    elif lit is not None and lit <= 0:
+                        # asyncio semantics: maxsize <= 0 IS infinite —
+                        # the literal zero/negative spelling of the
+                        # incident
+                        what = f"asyncio.Queue({lit}) is unbounded"
+                    else:
+                        continue
+                elif dotted == "collections.deque":
+                    # (deque(maxlen=0) is bounded — it keeps nothing)
+                    if len(node.args) >= 2 or "maxlen" in kws:
+                        continue
+                    what = "deque() without maxlen"
+                else:
+                    continue
+                yield (
+                    sf.relpath,
+                    node.lineno,
+                    f"{what} in a host-tier serving path — a flood "
+                    "turns it into unbounded memory; bound it at "
+                    "construction with an explicit overflow policy "
+                    "(429 / disconnect-with-reason / counted drop), or "
+                    "pragma-document where the bound actually lives",
+                )
+
+
 RULES = [
     UnalignedU8Draw,
     HostSyncInKernel,
@@ -471,4 +558,5 @@ RULES = [
     MetaKeyShadow,
     BlockingCallInAsync,
     BroadExceptSwallow,
+    UnboundedQueueInHostTier,
 ]

@@ -15,6 +15,34 @@ from typing import AsyncIterator, List, Optional, Sequence
 from .wire import decode_value, encode_tree
 
 
+class ApiError(RuntimeError):
+    """Non-200 API response with its status attached, so callers can
+    classify without parsing repr strings."""
+
+    def __init__(self, message: str, status: int):
+        super().__init__(message)
+        self.status = status
+
+
+class Overloaded(ApiError):
+    """429 from the serving tier's admission control (ISSUE 13): the
+    write was REFUSED, not committed — always safe to retry after
+    ``retry_after_s`` (the server's Retry-After header)."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float]):
+        super().__init__(message, 429)
+        self.retry_after_s = retry_after_s
+
+
+#: transport-level failures where the request MAY have committed before
+#: the connection died — retriable for idempotent statements (the
+#: loadgen's INSERT OR REPLACE shape), and the classification
+#: `execute_with_retry` counts separately from 429 backpressure
+TRANSPORT_ERRORS = (
+    ConnectionError, OSError, asyncio.IncompleteReadError, EOFError,
+)
+
+
 class ApiClient:
     def __init__(self, addr: str, authz_token: Optional[str] = None):
         self.addr = addr
@@ -33,7 +61,16 @@ class ApiClient:
             await writer.drain()
 
             status_line = await reader.readline()
-            status = int(status_line.split()[1])
+            if not status_line:
+                # server died between accept and response (the kill -9
+                # window): a TRANSPORT error, retriable — not a parse bug
+                raise ConnectionError("connection closed before response")
+            try:
+                status = int(status_line.split()[1])
+            except (IndexError, ValueError):
+                raise ConnectionError(
+                    f"malformed status line {status_line!r}"
+                ) from None
             resp_headers = {}
             while True:
                 h = await reader.readline()
@@ -68,11 +105,72 @@ class ApiClient:
         try:
             body = await self._read_body(headers, reader)
             payload = json.loads(body)
+            if status == 429:
+                ra = headers.get("retry-after")
+                raise Overloaded(
+                    f"execute refused (429): {payload}",
+                    retry_after_s=float(ra) if ra else None,
+                )
             if status != 200:
-                raise RuntimeError(f"execute failed ({status}): {payload}")
+                raise ApiError(
+                    f"execute failed ({status}): {payload}", status
+                )
             return payload
         finally:
             writer.close()
+
+    async def execute_with_retry(
+        self,
+        statements: Sequence,
+        max_retries: int = 8,
+        min_s: float = 0.05,
+        max_s: float = 2.0,
+        rng=None,
+        counters: Optional[dict] = None,
+    ) -> dict:
+        """`execute` behind the reference's decorrelated-jitter
+        `Backoff` (max_retries caps CONSECUTIVE failures; the budget is
+        the give-up signal).  Retries exactly two classes:
+
+        - **429 backpressure** (`Overloaded`) — the write was refused
+          before commit; sleep at least the server's Retry-After;
+        - **transport errors** — the write may or may not have
+          committed; retrying is safe for idempotent statements (the
+          loadgen's INSERT OR REPLACE contract).
+
+        Deterministic 4xx/5xx responses raise immediately — retrying a
+        schema error just burns the budget.  ``counters`` (optional)
+        gains ``retries_429`` / ``retries_transport`` / ``gave_up`` so
+        drivers can report observed backpressure honestly."""
+        from ..utils.backoff import Backoff
+
+        backoff = Backoff(min_s, max_s, rng=rng, max_retries=max_retries)
+
+        def _count(key):
+            if counters is not None:
+                counters[key] = counters.get(key, 0) + 1
+
+        while True:
+            try:
+                return await self.execute(statements)
+            except Overloaded as e:
+                _count("retries_429")
+                # budget check BEFORE the draw: a StopIteration must
+                # never escape a coroutine (PEP 479 would repackage it
+                # as RuntimeError and destroy the caller's failover
+                # classification) — the ORIGINAL error is the signal
+                if backoff.gave_up:
+                    _count("gave_up")
+                    raise
+                await asyncio.sleep(
+                    max(next(backoff), e.retry_after_s or 0.0)
+                )
+            except TRANSPORT_ERRORS:
+                _count("retries_transport")
+                if backoff.gave_up:
+                    _count("gave_up")
+                    raise
+                await asyncio.sleep(next(backoff))
 
     async def query(self, statement) -> List[list]:
         """Collect all rows of an NDJSON query stream."""

@@ -27,10 +27,18 @@ MAX_BODY = 64 * 1024 * 1024
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(
+        self,
+        status: int,
+        message: str,
+        retry_after_s: Optional[float] = None,
+    ):
         super().__init__(message)
         self.status = status
         self.message = message
+        # 429 backpressure responses carry an explicit Retry-After so
+        # clients back off instead of hammering (doc/serving.md)
+        self.retry_after_s = retry_after_s
 
 
 class ApiServer:
@@ -41,6 +49,8 @@ class ApiServer:
         port: int = 0,
         authz_token: Optional[str] = None,
         max_concurrency: int = 128,
+        max_inflight_tx: Optional[int] = None,
+        write_batch: Optional[int] = None,
     ):
         self.agent = agent
         self._host = host
@@ -51,6 +61,33 @@ class ApiServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._extra_routes: Dict[Tuple[str, str], Callable] = {}
         self._conn_tasks: set = set()
+        # -- write-path backpressure (ISSUE 13, doc/serving.md) --------
+        # admission control: at most this many writes IN FLIGHT
+        # (admitted, waiting on or holding the write lane); the
+        # (max_inflight_tx + 1)-th gets 429 + Retry-After, never an
+        # unbounded queue.  Defaults ride the agent's PerfConfig.
+        perf = agent.config.perf
+        self.max_inflight_tx = (
+            max_inflight_tx
+            if max_inflight_tx is not None
+            else perf.api_max_inflight_tx
+        )
+        # write batching: one write_sema hold drains up to this many
+        # admitted writes back-to-back (the commit path's lock-churn
+        # amortization under a flood) before yielding the lane to the
+        # ingest loop / PG front-end
+        self.write_batch = (
+            write_batch if write_batch is not None else perf.api_write_batch
+        )
+        self._tx_inflight = 0
+        from collections import deque
+
+        # bounded by admission control: _admit_transaction refuses
+        # (429) before appending once max_inflight_tx are in flight, so
+        # entries can never exceed that cap
+        # corrolint: disable=CT008
+        self._write_q: deque = deque()
+        self._write_drainer: Optional[asyncio.Task] = None
 
     def route(self, method: str, path: str, handler: Callable) -> None:
         """Extension point for subscription/updates endpoints."""
@@ -70,6 +107,11 @@ class ApiServer:
             for t in list(self._conn_tasks):
                 t.cancel()
             await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+            if self._write_drainer is not None:
+                self._write_drainer.cancel()
+                await asyncio.gather(
+                    self._write_drainer, return_exceptions=True
+                )
             await self._server.wait_closed()
 
     # -- plumbing ---------------------------------------------------------
@@ -153,14 +195,16 @@ class ApiServer:
             elif method == "POST" and base.startswith("/v1/updates/"):
                 await self._updates(path, writer)
                 return False
+            elif method == "POST" and base == "/v1/transactions":
+                # the write path sits behind ADMISSION CONTROL, not the
+                # request semaphore: its bound is max_inflight_tx and
+                # overflow answers 429 immediately — queueing overflow
+                # writes on _sem would hide saturation as latency
+                resp = await self._admit_transaction(body)
+                await _respond_json(writer, 200, resp)
+                return True
             async with self._sem:
-                if method == "POST" and path == "/v1/transactions":
-                    # single-writer lane: wait out any open PG explicit tx
-                    async with self.agent.write_sema:
-                        resp = self._transactions(
-                            json.loads(body), body_len=len(body)
-                        )
-                elif method == "POST" and path == "/v1/queries":
+                if method == "POST" and path == "/v1/queries":
                     await self._queries(json.loads(body), writer)
                     return True
                 elif method == "POST" and path == "/v1/migrations":
@@ -173,7 +217,12 @@ class ApiServer:
                 await _respond_json(writer, 200, resp)
                 return True
         except HttpError as e:
-            await _respond_json(writer, e.status, {"error": e.message})
+            extra = ""
+            if e.retry_after_s is not None:
+                extra = f"retry-after: {e.retry_after_s:g}\r\n"
+            await _respond_json(
+                writer, e.status, {"error": e.message}, extra=extra
+            )
             return True
         except (json.JSONDecodeError, KeyError, TypeError) as e:
             await _respond_json(writer, 400, {"error": str(e)})
@@ -181,6 +230,88 @@ class ApiServer:
         except Exception as e:  # sqlite errors etc.
             await _respond_json(writer, 500, {"error": str(e)})
             return True
+
+    # -- write admission + batching (ISSUE 13) ----------------------------
+
+    #: Retry-After hint on a 429 (seconds): roughly the time one write
+    #: batch takes to drain on a loopback cluster — a rejected writer
+    #: retrying after this lands in a freshly drained window instead of
+    #: re-colliding with the same full house
+    RETRY_AFTER_S = 0.25
+    #: yield the event loop every N commits inside a write batch (the
+    #: lane hold amortizes the lock, the yield bounds the LOOP stall)
+    WRITE_YIELD_EVERY = 8
+
+    async def _admit_transaction(self, body: bytes) -> dict:
+        """Admission control + batched write lane.  Bounded in-flight:
+        beyond ``max_inflight_tx`` the request is REFUSED with 429 +
+        Retry-After (counted as a saturation signal) rather than queued
+        — under overload the server degrades to explicit backpressure,
+        never to unbounded memory or silent drops."""
+        tel = self.agent.telemetry
+        if self._tx_inflight >= self.max_inflight_tx:
+            if tel is not None:
+                tel.admission_rejected()
+            raise HttpError(
+                429,
+                f"write admission limit reached "
+                f"({self.max_inflight_tx} in flight); retry",
+                retry_after_s=self.RETRY_AFTER_S,
+            )
+        stmts = json.loads(body)  # a 400 must not occupy an admit slot
+        self._tx_inflight += 1
+        if tel is not None:
+            tel.tx_inflight(self._tx_inflight)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._write_q.append((stmts, len(body), fut))
+        if self._write_drainer is None or self._write_drainer.done():
+            self._write_drainer = asyncio.create_task(self._drain_writes())
+        try:
+            return await fut
+        finally:
+            self._tx_inflight -= 1
+            if tel is not None:
+                tel.tx_inflight(self._tx_inflight)
+
+    async def _drain_writes(self) -> None:
+        """The ONE write-lane drainer: acquires ``write_sema`` once per
+        batch and commits up to ``write_batch`` admitted writes
+        back-to-back — the commit path's lock-churn amortization under a
+        flood — then yields the lane (PG explicit transactions and the
+        ingest loop interleave between batches).  Each write still
+        commits individually (its own db_version and response); the
+        batch is a LANE-ACQUISITION batch, not a transaction merge."""
+        while self._write_q:
+            async with self.agent.write_sema:
+                n = 0
+                while self._write_q and n < self.write_batch:
+                    stmts, body_len, fut = self._write_q.popleft()
+                    n += 1
+                    if fut.cancelled():
+                        continue
+                    try:
+                        resp = self._transactions(stmts, body_len=body_len)
+                    except Exception as e:  # noqa: BLE001 — routed to
+                        # the requester's future; _dispatch maps it to
+                        # the proper HTTP status (400/500)
+                        fut.set_exception(e)
+                    else:
+                        fut.set_result(resp)
+                    if n % self.WRITE_YIELD_EVERY == 0:
+                        # bound the LOOP hold, not just the lane hold:
+                        # 32 fsync-bound commits back-to-back would
+                        # starve SWIM probes / subscription flushes /
+                        # 429 responses for the whole batch.  The lane
+                        # (write_sema) stays held — the amortization is
+                        # the point — but the loop breathes
+                        await asyncio.sleep(0)
+            tel = self.agent.telemetry
+            if tel is not None and n:
+                tel.write_batch(n)
+            # yield so responses flush and new writes can admit before
+            # the next batch grabs the lane again
+            await asyncio.sleep(0)
 
     # -- handlers ---------------------------------------------------------
 
@@ -304,6 +435,16 @@ class ApiServer:
                 if writer.is_closing():
                     break
                 await _send_ndjson(writer, event)
+                if getattr(queue, "closed", False) and queue.qsize() == 0:
+                    # slow-consumer policy (ISSUE 13): the bound was
+                    # hit and the close-reason event has gone out —
+                    # disconnect explicitly so the client re-syncs.
+                    # The qsize guard matters when the close landed
+                    # while we were mid-send of an earlier event: the
+                    # reason event is still queued and MUST be
+                    # delivered before the hangup, or the client sees a
+                    # reasonless EOF
+                    break
         except (ConnectionError, OSError, asyncio.CancelledError):
             pass
         finally:
@@ -323,6 +464,11 @@ class ApiServer:
                 if writer.is_closing():
                     break
                 await _send_ndjson(writer, event)
+                if getattr(queue, "closed", False) and queue.qsize() == 0:
+                    # slow-consumer disconnect — only after the queued
+                    # close-reason event has been delivered (see
+                    # _stream_sub)
+                    break
         except (ConnectionError, OSError, asyncio.CancelledError):
             pass
         finally:
@@ -376,11 +522,12 @@ def _json_row(row):
     return [encode_value(v) for v in row]
 
 
-async def _respond_json(writer, status: int, payload) -> None:
+async def _respond_json(writer, status: int, payload, extra: str = "") -> None:
     body = json.dumps(payload).encode("utf-8")
     writer.write(
         f"HTTP/1.1 {status} {_reason(status)}\r\n"
         f"content-type: application/json\r\n"
+        f"{extra}"
         f"content-length: {len(body)}\r\n\r\n".encode("latin-1") + body
     )
     await writer.drain()
@@ -420,5 +567,7 @@ async def _end_ndjson(writer) -> None:
 def _reason(status: int) -> str:
     return {
         200: "OK", 400: "Bad Request", 401: "Unauthorized",
-        404: "Not Found", 413: "Payload Too Large", 500: "Internal Server Error",
+        404: "Not Found", 413: "Payload Too Large",
+        429: "Too Many Requests", 500: "Internal Server Error",
+        503: "Service Unavailable",
     }.get(status, "Unknown")

@@ -445,9 +445,21 @@ def _run_serving_cell(
     n_nodes = int(cell.get("n_nodes", spec.scenario["n_nodes"]))
     use_faults = spec.serving_faults(cell)
     params = spec.serving_params(cell)
+    # multi-process serving cells (ISSUE 13): shard the loadgen into
+    # worker processes over a real devcluster instead of the in-process
+    # cluster — same measured contract, same bands
+    mp_workers = spec.mp_workers(cell)
+    inflight_cap = int(spec._meta(cell, "api_max_inflight_tx", 0) or 0)
+    if inflight_cap and not mp_workers:
+        raise ValueError(
+            "api_max_inflight_tx pins devcluster node configs — it "
+            "needs an mp_workers > 0 serving cell (the in-process "
+            "driver boots agents with default PerfConfig)"
+        )
     k = len(spec.seeds)
     per_seed: Dict[str, List] = {
         "consistent": [], "writes_ok": [], "throughput_wps": [],
+        "retries_429": [], "retries_transport": [],
         **{m: [] for m in _SERVING_SEED_METRICS},
     }
     summaries: List[Optional[dict]] = []
@@ -476,24 +488,73 @@ def _run_serving_cell(
                 )
             # serving lanes run sequentially in real time, so each gets
             # a real per-lane span WRAPPING the run (unlike vmapped sim
-            # lanes, whose spans are host-synthesized afterwards); the
-            # loadgen's serving_loadgen span parents under it, giving
-            # cell → lane → serving_loadgen in one trace
+            # lanes, whose spans are host-synthesized afterwards).  The
+            # IN-PROCESS driver's serving_loadgen span parents under it
+            # (cell → lane → serving_loadgen in one trace); mp lanes run
+            # their agents in separate processes, so their lane context
+            # rides a manifest.json next to the per-node flight JSONLs
+            # instead of a span parent.
             with span("serving_lane", seed=int(seed)) as lane_span:
-                out = asyncio.run(
-                    run_serving_cluster_load(
-                        n_nodes=n_nodes, seed=int(seed), plan=plan,
-                        telemetry=telemetry, trace_path=trace_path,
-                        traceparent=lane_span.context.traceparent(),
-                        header={
-                            "campaign": spec.name,
-                            "spec_hash": spec.spec_hash(),
-                            "cell_index": cell_index,
-                            "seed": int(seed),
-                        },
-                        **params,
+                if mp_workers > 0:
+                    from ..loadgen_mp import run_devcluster_load
+
+                    state_dir = None
+                    if trace_path:
+                        # persist the per-node flight JSONLs next to
+                        # where the in-process lane trace would live,
+                        # with a manifest tying them back to the lane
+                        # (the flights themselves are written by the
+                        # agent processes, which know nothing of the
+                        # campaign)
+                        state_dir = trace_path + "-mp"
+                        os.makedirs(state_dir, exist_ok=True)
+                        with open(
+                            os.path.join(state_dir, "manifest.json"), "w"
+                        ) as mf:
+                            json.dump(
+                                {
+                                    "campaign": spec.name,
+                                    "spec_hash": spec.spec_hash(),
+                                    "cell_index": cell_index,
+                                    "seed": int(seed),
+                                    "traceparent": (
+                                        lane_span.context.traceparent()
+                                    ),
+                                },
+                                mf, indent=1, sort_keys=True,
+                            )
+                    out = asyncio.run(
+                        run_devcluster_load(
+                            n_nodes=n_nodes, n_workers=mp_workers,
+                            seed=int(seed), plan=plan,
+                            flight_recorder=telemetry,
+                            state_dir=state_dir,
+                            global_settle_s=float(
+                                spec._meta(cell, "global_settle_s", 45.0)
+                            ),
+                            perf=(
+                                {"api_max_inflight_tx": inflight_cap}
+                                if inflight_cap
+                                else None
+                            ),
+                            **params,
+                        )
                     )
-                )
+                else:
+                    out = asyncio.run(
+                        run_serving_cluster_load(
+                            n_nodes=n_nodes, seed=int(seed), plan=plan,
+                            telemetry=telemetry, trace_path=trace_path,
+                            traceparent=lane_span.context.traceparent(),
+                            header={
+                                "campaign": spec.name,
+                                "spec_hash": spec.spec_hash(),
+                                "cell_index": cell_index,
+                                "seed": int(seed),
+                            },
+                            **params,
+                        )
+                    )
                 lane_span.set_attribute(
                     "consistent", bool(out["consistent"])
                 )
@@ -506,10 +567,16 @@ def _run_serving_cell(
             per_seed["throughput_wps"].append(
                 float(out["throughput_wps"])
             )
+            per_seed["retries_429"].append(int(out.get("retries_429", 0)))
+            per_seed["retries_transport"].append(
+                int(out.get("retries_transport", 0))
+            )
             per_seed["publish_visible_p50_s"].append(vl.get("p50"))
             per_seed["publish_visible_p95_s"].append(vl.get("p95"))
             per_seed["publish_visible_p99_s"].append(vl.get("p99"))
-            summaries.append(out.get("telemetry"))
+            summaries.append(
+                out.get("telemetry") or out.get("node_flights")
+            )
         wall = time.monotonic() - t0
 
     result = {
@@ -525,6 +592,9 @@ def _run_serving_cell(
             for m in _SERVING_SEED_METRICS + ("throughput_wps",)
         },
         "all_converged": bool(all(per_seed["consistent"])),
+        # serialized only on multi-process cells, so the PR 8
+        # in-process serving cells' digest payload is byte-unchanged
+        **({"mp_workers": mp_workers} if mp_workers else {}),
         "wall_clock_s": round(wall, 4),
         # host walls are real time by construction — no HBM floor applies
         "wall_defensible_s": round(wall, 4),

@@ -36,6 +36,7 @@ from ..faults import FaultEvent, FaultPlan
 _TOPOLOGY_KEYS = (
     "n_regions", "intra_delay", "inter_delay", "loss",
     "n_azs", "az_delay", "az_loss", "inter_loss", "degree_classes",
+    "region_delay_matrix",
 )
 #: named-topology axis (ISSUE 9): resolves through
 #: `corrosion_tpu.topo.family_topology` before explicit keys overlay it
@@ -89,12 +90,22 @@ _PROTO_KEYS = (
 #:   `corrosion_tpu.proto.FAMILIES`), resolved by ``sim_config()`` into
 #:   SimConfig protocol knobs with explicit keys overlaying the family
 #:   (the `topo_family` compose rule applied to the protocol axis).
+#: - ``mp_workers`` — serving cells only (ISSUE 13): shard the loadgen
+#:   into this many WORKER PROCESSES and drive a real multi-process
+#:   devcluster (`loadgen_mp.run_devcluster_load`) instead of the
+#:   in-process cluster; 0 = the PR 8 in-process driver;
+#: - ``api_max_inflight_tx`` — serving cells: pin every node's write
+#:   admission limit (the overload axis: writers beyond it must see
+#:   429 + retry, never silent drops); 0 = the PerfConfig default;
+#: - ``global_settle_s`` — mp serving cells: the parent's acked-id
+#:   sweep window (anti-entropy heal budget after a kill+restart).
 _SCENARIO_META_KEYS = (
     "inject_every", "detect_membership", "kill_every",
     "serving", "n_writes", "n_writers", "n_watchers", "rate_hz",
     "settle_timeout_s", "use_faults",
     "topo_family", "churn", "churn_frac", "churn_round", "churn_seed",
     "measure_wire", "proto_family",
+    "mp_workers", "api_max_inflight_tx", "global_settle_s",
 )
 
 #: serving-cell workload knobs → run_serving_cluster_load kwarg names
@@ -419,6 +430,12 @@ class CampaignSpec:
                 out[k] = self.scenario[k]
         return out
 
+    def mp_workers(self, cell: Dict[str, object]) -> int:
+        """Serving cells (ISSUE 13): >0 shards the loadgen into worker
+        processes over a real devcluster; 0 keeps the in-process
+        driver."""
+        return int(self._meta(cell, "mp_workers", 0) or 0)
+
     def serving_faults(self, cell: Dict[str, object]) -> bool:
         """Whether this serving cell replays the spec's events through
         the host fault driver (default: yes iff the spec has events)."""
@@ -645,12 +662,66 @@ def protocol_frontier_spec(
     )
 
 
+def serving_loadgen_spec(
+    seeds: Sequence[int] = (0, 1),
+    n: int = 3,
+    n_writers: int = 192,
+    n_writes: int = 576,
+    mp_workers: int = 4,
+    overload_inflight: int = 48,
+    crash_node: Optional[int] = None,
+) -> CampaignSpec:
+    """The MULTI-PROCESS serving campaign (ISSUE 13): a real ``n``-node
+    devcluster (one agent process per node, flight recorders armed)
+    flooded by ``n_writers`` writer lanes sharded across ``mp_workers``
+    loadgen worker processes.  The grid crosses two robustness axes:
+
+    - ``use_faults`` — replay a kill -9 + respawn of the last node
+      (`DevClusterFaultDriver`) DURING the flood; the checker proves
+      zero ACKED writes lost across the restart (unacked failures ride
+      the 429/transport retry stack and classify retriable);
+    - ``api_max_inflight_tx`` — pin the write admission limit below
+      the writer count (the overload condition): saturated nodes must
+      answer 429 + Retry-After, clients back off and retry, and the
+      admission_rejected counters land in each node's flight JSONL.
+
+    ``all_converged`` ≡ every lane ``consistent`` (zero lost acked
+    writes, checker attached), so `report.compare` regresses on ANY
+    loss — the CI ``serving-loadgen-smoke`` gate's teeth.  The
+    committed baseline lives at
+    doc/experiments/CAMPAIGN_BASELINE_serving-loadgen.json."""
+    kill = (n - 1) if crash_node is None else crash_node
+    return CampaignSpec(
+        name="serving-loadgen",
+        scenario={
+            "n_nodes": n, "serving": True, "mp_workers": mp_workers,
+            "n_writes": n_writes, "n_writers": n_writers,
+            "n_watchers": 4, "rate_hz": 0.0,
+            "settle_timeout_s": 45.0, "global_settle_s": 60.0,
+        },
+        events=(
+            # process kill + restart (no wipe): rounds 8..40 at
+            # round_s=0.05 ≈ a 1.6 s outage mid-flood; the devcluster
+            # driver replays it as SIGKILL + respawn on the same state
+            # dir, so acked-write durability is what's under test
+            FaultEvent("crash", 8, 40, node=kill),
+        ),
+        grid={
+            "use_faults": [0, 1],
+            "api_max_inflight_tx": [0, overload_inflight],
+        },
+        seeds=tuple(seeds),
+        round_s=0.05,
+    )
+
+
 BUILTIN_SPECS = {
     "fault-parity-3node": fault_parity_3node_spec,
     "fault-campaign-3node": fault_campaign_3node_spec,
     "swim-churn-64": swim_churn_64_spec,
     "swim-churn-partial": swim_churn_partial_spec,
     "serving-3node": serving_3node_spec,
+    "serving-loadgen": serving_loadgen_spec,
     "peer-sampler-frontier": peer_sampler_frontier_spec,
     "protocol-frontier": protocol_frontier_spec,
 }

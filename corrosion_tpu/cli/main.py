@@ -110,6 +110,37 @@ def cmd_agent(args) -> int:
             host, _, port = cfg.prometheus_addr.rpartition(":")
             prom = MetricsServer(agent, host or "127.0.0.1", int(port))
             cfg.prometheus_addr = await prom.start()
+        flight_task = None
+        if cfg.telemetry_flight_path:
+            # [telemetry].flight_path (ISSUE 13): arm the host flight
+            # recorder on this agent and snapshot it to JSONL every few
+            # seconds (atomic replace) — a kill -9'd devcluster node
+            # leaves its last snapshot, so saturation gauges and
+            # per-write stage stamps survive the crash the FaultPlan
+            # injected
+            from ..telemetry import (
+                HostFlightRecorder,
+                attach_host_telemetry,
+                write_host_flight_jsonl,
+            )
+
+            flight_rec = HostFlightRecorder()
+            # the GLOBAL registry (attach's default): a configured
+            # prometheus_addr must scrape the corro_serving_* families
+            # — a private registry here would hide every 429/saturation
+            # signal from /metrics
+            attach_host_telemetry(agent, recorder=flight_rec)
+            head = {"node": cfg.gossip_addr, "api": cfg.api_addr}
+
+            async def _flight_flush_loop():
+                while True:
+                    await asyncio.sleep(2.0)
+                    await asyncio.to_thread(
+                        write_host_flight_jsonl,
+                        cfg.telemetry_flight_path, flight_rec, head,
+                    )
+
+            flight_task = asyncio.ensure_future(_flight_flush_loop())
         # first SIGINT/SIGTERM begins graceful shutdown; a second
         # force-exits (tripwire.rs signal stream).  Armed BEFORE the
         # "agent running" line so a supervisor reacting to that line
@@ -124,6 +155,15 @@ def cmd_agent(args) -> int:
             flush=True,
         )
         await tripwire.wait()
+        if flight_task is not None:
+            flight_task.cancel()
+            await asyncio.gather(flight_task, return_exceptions=True)
+            from ..telemetry import write_host_flight_jsonl
+
+            # final flush: the graceful-shutdown snapshot
+            write_host_flight_jsonl(
+                cfg.telemetry_flight_path, flight_rec, head
+            )
         if admin:
             await admin.stop()
         if prom:
@@ -375,6 +415,11 @@ _SIM_SCENARIOS = {
     # latency percentiles, instrumentation-overhead A/B, faultless AND
     # FaultPlan conditions, host flight JSONL via --trace-out
     "serving-loadgen": "config_serving_loadgen",
+    # the MULTI-PROCESS serving rung (ISSUE 13): ≥1000 writer lanes
+    # sharded across loadgen worker processes against a real devcluster
+    # — faultless + kill-and-restart FaultPlan + overload (429) runs,
+    # zero acked writes lost, saturation gauges from per-node flights
+    "serving-loadgen-mp": "config_serving_loadgen_mp",
     # the uniform-vs-PeerSwap frontier (ISSUE 9): both samplers × two
     # topology families as a campaign, reduced to per-family rounds ×
     # wire-bytes ratios (the paper-grounded sampler comparison)
@@ -477,6 +522,20 @@ def _run_sim_scenario(args) -> int:
     params = inspect.signature(fn).parameters
     if args.nodes and "n_nodes" in params:
         kwargs["n_nodes"] = args.nodes
+    # serving-rung workload shape (ISSUE 13): only scenarios whose
+    # config fn exposes the knob accept the flag — a silently ignored
+    # writer count would fake a scale measurement
+    for flag, kw in (("workers", "n_workers"), ("writers", "n_writers")):
+        val = getattr(args, flag)
+        if val:
+            if kw not in params:
+                print(
+                    f"error: scenario {args.scenario!r} does not take "
+                    f"--{flag} (serving rungs only)",
+                    file=sys.stderr,
+                )
+                return 2
+            kwargs[kw] = val
     # mesh sharding (ISSUE 7): --devices caps the 1-D nodes mesh on
     # scenarios that take one; refuse it loudly elsewhere (a silently
     # ignored device cap would fake a sharded measurement).  The same
@@ -692,13 +751,28 @@ def cmd_topo(args) -> int:
     topo = Topology(**kw)  # __post_init__ coerces degree_classes
     blocks = az_blocks(n, topo.n_regions, topo.n_azs)
     base, az_t, inter_t = loss_tiers(topo)
-    tiers = {
-        "same-az": {"delay_rounds": topo.intra_delay, "loss": base / 256.0},
-        "cross-az": {"delay_rounds": topo.az_delay, "loss": az_t / 256.0},
-        "cross-region": {
-            "delay_rounds": topo.inter_delay, "loss": inter_t / 256.0,
-        },
-    }
+    if topo.region_delay_matrix:
+        # measured-RTT family (ISSUE 13): the matrix IS the delay rule
+        # — render it per region pair instead of the 3-class tiers
+        tiers = {
+            "in-region": {"delay_rounds": 0, "loss": base / 256.0},
+            "cross-region": {
+                "delay_rounds": "matrix", "loss": inter_t / 256.0,
+            },
+            "delay_matrix_rounds": [
+                list(row) for row in topo.region_delay_matrix
+            ],
+        }
+    else:
+        tiers = {
+            "same-az": {
+                "delay_rounds": topo.intra_delay, "loss": base / 256.0,
+            },
+            "cross-az": {"delay_rounds": topo.az_delay, "loss": az_t / 256.0},
+            "cross-region": {
+                "delay_rounds": topo.inter_delay, "loss": inter_t / 256.0,
+            },
+        }
     degrees = {}
     if topo.degree_classes:
         k = len(topo.degree_classes)
@@ -727,6 +801,9 @@ def cmd_topo(args) -> int:
         f"r{r}[{lo}:{hi}]" for r, lo, hi in blocks
     ))
     for name, t in tiers.items():
+        if not isinstance(t, dict):
+            print(f"  {name}: {json.dumps(t)}")
+            continue
         print(
             f"  {name:>13}: delay {t['delay_rounds']} rounds, "
             f"loss {t['loss']:.3f}"
@@ -1308,6 +1385,15 @@ def build_parser() -> argparse.ArgumentParser:
         "omitted = the spec's own seed set)",
     )
     sm.add_argument("--nodes", type=int, default=None)
+    sm.add_argument(
+        "--workers", type=int, default=None,
+        help="multi-process serving rung (ISSUE 13): loadgen worker "
+        "process count",
+    )
+    sm.add_argument(
+        "--writers", type=int, default=None,
+        help="serving rungs: total writer lane count",
+    )
     sm.add_argument(
         "--devices", type=int, default=None,
         help="sharded scenarios (ISSUE 7): cap the 1-D nodes mesh at N "

@@ -10,6 +10,7 @@ and supervise until the first node dies or the caller interrupts.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -62,9 +63,24 @@ def generate_config(
     gossip_port: int,
     api_port: int,
     bootstrap: List[str],
+    flight_path: str = "",
+    perf: Optional[Dict[str, object]] = None,
 ) -> str:
-    """Per-node TOML (generate_config, corro-devcluster/src/main.rs:176-208)."""
+    """Per-node TOML (generate_config, corro-devcluster/src/main.rs:176-208).
+    ``flight_path`` arms the node's host flight recorder (ISSUE 13): the
+    agent snapshots per-write stage stamps + saturation gauges to that
+    JSONL every few seconds, so even a kill -9'd node leaves evidence.
+    ``perf`` emits a ``[perf]`` section — how a loadgen campaign pins
+    the admission-control / queue bounds it means to stress."""
     boots = ", ".join(f'"{b}"' for b in bootstrap)
+    tel = (
+        f'\n[telemetry]\nflight_path = "{flight_path}"\n' if flight_path else ""
+    )
+    if perf:
+        lines = "\n".join(
+            f"{k} = {json.dumps(v)}" for k, v in sorted(perf.items())
+        )
+        tel += f"\n[perf]\n{lines}\n"
     return f"""[db]
 path = "{state_dir}/corrosion.db"
 schema_paths = ["{schema_dir}"]
@@ -78,7 +94,7 @@ addr = "127.0.0.1:{api_port}"
 
 [admin]
 path = "{state_dir}/admin.sock"
-"""
+{tel}"""
 
 
 @dataclass
@@ -96,11 +112,18 @@ class Node:
 
 class DevCluster:
     def __init__(self, topo: Topology, state_dir: str, schema_dir: str,
-                 base_port: int = 0):
+                 base_port: int = 0, flight_recorder: bool = False,
+                 perf: Optional[Dict[str, object]] = None):
         self.topo = topo
         self.state_dir = state_dir
         self.schema_dir = schema_dir
         self._base_port = base_port
+        # arm each node's host flight recorder (ISSUE 13): JSONL
+        # snapshots at <state>/<name>/flight.jsonl
+        self.flight_recorder = flight_recorder
+        # PerfConfig overrides for every node ([perf] TOML section) —
+        # the loadgen campaign's admission/queue-bound knobs
+        self.perf = dict(perf or {})
         self.nodes: Dict[str, Node] = {}
 
     def _alloc_ports(self) -> None:
@@ -141,9 +164,36 @@ class DevCluster:
             cfg = generate_config(
                 node.state_dir, self.schema_dir, node.gossip_port,
                 node.api_port, boots,
+                flight_path=(
+                    os.path.join(node.state_dir, "flight.jsonl")
+                    if self.flight_recorder
+                    else ""
+                ),
+                perf=self.perf,
             )
             with open(os.path.join(node.state_dir, "config.toml"), "w") as f:
                 f.write(cfg)
+
+    @property
+    def api_addrs(self) -> List[str]:
+        """Every node's HTTP API address, in topology-node order — the
+        loadgen's write/read address vocabulary."""
+        return [self.nodes[n].api_addr for n in self.topo.nodes]
+
+    def _spawn(self, name: str, append_log: bool = False) -> None:
+        node = self.nodes[name]
+        # the child inherits the descriptor; close the parent's copy
+        mode = "a" if append_log else "w"
+        with open(os.path.join(node.state_dir, "node.log"), mode) as log:
+            node.proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "corrosion_tpu.cli.main",
+                    "-c", os.path.join(node.state_dir, "config.toml"),
+                    "agent",
+                ],
+                stdout=log,
+                stderr=subprocess.STDOUT,
+            )
 
     def start(self, stagger_s: float = 0.25) -> None:
         """Spawn agents: pure responders (no outgoing links) first
@@ -152,19 +202,41 @@ class DevCluster:
             n for n in self.topo.nodes if self.topo.links[n]
         ]
         for name in order:
-            node = self.nodes[name]
-            # the child inherits the descriptor; close the parent's copy
-            with open(os.path.join(node.state_dir, "node.log"), "w") as log:
-                node.proc = subprocess.Popen(
-                    [
-                        sys.executable, "-m", "corrosion_tpu.cli.main",
-                        "-c", os.path.join(node.state_dir, "config.toml"),
-                        "agent",
-                    ],
-                    stdout=log,
-                    stderr=subprocess.STDOUT,
-                )
+            self._spawn(name)
             time.sleep(stagger_s)
+
+    # -- process-level faults (ISSUE 13) -----------------------------------
+
+    def kill_node(self, name: str) -> None:
+        """kill -9 the node's agent process — the FaultPlan ``crash``
+        event at the PROCESS seam.  Durable state (sqlite WAL) stays on
+        disk, so every ACKED write survives the kill by construction."""
+        node = self.nodes[name]
+        if node.proc is not None and node.proc.poll() is None:
+            node.proc.kill()
+            node.proc.wait()
+
+    def restart_node(self, name: str, wipe: bool = False) -> None:
+        """Respawn a killed node on its original config/state dir.
+        ``wipe=True`` deletes the durable state first (the
+        crash-with-wipe rejoin: a cold joiner that must recover purely
+        via anti-entropy).  The node keeps its ports, so bootstrap
+        edges in the other nodes' configs stay valid."""
+        import glob
+        import shutil
+
+        node = self.nodes[name]
+        if node.proc is not None and node.proc.poll() is None:
+            raise RuntimeError(f"node {name} is still running")
+        if wipe:
+            for path in glob.glob(
+                os.path.join(node.state_dir, "corrosion.db*")
+            ):
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.unlink(path)
+        self._spawn(name, append_log=True)
 
     def wait_ready(self, timeout: float = 30.0) -> None:
         """Block until every node's log announces readiness."""
@@ -206,6 +278,9 @@ class DevCluster:
                     node.proc.kill()
                     node.proc.wait()
 
+    def fault_driver(self, plan) -> "DevClusterFaultDriver":
+        return DevClusterFaultDriver(plan, self)
+
     def run_forever(self) -> int:
         """Supervise until SIGINT/SIGTERM or the first node death."""
         stop_requested = False
@@ -231,3 +306,81 @@ class DevCluster:
         finally:
             signal.signal(signal.SIGTERM, prev)
             self.stop()
+
+
+#: fault kinds the PROCESS seam can express: a devcluster driver can
+#: kill and respawn agent processes, but link faults live inside each
+#: process's transport (the RealSocketFaultDriver seam) and clock skew
+#: inside its HLC — scheduling one of those here would silently not
+#: inject, so the driver refuses them loudly (faults.REALSOCKET_KINDS
+#: is the complementary set)
+DEVCLUSTER_KINDS = frozenset({"crash"})
+
+
+class DevClusterFaultDriver:
+    """Replay a FaultPlan's ``crash`` events against REAL agent
+    processes (ISSUE 13): the process-kill-and-restart seam of the
+    transport fault stack.  One driver round ≈ ``plan.round_s`` of
+    wall clock, the same time base as `HostFaultDriver` — a node down
+    over rounds [start, end) is SIGKILLed at ``start`` and respawned on
+    its original state dir at ``end`` (``wipe=True`` deletes the
+    durable state first, the cold-rejoin shape).
+
+    Crash targets index ``topo.nodes`` order — the same order
+    `DevCluster.api_addrs` exposes, so a loadgen can steer watchers
+    away from scheduled kills."""
+
+    def __init__(self, plan, cluster: DevCluster):
+        n = len(cluster.topo.nodes)
+        if plan.n_nodes != n:
+            raise ValueError(
+                f"plan is for {plan.n_nodes} nodes, devcluster has {n}"
+            )
+        bad = sorted(
+            {ev.kind for ev in plan.events} - DEVCLUSTER_KINDS
+        )
+        if bad:
+            raise ValueError(
+                f"devcluster fault driver replays {sorted(DEVCLUSTER_KINDS)} "
+                f"events only (got {bad}); link faults ride the "
+                "RealSocketFaultDriver seam inside each process"
+            )
+        self.plan = plan
+        self.cluster = cluster
+        self.round = -1
+        self.down: set = set()
+        self.log: List[tuple] = []  # (round, action, node-name)
+
+    def apply_round(self, r: int) -> None:
+        """Install round ``r``'s crash state (idempotent per round)."""
+        sched = self.plan.schedule_at(r, include_links=False)
+        names = self.cluster.topo.nodes
+        for i in sorted(sched.down):
+            if i not in self.down:
+                self.down.add(i)
+                self.log.append((r, "kill", names[i]))
+                self.cluster.kill_node(names[i])
+        for i in sorted(sched.restart):
+            if i in self.down:
+                wipe = i in sched.wipe
+                self.log.append((r, "restart", (names[i], wipe)))
+                self.cluster.restart_node(names[i], wipe=wipe)
+                self.down.discard(i)
+
+    async def run(self) -> None:
+        """Drive the schedule in real time; returns with every node
+        respawned (the all-clear steady state the settle checker needs)."""
+        import asyncio
+
+        from .invariants import sometimes
+
+        for r in range(self.plan.horizon + 1):
+            self.round = r
+            # kill/respawn are subprocess signals — fast, but keep them
+            # off the loop so a slow spawn can't stall other tasks
+            await asyncio.to_thread(self.apply_round, r)
+            if r < self.plan.horizon:
+                await asyncio.sleep(self.plan.round_s)
+        for kind in {ev.kind for ev in self.plan.events}:
+            sometimes(True, f"fault-{kind}-active")
+        sometimes(True, "fault-campaign-completed")

@@ -37,7 +37,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Union
 
-from .api.client import ApiClient
+from .api.client import TRANSPORT_ERRORS, ApiClient, ApiError, Overloaded
 
 
 @dataclass
@@ -48,6 +48,16 @@ class LoadReport:
     # why the LAST failed write failed (repr) — the count alone can't
     # distinguish a dead node from a driver bug when a lane regresses
     last_write_error: Optional[str] = None
+    # -- writer-side retry/backpressure accounting (ISSUE 13) ----------
+    # 429 admission refusals observed (each retried after Retry-After),
+    # transport-error retries, cross-address failovers, and writes whose
+    # whole retry budget ran dry.  A failed write is RETRIABLE by
+    # construction: it was never acked, so it can never count as lost —
+    # the loss checker convicts on ACKED ids only.
+    retries_429: int = 0
+    retries_transport: int = 0
+    write_failovers: int = 0
+    writes_gave_up: int = 0
     sub_rows_seen: int = 0
     update_events_seen: int = 0
     missing_on_sub: List[int] = field(default_factory=list)
@@ -115,6 +125,10 @@ class LoadReport:
             "stream_deaths": self.stream_deaths,
             "visible_latency_s": self.visible_latency_s,
             "write_latency_s": self.write_latency_s,
+            "retries_429": self.retries_429,
+            "retries_transport": self.retries_transport,
+            "write_failovers": self.write_failovers,
+            "writes_gave_up": self.writes_gave_up,
         }
 
 
@@ -125,6 +139,14 @@ class LoadGenerator:
     also validates convergence).  The single-addr single-lane form is
     the original Antithesis shape and stays the default."""
 
+    #: per-attempt retry budget (consecutive 429/transport failures on
+    #: ONE address before failing over to the next)
+    WRITE_MAX_RETRIES = 6
+    #: address-rotation budget per write: every address gets this many
+    #: full retry rounds before the write records an error (unacked →
+    #: retriable, never lost)
+    FAILOVER_ROUNDS = 2
+
     def __init__(
         self,
         write_addr: Union[str, Sequence[str]],
@@ -133,6 +155,7 @@ class LoadGenerator:
         seed: int = 0,
         n_writers: int = 1,
         n_watchers: int = 1,
+        retry_writes: bool = True,
     ):
         write_addrs = (
             [write_addr] if isinstance(write_addr, str) else list(write_addr)
@@ -150,6 +173,7 @@ class LoadGenerator:
         self.read_client = self.read_clients[0]
         self.table = table
         self._rng = random.Random(seed)
+        self.retry_writes = retry_writes
         self.n_writers = max(1, int(n_writers))
         self.n_watchers = max(1, int(n_watchers))
         self._written: Set[int] = set()
@@ -174,30 +198,84 @@ class LoadGenerator:
             writers=self.n_writers, watchers=self.n_watchers
         )
 
+    async def _write_one(self, w: int, rowid: int, rng) -> bool:
+        """One write through the retry/backpressure stack (ISSUE 13):
+        `execute_with_retry` rides the decorrelated-jitter Backoff on
+        each address (429s sleep at least the server's Retry-After);
+        an exhausted budget FAILS OVER to the next write address — a
+        crashed-and-restarting node must cost retries, not the write.
+        Returns committed?; an uncommitted write was never acked, so it
+        classifies retriable, never lost."""
+        stmts = [
+            [
+                f"INSERT OR REPLACE INTO {self.table} (id, text) "
+                "VALUES (?, ?)",
+                [rowid, f"load-{rowid}"],
+            ]
+        ]
+        counters: Dict[str, int] = {}
+        try:
+            n_clients = len(self.write_clients)
+            for attempt in range(self.FAILOVER_ROUNDS * n_clients):
+                client = self.write_clients[(w + attempt) % n_clients]
+                try:
+                    await client.execute_with_retry(
+                        stmts, max_retries=self.WRITE_MAX_RETRIES,
+                        rng=rng, counters=counters,
+                    )
+                    return True
+                except Overloaded as e:
+                    self.report.last_write_error = repr(e)
+                except ApiError as e:
+                    # deterministic refusal (schema error, 4xx/5xx):
+                    # retrying cannot help
+                    self.report.last_write_error = repr(e)
+                    return False
+                except TRANSPORT_ERRORS as e:
+                    self.report.last_write_error = repr(e)
+                if attempt + 1 < self.FAILOVER_ROUNDS * n_clients:
+                    self.report.write_failovers += 1
+            self.report.writes_gave_up += 1
+            return False
+        finally:
+            self.report.retries_429 += counters.get("retries_429", 0)
+            self.report.retries_transport += counters.get(
+                "retries_transport", 0
+            )
+
     async def _writer(
         self, w: int, n_writes: int, rate_hz: float, base_id: int
     ):
         client = self.write_clients[w % len(self.write_clients)]
+        # per-writer backoff stream: deterministic under the lane seed
+        rng = random.Random((self._rng.getrandbits(32) << 8) | (w & 0xFF))
         interval = 1.0 / rate_hz if rate_hz > 0 else 0.0
         for i in range(n_writes):
             rowid = base_id + i
             self.report.writes_attempted += 1
             t0 = time.monotonic()
             try:
-                await client.execute(
-                    [
+                if self.retry_writes:
+                    ok = await self._write_one(w, rowid, rng)
+                else:
+                    await client.execute(
                         [
-                            f"INSERT OR REPLACE INTO {self.table} (id, text) "
-                            "VALUES (?, ?)",
-                            [rowid, f"load-{rowid}"],
+                            [
+                                f"INSERT OR REPLACE INTO {self.table} "
+                                "(id, text) VALUES (?, ?)",
+                                [rowid, f"load-{rowid}"],
+                            ]
                         ]
-                    ]
-                )
-                now = time.monotonic()
-                self.report.writes_ok += 1
-                self._written.add(rowid)
-                self._write_ok_at[rowid] = now
-                self._write_lat.append(now - t0)
+                    )
+                    ok = True
+                if ok:
+                    now = time.monotonic()
+                    self.report.writes_ok += 1
+                    self._written.add(rowid)
+                    self._write_ok_at[rowid] = now
+                    self._write_lat.append(now - t0)
+                else:
+                    self.report.write_errors += 1
             except Exception as e:
                 # counted for the report's verdict AND kept: "why" is
                 # what distinguishes a dead node from a driver bug when

@@ -1,0 +1,498 @@
+"""Multi-process loadgen: the serving tier's scale-out harness.
+
+ISSUE 13 breaks the single-process ceiling PR 8 left: `loadgen.py`
+drives N writers × M watchers from ONE event loop, which tops out at
+dozens of lanes — the "heavy traffic" north star needs ≥1000 writers
+against REAL processes.  This module shards the measured driver:
+
+- **workers** — each worker is a separate ``python -m
+  corrosion_tpu.loadgen_mp`` process running its own `LoadGenerator`
+  slice (disjoint writer id ranges, its own watchers) against the
+  cluster's HTTP addresses; the task arrives as JSON on stdin, the
+  report leaves as JSON on stdout (stdlib-only, no IPC deps);
+- **cluster** — a `devcluster.DevCluster`: one real agent process per
+  node (real sockets, real HLC skew between processes — the
+  ``hlc_lag_ms`` column finally measures cross-process clock truth),
+  each optionally snapshotting its host flight JSONL (saturation
+  gauges included) so backpressure is visible from outside;
+- **faults** — a `FaultPlan` whose ``crash`` events replay through
+  `DevClusterFaultDriver` as kill -9 + respawn DURING the flood;
+- **the checker** — writers ride the 429/transport retry stack with
+  cross-address failover, so an unacked failure is RETRIABLE by
+  construction; after the flood the parent polls every node until all
+  ACKED ids are present (anti-entropy must heal the killed node), so
+  ``lost_writes`` convicts on exactly one thing: an acknowledged write
+  that no amount of settling brings back.
+
+Latency joining across processes: writer ack stamps and watcher
+first-sight stamps are both ``time.monotonic`` readings, which on
+Linux is CLOCK_MONOTONIC — one machine-wide clock — so the parent can
+join worker A's ack stamp against worker B's sighting stamp and report
+an honest cross-process publish→visible p99.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+from .loadgen import LoadGenerator
+from .telemetry import latency_block
+
+#: how long the parent polls the cluster for full acked-id visibility
+#: after every worker has returned (the anti-entropy heal window)
+DEFAULT_GLOBAL_SETTLE_S = 45.0
+
+
+# -- worker side -------------------------------------------------------------
+
+
+async def _run_worker(task: dict) -> dict:
+    """One worker process's slice: a LoadGenerator over the given
+    addresses, plus the raw per-row stamps the parent needs to join
+    latencies across processes."""
+    gen = LoadGenerator(
+        task["write_addrs"],
+        task.get("read_addrs") or None,
+        table=task.get("table", "tests"),
+        seed=int(task["seed"]),
+        n_writers=int(task["n_writers"]),
+        n_watchers=int(task["n_watchers"]),
+    )
+    report = await gen.run(
+        n_writes=int(task["n_writes"]),
+        rate_hz=float(task.get("rate_hz", 0.0)),
+        settle_timeout_s=float(task.get("settle_timeout_s", 30.0)),
+        base_id=int(task["base_id"]),
+    )
+    out = report.to_dict()
+    # raw cross-process join material (rounded: JSON size, not truth —
+    # 1 µs grain is two orders below loopback latency)
+    out["acked_at"] = {
+        str(rowid): round(t, 6) for rowid, t in gen._write_ok_at.items()
+    }
+    out["write_lat_raw"] = [round(v, 6) for v in gen._write_lat]
+    out["watchers_detail"] = [
+        {
+            "ok": gen._watcher_ok[j],
+            "dead": gen._watcher_dead[j],
+            "seen_at": {
+                str(rowid): round(t, 6)
+                for rowid, t in gen._seen_at[j].items()
+            },
+            "snap_seen": sorted(gen._snap_seen[j]),
+        }
+        for j in range(gen.n_watchers)
+    ]
+    return out
+
+
+def worker_main() -> int:
+    """``python -m corrosion_tpu.loadgen_mp``: task JSON on stdin,
+    report JSON on stdout (the only stdout line — logs go to stderr)."""
+    task = json.load(sys.stdin)
+    report = asyncio.run(_run_worker(task))
+    json.dump(report, sys.stdout, separators=(",", ":"))
+    sys.stdout.write("\n")
+    sys.stdout.flush()
+    return 0
+
+
+# -- parent side -------------------------------------------------------------
+
+
+def _split(total: int, shares: int) -> List[int]:
+    """Near-even split, first shares take the remainder."""
+    base, rem = divmod(total, shares)
+    return [base + (1 if i < rem else 0) for i in range(shares)]
+
+
+async def _spawn_worker(task: dict) -> dict:
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "corrosion_tpu.loadgen_mp",
+        stdin=asyncio.subprocess.PIPE,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.PIPE,
+    )
+    stdout, stderr = await proc.communicate(
+        json.dumps(task).encode()
+    )
+    if proc.returncode != 0 or not stdout.strip():
+        tail = stderr.decode(errors="replace")[-2000:]
+        raise RuntimeError(
+            f"loadgen worker {task.get('worker_index')} died "
+            f"rc={proc.returncode}: {tail}"
+        )
+    return json.loads(stdout.splitlines()[-1])
+
+
+async def _global_settle(
+    read_addrs: Sequence[str],
+    table: str,
+    acked: Set[int],
+    timeout_s: float,
+) -> Dict[str, List[int]]:
+    """Poll every node until all ACKED ids are present (or timeout).
+    Returns missing ids per still-missing node — empty means zero
+    acknowledged writes lost, INCLUDING on killed-and-restarted nodes
+    (anti-entropy healed them).
+
+    Classification matters: a node that ANSWERED with ids missing is a
+    loss conviction (``addr`` key); a node UNREACHABLE at the deadline
+    proved nothing — it surfaces as an ``addr:error`` key, which
+    `merge_reports` classifies checker-broken (inconclusive), never
+    loss.  Convicting an unreachable node of losing every acked id
+    would turn a slow reboot into a false lost-writes page."""
+    from .api.client import ApiClient
+
+    lo = min(acked) if acked else 0
+    missing: Dict[str, List] = {}
+    deadline = time.monotonic() + timeout_s
+    pending = {addr: ApiClient(addr) for addr in read_addrs}
+    while pending and time.monotonic() < deadline:
+        for addr, client in list(pending.items()):
+            try:
+                rows = await client.query(
+                    [f"SELECT id FROM {table} WHERE id >= ?", [lo]]
+                )
+            except Exception as e:  # node still rebooting: keep polling
+                missing[f"{addr}:error"] = [repr(e)]
+                await asyncio.sleep(0.25)
+                continue
+            have = {r[0] for r in rows}
+            gap = acked - have
+            missing.pop(f"{addr}:error", None)
+            if gap:
+                missing[addr] = sorted(gap)[:64]
+            else:
+                missing.pop(addr, None)
+                pending.pop(addr, None)
+        if pending:
+            await asyncio.sleep(0.25)
+    return missing
+
+
+def merge_reports(
+    worker_reports: List[dict],
+    settle_missing: Dict[str, List[int]],
+) -> dict:
+    """Fold worker reports + the parent settle verdict into one
+    LoadReport-shaped dict.  Classification mirrors the single-process
+    checker: ``lost_writes`` convicts only on acked ids missing from a
+    HEALTHY watcher or (stronger) from a node after the global settle;
+    dead streams are ``checker_broken`` — inconclusive, never loss."""
+    acked_at: Dict[int, float] = {}
+    for rep in worker_reports:
+        acked_at.update(
+            {int(k): v for k, v in rep.get("acked_at", {}).items()}
+        )
+    acked = set(acked_at)
+
+    visible_samples: List[float] = []
+    write_lat: List[float] = []
+    healthy_watchers = 0
+    for rep in worker_reports:
+        write_lat.extend(rep.get("write_lat_raw", []))
+        for wd in rep.get("watchers_detail", []):
+            # cross-process latency join: ANY worker's ack stamp vs this
+            # watcher's first-sight stamp (one machine-wide monotonic
+            # clock — module docstring)
+            seen_at = {int(k): v for k, v in wd["seen_at"].items()}
+            for rowid, seen_s in seen_at.items():
+                ok_s = acked_at.get(rowid)
+                if ok_s is not None:
+                    visible_samples.append(max(0.0, seen_s - ok_s))
+            if wd["ok"]:
+                healthy_watchers += 1
+    # loss conviction, two layers: each worker's checker convicts over
+    # its OWN acked ids (its settle loop only waits for those — another
+    # worker's tail writes may legitimately land after it detached), and
+    # the parent's global settle sweep convicts on any acked id a NODE
+    # still lacks after the heal window (the durability layer that
+    # covers killed-and-restarted nodes)
+    missing_on_sub: Set[int] = set()
+    worker_missing = sum(
+        int(rep.get("missing_on_sub", 0)) for rep in worker_reports
+    )
+    node_missing = {
+        k: v for k, v in settle_missing.items() if not k.endswith(":error")
+    }
+    for gap in node_missing.values():
+        missing_on_sub |= {int(g) for g in gap}
+
+    stream_errors: List[str] = []
+    for i, rep in enumerate(worker_reports):
+        stream_errors += [
+            f"worker[{i}] {e}" for e in rep.get("stream_errors", [])
+        ]
+    # a node UNREACHABLE at the settle deadline proved nothing: the
+    # sweep could not certify it either way — checker broken
+    # (inconclusive), the same doctrine as a dead watch stream
+    for key, err in sorted(settle_missing.items()):
+        if key.endswith(":error"):
+            stream_errors.append(
+                f"settle: {key[:-len(':error')]} unreachable at "
+                f"deadline ({err[0] if err else '?'})"
+            )
+    sums = {
+        k: sum(int(rep.get(k, 0)) for rep in worker_reports)
+        for k in (
+            "writes_attempted", "writes_ok", "write_errors",
+            "sub_rows_seen", "update_events_seen", "stream_deaths",
+            "retries_429", "retries_transport", "write_failovers",
+            "writes_gave_up",
+        )
+    }
+    flood_s = max(
+        (float(rep.get("flood_s", 0.0)) for rep in worker_reports),
+        default=0.0,
+    )
+    checker_broken = bool(stream_errors) or healthy_watchers == 0
+    lost = bool(missing_on_sub) or worker_missing > 0
+    out = {
+        **sums,
+        "workers": len(worker_reports),
+        "writers": sum(int(rep.get("writers", 0)) for rep in worker_reports),
+        "watchers": sum(
+            int(rep.get("watchers", 0)) for rep in worker_reports
+        ),
+        "healthy_watchers": healthy_watchers,
+        "flood_s": round(flood_s, 3),
+        "throughput_wps": round(
+            sums["writes_ok"] / flood_s if flood_s > 0 else 0.0, 1
+        ),
+        "missing_on_sub": worker_missing + len(missing_on_sub),
+        "settle_missing": {
+            k: v[:8] for k, v in sorted(settle_missing.items())
+        },
+        "stream_errors": stream_errors[:32],
+        "visible_latency_s": latency_block(visible_samples),
+        "write_latency_s": latency_block(write_lat),
+        "lost_writes": lost,
+        "checker_broken": checker_broken,
+        "consistent": (
+            sums["writes_ok"] > 0 and not lost and not checker_broken
+        ),
+        "last_write_error": next(
+            (
+                rep["last_write_error"]
+                for rep in reversed(worker_reports)
+                if rep.get("last_write_error")
+            ),
+            None,
+        ),
+    }
+    return out
+
+
+async def run_devcluster_load(
+    n_nodes: int = 3,
+    n_workers: int = 4,
+    n_writes: int = 512,
+    n_writers: int = 64,
+    n_watchers: int = 4,
+    rate_hz: float = 0.0,
+    settle_timeout_s: float = 30.0,
+    global_settle_s: float = DEFAULT_GLOBAL_SETTLE_S,
+    seed: int = 0,
+    plan=None,
+    state_dir: Optional[str] = None,
+    table: str = "tests",
+    flight_recorder: bool = True,
+    schema_sql: Optional[str] = None,
+    base_id: int = 10_000_000,
+    perf: Optional[Dict[str, object]] = None,
+) -> dict:
+    """One measured MULTI-PROCESS serving run: boot an ``n_nodes``
+    devcluster (one real agent process per node, full-mesh bootstrap,
+    host flight recorder armed per node), shard ``n_writers`` writer
+    lanes and ``n_watchers`` watchers across ``n_workers`` loadgen
+    worker processes, replay ``plan``'s crash events as kill -9 +
+    respawn during the flood, then settle: first each worker's own
+    watchers, then the parent's global acked-id sweep over every node.
+
+    Watchers read only nodes the plan never kills (a watcher pinned to
+    a scheduled kill would certify nothing — its death is already the
+    checker-broken signal); the KILLED node's recovery is proven by the
+    global settle sweep instead.  Returns the merged report dict plus
+    cluster/fault metadata and each surviving node's flight-JSONL path.
+    """
+    from .devcluster import DevCluster, Topology
+
+    if plan is not None and plan.n_nodes != n_nodes:
+        raise ValueError(
+            f"plan is for {plan.n_nodes} nodes, cluster has {n_nodes}"
+        )
+    tmp = None
+    if state_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="corro-loadgen-mp-")
+        state_dir = tmp.name
+    schema_dir = os.path.join(state_dir, "schema")
+    os.makedirs(schema_dir, exist_ok=True)
+    if schema_sql is None:
+        from .testing import TEST_SCHEMA
+
+        schema_sql = TEST_SCHEMA
+    with open(os.path.join(schema_dir, "schema.sql"), "w") as f:
+        f.write(schema_sql)
+
+    # full-mesh topology over generated names (node00, node01, ...):
+    # every node bootstraps to every other via explicit single edges
+    names = [f"node{i:02d}" for i in range(n_nodes)]
+    text = "\n".join(
+        f"{a} -> {b}" for a in names for b in names if a != b
+    ) or names[0]
+    topo = Topology.parse(text)
+
+    cluster = DevCluster(
+        topo, os.path.join(state_dir, "state"), schema_dir,
+        flight_recorder=flight_recorder, perf=perf,
+    )
+    cluster.write_configs()
+    t_start = time.monotonic()
+    out: dict = {
+        "n_nodes": n_nodes,
+        "workers": n_workers,
+        "cluster": "devcluster",
+        "faults": plan is not None,
+    }
+    try:
+        cluster.start(stagger_s=0.1)
+        cluster.wait_ready(timeout=60.0)
+        addrs = cluster.api_addrs
+
+        # watchers avoid nodes the plan kills (see docstring)
+        killed = set()
+        if plan is not None:
+            from .faults import sel_indices
+
+            for ev in plan.events:
+                if ev.kind == "crash":
+                    killed.update(sel_indices(ev.node, n_nodes))
+            out["plan_horizon"] = plan.horizon
+            out["killed_nodes"] = sorted(killed)
+        read_addrs = [
+            a for i, a in enumerate(addrs) if i not in killed
+        ] or addrs
+
+        writer_shares = _split(max(1, n_writers), n_workers)
+        watcher_shares = _split(max(1, n_watchers), n_workers)
+        write_shares = _split(n_writes, n_workers)
+        tasks = []
+        next_base = base_id
+        for w in range(n_workers):
+            if write_shares[w] <= 0:
+                continue
+            tasks.append(
+                {
+                    "worker_index": w,
+                    "write_addrs": addrs,
+                    "read_addrs": read_addrs,
+                    "table": table,
+                    "seed": seed * 10_007 + w,
+                    "n_writers": max(1, writer_shares[w]),
+                    "n_watchers": max(1, watcher_shares[w]),
+                    "n_writes": write_shares[w],
+                    "rate_hz": rate_hz,
+                    "settle_timeout_s": settle_timeout_s,
+                    "base_id": next_base,
+                }
+            )
+            next_base += write_shares[w]
+
+        driver = None
+        fault_error: List[str] = []
+        if plan is not None:
+            from .devcluster import DevClusterFaultDriver
+
+            drv = DevClusterFaultDriver(plan, cluster)
+
+            async def _drive():
+                try:
+                    await drv.run()
+                except Exception as e:  # noqa: BLE001 — recorded, one
+                    # broken driver must not crash the whole campaign
+                    fault_error.append(f"{type(e).__name__}: {e}")
+
+            driver = asyncio.ensure_future(_drive())
+
+        flood_t0 = time.monotonic()
+        try:
+            # return_exceptions: one failed worker must not abandon its
+            # siblings mid-communicate — an un-awaited worker whose
+            # stdout pipe nobody reads blocks forever in its report
+            # write and leaks the process.  Wait for ALL, then raise.
+            gathered = await asyncio.gather(
+                *(_spawn_worker(t) for t in tasks),
+                return_exceptions=True,
+            )
+            errors = [g for g in gathered if isinstance(g, BaseException)]
+            if errors:
+                raise errors[0]
+            worker_reports = list(gathered)
+        finally:
+            if driver is not None:
+                # the driver heals (respawns) everything by schedule
+                # end; wait for it so the settle sweep runs against a
+                # fully-restarted cluster — cancel only if it wedged
+                try:
+                    await asyncio.wait_for(
+                        driver,
+                        timeout=(plan.horizon + 2) * plan.round_s + 30.0,
+                    )
+                except asyncio.TimeoutError:
+                    driver.cancel()
+                    await asyncio.gather(driver, return_exceptions=True)
+                    fault_error.append("fault driver timed out")
+        out["workers_wall_s"] = round(time.monotonic() - flood_t0, 3)
+        if fault_error:
+            out["fault_driver_error"] = fault_error[0]
+
+        acked = set()
+        for rep in worker_reports:
+            acked.update(int(k) for k in rep.get("acked_at", {}))
+        settle_missing = await _global_settle(
+            addrs, table, acked, timeout_s=global_settle_s
+        )
+        out.update(merge_reports(worker_reports, settle_missing))
+        if driver is not None:
+            out["fault_rounds_applied"] = drv.round + 1
+        # graceful stop BEFORE reading flights: SIGTERM triggers each
+        # node's final flight flush, so the JSONLs carry the complete
+        # run (a kill -9'd node's file is its last periodic snapshot)
+        cluster.stop()
+        if flight_recorder:
+            flights = {}
+            for name in names:
+                p = os.path.join(
+                    cluster.nodes[name].state_dir, "flight.jsonl"
+                )
+                if os.path.exists(p):
+                    try:
+                        with open(p) as f:
+                            head = json.loads(f.readline())
+                        flights[name] = {
+                            "path": p,
+                            "writes": head.get("writes"),
+                            "saturation": head.get("summary", {}).get(
+                                "saturation"
+                            ),
+                        }
+                    except (OSError, ValueError) as e:
+                        flights[name] = {"path": p, "error": repr(e)}
+            out["node_flights"] = flights
+        out["elapsed_s"] = round(time.monotonic() - t_start, 3)
+        return out
+    finally:
+        cluster.stop()
+        if tmp is not None and not os.environ.get("CORRO_KEEP_MP_STATE"):
+            tmp.cleanup()
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

@@ -22,32 +22,118 @@ from ..core.types import Change, DELETE_SENTINEL, SqliteValue
 from .matcher import Matcher, MatcherError, _enc_cell
 
 
+#: default per-subscriber event queue bound (ISSUE 13): the serving
+#: tier's slow-consumer policy is DISCONNECT-WITH-REASON, never a
+#: silent drop — a consumer this many events behind can only fall
+#: further behind, and an unbounded queue would turn one stalled
+#: reader into unbounded server memory.  Agents pass
+#: ``perf.sub_queue_cap``; this is the standalone-manager default.
+SUB_QUEUE_CAP = 1024
+
+
+class SubQueue:
+    """One subscriber's BOUNDED event queue.  On overflow the queue is
+    closed: the backlog (which the consumer was never going to catch up
+    on) is replaced by a single ``{"error": reason}`` event, and the
+    streaming handler disconnects after sending it — the client re-syncs
+    through the snapshot / ``?from=`` path on reconnect, so events are
+    re-served, not lost.  Duck-types the asyncio.Queue surface the
+    stream handlers use (put_nowait/get/qsize)."""
+
+    __slots__ = ("_q", "closed", "close_reason")
+
+    def __init__(self, maxsize: int = SUB_QUEUE_CAP):
+        if maxsize <= 0:
+            # asyncio.Queue(0) is INFINITE — a config typo must not
+            # silently disable the slow-consumer policy
+            raise ValueError(
+                f"sub queue bound must be > 0 (got {maxsize}; 0 means "
+                "unbounded in asyncio semantics)"
+            )
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        self.closed = False
+        self.close_reason: Optional[str] = None
+
+    def put_nowait(self, event: dict) -> None:
+        if self.closed:
+            return  # disconnecting: the close event is already queued
+        self._q.put_nowait(event)
+
+    async def get(self) -> dict:
+        return await self._q.get()
+
+    def get_nowait(self) -> dict:
+        return self._q.get_nowait()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def close(self, reason: str) -> None:
+        """Terminal: drop the undeliverable backlog, queue the one
+        explicit close event the handler forwards before hanging up."""
+        if self.closed:
+            return
+        self.closed = True
+        self.close_reason = reason
+        while True:
+            try:
+                self._q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+        self._q.put_nowait({"error": reason})
+
+
 class SubHandle:
     """One active subscription: matcher + attached subscriber queues."""
 
-    def __init__(self, matcher: Matcher):
+    def __init__(self, matcher: Matcher, queue_cap: int = SUB_QUEUE_CAP):
         self.matcher = matcher
         self.id = matcher.id
-        self.queues: List[asyncio.Queue] = []
+        self.queue_cap = queue_cap
+        self.queues: List[SubQueue] = []
         # events fanned out to attached queues since creation; the
         # serving-telemetry counter advances a per-handle watermark
         # (`_fanout_reported`) so deliveries from the DEFERRED flush
         # path count too, not just the synchronous handle_changes ones
         self.delivered = 0
         self._fanout_reported = 0
+        # slow-consumer disconnects since creation (watermarked into the
+        # serving saturation counter like `delivered`)
+        self.slow_disconnects = 0
+        self._slow_reported = 0
         matcher.subscribe(self._on_event)
 
     def _on_event(self, event: dict):
+        dead: List[SubQueue] = []
+        delivered = 0
         for q in list(self.queues):
-            q.put_nowait(event)
-        self.delivered += len(self.queues)
+            try:
+                q.put_nowait(event)
+                delivered += 1
+            except asyncio.QueueFull:
+                dead.append(q)
+        for q in dead:
+            # the slow-consumer policy (doc/serving.md): disconnect with
+            # an explicit reason — the bound is the queue's whole point,
+            # and a silent drop would break the no-lost-events contract
+            # the checker certifies
+            self.queues.remove(q)
+            q.close(
+                f"slow consumer: subscriber fell {self.queue_cap} "
+                "events behind; reconnect and re-sync"
+            )
+            self.slow_disconnects += 1
+        self.delivered += delivered
 
-    def attach(self) -> asyncio.Queue:
-        q: asyncio.Queue = asyncio.Queue()
+    def attach(self) -> SubQueue:
+        q = SubQueue(maxsize=self.queue_cap)
         self.queues.append(q)
         return q
 
-    def detach(self, q: asyncio.Queue):
+    def detach(self, q):
         if q in self.queues:
             self.queues.remove(q)
 
@@ -56,9 +142,15 @@ class SubsManager:
     """Registry of live subscriptions, keyed by id and by normalized SQL
     hash so identical queries share one matcher (pubsub.rs:108-186)."""
 
-    def __init__(self, store, state_dir: Optional[str] = None):
+    def __init__(
+        self,
+        store,
+        state_dir: Optional[str] = None,
+        queue_cap: int = SUB_QUEUE_CAP,
+    ):
         self.store = store
         self.state_dir = state_dir
+        self.queue_cap = queue_cap
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
         self.by_id: Dict[str, SubHandle] = {}
@@ -107,7 +199,7 @@ class SubsManager:
             state_path=self._state_path(sub_id),
         )
         matcher.run_initial()
-        handle = SubHandle(matcher)
+        handle = SubHandle(matcher, queue_cap=self.queue_cap)
         self.by_id[sub_id] = handle
         self.by_hash[h] = sub_id
         self.store.conn.execute(
@@ -157,7 +249,9 @@ class SubsManager:
                     "DELETE FROM __corro_subs WHERE id = ?", (sub_id,)
                 )
                 continue
-            self.by_id[sub_id] = SubHandle(matcher)
+            self.by_id[sub_id] = SubHandle(
+                matcher, queue_cap=self.queue_cap
+            )
             self.by_hash[self._hash(sql, params)] = sub_id
 
     def match_changes(self, changes: Sequence[Change]):
@@ -260,12 +354,17 @@ class SubsManager:
             return
         fanned = 0
         depth = 0
+        slow = 0
         for h in self.by_id.values():
             fanned += h.delivered - h._fanout_reported
             h._fanout_reported = h.delivered
+            slow += h.slow_disconnects - h._slow_reported
+            h._slow_reported = h.slow_disconnects
             for q in h.queues:
                 depth = max(depth, q.qsize())
         tel.sub_fanout(fanned, depth)
+        if slow:
+            tel.slow_consumer(slow)
         self._drain_visible()
 
     def _schedule_flush(self, loop, handle):
@@ -308,17 +407,22 @@ class SubsManager:
 class UpdatesManager:
     """Per-table change notifier (updates.rs:61-268): no SQL matching, just
     "this pk in this table changed" NotifyEvents
-    ({"notify": [type, [pk values...]]})."""
+    ({"notify": [type, [pk values...]]}).  Queues are BOUNDED with the
+    same slow-consumer policy as SQL subscriptions (ISSUE 13): overflow
+    disconnects with a reason, never drops silently."""
 
-    def __init__(self):
-        self.by_table: Dict[str, List[asyncio.Queue]] = {}
+    def __init__(self, queue_cap: int = SUB_QUEUE_CAP):
+        self.queue_cap = queue_cap
+        self.by_table: Dict[str, List[SubQueue]] = {}
+        # serving telemetry handle (attach_host_telemetry); None = off
+        self.telemetry = None
 
-    def attach(self, table: str) -> asyncio.Queue:
-        q: asyncio.Queue = asyncio.Queue()
+    def attach(self, table: str) -> SubQueue:
+        q = SubQueue(maxsize=self.queue_cap)
         self.by_table.setdefault(table, []).append(q)
         return q
 
-    def detach(self, table: str, q: asyncio.Queue):
+    def detach(self, table: str, q):
         if table in self.by_table and q in self.by_table[table]:
             self.by_table[table].remove(q)
 
@@ -335,7 +439,20 @@ class UpdatesManager:
             queues = self.by_table.get(table, [])
             if not queues:
                 continue
+            dead: List[SubQueue] = []
             for pk, typ in pks.items():
                 event = {"notify": [typ, [_enc_cell(v) for v in decode_pk(pk)]]}
                 for q in list(queues):
-                    q.put_nowait(event)
+                    try:
+                        q.put_nowait(event)
+                    except asyncio.QueueFull:
+                        if q not in dead:
+                            dead.append(q)
+            for q in dead:
+                queues.remove(q)
+                q.close(
+                    f"slow consumer: updates watcher fell "
+                    f"{self.queue_cap} events behind; reconnect"
+                )
+                if self.telemetry is not None:
+                    self.telemetry.slow_consumer(1)

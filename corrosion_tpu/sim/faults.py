@@ -41,9 +41,12 @@ Two compiled representations, one consumer surface (ISSUE 4):
   (active[R+1], src_mask[N], dst_mask[N]) term — O(K·(R+N)) HBM, which
   is what makes a 100k-node fault storm compilable at all (the matrix
   form would be 10 GB *per round*).  Exact for block (OR of terms),
-  delay (sum — `LinkFault.merge` adds), and jitter (max); exact for
-  loss only when loss events never overlap on a (round, link), which
-  `compile_plan_factored` validates and refuses otherwise.
+  delay (sum — `LinkFault.merge` adds), jitter (max), AND loss:
+  overlapping loss events compile to one composite factor per
+  pairwise-overlapping subset carrying the matrix compiler's exact
+  merged u8 threshold (`_compose_overlapping_losses`, ISSUE 13 —
+  closing the PR 4 carried edge), capped at `MAX_OVERLAPPING_LOSS`
+  mutually-overlapping events with a loud matrix-fallback refusal.
 
 The kernels never index the tensors directly: `fault_edge_block` /
 `fault_edge_loss` / `fault_edge_delay` / `fault_edge_jitter` evaluate
@@ -210,8 +213,11 @@ def fault_edge_loss(faults, src, dst):
     hit = _factored_hits(
         faults.loss_on, faults.loss_src, faults.loss_dst, src, dst
     )
-    # loss factors are compile-validated non-overlapping per (round,
-    # link): at most one hits, so max == the merged threshold
+    # factors compose by MAX: overlapping loss events compile to one
+    # composite factor per overlapping subset (`_compose_overlapping_
+    # losses`), the maximal active subset carries the matrix-merged
+    # threshold, and every other hitting factor is ≤ it by fold
+    # monotonicity — so the max IS the merged threshold, bit-exactly
     return jnp.max(
         jnp.where(hit, faults.loss_thr[:, None], jnp.uint8(0)), axis=0
     )
@@ -422,6 +428,96 @@ def _events_overlap(a, b, n: int) -> bool:
     )
 
 
+#: largest mutually-overlapping loss-event set the factored compiler
+#: composes exactly: the composition emits one rank-1 factor per
+#: pairwise-overlapping SUBSET (2^k - k - 1 composites for a k-clique),
+#: so an adversarial plan must not explode compile time.  Above the cap
+#: the compiler refuses loudly — compile with ``factored=False`` (the
+#: matrix form has no restriction; at ≥1024 nodes that fallback is the
+#: documented O(R·N²) cost the refusal message names).
+MAX_OVERLAPPING_LOSS = 8
+
+
+def _compose_overlapping_losses(losses, loss_events, blocks, n: int) -> None:
+    """EXACT integer composition of overlapping loss events (ISSUE 13
+    satellite, closing the PR 4 carried edge).
+
+    The matrix compiler merges concurrent losses per (round, link) as
+    independent drops — a float64 fold of ``1-(1-a)(1-b)`` in
+    plan-event order — and quantizes ONCE at the end
+    (``int(round(p·256))``).  That merged u8 is not a function of the
+    per-event u8 thresholds, which is why the factored form used to
+    refuse overlapping losses outright.
+
+    The composition that IS rank-1 exact: for every pairwise-
+    overlapping subset S of loss events, emit one composite factor
+    whose window/rectangle is the subset's intersection (selectors are
+    contiguous ranges, so 1-D Helly gives pairwise ⇒ joint) and whose
+    threshold is the SAME plan-order float64 fold the matrix compiler
+    computes, quantized the same way.  `fault_edge_loss` composes
+    factors by MAX: at any (round, edge) the hitting factors are
+    exactly the subsets of the active covering set A, the S = A
+    composite carries the matrix-merged threshold, and every proper
+    subset's fold is ≤ it (the fold is monotone in adding events, and
+    round is monotone) — so max == the matrix value, bit-exactly.
+    A composite that folds to certainty (p·256 ≥ 256) lowers to a cut,
+    the same rule a single p≈1 event follows."""
+    k = len(loss_events)
+    if k < 2:
+        return
+    # overlap graph: DFS extends a combo ONLY by events overlapping
+    # every member, so the walk touches exactly the pairwise-
+    # overlapping subsets — a plan of many DISJOINT loss events (e.g.
+    # topology_link_events rectangles) costs O(k²) like the old check,
+    # never 2^k
+    neighbors = [
+        {
+            j
+            for j in range(k)
+            if j != i and _events_overlap(loss_events[i], loss_events[j], n)
+        }
+        for i in range(k)
+    ]
+
+    def _emit(combo):
+        act = np.logical_and.reduce([losses[i][0] for i in combo])
+        sm = np.logical_and.reduce([losses[i][1] for i in combo])
+        dm = np.logical_and.reduce([losses[i][2] for i in combo])
+        if not (act.any() and sm.any() and dm.any()):
+            return
+        # the matrix compiler's fold, verbatim: plan-event order,
+        # float64, quantized once (LinkFault.merge's loss rule)
+        p = 0.0
+        for i in combo:
+            p = 1.0 - (1.0 - p) * (1.0 - loss_events[i].p)
+        thr = int(round(p * 256.0))
+        if thr >= 256:
+            blocks.append((act, sm, dm))
+        elif thr > 0:
+            losses.append((act, sm, dm, thr))
+
+    def _extend(combo, cands):
+        if not cands:
+            return
+        if len(combo) >= MAX_OVERLAPPING_LOSS:
+            raise ValueError(
+                f"factored loss composition caps at "
+                f"{MAX_OVERLAPPING_LOSS} mutually-overlapping loss "
+                "events (subset composition is exponential in the "
+                "clique size); compile with factored=False — the "
+                "matrix form handles any overlap at O(R·N²) memory"
+            )
+        for j in sorted(cands):
+            grown = combo + (j,)
+            if len(grown) >= 2:
+                _emit(grown)
+            _extend(
+                grown, {c for c in cands if c > j and c in neighbors[j]}
+            )
+
+    _extend((), set(range(k)))
+
+
 def compile_plan_factored(
     plan: FaultPlan, cfg: SimConfig, topo: Topology = Topology()
 ) -> FactoredFaultPlan:
@@ -487,14 +583,7 @@ def compile_plan_factored(
         elif ev.kind == "jitter":
             jitters.append(term + (ev.delay_rounds,))
 
-    for i in range(len(loss_events)):
-        for j in range(i + 1, len(loss_events)):
-            if _events_overlap(loss_events[i], loss_events[j], n):
-                raise ValueError(
-                    "factored fault compilation needs non-overlapping "
-                    "loss events (combined-drop u8 quantization is not "
-                    "factorable); compile with factored=False instead"
-                )
+    _compose_overlapping_losses(losses, loss_events, blocks, n)
 
     # ring-envelope validation: per round, a link's worst extra delay is
     # the sum of the delay events covering it — bounded here by, for

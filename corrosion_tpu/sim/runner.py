@@ -935,6 +935,132 @@ def config_serving_loadgen(
     return out
 
 
+def config_serving_loadgen_mp(
+    seed: int = 0,
+    n_nodes: int = 3,
+    n_workers: int = 8,
+    n_writers: int = 1024,
+    n_watchers: int = 8,
+    n_writes: int = 2048,
+    rate_hz: float = 0.0,
+    overload_inflight: Optional[int] = None,
+    settle_timeout_s: float = 60.0,
+    global_settle_s: float = 60.0,
+) -> Dict[str, object]:
+    """The MULTI-PROCESS serving rung (ISSUE 13): ``n_writers`` writer
+    lanes sharded across ``n_workers`` loadgen WORKER PROCESSES against
+    a real ``n_nodes`` devcluster (one agent process per node, flight
+    recorders armed) — the ≥1000-writers form of the serving-tier
+    claim.  Three measured conditions:
+
+    - **faultless** — full writer count, publish→visible percentiles
+      joined across processes (one machine-wide monotonic clock);
+    - **kill + restart** — a FaultPlan crash event replayed as SIGKILL
+      + respawn of the last node mid-flood (`DevClusterFaultDriver`);
+      the checker proves zero ACKED writes lost across the restart;
+    - **overload** — every node's admission limit pinned to
+      ``overload_inflight`` (far below the writer count): saturated
+      nodes must answer 429 + Retry-After, writers back off and retry,
+      and the server-side ``admission_rejected`` counters (read from
+      the nodes' flight JSONLs) must match the degradation story — no
+      silent drops, no unbounded queues.
+
+    ``converged`` ≡ every condition ``consistent`` AND the overload
+    condition actually observed backpressure (a rung that never hit
+    the limit measured nothing)."""
+    import asyncio as _asyncio
+
+    from ..faults import FaultEvent, FaultPlan
+    from ..loadgen_mp import run_devcluster_load
+
+    if overload_inflight is None:
+        # scale the limit with the workload so the overload condition
+        # actually overloads at ANY --writers: ~1/16th of the writer
+        # count (64 at the 1024-writer acceptance shape), floored so a
+        # tiny smoke still has a meaningful bound to hit
+        overload_inflight = max(2, min(64, n_writers // 16))
+    t0 = time.monotonic()
+
+    def one(plan=None, perf=None, s=0):
+        return _asyncio.run(
+            run_devcluster_load(
+                n_nodes=n_nodes, n_workers=n_workers,
+                n_writes=n_writes, n_writers=n_writers,
+                n_watchers=n_watchers, rate_hz=rate_hz,
+                settle_timeout_s=settle_timeout_s,
+                global_settle_s=global_settle_s,
+                seed=seed + s, plan=plan, perf=perf,
+            )
+        )
+
+    faultless = one(s=0)
+    crash_plan = FaultPlan(
+        n_nodes=n_nodes, seed=seed,
+        events=(FaultEvent("crash", 8, 40, node=n_nodes - 1),),
+        round_s=0.05,
+    )
+    crashed = one(plan=crash_plan, s=100)
+    overload = one(perf={"api_max_inflight_tx": overload_inflight}, s=200)
+
+    def _sat_total(rep, kind):
+        total = 0
+        for f in (rep.get("node_flights") or {}).values():
+            c = (f.get("saturation") or {}).get("counters", {})
+            total += int(c.get(kind, {}).get("total", 0))
+        return total
+
+    rejected = _sat_total(overload, "admission_rejected")
+    runs = (faultless, crashed, overload)
+    consistent = all(r["consistent"] for r in runs)
+    backpressure_seen = (
+        overload["retries_429"] > 0 and rejected > 0
+    )
+    out = {
+        "n_nodes": n_nodes,
+        "round_path": "host-mp",
+        "workers": n_workers,
+        "writers": n_writers,
+        "watchers": n_watchers,
+        "writes": n_writes,
+        "seed": seed,
+        "converged": consistent and backpressure_seen,
+        "consistent": consistent,
+        "lost_writes": any(r["lost_writes"] for r in runs),
+        "checker_broken": any(r["checker_broken"] for r in runs),
+        "publish_visible_s": faultless["visible_latency_s"],
+        "write_latency_s": faultless["write_latency_s"],
+        "throughput_wps": faultless["throughput_wps"],
+        "crash": {
+            "publish_visible_s": crashed["visible_latency_s"],
+            "consistent": crashed["consistent"],
+            "lost_writes": crashed["lost_writes"],
+            "killed_nodes": crashed.get("killed_nodes"),
+            "retries_transport": crashed["retries_transport"],
+            "write_failovers": crashed["write_failovers"],
+            "settle_missing": crashed.get("settle_missing"),
+            "plan_horizon": crash_plan.horizon,
+        },
+        "overload": {
+            "inflight_limit": overload_inflight,
+            "retries_429": overload["retries_429"],
+            "admission_rejected_total": rejected,
+            "backpressure_seen": backpressure_seen,
+            "consistent": overload["consistent"],
+            "publish_visible_s": overload["visible_latency_s"],
+            "writes_gave_up": overload["writes_gave_up"],
+        },
+        # per-node saturation evidence from the faultless run's flight
+        # JSONLs (queue-depth high-water marks): the gauges the host
+        # flight recorder surfaces for the serving tier's limits
+        "saturation_high_water": {
+            name: (f.get("saturation") or {}).get("high_water")
+            for name, f in (faultless.get("node_flights") or {}).items()
+        },
+        "wall_clock_s": round(time.monotonic() - t0, 3),
+    }
+    return out
+
+
 def config_peer_sampler_frontier(
     seed: int = 0,
     n_nodes: int = 96,

@@ -55,11 +55,29 @@ class Topology:
     # `round.validate` — a class above the slot count would silently
     # clamp, not expand.
     degree_classes: Tuple[int, ...] = ()
+    # measured-RTT WAN matrix (ISSUE 13 satellite): per-(region,
+    # region) delay classes in ROUNDS, quantized from a real RTT table
+    # (`corrosion_tpu.topo.FLY_RTT_MS` → the ``wan-fly-6r`` family).
+    # () = the 3-class tier model above; non-empty replaces the
+    # region-distance rule entirely (so it requires n_azs == 1 — a
+    # measured matrix and the AZ tier model would double-count), and
+    # the kernels branch at trace time, so matrix-free topologies
+    # compile byte-identically.
+    region_delay_matrix: Tuple[Tuple[int, ...], ...] = ()
 
     def __post_init__(self):
         object.__setattr__(
             self, "degree_classes",
             tuple(int(d) for d in self.degree_classes),
+        )
+        # JSON round-trips the matrix as nested lists; jit keys need
+        # nested tuples
+        object.__setattr__(
+            self, "region_delay_matrix",
+            tuple(
+                tuple(int(d) for d in row)
+                for row in self.region_delay_matrix
+            ),
         )
         if self.n_regions < 1 or self.n_azs < 1:
             raise ValueError("n_regions and n_azs must be >= 1")
@@ -69,9 +87,27 @@ class Topology:
                 raise ValueError(f"{name}={p} outside [0, 1]")
         if any(d < 1 for d in self.degree_classes):
             raise ValueError("degree_classes entries must be >= 1")
+        if self.region_delay_matrix:
+            m = self.region_delay_matrix
+            if len(m) != self.n_regions or any(
+                len(row) != self.n_regions for row in m
+            ):
+                raise ValueError(
+                    f"region_delay_matrix must be {self.n_regions}×"
+                    f"{self.n_regions} (n_regions rows and columns)"
+                )
+            if self.n_azs != 1:
+                raise ValueError(
+                    "region_delay_matrix replaces the tier model — it "
+                    "needs n_azs == 1 (AZ classes would double-count)"
+                )
+            if any(d < 0 for row in m for d in row):
+                raise ValueError("region_delay_matrix entries must be >= 0")
 
     @property
     def max_delay(self) -> int:
+        if self.region_delay_matrix:
+            return max(d for row in self.region_delay_matrix for d in row)
         return max(self.intra_delay, self.az_delay, self.inter_delay)
 
 
@@ -123,7 +159,13 @@ def edge_delay(
 ) -> jnp.ndarray:
     """Delay class (rounds) per edge, from region (and AZ) distance.
     Single-AZ topologies compile the exact legacy two-class expression
-    (a trace-time branch — default runs stay byte-identical)."""
+    (a trace-time branch — default runs stay byte-identical).  A
+    measured-RTT ``region_delay_matrix`` (ISSUE 13) replaces the
+    distance rule with a per-(region, region) gather — same trace-time
+    branching discipline."""
+    if topo.region_delay_matrix:
+        m = jnp.asarray(topo.region_delay_matrix, jnp.int32)
+        return m[region[src], region[dst]]
     same_r = region[src] == region[dst]
     if topo.n_azs <= 1:
         return jnp.where(same_r, topo.intra_delay, topo.inter_delay).astype(

@@ -165,6 +165,13 @@ class HostFlightRecorder:
         self.cap = cap
         self.clock = clock
         self.dropped = 0
+        # serving saturation side-channel (ISSUE 13): counters (429
+        # admissions, slow-consumer disconnects) and high-water gauges
+        # (in-flight tx, queue depths) keyed kind -> node — surfaced in
+        # `summary()` so every backpressure limit the serving tier
+        # enforces is VISIBLE in the host flight JSONL header
+        self._sat_counts: Dict[str, Dict[str, float]] = {}
+        self._sat_highs: Dict[str, Dict[str, float]] = {}
 
     def _rec(self, actor: str, version: int) -> Optional[_WriteRecord]:
         key = (actor, version)
@@ -235,6 +242,41 @@ class HostFlightRecorder:
                 rec.visible_hlc.setdefault(node, hlc_now)
             return rec.publish_s
 
+    # -- saturation side-channel (ISSUE 13) ---------------------------
+
+    def sat_count(self, kind: str, node: str, n: float = 1) -> None:
+        """Advance a saturation counter (e.g. ``admission_rejected``,
+        ``slow_consumer_disconnects``) for one node."""
+        with self._lock:
+            per = self._sat_counts.setdefault(kind, {})
+            per[node] = per.get(node, 0) + n
+
+    def sat_high(self, kind: str, node: str, value: float) -> None:
+        """Record a queue-depth/inflight high-water mark (e.g.
+        ``tx_inflight_max``, ``sub_queue_max``)."""
+        with self._lock:
+            per = self._sat_highs.setdefault(kind, {})
+            if value > per.get(node, 0):
+                per[node] = value
+
+    def saturation(self) -> dict:
+        """The saturation block: ``counters`` (totals + per node) and
+        ``high_water`` gauges — deterministic key order."""
+        with self._lock:
+            return {
+                "counters": {
+                    kind: {
+                        "total": sum(per.values()),
+                        "by_node": dict(sorted(per.items())),
+                    }
+                    for kind, per in sorted(self._sat_counts.items())
+                },
+                "high_water": {
+                    kind: dict(sorted(per.items()))
+                    for kind, per in sorted(self._sat_highs.items())
+                },
+            }
+
     # -- exports ------------------------------------------------------
 
     def records(self) -> List[_WriteRecord]:
@@ -279,6 +321,7 @@ class HostFlightRecorder:
             "publish_to_apply_s": latency_block(apply_),
             "publish_to_visible_s": latency_block(vis),
             "hlc_lag_s": latency_block(hlc),
+            "saturation": self.saturation(),
         }
 
 
@@ -331,6 +374,19 @@ class HostTelemetry:
         # fabricated visibility moment
         self.c_vis_dropped = reg.counter(
             "corro_serving_visible_stamps_dropped_total"
+        )
+        # serving backpressure (ISSUE 13): admission control + the
+        # slow-consumer policy, each limit paired with its saturation
+        # signal so the flight recorder can SEE degradation
+        self.g_tx_inflight = reg.gauge("corro_serving_tx_inflight")
+        self.c_admission = reg.counter(
+            "corro_serving_admission_rejected_total"
+        )
+        self.c_slow_consumer = reg.counter(
+            "corro_serving_slow_consumer_disconnects_total"
+        )
+        self.c_write_batches = reg.counter(
+            "corro_serving_write_batches_total"
         )
 
     # -- flight-record stages -----------------------------------------
@@ -394,11 +450,44 @@ class HostTelemetry:
     def queue_depths(self, ingest: int, bcast: int):
         self.g_ingest_q.set(ingest, node=self.node)
         self.g_bcast_q.set(bcast, node=self.node)
+        if self.recorder is not None:
+            self.recorder.sat_high("ingest_queue_max", self.node, ingest)
+            self.recorder.sat_high("bcast_queue_max", self.node, bcast)
 
     def sub_fanout(self, n_events: int, max_depth: int):
         if n_events:
             self.c_fanout.inc(n_events, node=self.node)
         self.g_sub_q.set(max_depth, node=self.node)
+        if self.recorder is not None:
+            self.recorder.sat_high("sub_queue_max", self.node, max_depth)
+
+    # -- backpressure hooks (ISSUE 13) ---------------------------------
+
+    def tx_inflight(self, depth: int):
+        """Admission-control occupancy sampled at admit/release."""
+        self.g_tx_inflight.set(depth, node=self.node)
+        if self.recorder is not None:
+            self.recorder.sat_high("tx_inflight_max", self.node, depth)
+
+    def admission_rejected(self):
+        """One write refused with 429 + Retry-After (never queued)."""
+        self.c_admission.inc(1, node=self.node)
+        if self.recorder is not None:
+            self.recorder.sat_count("admission_rejected", self.node)
+
+    def slow_consumer(self, n: int):
+        """Subscriber queues force-disconnected by the bound."""
+        self.c_slow_consumer.inc(n, node=self.node)
+        if self.recorder is not None:
+            self.recorder.sat_count(
+                "slow_consumer_disconnects", self.node, n
+            )
+
+    def write_batch(self, n: int):
+        """One write-lane drain committed ``n`` admitted writes."""
+        self.c_write_batches.inc(1, node=self.node)
+        if self.recorder is not None:
+            self.recorder.sat_high("write_batch_max", self.node, n)
 
     def swim_event(self, event: str):
         self.c_swim.inc(1, event=event, node=self.node)
@@ -422,6 +511,7 @@ def attach_host_telemetry(
     tel = HostTelemetry(node, recorder=recorder, registry=registry)
     agent.telemetry = tel
     agent.subs.telemetry = tel
+    agent.updates.telemetry = tel
     agent.store.telemetry = tel
     return tel
 
@@ -429,6 +519,7 @@ def attach_host_telemetry(
 def detach_host_telemetry(agent) -> None:
     agent.telemetry = None
     agent.subs.telemetry = None
+    agent.updates.telemetry = None
     agent.store.telemetry = None
 
 

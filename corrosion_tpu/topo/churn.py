@@ -146,7 +146,13 @@ def topology_link_events(
     for r_i, lo_i, hi_i in blocks:
         for r_j, lo_j, hi_j in blocks:
             same_block = (lo_i, hi_i) == (lo_j, hi_j)
-            if same_block:
+            if topo.region_delay_matrix:
+                # measured-RTT matrix (ISSUE 13): the matrix IS the
+                # delay rule (n_azs == 1 enforced by Topology, so a
+                # block is a region); loss keeps the 2-tier rule
+                delay = topo.region_delay_matrix[r_i][r_j]
+                thr = base if r_i == r_j else inter_t
+            elif same_block:
                 delay, thr = topo.intra_delay, base
             elif r_i == r_j:
                 delay, thr = topo.az_delay, az_t

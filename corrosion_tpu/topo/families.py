@@ -16,12 +16,60 @@ Families mirror deployment shapes the reference actually runs in:
   with distance;
 - ``wan-2region``   — a two-region split with a long, lossy trunk;
 - ``hetero-degree`` — flat latency but hub/leaf fan-out classes
-  (3/2/1 round-robin), the heterogeneous-degree distribution axis.
+  (3/2/1 round-robin), the heterogeneous-degree distribution axis;
+- ``wan-fly-6r``  — the measured-RTT-matrix family (ISSUE 13): six
+  real Fly.io regions with the committed `FLY_RTT_MS` median
+  region-to-region RTT table quantized into per-(region, region)
+  delay classes (`Topology.region_delay_matrix`) — real WAN geometry
+  (asymmetric distances, the trans-Pacific long pole) instead of the
+  3-class tier constants.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Sequence, Tuple
+
+#: Fly.io region slugs, in matrix order
+FLY_REGIONS = ("iad", "ord", "sjc", "lhr", "fra", "nrt")
+
+#: measured median region-to-region RTTs, milliseconds — a committed
+#: CONSTANT table (public Fly.io backbone measurements, mid-2025
+#: medians, symmetric), so the family is reproducible and diffable
+#: rather than fetched.  Diagonal = in-region RTT.
+FLY_RTT_MS: Tuple[Tuple[float, ...], ...] = (
+    #  iad    ord    sjc    lhr    fra    nrt
+    (   2.0,  20.0,  65.0,  75.0,  90.0, 165.0),  # iad
+    (  20.0,   2.0,  50.0,  90.0, 100.0, 145.0),  # ord
+    (  65.0,  50.0,   2.0, 140.0, 150.0, 105.0),  # sjc
+    (  75.0,  90.0, 140.0,   2.0,  15.0, 220.0),  # lhr
+    (  90.0, 100.0, 150.0,  15.0,   2.0, 235.0),  # fra
+    ( 165.0, 145.0, 105.0, 220.0, 235.0,   2.0),  # nrt
+)
+
+#: quantization grain: one sim round ≈ this much wall RTT.  40 ms/round
+#: spreads the table over delay classes 0..6 (a 500 ms flush tick would
+#: flatten everything into one class and measure nothing).
+FLY_MS_PER_ROUND = 40.0
+
+
+def rtt_matrix_to_delay_classes(
+    rtt_ms: Sequence[Sequence[float]], ms_per_round: float
+) -> Tuple[Tuple[int, ...], ...]:
+    """Quantize an RTT matrix (ms) into round-delay classes:
+    ``ceil(rtt / ms_per_round) - 1`` floored at 0, so sub-round RTTs
+    are the free same-rack class and each extra round covers one more
+    ``ms_per_round`` of wire distance."""
+    import math
+
+    out: List[Tuple[int, ...]] = []
+    for row in rtt_ms:
+        out.append(
+            tuple(
+                max(0, math.ceil(ms / ms_per_round) - 1) for ms in row
+            )
+        )
+    return tuple(out)
+
 
 FAMILIES: Dict[str, Dict[str, object]] = {
     "flat": {},
@@ -37,6 +85,13 @@ FAMILIES: Dict[str, Dict[str, object]] = {
         "loss": 0.01, "inter_loss": 0.2,
     },
     "hetero-degree": {"degree_classes": (3, 2, 1)},
+    "wan-fly-6r": {
+        "n_regions": len(FLY_REGIONS),
+        "region_delay_matrix": rtt_matrix_to_delay_classes(
+            FLY_RTT_MS, FLY_MS_PER_ROUND
+        ),
+        "loss": 0.0, "inter_loss": 0.05,
+    },
 }
 
 
@@ -54,10 +109,12 @@ def min_delay_slots(topo_kwargs: Dict[str, object]) -> int:
     """Smallest ``n_delay_slots`` a family's delay classes fit in
     (`round.validate`'s envelope: every delay, and sync's t+1 slot,
     must be representable without ring wraparound)."""
+    matrix = topo_kwargs.get("region_delay_matrix") or ()
     d = max(
         int(topo_kwargs.get("intra_delay", 0)),
         int(topo_kwargs.get("az_delay", 0)),
         int(topo_kwargs.get("inter_delay", 1)),
+        max((int(v) for row in matrix for v in row), default=0),
         1,
     )
     return d + 1

@@ -1,0 +1,211 @@
+"""Serving-tier backpressure (ISSUE 13): admission control, the
+slow-consumer policy, write-lane batching, and the saturation
+side-channel — each limit pinned with its explicit overflow policy."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.api.client import ApiClient, Overloaded
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.testing import Cluster
+
+
+async def _one_node(fn, **server_kw):
+    cluster = Cluster(1, use_swim=False)
+    await cluster.start()
+    srv = ApiServer(cluster.agents[0], **server_kw)
+    await srv.start()
+    try:
+        await fn(cluster.agents[0], srv)
+    finally:
+        await srv.stop()
+        await cluster.stop()
+
+
+def test_admission_control_429_retry_after_and_retry():
+    """Writes beyond max_inflight_tx get 429 + Retry-After (the typed
+    `Overloaded`), the rejection is COUNTED, and `execute_with_retry`
+    rides it to success — graceful degradation, not an error surface."""
+
+    async def body(agent, srv):
+        from corrosion_tpu.metrics import Registry
+        from corrosion_tpu.telemetry import (
+            HostFlightRecorder,
+            attach_host_telemetry,
+        )
+
+        rec = HostFlightRecorder()
+        attach_host_telemetry(agent, recorder=rec, registry=Registry())
+        client = ApiClient(srv.addr)
+        stmts = [["INSERT INTO tests (id, text) VALUES (?, ?)", [1, "x"]]]
+
+        # stall the write lane so admitted writes pile up at the cap
+        async with agent.write_sema:
+            tasks = [
+                asyncio.create_task(client.execute(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                      [10 + i, "x"]]]
+                ))
+                for i in range(6)
+            ]
+            await asyncio.sleep(0.3)  # all dialed; cap (2) reached
+            with pytest.raises(Overloaded) as ei:
+                await client.execute(stmts)
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        ok = [r for r in results if isinstance(r, dict)]
+        rejected = [r for r in results if isinstance(r, Overloaded)]
+        assert len(ok) >= 2  # the admitted writes committed
+        assert rejected, results  # overflow was refused, not queued
+        sat = rec.saturation()
+        assert sat["counters"]["admission_rejected"]["total"] >= 1
+        assert sat["high_water"]["tx_inflight_max"]
+
+        # the retry stack absorbs the refusal once the lane frees up
+        counters = {}
+        out = await client.execute_with_retry(
+            stmts, counters=counters
+        )
+        assert out["results"][0]["rows_affected"] == 1
+
+    asyncio.run(_one_node(body, max_inflight_tx=2))
+
+
+def test_write_batching_drains_under_one_lane_hold():
+    """Concurrent admitted writes drain in batches (one write_sema
+    hold for up to write_batch commits) — visible as the
+    write_batch_max high-water mark ≥ 2."""
+
+    async def body(agent, srv):
+        from corrosion_tpu.metrics import Registry
+        from corrosion_tpu.telemetry import (
+            HostFlightRecorder,
+            attach_host_telemetry,
+        )
+
+        rec = HostFlightRecorder()
+        attach_host_telemetry(agent, recorder=rec, registry=Registry())
+        client = ApiClient(srv.addr)
+        # hold the lane so a burst accumulates, then release: the
+        # drainer must take them in one batch
+        async with agent.write_sema:
+            tasks = [
+                asyncio.create_task(client.execute(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                      [100 + i, "b"]]]
+                ))
+                for i in range(8)
+            ]
+            await asyncio.sleep(0.3)
+        results = await asyncio.gather(*tasks)
+        assert all(r["results"][0]["rows_affected"] == 1 for r in results)
+        assert rec.saturation()["high_water"]["write_batch_max"][
+            agent.telemetry.node
+        ] >= 2
+
+    asyncio.run(_one_node(body, max_inflight_tx=64, write_batch=8))
+
+
+def test_slow_consumer_disconnected_with_reason():
+    """A subscriber that stops reading is disconnected at the queue
+    bound with an explicit error event — never a silent drop, and the
+    fan-out keeps serving the healthy subscribers."""
+    from corrosion_tpu.pubsub.manager import SubQueue
+
+    async def scenario():
+        from corrosion_tpu.agent.config import Config
+        from corrosion_tpu.agent.agent import Agent
+        from corrosion_tpu.agent.transport import MemoryNetwork
+        from corrosion_tpu.testing import TEST_SCHEMA, fast_perf
+
+        perf = fast_perf()
+        perf.sub_queue_cap = 8
+        cfg = Config(use_swim=False, gossip_addr="n0", perf=perf)
+        net = MemoryNetwork()
+        agent = Agent(cfg, net.transport("n0"))
+        agent.store.execute_schema(TEST_SCHEMA)
+        await agent.start()
+        try:
+            handle, _ = agent.subs.get_or_insert(
+                "SELECT id, text FROM tests", ()
+            )
+            slow = handle.attach()   # never read
+            fast = handle.attach()
+            assert isinstance(slow, SubQueue)
+            fast_seen = 0
+            for i in range(32):
+                agent.exec_transaction(
+                    [(f"INSERT INTO tests (id, text) VALUES ({i}, 'x')", ())]
+                )
+                while not fast.empty():  # a HEALTHY consumer keeps up
+                    fast.get_nowait()
+                    fast_seen += 1
+            # the slow queue closed with a reason; the close event is
+            # the ONLY thing left on it
+            assert slow.closed
+            assert "slow consumer" in slow.close_reason
+            ev = slow.get_nowait()
+            assert "slow consumer" in ev["error"]
+            assert slow not in handle.queues
+            assert handle.slow_disconnects == 1
+            # the healthy subscriber stayed attached and saw every event
+            assert not fast.closed
+            assert fast in handle.queues
+            assert fast_seen >= 32
+        finally:
+            await agent.stop()
+
+    asyncio.run(scenario())
+
+
+def test_updates_watcher_slow_consumer_policy():
+    """The per-table updates notifier applies the same bound."""
+    from corrosion_tpu.pubsub.manager import UpdatesManager
+    from corrosion_tpu.core.types import Change
+    from corrosion_tpu.core.pkcodec import encode_pk
+    from corrosion_tpu.core.types import ActorId
+
+    async def scenario():
+        mgr = UpdatesManager(queue_cap=4)
+        q = mgr.attach("tests")
+        site = ActorId(bytes(16))
+        for i in range(12):
+            mgr.match_changes(
+                [
+                    Change(
+                        table="tests", pk=encode_pk([i]), cid="text",
+                        val="x", col_version=1, db_version=i + 1, seq=0,
+                        site_id=site, cl=1,
+                    )
+                ]
+            )
+        assert q.closed
+        assert "slow consumer" in q.close_reason
+        assert q not in mgr.by_table["tests"]
+
+    asyncio.run(scenario())
+
+
+def test_saturation_block_reaches_flight_jsonl(tmp_path):
+    """The recorder's saturation side-channel lands in the JSONL
+    header summary — what `sim trace show` renders."""
+    import json
+
+    from corrosion_tpu.telemetry import (
+        HostFlightRecorder,
+        write_host_flight_jsonl,
+    )
+
+    rec = HostFlightRecorder()
+    rec.sat_count("admission_rejected", "node0", 3)
+    rec.sat_high("tx_inflight_max", "node0", 17)
+    rec.sat_high("tx_inflight_max", "node0", 11)  # high-water keeps 17
+    path = str(tmp_path / "flight.jsonl")
+    write_host_flight_jsonl(path, rec)
+    with open(path) as f:
+        head = json.loads(f.readline())
+    sat = head["summary"]["saturation"]
+    assert sat["counters"]["admission_rejected"]["total"] == 3
+    assert sat["high_water"]["tx_inflight_max"]["node0"] == 17

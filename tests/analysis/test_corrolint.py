@@ -589,3 +589,91 @@ def test_ct007_builtin_drift_flagged(repo):
     res = lint(repo, SpecHashDrift)
     assert len(res.findings) == 1
     assert "builtin drift" in res.findings[0].message
+
+
+# -- CT008 unbounded-queue-in-host-tier --------------------------------------
+
+
+def test_ct008_flags_unbounded_queue_and_deque(repo):
+    from corrosion_tpu.analysis.rules import UnboundedQueueInHostTier
+
+    write(
+        repo,
+        "corrosion_tpu/pubsub/fanout.py",
+        """
+        import asyncio
+        from collections import deque
+
+        def make():
+            q = asyncio.Queue()
+            z = asyncio.Queue(0)       # asyncio: maxsize<=0 is INFINITE
+            y = asyncio.Queue(maxsize=0)
+            w = asyncio.Queue(-1)      # negative literal, same class
+            d = deque()
+            return q, z, y, w, d
+        """,
+    )
+    res = lint(repo, UnboundedQueueInHostTier)
+    assert [f.rule for f in res.findings] == ["CT008"] * 5
+    assert "maxsize" in res.findings[0].message
+    assert "unbounded" in res.findings[1].message
+    assert "asyncio.Queue(-1)" in res.findings[3].message
+
+
+def test_ct008_bounded_and_aliased_clean(repo):
+    from corrosion_tpu.analysis.rules import UnboundedQueueInHostTier
+
+    write(
+        repo,
+        "corrosion_tpu/api/server.py",
+        """
+        import asyncio
+        import collections
+
+        def make(cap):
+            # keyword, positional, and module-attribute spellings all
+            # count as bounded
+            a = asyncio.Queue(maxsize=cap)
+            b = asyncio.Queue(cap)
+            c = collections.deque([], cap)
+            d = collections.deque(maxlen=cap)
+            return a, b, c, d
+        """,
+    )
+    assert lint(repo, UnboundedQueueInHostTier).clean
+
+
+def test_ct008_out_of_scope_tiers_clean(repo):
+    """The sim tier and operator tooling are not serving paths."""
+    from corrosion_tpu.analysis.rules import UnboundedQueueInHostTier
+
+    for rel in ("corrosion_tpu/sim/runner2.py", "corrosion_tpu/cli/tool.py"):
+        write(
+            repo,
+            rel,
+            """
+            import asyncio
+
+            def make():
+                return asyncio.Queue()
+            """,
+        )
+    assert lint(repo, UnboundedQueueInHostTier).clean
+
+
+def test_ct008_pragma_documents_external_bound(repo):
+    from corrosion_tpu.analysis.rules import UnboundedQueueInHostTier
+
+    write(
+        repo,
+        "corrosion_tpu/agent/lanes.py",
+        """
+        import asyncio
+
+        def make():
+            # bounded by the drop-oldest policy at enqueue
+            # corrolint: disable=CT008
+            return asyncio.Queue()
+        """,
+    )
+    assert lint(repo, UnboundedQueueInHostTier).clean

@@ -456,3 +456,111 @@ def test_families_registry_complete():
     for name in FAMILIES:
         topo = Topology(**family_topology(name))
         assert topo.max_delay < min_delay_slots(family_topology(name)) + 1
+
+
+# -- measured-RTT-matrix family (ISSUE 13 satellite) -------------------------
+
+
+def test_region_delay_matrix_edge_delay_gather():
+    """A measured-RTT matrix replaces the distance rule: per-edge delay
+    is the (region[src], region[dst]) gather, validated square and
+    n_azs == 1 only."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.sim.topology import edge_delay, regions
+
+    m = ((0, 1, 2), (1, 0, 3), (2, 3, 0))
+    topo = Topology(n_regions=3, region_delay_matrix=m)
+    n = 6  # 2 nodes per region
+    reg = regions(n, 3)
+    src = jnp.asarray([0, 0, 0, 2, 4, 5])
+    dst = jnp.asarray([1, 2, 4, 5, 0, 3])
+    got = np.asarray(edge_delay(topo, reg, src, dst))
+    assert got.tolist() == [0, 1, 2, 3, 2, 3]
+    assert topo.max_delay == 3
+    with pytest.raises(ValueError, match="region_delay_matrix"):
+        Topology(n_regions=2, region_delay_matrix=m)  # not 2x2
+    with pytest.raises(ValueError, match="n_azs"):
+        Topology(n_regions=3, n_azs=2, region_delay_matrix=m)
+
+
+def test_wan_fly_family_registered_and_quantized():
+    """The committed Fly.io RTT table quantizes into the registered
+    wan-fly-6r family: symmetric classes, trans-Pacific long pole, and
+    a Topology that validates under min_delay_slots (the existing tier
+    rule) — plus spec JSON round-trip back to hashable tuples."""
+    from corrosion_tpu.topo import family_topology, min_delay_slots
+    from corrosion_tpu.topo.families import (
+        FLY_MS_PER_ROUND,
+        FLY_REGIONS,
+        FLY_RTT_MS,
+        rtt_matrix_to_delay_classes,
+    )
+
+    kw = family_topology("wan-fly-6r")
+    topo = Topology(**kw)
+    m = topo.region_delay_matrix
+    assert len(m) == len(FLY_REGIONS) == topo.n_regions
+    # symmetric table → symmetric classes; diagonal is the free class
+    for i in range(len(m)):
+        assert m[i][i] == 0
+        for j in range(len(m)):
+            assert m[i][j] == m[j][i]
+    # the fra-nrt trans-continental pole carries the deepest class
+    fra, nrt = FLY_REGIONS.index("fra"), FLY_REGIONS.index("nrt")
+    assert m[fra][nrt] == max(d for row in m for d in row)
+    assert topo.max_delay < min_delay_slots(kw) + 1
+    # quantization rule pinned: ceil(ms/grain) - 1, floored at 0
+    assert rtt_matrix_to_delay_classes(
+        ((2.0, 90.0), (90.0, 2.0)), FLY_MS_PER_ROUND
+    ) == ((0, 2), (2, 0))
+    # a spec cell naming the family round-trips lists back to tuples
+    from corrosion_tpu.campaign.spec import CampaignSpec
+
+    spec = CampaignSpec.from_dict(
+        {
+            "name": "t",
+            "scenario": {"n_nodes": 12, "n_payloads": 2},
+            "topology": {"topo_family": "wan-fly-6r"},
+        }
+    )
+    t2 = spec.topo({})
+    assert t2.region_delay_matrix == m
+    assert isinstance(t2.region_delay_matrix[0], tuple)
+
+
+def test_wan_fly_matrix_converges_and_host_events():
+    """A small broadcast over the wan-fly-6r matrix converges, and
+    `topology_link_events` lowers the matrix into per-region-pair delay
+    rectangles (the host-parity compile path)."""
+    from corrosion_tpu.topo.families import FLY_REGIONS
+
+    kw = family_topology("wan-fly-6r")
+    topo = Topology(**kw)
+    cfg = SimConfig(
+        n_nodes=24, n_payloads=4, fanout=3, sync_interval_rounds=4,
+        n_delay_slots=min_delay_slots(kw) + 1,
+    )
+    meta = uniform_payloads(cfg, inject_every=1)
+    final, metrics = run_to_convergence(
+        new_sim(cfg, 0), meta, cfg, topo, 400
+    )
+    assert (np.asarray(final.have) > 0).all()
+    evs = topology_link_events(topo, 24, end=8)
+    delays = [e for e in evs if e.kind == "delay"]
+    # every region pair with a non-zero class gets a rectangle
+    n_regions = len(FLY_REGIONS)
+    m = topo.region_delay_matrix
+    want = sum(
+        1
+        for i in range(n_regions)
+        for j in range(n_regions)
+        if m[i][j] > 0
+    )
+    assert len(delays) == want
+    # and the rectangle's class matches the matrix entry it came from
+    per = 24 // n_regions
+    for e in delays:
+        r_i = min(int(str(e.src).split(":")[0]) // per, n_regions - 1)
+        r_j = min(int(str(e.dst).split(":")[0]) // per, n_regions - 1)
+        assert e.delay_rounds == m[r_i][r_j]
