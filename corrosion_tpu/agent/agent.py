@@ -182,6 +182,11 @@ class Agent:
         # write_sema): held across PG explicit transactions, acquired by
         # the ingest loop so remote applies can't interleave with one
         self.write_sema = _WriterLock()
+        # `slow` gray-failure stall gate (faults.py, ISSUE 15): seconds
+        # every gated operation (commit drain, sync need serve, SWIM
+        # datagram handling) sleeps while armed.  0.0 = healthy; fault
+        # drivers arm it via set_slow_inject()
+        self.slow_inject_s = 0.0
         self._rng = random.Random(self.actor_id.bytes_)
         self.swim = None  # attached by SwimRuntime.attach()
         # host-tier flight recorder + serving metric families (ISSUE 8):
@@ -349,6 +354,30 @@ class Agent:
 
             # final batch flush happens off-loop; bounded join
             await asyncio.to_thread(self._otlp.shutdown, TRACER)
+
+    # -- slow gray failure (ISSUE 15) -------------------------------------
+
+    def set_slow_inject(self, stall_s: float) -> None:
+        """Arm (or, with 0.0, clear) the `slow` gray-failure gate.  The
+        node stays alive and correct — it just crawls: commits stall in
+        the write lane (→ admission 429s), sync serves stall per need
+        (→ the peer's adaptive sender shrinks chunks / aborts), and SWIM
+        datagram handling stalls (→ delayed acks → suspects).  Exposed
+        as a gauge so the gray failure is visible from /metrics, not
+        just inferable from symptoms."""
+        self.slow_inject_s = stall_s
+        from ..metrics import REGISTRY
+
+        REGISTRY.gauge("corro_fault_slow_inject_seconds").set(stall_s)
+
+    async def slow_gate(self) -> None:
+        """The stall itself, sliced so a heal mid-stall cuts the tail
+        short instead of serving the whole original sentence."""
+        remaining = self.slow_inject_s
+        while remaining > 0 and self.slow_inject_s > 0:
+            slice_s = min(remaining, 0.1)
+            await asyncio.sleep(slice_s)
+            remaining -= slice_s
 
     # -- write path (L10 → L6) -------------------------------------------
 
@@ -527,6 +556,12 @@ class Agent:
     # -- receive path (L8) ------------------------------------------------
 
     async def _on_datagram(self, src: str, data: bytes):
+        if self.slow_inject_s > 0:
+            # slow-node gray failure: probe handling crawls, so acks
+            # leave late and peers' probe timeouts mark us SUSPECT —
+            # degraded-not-dead, exactly the signal SWIM exists to raise
+            # (runs off the frame pump, so only this datagram stalls)
+            await self.slow_gate()
         if self.swim is not None:
             await self.swim.handle_datagram(src, data)
 
@@ -1119,6 +1154,11 @@ class Agent:
         perf = self.config.perf
         if sender is None:
             sender = AdaptiveSender(perf)
+        if self.slow_inject_s > 0:
+            # slow-node gray failure: the sync stream stalls per served
+            # need — the puller sees slow sends (its adaptive sender
+            # telemetry) but every chunk still arrives; nothing is lost
+            await self.slow_gate()
         if need.kind == "full":
             lo, hi = need.versions
             booked = self.bookie.for_actor(actor_id)

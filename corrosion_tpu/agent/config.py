@@ -110,6 +110,12 @@ class Config:
     # insecure (bool), client.cert_file/key_file (mTLS),
     # client.required (bool, server demands client certs)
     gossip_tls: dict = field(default_factory=dict)
+    # [faults] — in-process fault replay (ISSUE 15; devcluster.py writes
+    # it).  Keys: plan (FaultPlan JSON, faults.plan_to_dict), node_index
+    # (this node's position in gossip_addrs), gossip_addrs (every node's
+    # gossip addr in plan-index order), control_path (the parent
+    # driver's round file).  Empty dict = no fault runtime armed.
+    faults: dict = field(default_factory=dict)
 
     @classmethod
     def load(cls, path: str) -> "Config":
@@ -159,6 +165,7 @@ class Config:
             ),
             otlp_service_name=tel.get("service_name", "corrosion-tpu"),
             telemetry_flight_path=tel.get("flight_path", ""),
+            faults=raw.get("faults", {}),
         )
         for k, v in perf_raw.items():
             if hasattr(cfg.perf, k):

@@ -395,9 +395,15 @@ def _frame(data: bytes) -> bytes:
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
+    # deliberately unbounded: the bound lives at the call sites —
+    # _TcpBiStream.recv wraps this whole coroutine in wait_for, and the
+    # _on_tcp server pump reads long-lived conns where an idle peer is
+    # normal (liveness is SWIM's job, not a read timeout's)
     try:
+        # corrolint: disable=CT009 — bounded by callers (see above)
         hdr = await reader.readexactly(4)
         (n,) = struct.unpack(">I", hdr)
+        # corrolint: disable=CT009 — bounded by callers (see above)
         return await reader.readexactly(n)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
@@ -594,6 +600,10 @@ class UdpTcpTransport(Transport):
         self._server_writers.add(writer)
         try:
             try:
+                # server read, deliberately unbounded: an idle client is
+                # normal on a long-lived conn; a dead one raises.  Peer
+                # liveness is SWIM's job, not a read timeout's.
+                # corrolint: disable=CT009
                 tag = await reader.readexactly(1)
             except (asyncio.IncompleteReadError, ConnectionError):
                 writer.close()
@@ -604,6 +614,9 @@ class UdpTcpTransport(Transport):
                 # TLS it also carries every SWIM datagram from the peer)
                 while True:
                     try:
+                        # server pump read: unbounded for the same
+                        # reason as the tag read above
+                        # corrolint: disable=CT009
                         kind = await reader.readexactly(1)
                     except (asyncio.IncompleteReadError, ConnectionError):
                         break

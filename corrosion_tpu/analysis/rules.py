@@ -1,4 +1,4 @@
-"""The corrolint rule catalog, CT001–CT006.
+"""The corrolint rule catalog, CT001–CT009.
 
 Every rule is distilled from a bug this repo actually shipped and then
 fixed (doc/lint.md carries the full incident write-ups):
@@ -25,6 +25,10 @@ fixed (doc/lint.md carries the full incident write-ups):
   ``asyncio.Queue()``/``deque()`` in a host-tier serving path turns a
   flood (or one slow consumer) into unbounded memory instead of an
   explicit 429 / disconnect-with-reason policy.
+- CT009 — ISSUE 15's gray-failure class: a bare ``await`` of an
+  asyncio network primitive in ``agent/`` with no wait_for/timeout
+  bound parks its task forever against a degraded-not-dead peer (the
+  ``slow`` fault kind injects exactly that stall on purpose).
 """
 
 from __future__ import annotations
@@ -551,6 +555,114 @@ class UnboundedQueueInHostTier(Rule):
                 )
 
 
+#: asyncio network primitives whose bare ``await`` can park a task
+#: forever when the peer goes GRAY — alive at the TCP layer, never
+#: sending another byte.  Connect/accept/read verbs only; the repo's
+#: own wrappers (``BiStream.recv`` et al.) carry internal timeouts and
+#: are deliberately not listed.
+_NETWORK_AWAIT_CALLS = {
+    "asyncio.open_connection",
+    "asyncio.open_unix_connection",
+}
+_NETWORK_AWAIT_METHODS = {
+    # StreamReader framed/line reads
+    "readexactly",
+    "readline",
+    "readuntil",
+    # raw loop.sock_* ops
+    "sock_recv",
+    "sock_recv_into",
+    "sock_accept",
+    "sock_connect",
+    # datagram endpoints
+    "recvfrom",
+}
+#: timeout context managers that bound every await in their body
+_TIMEOUT_CTXES = ("asyncio.timeout", "asyncio.timeout_at")
+
+
+class UnboundedNetworkAwait(Rule):
+    """CT009: a bare ``await`` of an asyncio network primitive in the
+    agent tier, with no ``asyncio.wait_for`` / ``asyncio.timeout``
+    bound.  The gray-failure class ISSUE 15 injects on purpose: a peer
+    that is degraded-not-dead keeps the TCP connection open and simply
+    stops sending, so an unbounded read never errors and never returns
+    — the awaiting task leaks for the process lifetime.  Detection is
+    structural: a wait_for-wrapped op is never the *direct* operand of
+    ``await`` (the wrapper is), so any direct await of a listed op is
+    by definition unbounded unless an ``async with asyncio.timeout``
+    ancestor bounds it lexically."""
+
+    code = "CT009"
+    name = "unbounded-network-await"
+    incident = (
+        "ISSUE 15: the `slow` gray-failure kind stalls live peers "
+        "mid-stream; every unbounded network await becomes a leaked "
+        "task that survives the fault and holds its stream slot"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        for sf in ctx.under("corrosion_tpu/agent/"):
+            if sf.tree is None:
+                continue
+            idx = ModuleIndex(sf)
+            for fn in ast.walk(sf.tree):
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    yield from self._scan(sf, idx, fn)
+
+    def _scan(
+        self, sf: SourceFile, idx: ModuleIndex, fn: ast.AsyncFunctionDef
+    ) -> Iterable[Tuple[str, int, str]]:
+        def visit(node: ast.AST, guarded: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    # separate scope: nested async defs are scanned on
+                    # their own walk, unguarded — an enclosing timeout
+                    # ctx bounds call SITES, not the def's body
+                    continue
+                g = guarded
+                if isinstance(child, ast.AsyncWith) and any(
+                    isinstance(item.context_expr, ast.Call)
+                    and idx.canonical(item.context_expr.func)
+                    in _TIMEOUT_CTXES
+                    for item in child.items
+                ):
+                    g = True
+                if (
+                    isinstance(child, ast.Await)
+                    and not g
+                    and isinstance(child.value, ast.Call)
+                ):
+                    call = child.value
+                    dotted = idx.canonical(call.func)
+                    hit = None
+                    if dotted in _NETWORK_AWAIT_CALLS:
+                        hit = dotted
+                    elif (
+                        isinstance(call.func, ast.Attribute)
+                        and call.func.attr in _NETWORK_AWAIT_METHODS
+                    ):
+                        hit = f".{call.func.attr}(...)"
+                    if hit:
+                        yield (
+                            sf.relpath,
+                            child.lineno,
+                            f"unbounded await of {hit} in async def "
+                            f"{fn.name} — a gray peer (alive, silent) "
+                            "parks this task forever; wrap it in "
+                            "asyncio.wait_for / asyncio.timeout, or "
+                            "pragma-document why unbounded is the "
+                            "design (e.g. a server read whose "
+                            "liveness SWIM owns)",
+                        )
+                yield from visit(child, g)
+
+        yield from visit(fn, False)
+
+
 RULES = [
     UnalignedU8Draw,
     HostSyncInKernel,
@@ -559,4 +671,5 @@ RULES = [
     BlockingCallInAsync,
     BroadExceptSwallow,
     UnboundedQueueInHostTier,
+    UnboundedNetworkAwait,
 ]

@@ -127,6 +127,7 @@ class ApiClient:
         max_s: float = 2.0,
         rng=None,
         counters: Optional[dict] = None,
+        give_up_s: Optional[float] = None,
     ) -> dict:
         """`execute` behind the reference's decorrelated-jitter
         `Backoff` (max_retries caps CONSECUTIVE failures; the budget is
@@ -141,10 +142,18 @@ class ApiClient:
         Deterministic 4xx/5xx responses raise immediately — retrying a
         schema error just burns the budget.  ``counters`` (optional)
         gains ``retries_429`` / ``retries_transport`` / ``gave_up`` so
-        drivers can report observed backpressure honestly."""
+        drivers can report observed backpressure honestly.
+
+        ``give_up_s`` adds a wall budget: server Retry-After hints are
+        CLAMPED to what's left of it (a bogus ``Retry-After: 3600``
+        must not sleep a writer past its deadline), and once it elapses
+        the next failure surfaces instead of retrying."""
         from ..utils.backoff import Backoff
 
-        backoff = Backoff(min_s, max_s, rng=rng, max_retries=max_retries)
+        backoff = Backoff(
+            min_s, max_s, rng=rng, max_retries=max_retries,
+            give_up_s=give_up_s,
+        )
 
         def _count(key):
             if counters is not None:
@@ -162,15 +171,18 @@ class ApiClient:
                 if backoff.gave_up:
                     _count("gave_up")
                     raise
+                # clamp the SERVER's hint against the remaining wall
+                # budget: the backoff's own draw is already bounded by
+                # max_s, but Retry-After is attacker/bug-controlled
                 await asyncio.sleep(
-                    max(next(backoff), e.retry_after_s or 0.0)
+                    backoff.clamp(max(next(backoff), e.retry_after_s or 0.0))
                 )
             except TRANSPORT_ERRORS:
                 _count("retries_transport")
                 if backoff.gave_up:
                     _count("gave_up")
                     raise
-                await asyncio.sleep(next(backoff))
+                await asyncio.sleep(backoff.clamp(next(backoff)))
 
     async def query(self, statement) -> List[list]:
         """Collect all rows of an NDJSON query stream."""

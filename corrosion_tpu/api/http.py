@@ -286,6 +286,12 @@ class ApiServer:
             async with self.agent.write_sema:
                 n = 0
                 while self._write_q and n < self.write_batch:
+                    if self.agent.slow_inject_s > 0:
+                        # slow-node gray failure (ISSUE 15): commits
+                        # crawl while the write lane is held, so
+                        # admission fills up and refuses with 429 —
+                        # explicit backpressure, never a lost ack
+                        await self.agent.slow_gate()
                     stmts, body_len, fut = self._write_q.popleft()
                     n += 1
                     if fut.cancelled():

@@ -715,6 +715,58 @@ def serving_loadgen_spec(
     )
 
 
+def serving_chaos_spec(
+    seeds: Sequence[int] = (0,),
+    n: int = 3,
+    n_writers: int = 1024,
+    n_writes: int = 1536,
+    mp_workers: int = 8,
+) -> CampaignSpec:
+    """The COMPOSED-chaos serving campaign (ISSUE 15): the full fault
+    matrix thrown at one real devcluster lane SIMULTANEOUSLY, under
+    ≥1000 multi-process writer lanes —
+
+    - an **asymmetric partition** (node 1's egress to node 0 cut, the
+      reverse direction alive), installed INSIDE node 1's own process
+      by its `faults.AgentFaultRuntime` from the [faults] config
+      section + the parent's round control file;
+    - a **kill -9 + respawn** of node 2 (the parent
+      `DevClusterFaultDriver`'s half of the matrix), overlapping the
+      partition window;
+    - a **slow-node gray failure** on node 1 at the same time: every
+      gated commit/stream operation stalls, so the node is degraded —
+      visible as SWIM suspects and saturation gauges, answering 429s —
+      but never dead and never lying about acks.
+
+    One cell, all three at once.  ``all_converged`` ≡ the lane ended
+    ``consistent``: the global settle sweep proves anti-entropy healed
+    across the partition AND the restart with ZERO acked writes lost —
+    the ISSUE 15 acceptance shape.  Watchers read only nodes the plan
+    never kills; writers absorb the chaos as 429/transport retries and
+    failovers.  The committed baseline lives at
+    doc/experiments/CAMPAIGN_BASELINE_serving-chaos.json (CI
+    ``chaos-smoke``)."""
+    return CampaignSpec(
+        name="serving-chaos",
+        scenario={
+            "n_nodes": n, "serving": True, "mp_workers": mp_workers,
+            "n_writes": n_writes, "n_writers": n_writers,
+            "n_watchers": 4, "rate_hz": 0.0,
+            "settle_timeout_s": 60.0, "global_settle_s": 90.0,
+        },
+        events=(
+            # rounds at round_s=0.05: partition+slow hold [0.2 s, 2.2 s],
+            # the kill window [0.4 s, 2.0 s) sits inside it — all three
+            # faults overlap mid-flood
+            FaultEvent("partition", 4, 44, src=1, dst=0),
+            FaultEvent("slow", 4, 44, node=1, delay_rounds=2),
+            FaultEvent("crash", 8, 40, node=2),
+        ),
+        seeds=tuple(seeds),
+        round_s=0.05,
+    )
+
+
 BUILTIN_SPECS = {
     "fault-parity-3node": fault_parity_3node_spec,
     "fault-campaign-3node": fault_campaign_3node_spec,
@@ -722,6 +774,7 @@ BUILTIN_SPECS = {
     "swim-churn-partial": swim_churn_partial_spec,
     "serving-3node": serving_3node_spec,
     "serving-loadgen": serving_loadgen_spec,
+    "serving-chaos": serving_chaos_spec,
     "peer-sampler-frontier": peer_sampler_frontier_spec,
     "protocol-frontier": protocol_frontier_spec,
 }

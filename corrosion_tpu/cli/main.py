@@ -141,6 +141,25 @@ def cmd_agent(args) -> int:
                     )
 
             flight_task = asyncio.ensure_future(_flight_flush_loop())
+        fault_task = None
+        if cfg.faults:
+            # [faults] (ISSUE 15): arm the node-local fault runtime —
+            # link faults / slow / clock skew from the shipped FaultPlan
+            # replay INSIDE this process, following the parent
+            # devcluster driver's round control file.  Armed before the
+            # "agent running" line so no fault round can race the
+            # supervisor's readiness signal.
+            from ..faults import AgentFaultRuntime, plan_from_dict
+
+            fault_runtime = AgentFaultRuntime(
+                plan_from_dict(json.loads(cfg.faults["plan"])),
+                int(cfg.faults["node_index"]),
+                list(cfg.faults["gossip_addrs"]),
+                transport,
+                agent=agent,
+                control_path=str(cfg.faults.get("control_path", "")),
+            )
+            fault_task = asyncio.ensure_future(fault_runtime.run())
         # first SIGINT/SIGTERM begins graceful shutdown; a second
         # force-exits (tripwire.rs signal stream).  Armed BEFORE the
         # "agent running" line so a supervisor reacting to that line
@@ -155,6 +174,9 @@ def cmd_agent(args) -> int:
             flush=True,
         )
         await tripwire.wait()
+        if fault_task is not None:
+            fault_task.cancel()
+            await asyncio.gather(fault_task, return_exceptions=True)
         if flight_task is not None:
             flight_task.cancel()
             await asyncio.gather(flight_task, return_exceptions=True)

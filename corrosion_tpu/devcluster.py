@@ -65,13 +65,18 @@ def generate_config(
     bootstrap: List[str],
     flight_path: str = "",
     perf: Optional[Dict[str, object]] = None,
+    faults: Optional[Dict[str, object]] = None,
 ) -> str:
     """Per-node TOML (generate_config, corro-devcluster/src/main.rs:176-208).
     ``flight_path`` arms the node's host flight recorder (ISSUE 13): the
     agent snapshots per-write stage stamps + saturation gauges to that
     JSONL every few seconds, so even a kill -9'd node leaves evidence.
     ``perf`` emits a ``[perf]`` section — how a loadgen campaign pins
-    the admission-control / queue bounds it means to stress."""
+    the admission-control / queue bounds it means to stress.
+    ``faults`` emits a ``[faults]`` section (ISSUE 15): the FaultPlan
+    JSON + this node's index + every node's gossip addr + the parent's
+    round control file — what arms the in-process `AgentFaultRuntime`
+    so link/slow/clock faults replay INSIDE the agent."""
     boots = ", ".join(f'"{b}"' for b in bootstrap)
     tel = (
         f'\n[telemetry]\nflight_path = "{flight_path}"\n' if flight_path else ""
@@ -81,6 +86,14 @@ def generate_config(
             f"{k} = {json.dumps(v)}" for k, v in sorted(perf.items())
         )
         tel += f"\n[perf]\n{lines}\n"
+    if faults:
+        # json.dumps doubles as a TOML basic-string/value emitter here:
+        # the plan payload is itself a JSON string, escaped once more so
+        # quotes inside survive the TOML parse
+        lines = "\n".join(
+            f"{k} = {json.dumps(v)}" for k, v in sorted(faults.items())
+        )
+        tel += f"\n[faults]\n{lines}\n"
     return f"""[db]
 path = "{state_dir}/corrosion.db"
 schema_paths = ["{schema_dir}"]
@@ -113,7 +126,8 @@ class Node:
 class DevCluster:
     def __init__(self, topo: Topology, state_dir: str, schema_dir: str,
                  base_port: int = 0, flight_recorder: bool = False,
-                 perf: Optional[Dict[str, object]] = None):
+                 perf: Optional[Dict[str, object]] = None,
+                 plan=None):
         self.topo = topo
         self.state_dir = state_dir
         self.schema_dir = schema_dir
@@ -124,7 +138,17 @@ class DevCluster:
         # PerfConfig overrides for every node ([perf] TOML section) —
         # the loadgen campaign's admission/queue-bound knobs
         self.perf = dict(perf or {})
+        # FaultPlan shipped into every agent via [faults] (ISSUE 15):
+        # link/slow/clock kinds replay in-process, driven by the round
+        # control file the DevClusterFaultDriver publishes
+        self.fault_plan = plan
         self.nodes: Dict[str, Node] = {}
+
+    @property
+    def control_path(self) -> str:
+        """The epoch-advance control file every agent polls (written
+        atomically by `DevClusterFaultDriver`)."""
+        return os.path.join(self.state_dir, "faults.round")
 
     def _alloc_ports(self) -> None:
         import socket
@@ -155,7 +179,28 @@ class DevCluster:
 
     def write_configs(self) -> None:
         self._alloc_ports()
-        for name, node in self.nodes.items():
+        fault_base: Optional[Dict[str, object]] = None
+        if self.fault_plan is not None:
+            from .faults import plan_to_dict
+
+            if self.fault_plan.n_nodes != len(self.topo.nodes):
+                raise ValueError(
+                    f"plan is for {self.fault_plan.n_nodes} nodes, "
+                    f"topology has {len(self.topo.nodes)}"
+                )
+            fault_base = {
+                "plan": json.dumps(plan_to_dict(self.fault_plan)),
+                # every node's gossip addr in topo.nodes order — plan
+                # node indices resolve against THIS list on every node,
+                # so src/dst selectors mean the same thing everywhere
+                "gossip_addrs": [
+                    f"127.0.0.1:{self.nodes[n].gossip_port}"
+                    for n in self.topo.nodes
+                ],
+                "control_path": self.control_path,
+            }
+        for i, name in enumerate(self.topo.nodes):
+            node = self.nodes[name]
             os.makedirs(node.state_dir, exist_ok=True)
             boots = [
                 f"127.0.0.1:{self.nodes[peer].gossip_port}"
@@ -170,6 +215,11 @@ class DevCluster:
                     else ""
                 ),
                 perf=self.perf,
+                faults=(
+                    {**fault_base, "node_index": i}
+                    if fault_base is not None
+                    else None
+                ),
             )
             with open(os.path.join(node.state_dir, "config.toml"), "w") as f:
                 f.write(cfg)
@@ -308,23 +358,38 @@ class DevCluster:
             self.stop()
 
 
-#: fault kinds the PROCESS seam can express: a devcluster driver can
-#: kill and respawn agent processes, but link faults live inside each
-#: process's transport (the RealSocketFaultDriver seam) and clock skew
-#: inside its HLC — scheduling one of those here would silently not
-#: inject, so the driver refuses them loudly (faults.REALSOCKET_KINDS
-#: is the complementary set)
-DEVCLUSTER_KINDS = frozenset({"crash"})
+#: fault kinds the PROCESS seam can express (ISSUE 15: the FULL matrix).
+#: ``crash`` is the parent's — only the process owner can SIGKILL and
+#: respawn.  Everything else (link faults, the `slow` gray failure,
+#: clock skew — faults.AGENT_RUNTIME_KINDS) replays INSIDE each agent
+#: via the [faults] config section + the round control file this
+#: driver publishes; scheduling those against a cluster that was NOT
+#: built with ``plan=`` would silently not inject, so the driver
+#: refuses that loudly below.
+DEVCLUSTER_KINDS = frozenset(
+    {"crash", "loss", "delay", "jitter", "duplicate", "partition",
+     "slow", "clock_skew"}
+)
+
+#: the subset each agent's in-process runtime owns (parent owns crash)
+_IN_AGENT_KINDS = DEVCLUSTER_KINDS - {"crash"}
 
 
 class DevClusterFaultDriver:
-    """Replay a FaultPlan's ``crash`` events against REAL agent
-    processes (ISSUE 13): the process-kill-and-restart seam of the
-    transport fault stack.  One driver round ≈ ``plan.round_s`` of
-    wall clock, the same time base as `HostFaultDriver` — a node down
-    over rounds [start, end) is SIGKILLed at ``start`` and respawned on
-    its original state dir at ``end`` (``wipe=True`` deletes the
-    durable state first, the cold-rejoin shape).
+    """Replay a FaultPlan against REAL agent processes — the full fault
+    matrix at the process seam (ISSUE 13 crash, ISSUE 15 everything
+    else).  One driver round ≈ ``plan.round_s`` of wall clock, the same
+    time base as `HostFaultDriver`:
+
+    - ``crash``: a node down over rounds [start, end) is SIGKILLed at
+      ``start`` and respawned on its original state dir at ``end``
+      (``wipe=True`` deletes the durable state first, the cold-rejoin
+      shape);
+    - link faults / ``slow`` / ``clock_skew``: the driver only
+      PUBLISHES the current round to the cluster's control file
+      (atomic replace); each agent's `faults.AgentFaultRuntime` polls
+      it and installs its node-local share — including a node respawned
+      mid-plan, which fast-forwards through every boundary it missed.
 
     Crash targets index ``topo.nodes`` order — the same order
     `DevCluster.api_addrs` exposes, so a loadgen can steer watchers
@@ -336,14 +401,23 @@ class DevClusterFaultDriver:
             raise ValueError(
                 f"plan is for {plan.n_nodes} nodes, devcluster has {n}"
             )
-        bad = sorted(
-            {ev.kind for ev in plan.events} - DEVCLUSTER_KINDS
-        )
+        bad = sorted({ev.kind for ev in plan.events} - DEVCLUSTER_KINDS)
         if bad:
             raise ValueError(
                 f"devcluster fault driver replays {sorted(DEVCLUSTER_KINDS)} "
-                f"events only (got {bad}); link faults ride the "
-                "RealSocketFaultDriver seam inside each process"
+                f"events only (got {bad})"
+            )
+        in_agent = sorted(
+            {ev.kind for ev in plan.events} & _IN_AGENT_KINDS
+        )
+        if in_agent and cluster.fault_plan is not plan:
+            # the agents compile their fault state from the [faults]
+            # config section at spawn — a plan the cluster wasn't built
+            # with would publish rounds nobody is listening to
+            raise ValueError(
+                f"plan schedules {in_agent}, which replay INSIDE the "
+                "agents: build the DevCluster with plan=<this plan> so "
+                "write_configs ships it via [faults]"
             )
         self.plan = plan
         self.cluster = cluster
@@ -351,8 +425,18 @@ class DevClusterFaultDriver:
         self.down: set = set()
         self.log: List[tuple] = []  # (round, action, node-name)
 
+    def _publish_round(self, r: int, done: bool = False) -> None:
+        """Atomically publish the current round — the epoch-advance
+        control signal every agent's fault runtime follows."""
+        path = self.cluster.control_path
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"round": r, "done": done}))
+        os.replace(tmp, path)
+
     def apply_round(self, r: int) -> None:
-        """Install round ``r``'s crash state (idempotent per round)."""
+        """Install round ``r``'s crash state and publish the round
+        (idempotent per round)."""
         sched = self.plan.schedule_at(r, include_links=False)
         names = self.cluster.topo.nodes
         for i in sorted(sched.down):
@@ -366,10 +450,12 @@ class DevClusterFaultDriver:
                 self.log.append((r, "restart", (names[i], wipe)))
                 self.cluster.restart_node(names[i], wipe=wipe)
                 self.down.discard(i)
+        self._publish_round(r, done=r > self.plan.horizon)
 
     async def run(self) -> None:
         """Drive the schedule in real time; returns with every node
-        respawned (the all-clear steady state the settle checker needs)."""
+        respawned and every in-agent fault cleared (the all-clear
+        steady state the settle checker needs)."""
         import asyncio
 
         from .invariants import sometimes
@@ -381,6 +467,12 @@ class DevClusterFaultDriver:
             await asyncio.to_thread(self.apply_round, r)
             if r < self.plan.horizon:
                 await asyncio.sleep(self.plan.round_s)
+        # final control write: done=True tells every agent runtime to
+        # clear its injector; give the pollers one cadence to see it
+        await asyncio.to_thread(
+            self._publish_round, self.plan.horizon + 1, True
+        )
+        await asyncio.sleep(self.plan.round_s)
         for kind in {ev.kind for ev in self.plan.events}:
             sometimes(True, f"fault-{kind}-active")
         sometimes(True, "fault-campaign-completed")

@@ -40,8 +40,10 @@ directly.
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
-from dataclasses import dataclass
+import json
+from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple, Union
 
 from .invariants import CATALOG, Catalog, sometimes
@@ -55,7 +57,14 @@ KINDS = (
     "partition",   # directed (or symmetric) edge cut
     "crash",       # node down [start, end); restarts at `end`, optionally wiped
     "clock_skew",  # HLC physical-clock offset on one node
+    "slow",        # gray failure: commit/stream stall on a LIVE node
+                   # (delay_rounds × round_s seconds per gated operation;
+                   # degraded-not-dead — SWIM suspects + saturation, never
+                   # lost writes).  No sim twin (doc/faults.md).
 )
+
+#: node-level kinds (selected via ``node=``, no link rectangle)
+NODE_KINDS = ("crash", "clock_skew", "slow")
 
 NodeSel = Union[int, str]  # node index, "*", or a "lo:hi" half-open range
 
@@ -119,8 +128,13 @@ class FaultEvent:
             raise ValueError(f"unknown fault kind {self.kind!r} (use one of {KINDS})")
         if self.end <= self.start:
             raise ValueError(f"{self.kind}: end {self.end} must be > start {self.start}")
-        if self.kind in ("crash", "clock_skew") and self.node is None:
+        if self.kind in NODE_KINDS and self.node is None:
             raise ValueError(f"{self.kind} needs node=")
+        if self.kind == "slow" and self.delay_rounds <= 0:
+            raise ValueError(
+                "slow needs delay_rounds= (the stall magnitude: each gated "
+                "operation on the node stalls delay_rounds * round_s seconds)"
+            )
         if self.kind in ("loss", "duplicate") and not (0.0 <= self.p <= 1.0):
             raise ValueError(f"{self.kind}: p={self.p} outside [0, 1]")
         if self.delay_rounds > 255:
@@ -181,6 +195,9 @@ class RoundSchedule:
     restart: FrozenSet[int]     # nodes restarting this round (were down)
     wipe: FrozenSet[int]        # restarting nodes that lost durable state
     skews: Dict[int, int]       # node -> HLC offset (ns) active this round
+    # node -> stall magnitude in rounds (the `slow` gray failure);
+    # overlapping slow events take the max, like jitter
+    slow: Dict[int, int] = field(default_factory=dict)
 
     def active_kinds(self) -> List[str]:
         """Fault kinds in effect this round — the single source for
@@ -202,6 +219,8 @@ class RoundSchedule:
             kinds.add("crash")
         if self.skews:
             kinds.add("clock_skew")
+        if self.slow:
+            kinds.add("slow")
         return sorted(kinds)
 
 
@@ -260,6 +279,7 @@ class FaultPlan:
         links: Dict[Tuple[int, int], LinkFault] = {}
         down, restart, wipe = set(), set(), set()
         skews: Dict[int, int] = {}
+        slow: Dict[int, int] = {}
         for ev in self.events:
             if ev.kind == "crash":
                 # crash targets may be range selectors (ISSUE 9 churn)
@@ -277,6 +297,10 @@ class FaultPlan:
                 for i in sel_indices(ev.node, self.n_nodes):
                     skews[i] = skews.get(i, 0) + ev.skew_ns
                 continue
+            if ev.kind == "slow":
+                for i in sel_indices(ev.node, self.n_nodes):
+                    slow[i] = max(slow.get(i, 0), ev.delay_rounds)
+                continue
             if not include_links:
                 continue
             f = _event_link_fault(ev)
@@ -284,7 +308,7 @@ class FaultPlan:
                 links[pair] = links.get(pair, CLEAR).merge(f)
         return RoundSchedule(
             links=links, down=frozenset(down), restart=frozenset(restart),
-            wipe=frozenset(wipe), skews=skews,
+            wipe=frozenset(wipe), skews=skews, slow=slow,
         )
 
     def _has_pair(self, ev: FaultEvent) -> bool:
@@ -311,7 +335,7 @@ class FaultPlan:
                 continue
             if ev.kind in ("delay", "jitter") and ev.delay_rounds <= 0:
                 continue
-            if ev.kind not in ("crash", "clock_skew") and not self._has_pair(ev):
+            if ev.kind not in NODE_KINDS and not self._has_pair(ev):
                 continue
             kinds.add(ev.kind)
         return sorted(kinds)
@@ -351,7 +375,7 @@ class FaultPlan:
         lifted to ranges."""
         rects = []
         for ev in self.events:
-            if ev.kind in ("crash", "clock_skew"):
+            if ev.kind in NODE_KINDS:
                 continue
             sr = sel_indices(ev.src, self.n_nodes)
             dr = sel_indices(ev.dst, self.n_nodes)
@@ -461,6 +485,30 @@ def demo_plan(n_nodes: int = 3, seed: int = 0, rounds: int = 36) -> FaultPlan:
                 "crash", 2 * third, rounds - 2, node=n_nodes - 1, wipe=True
             ),
         ),
+    )
+
+
+def plan_to_dict(plan: FaultPlan) -> dict:
+    """JSON-safe encoding of a FaultPlan — the ``[faults]`` config
+    payload a devcluster parent hands each agent process (ISSUE 15).
+    Round-trips exactly through :func:`plan_from_dict`, so the child's
+    ``derive_seed`` streams are computed from the identical plan."""
+    return {
+        "n_nodes": plan.n_nodes,
+        "seed": plan.seed,
+        "round_s": plan.round_s,
+        "events": [dataclasses.asdict(ev) for ev in plan.events],
+    }
+
+
+def plan_from_dict(d: dict) -> FaultPlan:
+    """Inverse of :func:`plan_to_dict` (validation re-runs in
+    ``FaultEvent.__post_init__`` — a corrupt payload fails loudly)."""
+    return FaultPlan(
+        n_nodes=int(d["n_nodes"]),
+        seed=int(d["seed"]),
+        round_s=float(d.get("round_s", 0.05)),
+        events=tuple(FaultEvent(**ev) for ev in d["events"]),
     )
 
 
@@ -680,6 +728,26 @@ class HostFaultDriver:
                 self._skew_offset.pop(i, None)
                 self.log.append((r, "clock_skew_clear", i))
 
+        # -- slow gray failure: arm/clear the per-agent stall gate (the
+        # agent stays LIVE — its gated operations just crawl; doc/faults.md
+        # explains why this kind has no sim twin).  A crashed node's gate
+        # dies with the process; the restarted agent starts un-stalled and
+        # re-arms here if its slow window is still open.
+        for i, stall_rounds in sched.slow.items():
+            if i in self.cluster.down:
+                continue
+            stall_s = stall_rounds * plan.round_s
+            agent = self.cluster.agents[i]
+            if getattr(agent, "slow_inject_s", 0.0) != stall_s:
+                agent.set_slow_inject(stall_s)
+                self.log.append((r, "slow", (i, stall_s)))
+        for i, agent in enumerate(self.cluster.agents):
+            if i in sched.slow or i in self.cluster.down:
+                continue
+            if getattr(agent, "slow_inject_s", 0.0):
+                agent.set_slow_inject(0.0)
+                self.log.append((r, "slow_clear", i))
+
     async def run(self) -> None:
         """Drive the whole schedule in real time, one round per
         ``plan.round_s``; returns with every fault healed."""
@@ -719,6 +787,10 @@ class RealSocketFaultDriver:
       on the src side; a symmetric event lands on both sides via its
       expanded directed pairs), severing established TCP like the
       Antithesis rig's iptables cut;
+    - **slow** (the gray failure) stalls a LIVE node's gated operations
+      — an AGENT-level fault, so it needs the optional ``agents``
+      sequence; scheduling ``slow`` without handing agents over is a
+      loud refusal (a transport injector cannot stall its own agent);
     - **crash/clock_skew** are out of scope at this seam
       (`REALSOCKET_KINDS`): they are process-level faults the
       multi-process campaign drives separately.
@@ -735,6 +807,7 @@ class RealSocketFaultDriver:
         transports: Sequence,
         addrs: Sequence[str],
         catalog: Catalog = CATALOG,
+        agents: Optional[Sequence] = None,
     ):
         from .agent.transport import FaultInjector
 
@@ -742,6 +815,16 @@ class RealSocketFaultDriver:
             raise ValueError(
                 f"plan is for {plan.n_nodes} nodes, got "
                 f"{len(transports)} transports / {len(addrs)} addrs"
+            )
+        self.agents = list(agents) if agents is not None else None
+        if (
+            any(ev.kind == "slow" for ev in plan.events)
+            and self.agents is None
+        ):
+            raise ValueError(
+                "plan schedules `slow` but no agents= were handed to "
+                "RealSocketFaultDriver — the stall gate lives on the "
+                "Agent, not the transport injector"
             )
         self.plan = plan
         self.transports = list(transports)
@@ -797,9 +880,21 @@ class RealSocketFaultDriver:
             for i, inj in enumerate(self.injectors):
                 inj.set_partition(blocked.get(i, set()))
 
+        # -- slow gray failure: arm/clear the per-agent stall gate (only
+        # when the caller handed us agents; see __init__'s loud refusal)
+        if self.agents is not None:
+            slow = plan.schedule_at(r, include_links=False).slow
+            for i, agent in enumerate(self.agents):
+                stall_s = slow.get(i, 0) * plan.round_s
+                if getattr(agent, "slow_inject_s", 0.0) != stall_s:
+                    agent.set_slow_inject(stall_s)
+                    self.log.append((r, "slow", (i, stall_s)))
+
         # -- coverage markers for the kinds this seam can express
         for kind in plan.active_kinds_at(r):
-            if kind in REALSOCKET_KINDS:
+            if kind in REALSOCKET_KINDS or (
+                kind == "slow" and self.agents is not None
+            ):
                 self.catalog.sometimes(True, f"fault-{kind}-active")
 
     async def run(self) -> None:
@@ -819,3 +914,205 @@ class RealSocketFaultDriver:
     def clear(self) -> None:
         for t in self.transports:
             t.install_faults(None)
+        if self.agents is not None:
+            for agent in self.agents:
+                if getattr(agent, "slow_inject_s", 0.0):
+                    agent.set_slow_inject(0.0)
+
+
+#: fault kinds `AgentFaultRuntime` replays INSIDE an agent process —
+#: everything except `crash`, which only the parent (the process owner)
+#: can express; `devcluster.DEVCLUSTER_KINDS` is the union of both
+AGENT_RUNTIME_KINDS = frozenset(
+    {"loss", "delay", "jitter", "duplicate", "partition", "slow",
+     "clock_skew"}
+)
+
+
+class AgentFaultRuntime:
+    """Node-local FaultPlan replay INSIDE one agent process — what makes
+    the devcluster the third FULL fault seam (ISSUE 15).
+
+    The devcluster parent can kill -9 a process, but link faults live at
+    each node's transport and the `slow`/`clock_skew` gray failures on
+    its Agent — all inside the child.  So the parent ships the plan into
+    every agent via the ``[faults]`` config section (``plan_to_dict``
+    JSON + this node's index + every node's gossip addr in
+    ``topo.nodes`` order), and each agent arms one of these runtimes at
+    startup:
+
+    - **link faults** install per-destination LinkModel streams into
+      this node's own :class:`~corrosion_tpu.agent.transport.FaultInjector`
+      through the SAME ``advance_range_epochs`` walk both host drivers
+      use.  The walk visits every atom — the install callback merely
+      skips edges whose ``src`` isn't this node — so the epoch index
+      handed to ``derive_seed(seed, "link", src, dst, epoch)`` is
+      exactly what `RealSocketFaultDriver` computes for the same plan:
+      the schedule is byte-identical across the process boundary
+      (pinned by tests/cluster/test_devcluster_faults.py);
+    - **partitions** become this node's egress ``blocked_peers`` set
+      (each side of a symmetric cut installs its own direction);
+    - **slow / clock_skew** arm the Agent's stall gate / wrap its HLC
+      clock, same as `HostFaultDriver`;
+    - **crash** stays with the parent — a child cannot respawn itself.
+
+    **Epoch-advance control signal**: the parent's
+    `devcluster.DevClusterFaultDriver` atomically publishes the current
+    round to ``control_path`` every ``plan.round_s``; the runtime polls
+    at twice that cadence and fast-forwards through every boundary ≤ the
+    published round.  Because ``advance_range_epochs`` walks
+    cumulatively, a node respawned mid-plan re-arms from round 0 state
+    straight to the current round — the correct link/partition/slow
+    state, with the correct epoch indices.
+
+    Coverage markers are NOT fired here: `sometimes` counters are
+    per-process, and the campaign's `CampaignCoverage` lives in the
+    parent (the devcluster driver fires them).
+    """
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        node_index: int,
+        addrs: Sequence[str],
+        transport,
+        agent=None,
+        control_path: str = "",
+    ):
+        from .agent.transport import FaultInjector
+
+        if len(addrs) != plan.n_nodes:
+            raise ValueError(
+                f"plan is for {plan.n_nodes} nodes, got {len(addrs)} addrs"
+            )
+        if not 0 <= node_index < plan.n_nodes:
+            raise ValueError(
+                f"node_index {node_index} outside 0..{plan.n_nodes - 1}"
+            )
+        bad = sorted(
+            {ev.kind for ev in plan.events} - AGENT_RUNTIME_KINDS - {"crash"}
+        )
+        if bad:
+            raise ValueError(
+                f"agent fault runtime cannot replay {bad} "
+                f"(supported: {sorted(AGENT_RUNTIME_KINDS)} + parent-owned "
+                "crash)"
+            )
+        self.plan = plan
+        self.node_index = node_index
+        self.addrs = list(addrs)
+        self.transport = transport
+        self.agent = agent
+        self.control_path = control_path
+        self.round = -1
+        self._atoms = plan.range_link_epochs()
+        self._epoch_idx: Dict[int, int] = {}
+        self._partition_epoch = None
+        self._node_sched = any(
+            ev.kind in ("slow", "clock_skew") for ev in plan.events
+        )
+        self._skew_base = None   # original clock._now_ns while skewed
+        self._skew_offset = None
+        self.injector = FaultInjector()
+        transport.install_faults(self.injector)
+        self.log: List[Tuple[int, str, object]] = []
+
+    def apply_round(self, r: int) -> None:
+        """Fast-forward this node's fault state through every boundary
+        ≤ round ``r`` (idempotent; cumulative, so it also serves as the
+        respawn-resume path)."""
+        from .agent.transport import LinkModel
+
+        plan, me = self.plan, self.node_index
+
+        def install(src, dst, idx, params):
+            # the walk advances EVERY atom's epoch index — only the
+            # install itself is node-local, so `idx` here matches the
+            # all-nodes drivers byte for byte
+            if src != me:
+                return
+            if params == CLEAR:
+                self.injector.links.pop(self.addrs[dst], None)
+            else:
+                self.injector.links[self.addrs[dst]] = LinkModel(
+                    latency_s=params.delay_rounds * plan.round_s,
+                    loss=params.loss,
+                    jitter_s=params.jitter_rounds * plan.round_s,
+                    duplicate=params.duplicate,
+                    seed=derive_seed(plan.seed, "link", src, dst, idx),
+                )
+            self.log.append((r, "link", ((src, dst), idx, params)))
+
+        advance_range_epochs(self._atoms, self._epoch_idx, r, install)
+
+        # -- partitions: this node's egress blocked set only
+        pepoch = plan.partition_epoch(r)
+        if pepoch != self._partition_epoch:
+            self._partition_epoch = pepoch
+            self.injector.set_partition(
+                {
+                    self.addrs[d]
+                    for s, d in plan.blocked_pairs_at(r)
+                    if s == me
+                }
+            )
+
+        # -- node faults on the local agent (slow stall gate, HLC skew)
+        if self.agent is not None and self._node_sched:
+            sched = plan.schedule_at(r, include_links=False)
+            stall_s = sched.slow.get(me, 0) * plan.round_s
+            if getattr(self.agent, "slow_inject_s", 0.0) != stall_s:
+                self.agent.set_slow_inject(stall_s)
+                self.log.append((r, "slow", stall_s))
+            offset = sched.skews.get(me)
+            clock = self.agent.clock
+            if offset is not None and offset != self._skew_offset:
+                if self._skew_base is None:
+                    self._skew_base = clock._now_ns
+                base = self._skew_base
+                clock._now_ns = lambda base=base, off=offset: base() + off
+                self._skew_offset = offset
+                self.log.append((r, "clock_skew", offset))
+            elif offset is None and self._skew_base is not None:
+                clock._now_ns = self._skew_base
+                self._skew_base = None
+                self._skew_offset = None
+                self.log.append((r, "clock_skew_clear", me))
+
+    def _read_control(self) -> Optional[dict]:
+        try:
+            with open(self.control_path) as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            return None  # not written yet / mid-replace on exotic fs
+
+    async def run(self) -> None:
+        """Follow the parent's control file until it declares the
+        campaign done, then clear every installed fault (the all-clear
+        steady state the settle sweep converges under)."""
+        import asyncio
+
+        poll_s = max(self.plan.round_s / 2.0, 0.01)
+        try:
+            while True:
+                ctl = self._read_control()
+                if ctl is not None:
+                    r = int(ctl.get("round", -1))
+                    if r > self.round:
+                        self.apply_round(r)
+                        self.round = r
+                    if ctl.get("done"):
+                        break
+                await asyncio.sleep(poll_s)
+        finally:
+            self.clear()
+
+    def clear(self) -> None:
+        self.transport.install_faults(None)
+        if self.agent is not None:
+            if getattr(self.agent, "slow_inject_s", 0.0):
+                self.agent.set_slow_inject(0.0)
+            if self._skew_base is not None:
+                self.agent.clock._now_ns = self._skew_base
+                self._skew_base = None
+                self._skew_offset = None

@@ -146,6 +146,11 @@ class LoadGenerator:
     #: full retry rounds before the write records an error (unacked →
     #: retriable, never lost)
     FAILOVER_ROUNDS = 2
+    #: wall budget per address-attempt (ISSUE 15 satellite): server
+    #: Retry-After hints are clamped against what's left of it, so a
+    #: bogus `Retry-After: 3600` from a confused node costs at most
+    #: this much before the writer fails over to the next address
+    WRITE_GIVE_UP_S = 20.0
 
     def __init__(
         self,
@@ -222,6 +227,7 @@ class LoadGenerator:
                     await client.execute_with_retry(
                         stmts, max_retries=self.WRITE_MAX_RETRIES,
                         rng=rng, counters=counters,
+                        give_up_s=self.WRITE_GIVE_UP_S,
                     )
                     return True
                 except Overloaded as e:

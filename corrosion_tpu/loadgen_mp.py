@@ -48,14 +48,55 @@ from .telemetry import latency_block
 #: after every worker has returned (the anti-entropy heal window)
 DEFAULT_GLOBAL_SETTLE_S = 45.0
 
+#: worker liveness (ISSUE 15 satellite): each worker touches its
+#: heartbeat file on this cadence from a background task, so a
+#: hard-wedged event loop (sync block, deadlock) goes silent and the
+#: parent can reap it instead of blocking the gather forever
+WORKER_HEARTBEAT_S = 1.0
+#: parent reaps a worker whose heartbeat is older than this (generous:
+#: must cover interpreter start + imports before the first touch)
+WORKER_HEARTBEAT_STALE_S = 30.0
+#: absolute per-worker wall deadline — catches the other hang mode, a
+#: loop that still ticks (heartbeats fresh) but never finishes
+DEFAULT_WORKER_DEADLINE_S = 600.0
+
+#: worker argv, module-level so tests can substitute a hanging stub
+_WORKER_ARGV = (sys.executable, "-m", "corrosion_tpu.loadgen_mp")
+
 
 # -- worker side -------------------------------------------------------------
+
+
+async def _heartbeat_loop(path: str) -> None:
+    """Touch ``path`` every WORKER_HEARTBEAT_S.  Runs as a plain task on
+    the worker's loop: if the loop wedges, the file goes stale — that IS
+    the signal, not a failure of this loop."""
+    while True:
+        try:
+            with open(path, "w") as f:
+                f.write(f"{time.monotonic():.3f}\n")
+        except OSError:
+            pass  # parent's deadline still covers us
+        await asyncio.sleep(WORKER_HEARTBEAT_S)
 
 
 async def _run_worker(task: dict) -> dict:
     """One worker process's slice: a LoadGenerator over the given
     addresses, plus the raw per-row stamps the parent needs to join
     latencies across processes."""
+    hb_task = None
+    hb_path = task.get("heartbeat_path")
+    if hb_path:
+        hb_task = asyncio.ensure_future(_heartbeat_loop(str(hb_path)))
+    try:
+        return await _run_worker_inner(task)
+    finally:
+        if hb_task is not None:
+            hb_task.cancel()
+            await asyncio.gather(hb_task, return_exceptions=True)
+
+
+async def _run_worker_inner(task: dict) -> dict:
     gen = LoadGenerator(
         task["write_addrs"],
         task.get("read_addrs") or None,
@@ -112,16 +153,61 @@ def _split(total: int, shares: int) -> List[int]:
     return [base + (1 if i < rem else 0) for i in range(shares)]
 
 
-async def _spawn_worker(task: dict) -> dict:
+def _reaped_report(task: dict, why: str) -> dict:
+    """Synthetic report for a reaped (hung) worker.  Carries a
+    stream_errors entry so `merge_reports` classifies the run
+    checker_broken (inconclusive) — and NO acked ids, so it can never
+    manufacture a false lost-writes conviction."""
+    return {
+        "writers": int(task.get("n_writers", 0)),
+        "watchers": int(task.get("n_watchers", 0)),
+        "stream_errors": [f"reaped hung worker: {why}"],
+        "reaped": True,
+    }
+
+
+async def _spawn_worker(
+    task: dict, deadline_s: Optional[float] = None
+) -> dict:
     proc = await asyncio.create_subprocess_exec(
-        sys.executable, "-m", "corrosion_tpu.loadgen_mp",
+        *_WORKER_ARGV,
         stdin=asyncio.subprocess.PIPE,
         stdout=asyncio.subprocess.PIPE,
         stderr=asyncio.subprocess.PIPE,
     )
-    stdout, stderr = await proc.communicate(
-        json.dumps(task).encode()
+    comm = asyncio.ensure_future(
+        proc.communicate(json.dumps(task).encode())
     )
+    # poll the communicate future in slices instead of awaiting it bare:
+    # a worker whose loop wedged never writes its report line, and a
+    # bare await would block the parent's gather forever (ISSUE 15
+    # satellite).  Two tripwires — stale heartbeat (wedged loop) and
+    # absolute deadline (live loop that never finishes).
+    hb_path = task.get("heartbeat_path")
+    t0 = time.monotonic()
+    reaped = ""
+    while True:
+        done, _ = await asyncio.wait({comm}, timeout=1.0)
+        if done:
+            break
+        now = time.monotonic()
+        if deadline_s is not None and now - t0 > deadline_s:
+            reaped = f"deadline {deadline_s:.0f}s exceeded"
+        elif hb_path:
+            try:
+                age = time.time() - os.stat(hb_path).st_mtime
+            except OSError:
+                age = now - t0  # never wrote one: count from spawn
+            if age > WORKER_HEARTBEAT_STALE_S:
+                reaped = f"heartbeat stale {age:.0f}s"
+        if reaped:
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            await asyncio.gather(comm, return_exceptions=True)
+            return _reaped_report(task, reaped)
+    stdout, stderr = comm.result()
     if proc.returncode != 0 or not stdout.strip():
         tail = stderr.decode(errors="replace")[-2000:]
         raise RuntimeError(
@@ -257,6 +343,9 @@ def merge_reports(
     out = {
         **sums,
         "workers": len(worker_reports),
+        "reaped_workers": sum(
+            1 for rep in worker_reports if rep.get("reaped")
+        ),
         "writers": sum(int(rep.get("writers", 0)) for rep in worker_reports),
         "watchers": sum(
             int(rep.get("watchers", 0)) for rep in worker_reports
@@ -299,6 +388,7 @@ async def run_devcluster_load(
     rate_hz: float = 0.0,
     settle_timeout_s: float = 30.0,
     global_settle_s: float = DEFAULT_GLOBAL_SETTLE_S,
+    worker_deadline_s: float = DEFAULT_WORKER_DEADLINE_S,
     seed: int = 0,
     plan=None,
     state_dir: Optional[str] = None,
@@ -349,9 +439,12 @@ async def run_devcluster_load(
     ) or names[0]
     topo = Topology.parse(text)
 
+    # plan= rides into the cluster so write_configs ships the [faults]
+    # section: link faults + slow replay INSIDE the agent processes,
+    # only crash stays with the parent driver (kill -9 + respawn)
     cluster = DevCluster(
         topo, os.path.join(state_dir, "state"), schema_dir,
-        flight_recorder=flight_recorder, perf=perf,
+        flight_recorder=flight_recorder, perf=perf, plan=plan,
     )
     cluster.write_configs()
     t_start = time.monotonic()
@@ -383,6 +476,8 @@ async def run_devcluster_load(
         writer_shares = _split(max(1, n_writers), n_workers)
         watcher_shares = _split(max(1, n_watchers), n_workers)
         write_shares = _split(n_writes, n_workers)
+        hb_dir = os.path.join(state_dir, "hb")
+        os.makedirs(hb_dir, exist_ok=True)
         tasks = []
         next_base = base_id
         for w in range(n_workers):
@@ -391,6 +486,9 @@ async def run_devcluster_load(
             tasks.append(
                 {
                     "worker_index": w,
+                    "heartbeat_path": os.path.join(
+                        hb_dir, f"worker{w:02d}.hb"
+                    ),
                     "write_addrs": addrs,
                     "read_addrs": read_addrs,
                     "table": table,
@@ -428,7 +526,10 @@ async def run_devcluster_load(
             # stdout pipe nobody reads blocks forever in its report
             # write and leaks the process.  Wait for ALL, then raise.
             gathered = await asyncio.gather(
-                *(_spawn_worker(t) for t in tasks),
+                *(
+                    _spawn_worker(t, deadline_s=worker_deadline_s)
+                    for t in tasks
+                ),
                 return_exceptions=True,
             )
             errors = [g for g in gathered if isinstance(g, BaseException)]

@@ -340,6 +340,16 @@ def compile_plan(
         raise ValueError(
             f"plan is for {plan.n_nodes} nodes, SimConfig has {cfg.n_nodes}"
         )
+    if any(ev.kind == "slow" for ev in plan.events):
+        # the `slow` gray failure is a WALL-CLOCK stall on a live node's
+        # gated operations — the sim has no wall clock, only
+        # round-denominated link delays, and a node-level stall is not a
+        # link property; refusing loudly beats silently dropping the
+        # event (doc/faults.md, "three-seam kind matrix")
+        raise ValueError(
+            "the sim tier cannot express `slow` (wall-clock node stall); "
+            "replay it on the host or devcluster seam"
+        )
     if factored is None:
         factored = cfg.n_nodes >= FACTORED_MIN_NODES
     if factored:
@@ -532,6 +542,13 @@ def compile_plan_factored(
     if plan.n_nodes != cfg.n_nodes:
         raise ValueError(
             f"plan is for {plan.n_nodes} nodes, SimConfig has {cfg.n_nodes}"
+        )
+    if any(ev.kind == "slow" for ev in plan.events):
+        # same refusal as compile_plan (direct callers bypass it): a
+        # wall-clock node stall has no tensor lowering
+        raise ValueError(
+            "the sim tier cannot express `slow` (wall-clock node stall); "
+            "replay it on the host or devcluster seam"
         )
     n, rounds = plan.n_nodes, plan.horizon
     alive = np.full((rounds + 1, n), -1, np.int8)

@@ -6,6 +6,7 @@ used by the sync cadence, announcer, and client reconnect loops."""
 from __future__ import annotations
 
 import random
+import time
 from typing import Optional
 
 
@@ -18,10 +19,16 @@ class Backoff:
     raises StopIteration and :attr:`gave_up` turns True — the give-up
     signal reconnect loops need to surface a terminal error instead of
     iterating forever (a ``for`` over the backoff simply ends).
-    ``reset()`` — called when a connection/sync succeeds — restores both
-    the interval and the retry budget, so the cap bounds CONSECUTIVE
-    failures, not lifetime ones.  Draws come from the injected ``rng``
-    only, so a seeded ``random.Random`` replays the exact schedule."""
+    ``give_up_s`` adds a WALL budget on top: once it elapses,
+    :attr:`gave_up` turns True regardless of attempts, and
+    :meth:`clamp` caps any externally-suggested sleep (a server's
+    Retry-After) to the remaining budget — a bogus ``Retry-After: 3600``
+    must not park a caller past its own deadline (ISSUE 15 satellite).
+    ``reset()`` — called when a connection/sync succeeds — restores the
+    interval, the retry budget, and the wall budget, so the caps bound
+    CONSECUTIVE failures, not lifetime ones.  Draws come from the
+    injected ``rng`` only, so a seeded ``random.Random`` replays the
+    exact schedule."""
 
     def __init__(
         self,
@@ -30,6 +37,7 @@ class Backoff:
         factor: float = 3.0,
         rng: Optional[random.Random] = None,
         max_retries: Optional[int] = None,
+        give_up_s: Optional[float] = None,
     ):
         self.min_s = min_s
         self.max_s = max_s
@@ -38,15 +46,38 @@ class Backoff:
         self._prev = min_s
         self.max_retries = max_retries
         self.attempts = 0
+        self.give_up_s = give_up_s
+        self._deadline = (
+            time.monotonic() + give_up_s if give_up_s is not None else None
+        )
+
+    def remaining_s(self) -> Optional[float]:
+        """Wall budget left (never negative); None when unbudgeted."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def clamp(self, sleep_s: float) -> float:
+        """Cap a proposed sleep to the remaining wall budget — the
+        Retry-After guard: honor the server's hint only as far as this
+        caller's own deadline allows.  Identity when unbudgeted."""
+        rem = self.remaining_s()
+        return sleep_s if rem is None else min(sleep_s, rem)
 
     @property
     def gave_up(self) -> bool:
-        """True once the retry budget is spent (always False uncapped)."""
-        return self.max_retries is not None and self.attempts >= self.max_retries
+        """True once the retry budget or the wall budget is spent
+        (always False uncapped)."""
+        if self.max_retries is not None and self.attempts >= self.max_retries:
+            return True
+        rem = self.remaining_s()
+        return rem is not None and rem <= 0.0
 
     def reset(self):
         self._prev = self.min_s
         self.attempts = 0
+        if self.give_up_s is not None:
+            self._deadline = time.monotonic() + self.give_up_s
 
     def __iter__(self):
         return self

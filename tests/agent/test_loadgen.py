@@ -86,14 +86,18 @@ def test_loadgen_stream_death_reads_checker_broken():
         gen = LoadGenerator(servers[0].addr, servers[1].addr)
 
         async def kill_reader():
-            await asyncio.sleep(0.4)
+            # 0.3 s lands strictly inside the flood: streams attach for
+            # the first 0.2 s, and 40 paced writes at 100 Hz cannot
+            # finish before ~0.4 s (the pacing jitter floor is 0.5x),
+            # so the stream dies while writes are still outstanding
+            await asyncio.sleep(0.3)
             await servers[1].stop()
 
         killer = asyncio.create_task(kill_reader())
         # settle long enough for the stream's capped reconnect chain to
         # exhaust against the dead node and surface the root cause
         report = await gen.run(
-            n_writes=15, rate_hz=100.0, settle_timeout_s=15.0
+            n_writes=40, rate_hz=100.0, settle_timeout_s=15.0
         )
         await killer
         assert report.stream_errors, report.to_dict()

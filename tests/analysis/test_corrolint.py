@@ -677,3 +677,116 @@ def test_ct008_pragma_documents_external_bound(repo):
         """,
     )
     assert lint(repo, UnboundedQueueInHostTier).clean
+
+
+# -- CT009 unbounded-network-await --------------------------------------------
+
+
+def test_ct009_flags_bare_network_awaits(repo):
+    from corrosion_tpu.analysis.rules import UnboundedNetworkAwait
+
+    write(
+        repo,
+        "corrosion_tpu/agent/neto.py",
+        """
+        import asyncio
+
+        async def pump(reader, loop, sock):
+            hdr = await reader.readexactly(4)
+            line = await reader.readline()
+            raw = await loop.sock_recv(sock, 4096)
+            r, w = await asyncio.open_connection("h", 1)
+            return hdr, line, raw, r, w
+        """,
+    )
+    res = lint(repo, UnboundedNetworkAwait)
+    assert [f.rule for f in res.findings] == ["CT009"] * 4
+    hits = sorted(f.message.split()[3] for f in res.findings)
+    assert hits == [
+        ".readexactly(...)", ".readline(...)", ".sock_recv(...)",
+        "asyncio.open_connection",
+    ]
+
+
+def test_ct009_wait_for_and_timeout_ctx_clean(repo):
+    from corrosion_tpu.analysis.rules import UnboundedNetworkAwait
+
+    write(
+        repo,
+        "corrosion_tpu/agent/neto.py",
+        """
+        import asyncio
+
+        async def bounded(reader):
+            # wrapped op: the await's direct operand is wait_for
+            hdr = await asyncio.wait_for(reader.readexactly(4), 2.0)
+            async with asyncio.timeout(5.0):
+                body = await reader.readexactly(16)
+            return hdr, body
+        """,
+    )
+    assert lint(repo, UnboundedNetworkAwait).clean
+
+
+def test_ct009_nested_def_not_covered_by_outer_timeout(repo):
+    """A timeout ctx bounds call SITES in its body, not the body of a
+    nested def that may run elsewhere later."""
+    from corrosion_tpu.analysis.rules import UnboundedNetworkAwait
+
+    write(
+        repo,
+        "corrosion_tpu/agent/neto.py",
+        """
+        import asyncio
+
+        async def outer(reader):
+            async with asyncio.timeout(5.0):
+                async def escapee():
+                    return await reader.readexactly(4)
+                return escapee
+        """,
+    )
+    res = lint(repo, UnboundedNetworkAwait)
+    assert len(res.findings) == 1
+    assert "escapee" in res.findings[0].message
+
+
+def test_ct009_sync_defs_wrappers_and_other_tiers_clean(repo):
+    from corrosion_tpu.analysis.rules import UnboundedNetworkAwait
+
+    # repo wrappers with internal timeouts (bi.recv) are not listed,
+    # and sync defs / non-agent tiers are out of scope
+    write(
+        repo,
+        "corrosion_tpu/agent/neto.py",
+        """
+        async def wrapped(bi):
+            return await bi.recv(30.0)
+        """,
+    )
+    write(
+        repo,
+        "corrosion_tpu/api/neto.py",
+        """
+        async def pump(reader):
+            return await reader.readexactly(4)
+        """,
+    )
+    assert lint(repo, UnboundedNetworkAwait).clean
+
+
+def test_ct009_pragma_suppresses(repo):
+    from corrosion_tpu.analysis.rules import UnboundedNetworkAwait
+
+    write(
+        repo,
+        "corrosion_tpu/agent/neto.py",
+        """
+        async def serve(reader):
+            # server read: idle peers are normal, SWIM owns liveness
+            # corrolint: disable=CT009
+            return await reader.readexactly(1)
+        """,
+    )
+    res = lint(repo, UnboundedNetworkAwait)
+    assert res.clean and res.suppressed == 1

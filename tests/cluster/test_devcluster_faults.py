@@ -1,0 +1,486 @@
+"""The devcluster as the third FULL fault seam (ISSUE 15 tentpole).
+
+Link faults, the `slow` gray failure, and clock skew replay INSIDE each
+agent process via `faults.AgentFaultRuntime`, armed from the ``[faults]``
+config section the devcluster parent writes and driven by the round
+control file `DevClusterFaultDriver` publishes.  These tests pin the
+contract in layers:
+
+- **byte identity**: the per-link LinkModel schedule an agent runtime
+  installs (parameters AND ``derive_seed(seed, "link", src, dst,
+  epoch)`` seeds) is byte-identical to what `RealSocketFaultDriver`
+  installs for the same plan, at every round, with crash and
+  clock_skew events in the plan unable to disturb the epoch indices;
+- **respawn resume**: a fresh runtime fast-forwarded to round R in one
+  `apply_round` call equals a runtime that walked every round — the
+  path a kill -9'd node takes when it rejoins mid-plan;
+- **control protocol**: a runtime following a real control file applies
+  published rounds and clears everything at ``done``;
+- **config plumbing**: the plan round-trips exactly through
+  ``plan_to_dict`` → ``[faults]`` TOML → ``Config.load`` →
+  ``plan_from_dict`` on every node, with the right node_index and the
+  gossip addrs in ``topo.nodes`` order;
+- **loud refusals**: `slow` without node=/delay_rounds=, `slow` on a
+  `RealSocketFaultDriver` without agents=, `slow` on the sim compilers,
+  and in-agent kinds on a `DevClusterFaultDriver` whose cluster was not
+  built with ``plan=``;
+- **the real thing**: a symmetric partition installed mid-flood across
+  four REAL agent processes isolates the sides (writes on one side are
+  invisible on the other while the cut holds — the devcluster twin of
+  tests/cluster/test_realsocket_partition.py), then heals at the
+  horizon and anti-entropy converges every process to the full row
+  set, which exercises the PR 8 bi-stream re-check across the process
+  boundary.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from corrosion_tpu.devcluster import (
+    DEVCLUSTER_KINDS,
+    DevCluster,
+    DevClusterFaultDriver,
+    Topology,
+)
+from corrosion_tpu.faults import (
+    AGENT_RUNTIME_KINDS,
+    AgentFaultRuntime,
+    FaultEvent,
+    FaultPlan,
+    RealSocketFaultDriver,
+    derive_seed,
+    plan_from_dict,
+    plan_to_dict,
+)
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER PRIMARY KEY NOT NULL, "
+    "text TEXT NOT NULL DEFAULT '');"
+)
+
+
+class StubTransport:
+    """The one method both fault installers need."""
+
+    def __init__(self):
+        self.faults = None
+
+    def install_faults(self, fi):
+        self.faults = fi
+
+
+class _StubClock:
+    def __init__(self):
+        self._now_ns = lambda: 0
+
+
+class StubAgent:
+    """slow/clock_skew surface of a real Agent."""
+
+    def __init__(self):
+        self.slow_inject_s = 0.0
+        self.clock = _StubClock()
+
+    def set_slow_inject(self, stall_s):
+        self.slow_inject_s = stall_s
+
+
+def seam_plan(seed: int = 13) -> FaultPlan:
+    """Every kind the process seam supports, including the shapes that
+    stress epoch indexing: overlapping delay+jitter on one link (two
+    epochs as each ends), an asymmetric pair partition AND a WAN-tier
+    range rectangle, plus crash and clock_skew events that must flow
+    through the walk WITHOUT perturbing any link epoch index."""
+    return FaultPlan(
+        n_nodes=4, seed=seed, round_s=0.05,
+        events=(
+            FaultEvent("loss", 0, 20, p=0.35),
+            FaultEvent("delay", 2, 14, src=0, dst=1, delay_rounds=1),
+            FaultEvent("jitter", 2, 10, src=0, dst=1, delay_rounds=2),
+            FaultEvent("duplicate", 4, 16, src=1, dst=2, p=0.25),
+            FaultEvent("partition", 6, 12, src=3, dst=0),
+            FaultEvent(
+                "partition", 8, 12, src="0:2", dst="2:4", symmetric=True
+            ),
+            FaultEvent("slow", 10, 18, node=2, delay_rounds=3),
+            FaultEvent("clock_skew", 0, 20, node=1, skew_ns=50_000_000),
+            FaultEvent("crash", 14, 18, node=3),
+        ),
+    )
+
+
+def _addrs(n):
+    return [f"10.0.0.{i}:9000" for i in range(n)]
+
+
+def injector_state(fi):
+    """Everything observable about an injector's installed schedule:
+    per-destination LinkModel parameters INCLUDING the derived seed
+    (the byte-identity anchor), plus the egress blocked set."""
+    return (
+        {
+            addr: (lm.latency_s, lm.loss, lm.jitter_s, lm.duplicate, lm.seed)
+            for addr, lm in fi.links.items()
+        },
+        frozenset(fi.blocked_peers),
+    )
+
+
+def test_agent_runtime_schedule_byte_identical_to_realsocket_driver():
+    """THE tentpole pin: per round, every node's in-process runtime
+    holds exactly the link state (params + derive_seed streams + egress
+    blocks + slow gate) the all-nodes RealSocketFaultDriver holds for
+    that node — so the devcluster's distributed replay cannot drift
+    from the host driver the parity suite trusts."""
+    plan = seam_plan()
+    addrs = _addrs(plan.n_nodes)
+
+    drv_transports = [StubTransport() for _ in range(plan.n_nodes)]
+    drv_agents = [StubAgent() for _ in range(plan.n_nodes)]
+    driver = RealSocketFaultDriver(
+        plan, drv_transports, addrs, agents=drv_agents
+    )
+
+    rt_agents = [StubAgent() for _ in range(plan.n_nodes)]
+    runtimes = [
+        AgentFaultRuntime(
+            plan, i, addrs, StubTransport(), agent=rt_agents[i]
+        )
+        for i in range(plan.n_nodes)
+    ]
+
+    saw_links = saw_blocks = saw_slow = False
+    for r in range(plan.horizon + 2):
+        driver.apply_round(r)
+        for rt in runtimes:
+            rt.apply_round(r)
+        for i in range(plan.n_nodes):
+            drv = injector_state(driver.injectors[i])
+            agt = injector_state(runtimes[i].injector)
+            assert drv == agt, f"node {i} diverged at round {r}"
+            assert drv_agents[i].slow_inject_s == rt_agents[i].slow_inject_s
+            saw_links = saw_links or bool(drv[0])
+            saw_blocks = saw_blocks or bool(drv[1])
+            saw_slow = saw_slow or drv_agents[i].slow_inject_s > 0
+    # the comparison was not vacuous: every fault family materialized
+    assert saw_links and saw_blocks and saw_slow
+
+    # the seeds really are the documented derivation, with epoch > 0
+    # reached (a link whose params changed re-seeded its stream)
+    installs = [
+        (detail[0], detail[1])
+        for _, action, detail in driver.log
+        if action == "link"
+    ]
+    assert any(idx > 0 for _, idx in installs)
+    pair, idx = next((p, i) for p, i in installs if i > 0)
+    lm = driver.injectors[pair[0]].links.get(addrs[pair[1]])
+    if lm is not None:  # last install on this edge may have been CLEAR
+        assert lm.seed == derive_seed(
+            plan.seed, "link", pair[0], pair[1],
+            max(i for p, i in installs if p == pair),
+        )
+
+
+def test_respawn_mid_plan_resumes_exact_state():
+    """A node respawned mid-plan arms a FRESH runtime and applies the
+    currently-published round once; because the epoch walk is
+    cumulative, that single call must reproduce the exact state (and
+    epoch indices — checked via the seeds) of a runtime that lived
+    through every round, and the two must stay identical as the rest
+    of the plan unfolds."""
+    plan = seam_plan()
+    addrs = _addrs(plan.n_nodes)
+    me = 0  # node 0 sends on the busiest link (delay+jitter epochs)
+
+    lived = AgentFaultRuntime(plan, me, addrs, StubTransport(),
+                              agent=StubAgent())
+    mid = plan.horizon // 2
+    for r in range(mid + 1):
+        lived.apply_round(r)
+
+    respawned = AgentFaultRuntime(plan, me, addrs, StubTransport(),
+                                  agent=StubAgent())
+    respawned.apply_round(mid)  # the fast-forward a rejoiner performs
+
+    assert injector_state(lived.injector) == injector_state(
+        respawned.injector
+    )
+    assert lived._epoch_idx == respawned._epoch_idx
+
+    for r in range(mid + 1, plan.horizon + 2):
+        lived.apply_round(r)
+        respawned.apply_round(r)
+        assert injector_state(lived.injector) == injector_state(
+            respawned.injector
+        ), f"diverged at round {r}"
+
+
+def test_runtime_follows_control_file_and_clears_on_done(tmp_path):
+    """The epoch-advance control protocol end-to-end: a runtime's run()
+    loop applies rounds as the parent publishes them (atomic replace,
+    the devcluster driver's write shape) and clears everything —
+    injector uninstalled, slow gate and skew restored — at done."""
+    plan = FaultPlan(
+        n_nodes=2, seed=3, round_s=0.02,
+        events=(
+            FaultEvent("partition", 0, 4, src=0, dst=1),
+            FaultEvent("slow", 0, 4, node=0, delay_rounds=2),
+        ),
+    )
+    ctl = str(tmp_path / "faults.round")
+    transport, agent = StubTransport(), StubAgent()
+    rt = AgentFaultRuntime(
+        plan, 0, _addrs(2), transport, agent=agent, control_path=ctl
+    )
+
+    def publish(r, done=False):
+        tmp = ctl + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"round": r, "done": done}))
+        os.replace(tmp, ctl)
+
+    async def body():
+        task = asyncio.ensure_future(rt.run())
+        publish(0)
+        for _ in range(100):
+            if rt.round >= 0:
+                break
+            await asyncio.sleep(0.01)
+        assert rt.round == 0
+        assert _addrs(2)[1] in rt.injector.blocked_peers
+        assert agent.slow_inject_s == pytest.approx(2 * plan.round_s)
+        publish(plan.horizon + 1, done=True)
+        await asyncio.wait_for(task, 5.0)
+
+    asyncio.run(body())
+    # done → all-clear: injector uninstalled, gates reset
+    assert transport.faults is None
+    assert agent.slow_inject_s == 0.0
+
+
+def test_plan_round_trips_through_faults_config(tmp_path):
+    """write_configs ships the plan into every node's [faults] section;
+    Config.load on each emitted TOML must hand back the IDENTICAL plan
+    (same derive_seed inputs), the node's own index, every gossip addr
+    in topo.nodes order, and the cluster's control path."""
+    from corrosion_tpu.agent.config import Config
+
+    plan = seam_plan(seed=29)
+    names = ["n0", "n1", "n2", "n3"]
+    text = "\n".join(
+        f"{a} -> {b}" for a in names for b in names if a != b
+    )
+    cluster = DevCluster(
+        Topology.parse(text), str(tmp_path / "state"),
+        str(tmp_path / "schema"), plan=plan,
+    )
+    cluster.write_configs()
+
+    expected_addrs = [
+        f"127.0.0.1:{cluster.nodes[n].gossip_port}"
+        for n in cluster.topo.nodes
+    ]
+    for i, name in enumerate(cluster.topo.nodes):
+        cfg = Config.load(
+            os.path.join(cluster.nodes[name].state_dir, "config.toml")
+        )
+        assert cfg.faults, f"{name} got no [faults] section"
+        assert cfg.faults["node_index"] == i
+        assert cfg.faults["gossip_addrs"] == expected_addrs
+        assert cfg.faults["control_path"] == cluster.control_path
+        assert plan_from_dict(json.loads(cfg.faults["plan"])) == plan
+
+    # and the encoding itself is exact, not just equal-enough
+    assert plan_from_dict(plan_to_dict(plan)) == plan
+
+
+def test_kind_sets_cover_the_full_matrix():
+    """DEVCLUSTER_KINDS is the FULL kind set: everything the agents
+    replay in-process plus the parent-owned crash — the ISSUE 15 'third
+    full fault seam' claim, stated as set algebra."""
+    from corrosion_tpu.faults import KINDS
+
+    assert DEVCLUSTER_KINDS == set(KINDS)
+    assert DEVCLUSTER_KINDS == AGENT_RUNTIME_KINDS | {"crash"}
+
+
+def test_loud_refusals_across_the_seams(tmp_path):
+    """Every place a fault kind is unsupported must refuse at build
+    time, never silently not-inject."""
+    # slow needs a node and a stall magnitude
+    with pytest.raises(ValueError, match="needs node="):
+        FaultEvent("slow", 0, 4)
+    with pytest.raises(ValueError, match="delay_rounds"):
+        FaultEvent("slow", 0, 4, node=1)
+
+    slow_plan = FaultPlan(
+        n_nodes=2, seed=1,
+        events=(FaultEvent("slow", 0, 4, node=0, delay_rounds=1),),
+    )
+
+    # the socket driver cannot stall an agent it was never handed
+    with pytest.raises(ValueError, match="no agents="):
+        RealSocketFaultDriver(
+            slow_plan, [StubTransport(), StubTransport()], _addrs(2)
+        )
+
+    # the devcluster driver refuses in-agent kinds the agents were not
+    # configured to replay (cluster built without plan=)
+    topo = Topology.parse("a -> b\nb -> a")
+    bare = DevCluster(topo, str(tmp_path / "s"), str(tmp_path / "sch"))
+    with pytest.raises(ValueError, match=r"plan=<this plan>"):
+        DevClusterFaultDriver(slow_plan, bare)
+    # crash-only plans predate [faults] and still work without it
+    crash_only = FaultPlan(
+        n_nodes=2, seed=1, events=(FaultEvent("crash", 0, 4, node=1),)
+    )
+    DevClusterFaultDriver(crash_only, bare)
+    # and a cluster built WITH the plan accepts the full matrix
+    armed = DevCluster(
+        topo, str(tmp_path / "s2"), str(tmp_path / "sch"), plan=slow_plan
+    )
+    DevClusterFaultDriver(slow_plan, armed)
+
+
+def test_sim_compilers_refuse_slow():
+    """`slow` is a wall-clock stall — no sim twin (doc/faults.md); both
+    sim compilers must refuse it loudly."""
+    from corrosion_tpu.sim.faults import compile_plan, compile_plan_factored
+    from corrosion_tpu.sim.state import SimConfig
+
+    plan = FaultPlan(
+        n_nodes=3, seed=1,
+        events=(FaultEvent("slow", 0, 4, node=0, delay_rounds=1),),
+    )
+    cfg = SimConfig(n_nodes=3, n_payloads=4)
+    with pytest.raises(ValueError, match="cannot express `slow`"):
+        compile_plan(plan, cfg)
+    with pytest.raises(ValueError, match="cannot express `slow`"):
+        compile_plan_factored(plan, cfg)
+
+
+# ---------------------------------------------------------------------------
+# the real thing: partition-heal across four REAL agent processes — the
+# devcluster twin of tests/cluster/test_realsocket_partition.py
+
+
+def _boot_cluster(tmp_path, n, plan):
+    names = [f"n{i}" for i in range(n)]
+    text = "\n".join(f"{a} -> {b}" for a in names for b in names if a != b)
+    schema_dir = tmp_path / "schema"
+    schema_dir.mkdir()
+    (schema_dir / "schema.sql").write_text(SCHEMA)
+    cluster = DevCluster(
+        Topology.parse(text), str(tmp_path / "state"), str(schema_dir),
+        plan=plan,
+    )
+    cluster.write_configs()
+    cluster.start(stagger_s=0.1)
+    cluster.wait_ready(timeout=30.0)
+    return cluster
+
+
+async def _counts(client, ids):
+    rows = await client.query(
+        [
+            "SELECT count(*) FROM tests WHERE id BETWEEN ? AND ?",
+            [min(ids), max(ids)],
+        ]
+    )
+    return rows[0][0]
+
+
+@pytest.mark.chaos
+def test_partition_heal_on_devcluster(tmp_path):
+    """The devcluster twin of test_partition_heal_on_real_sockets,
+    across REAL processes: a symmetric {0,1}|{2,3} partition — shipped
+    via [faults] and installed by each agent's own runtime when the
+    parent publishes the round — isolates the sides mid-flood (side A
+    writes invisible on side B while the cut holds), then heals at the
+    horizon, and anti-entropy (the PR 8 bi-stream re-check, now running
+    between distinct OS processes) converges every node to the full row
+    set."""
+    from corrosion_tpu.api.client import ApiClient
+
+    # window [round 4, round 56) at 50 ms rounds: opens ~0.2 s after
+    # the driver starts (time to flood both sides) and holds ~2.6 s
+    plan = FaultPlan(
+        n_nodes=4, seed=17, round_s=0.05,
+        events=(
+            FaultEvent(
+                "partition", 4, 56, src="0:2", dst="2:4", symmetric=True
+            ),
+        ),
+    )
+    cluster = _boot_cluster(tmp_path, 4, plan)
+    try:
+        clients = {}
+
+        async def body():
+            for i, name in enumerate(cluster.topo.nodes):
+                clients[i] = ApiClient(cluster.nodes[name].api_addr)
+
+            # warmup BEFORE any fault: id=0 must reach every process
+            await clients[0].execute_with_retry(
+                [["INSERT INTO tests (id, text) VALUES (?, ?)", [0, "warm"]]]
+            )
+            for i in range(4):
+                for _ in range(200):
+                    if await _counts(clients[i], [0, 0]) == 1:
+                        break
+                    await asyncio.sleep(0.05)
+                else:
+                    raise AssertionError(f"warmup never reached node {i}")
+
+            driver = cluster.fault_driver(plan)
+            drive = asyncio.ensure_future(driver.run())
+            # let the cut install: past round 4, plus one poll cadence
+            await asyncio.sleep(4 * plan.round_s + 0.2)
+
+            # flood both sides while the partition holds
+            for i in range(1, 11):
+                await clients[0].execute_with_retry(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                      [i, f"a{i}"]]]
+                )
+            for i in range(101, 111):
+                await clients[2].execute_with_retry(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                      [i, f"b{i}"]]]
+                )
+
+            # the partition is REAL across processes: nothing crossed
+            assert await _counts(clients[2], range(1, 11)) == 0
+            assert await _counts(clients[0], range(101, 111)) == 0
+            # ...but flowed freely within a side
+            for _ in range(100):
+                if await _counts(clients[1], range(1, 11)) == 10:
+                    break
+                await asyncio.sleep(0.05)
+            assert await _counts(clients[1], range(1, 11)) == 10
+
+            await drive  # horizon: heals, publishes done, agents clear
+
+            # full convergence on EVERY process: all 21 rows everywhere
+            for i in range(4):
+                for _ in range(600):
+                    rows = await clients[i].query(
+                        ["SELECT count(*) FROM tests", []]
+                    )
+                    if rows[0][0] == 21:
+                        break
+                    await asyncio.sleep(0.05)
+                ids = await clients[i].query(
+                    ["SELECT id FROM tests ORDER BY id", []]
+                )
+                assert [r[0] for r in ids] == (
+                    list(range(0, 11)) + list(range(101, 111))
+                ), f"node {i} never fully converged"
+
+        asyncio.run(body())
+    finally:
+        cluster.stop()

@@ -151,6 +151,94 @@ def test_fault_plan_parity_host_vs_sim():
     )
 
 
+def run_devcluster_campaign(plan: FaultPlan, tmp_path) -> dict:
+    """Process seam (ISSUE 15): the SAME plan against REAL agent
+    processes.  Crash stays with the parent driver (kill -9 + wiped
+    respawn); loss/partition/delay/jitter/duplicate/clock_skew replay
+    INSIDE each agent via the [faults] config section and the round
+    control file.  Node 0 takes the same N_VERSIONS writes over HTTP,
+    and the eventual per-node row counts are the ground truth the sim
+    tier must agree with."""
+    import os
+
+    from corrosion_tpu.api.client import ApiClient
+    from corrosion_tpu.devcluster import DevCluster
+    from corrosion_tpu.devcluster import Topology as DevTopology
+
+    names = [f"n{i}" for i in range(plan.n_nodes)]
+    text = "\n".join(f"{a} -> {b}" for a in names for b in names if a != b)
+    schema_dir = os.path.join(str(tmp_path), "schema")
+    os.makedirs(schema_dir, exist_ok=True)
+    with open(os.path.join(schema_dir, "schema.sql"), "w") as f:
+        f.write(
+            "CREATE TABLE tests (id INTEGER PRIMARY KEY NOT NULL, "
+            "text TEXT NOT NULL DEFAULT '');"
+        )
+    cluster = DevCluster(
+        DevTopology.parse(text), os.path.join(str(tmp_path), "state"),
+        schema_dir, plan=plan,
+    )
+    cluster.write_configs()
+    cluster.start(stagger_s=0.1)
+    cluster.wait_ready(timeout=30.0)
+    try:
+
+        async def body():
+            clients = [ApiClient(a) for a in cluster.api_addrs]
+            driver = cluster.fault_driver(plan)
+            drive = asyncio.ensure_future(driver.run())
+            for i in range(N_VERSIONS):
+                await clients[0].execute_with_retry(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                      [i, f"v{i}"]]]
+                )
+                await asyncio.sleep(plan.round_s)
+            await drive
+            assert not driver.down  # every crash was restarted
+            rows = []
+            for i in range(plan.n_nodes):
+                # the wiped crash victim recovers purely via
+                # anti-entropy — give the heal a generous window
+                got = -1
+                for _ in range(1200):
+                    try:
+                        got = (await clients[i].query(
+                            ["SELECT count(*) FROM tests", []]
+                        ))[0][0]
+                    except OSError:
+                        pass  # respawned node still binding its API
+                    if got == N_VERSIONS:
+                        break
+                    await asyncio.sleep(0.05)
+                rows.append(got)
+            return {"rows": rows, "log": list(driver.log)}
+
+        return asyncio.run(body())
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.chaos
+def test_fault_plan_parity_sim_vs_devcluster(tmp_path):
+    """ISSUE 15: the parity harness extended to the PROCESS seam — the
+    shared 3-node adversarial schedule runs against real agent
+    processes (crash via SIGKILL, everything else replayed in-process
+    by each agent's fault runtime) and against the sim, and both must
+    end at the same ground truth: every node holds all N_VERSIONS."""
+    plan = parity_plan()
+    with CampaignCoverage(plan.coverage_markers()) as cov:
+        dev = run_devcluster_campaign(plan, tmp_path)
+    sim = run_sim_campaign(plan)
+
+    assert dev["rows"] == [N_VERSIONS] * plan.n_nodes, dev
+    assert sim["heads"] == [N_VERSIONS] * plan.n_nodes, sim
+    # the campaign was real: the kill -9 and wiped respawn happened
+    kills = [d for _, a, d in dev["log"] if a == "kill"]
+    restarts = [d for _, a, d in dev["log"] if a == "restart"]
+    assert kills == ["n2"] and restarts == [("n2", True)]
+    cov.assert_covered()
+
+
 @pytest.mark.chaos
 def test_wan_tiered_topology_parity_host_vs_sim():
     """ISSUE 9 host-tier parity for a TOPOLOGY FAMILY: a 3-node

@@ -1,0 +1,117 @@
+"""Hung-worker reaping (ISSUE 15 satellite): the mp parent must never
+block its gather on a wedged worker process.  Two tripwires — a stale
+heartbeat file (worker loop hard-wedged) and an absolute wall deadline
+(loop alive but never finishing) — both reap the process and synthesize
+a report that `merge_reports` classifies checker_broken (inconclusive),
+NEVER lost_writes (the synthetic report carries no acked ids)."""
+
+import asyncio
+import sys
+import time
+
+from corrosion_tpu import loadgen_mp
+
+
+def _hang_argv():
+    # stands in for the real worker: reads stdin like worker_main, then
+    # wedges without ever writing a report line or a heartbeat
+    return (
+        sys.executable, "-c",
+        "import sys, time; sys.stdin.read(); time.sleep(300)",
+    )
+
+
+def test_stale_heartbeat_reaps_worker(monkeypatch, tmp_path):
+    monkeypatch.setattr(loadgen_mp, "_WORKER_ARGV", _hang_argv())
+    monkeypatch.setattr(loadgen_mp, "WORKER_HEARTBEAT_STALE_S", 1.5)
+    task = {
+        "worker_index": 0, "n_writers": 4, "n_watchers": 1,
+        "heartbeat_path": str(tmp_path / "w0.hb"),  # never written
+    }
+    t0 = time.monotonic()
+    rep = asyncio.run(loadgen_mp._spawn_worker(task, deadline_s=120.0))
+    assert time.monotonic() - t0 < 30.0  # reaped, not deadline-bound
+    assert rep["reaped"]
+    assert "heartbeat stale" in rep["stream_errors"][0]
+
+
+def test_deadline_reaps_worker_with_live_heartbeat(monkeypatch, tmp_path):
+    hb = tmp_path / "w0.hb"
+    # the other hang mode: loop alive (heartbeats fresh) but the report
+    # never comes — only the absolute deadline catches this one
+    monkeypatch.setattr(
+        loadgen_mp, "_WORKER_ARGV",
+        (
+            sys.executable, "-c",
+            "import sys, time\n"
+            "sys.stdin.read()\n"
+            "while True:\n"
+            "    open(sys.argv[1], 'w').write(str(time.monotonic()))\n"
+            "    time.sleep(0.2)\n",
+            str(hb),
+        ),
+    )
+    monkeypatch.setattr(loadgen_mp, "WORKER_HEARTBEAT_STALE_S", 600.0)
+    task = {
+        "worker_index": 0, "n_writers": 4, "n_watchers": 1,
+        "heartbeat_path": str(hb),
+    }
+    rep = asyncio.run(loadgen_mp._spawn_worker(task, deadline_s=3.0))
+    assert rep["reaped"]
+    assert "deadline" in rep["stream_errors"][0]
+    # the heartbeat really was alive when the deadline fired
+    assert hb.exists()
+
+
+def test_healthy_worker_report_passes_through(monkeypatch):
+    monkeypatch.setattr(
+        loadgen_mp, "_WORKER_ARGV",
+        (
+            sys.executable, "-c",
+            "import sys, json; json.load(sys.stdin); "
+            "print(json.dumps({'ok': 1}))",
+        ),
+    )
+    rep = asyncio.run(
+        loadgen_mp._spawn_worker({"worker_index": 0}, deadline_s=60.0)
+    )
+    assert rep == {"ok": 1}
+
+
+def test_reaped_report_classifies_checker_broken_never_lost():
+    """The classification contract end-to-end through merge_reports: a
+    reaped worker is inconclusive, and cannot convict lost writes."""
+    healthy = {
+        "writers": 4, "watchers": 1, "writes_attempted": 8,
+        "writes_ok": 8, "flood_s": 1.0,
+        "acked_at": {"10": 0.5}, "write_lat_raw": [0.01],
+        "watchers_detail": [
+            {"ok": True, "dead": False, "seen_at": {"10": 0.6},
+             "snap_seen": []},
+        ],
+    }
+    reaped = loadgen_mp._reaped_report(
+        {"worker_index": 1, "n_writers": 4, "n_watchers": 1}, "test reap"
+    )
+    merged = loadgen_mp.merge_reports([healthy, reaped], {})
+    assert merged["reaped_workers"] == 1
+    assert merged["checker_broken"]
+    assert not merged["lost_writes"]
+    assert not merged["consistent"]
+
+
+def test_worker_heartbeat_file_is_touched(tmp_path):
+    """Worker side: the heartbeat loop really touches its file."""
+    hb = tmp_path / "hb"
+
+    async def body():
+        t = asyncio.ensure_future(loadgen_mp._heartbeat_loop(str(hb)))
+        for _ in range(50):
+            if hb.exists():
+                break
+            await asyncio.sleep(0.05)
+        t.cancel()
+        await asyncio.gather(t, return_exceptions=True)
+
+    asyncio.run(body())
+    assert hb.exists() and hb.read_text().strip()

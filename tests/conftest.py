@@ -22,6 +22,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: tier-1 wall time on the 1-CPU CI box is
+# dominated by recompiling the same packed/sharded kernels every run (the
+# suite re-jits identical HLO for the virtual 8-device mesh each session).
+# Executables are keyed by HLO hash, so cache hits are bit-identical to
+# fresh compiles — determinism/byte-equality tests are unaffected.  The
+# threshold is 0 because these kernels are many small compiles rather than
+# a few big ones (the default 1 s floor would cache almost nothing).  The
+# directory is gitignored scratch; deleting it only costs one cold run.
+_cache_dir = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".cache", "xla"
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # invariant violations in the suite are bugs, not warnings: strict mode

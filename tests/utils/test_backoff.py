@@ -50,3 +50,52 @@ def test_growth_respects_min_max_envelope():
     b = Backoff(0.5, 1.0, rng=random.Random(3))
     seq = [next(b) for _ in range(50)]
     assert all(0.5 <= s <= 1.0 for s in seq)
+
+
+# -- wall budget + Retry-After clamp (ISSUE 15 satellite) ----------------
+
+
+def test_clamp_caps_server_retry_after_to_remaining_budget():
+    b = Backoff(0.01, 0.1, rng=random.Random(1), give_up_s=5.0)
+    # a bogus Retry-After: 3600 must not park the caller past its deadline
+    assert b.clamp(3600.0) <= 5.0
+    # small hints pass through untouched
+    assert b.clamp(0.25) == 0.25
+
+
+def test_clamp_is_identity_when_unbudgeted():
+    b = Backoff(0.01, 0.1, rng=random.Random(1))
+    assert b.remaining_s() is None
+    assert b.clamp(3600.0) == 3600.0
+
+
+def test_wall_budget_exhaustion_signals_give_up(monkeypatch):
+    import corrosion_tpu.utils.backoff as mod
+
+    now = [100.0]
+    monkeypatch.setattr(mod.time, "monotonic", lambda: now[0])
+    b = Backoff(0.01, 0.1, rng=random.Random(1), give_up_s=2.0)
+    assert not b.gave_up
+    assert b.remaining_s() == 2.0
+    now[0] += 1.5
+    assert b.remaining_s() == pytest.approx(0.5)
+    assert b.clamp(3600.0) == pytest.approx(0.5)
+    now[0] += 1.0
+    assert b.remaining_s() == 0.0  # never negative
+    assert b.clamp(3600.0) == 0.0
+    assert b.gave_up
+    with pytest.raises(StopIteration):
+        next(b)
+
+
+def test_reset_refreshes_wall_budget(monkeypatch):
+    import corrosion_tpu.utils.backoff as mod
+
+    now = [0.0]
+    monkeypatch.setattr(mod.time, "monotonic", lambda: now[0])
+    b = Backoff(0.01, 0.1, rng=random.Random(1), give_up_s=1.0)
+    now[0] += 2.0
+    assert b.gave_up
+    b.reset()  # a success restores the wall budget: it bounds CONSECUTIVE failures
+    assert not b.gave_up
+    assert b.remaining_s() == 1.0
