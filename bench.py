@@ -260,13 +260,29 @@ def preflight() -> tuple[str, str] | None:
     Retries the default (TPU) platform with growing timeouts — transient
     tunnel wedges were the round-1 killer — then falls back to CPU so the
     benchmark still lands a measured (if slower) point.
+
+    Each retry backs off exponentially, bounded by
+    BENCH_PREFLIGHT_BACKOFF_CAP_S (default 10 s) so a dead backend can
+    never silently eat the storm budget in sleeps; every attempt lands
+    in ``_diag["preflight_attempts"]`` — requested platform, timeout,
+    outcome, backoff — flushed to BENCH_DIAG.json as it happens so a
+    killed run still shows how far preflight got.
     """
     forced = os.environ.get("BENCH_PLATFORM")
     base_t = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "150"))
+    backoff_cap = float(
+        os.environ.get("BENCH_PREFLIGHT_BACKOFF_CAP_S", "10")
+    )
     candidates = [forced] if forced else [None, None, None, "cpu"]
+    trail: list[dict] = []
+    _diag["preflight_attempts"] = trail
     for i, plat in enumerate(candidates):
         timeout = min(base_t * (1 + i * 0.5), max(30.0, _remaining() * 0.4))
         if _remaining() < 30:
+            trail.append(
+                {"attempt": i + 1, "skipped": "budget exhausted"}
+            )
+            _write_diag()
             break
         res = run_child(
             {"mode": "preflight", "platform": plat}, timeout=timeout
@@ -274,9 +290,23 @@ def preflight() -> tuple[str, str] | None:
         res["requested_platform"] = plat or "default(axon/tpu)"
         _diag["preflight"] = res
         _diag["attempts"].append({"phase": "preflight", **res})
+        entry = {
+            "attempt": i + 1,
+            "of": len(candidates),
+            "requested_platform": res["requested_platform"],
+            "timeout_s": round(timeout, 1),
+            "ok": bool(res.get("ok")),
+            "wall_s": res.get("wall_s"),
+        }
+        if not res.get("ok"):
+            entry["error"] = res.get("error")
+        trail.append(entry)
+        _write_diag()
         if res.get("ok"):
             return plat or "", str(res.get("platform", plat or ""))
-        time.sleep(min(10, 2**i))
+        backoff = min(backoff_cap, float(2**i))
+        entry["backoff_s"] = backoff
+        time.sleep(backoff)
     return None
 
 

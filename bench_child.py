@@ -73,9 +73,14 @@ def main() -> int:
         # device work lands in the given dir; profiling never gates the
         # result — a capture failure is recorded and the run proceeds,
         # and stop_trace rides a finally so a crashing attempt (the one
-        # a profiler exists to explain) still flushes its capture
+        # a profiler exists to explain) still flushes its capture.
+        # Rungs whose config accepts ``profile_dir`` (ISSUE 16) own the
+        # capture themselves — a scoped trace + phase map + parsed
+        # phase_profile block — so the child must not nest a second
+        # jax.profiler trace around them.
         prof_dir = spec.get("xla_profile")
-        if prof_dir:
+        config_owns = bool(prof_dir) and _config_owns_profile(spec)
+        if prof_dir and not config_owns:
             try:
                 os.makedirs(prof_dir, exist_ok=True)
                 jax.profiler.start_trace(prof_dir)
@@ -85,15 +90,26 @@ def main() -> int:
                 prof_dir = None
 
         try:
-            _run_mode(spec, res, devs, t0)
+            _run_mode(
+                spec, res, devs, t0,
+                profile_dir=prof_dir if config_owns else None,
+            )
         finally:
-            if prof_dir:
+            if prof_dir and not config_owns:
                 try:
                     jax.profiler.stop_trace()
                 except Exception as exc:  # noqa: BLE001
                     res["xla_profile_error"] = (
                         f"{type(exc).__name__}: {exc}"
                     )
+                else:
+                    _attach_phase_profile(res, prof_dir)
+        if prof_dir and config_owns:
+            res["xla_profile"] = prof_dir
+            m = res.get("metrics")
+            if isinstance(m, dict) and m.get("phase_profile"):
+                # hoist so both capture paths expose the same key
+                res["phase_profile"] = m["phase_profile"]
     except BaseException as exc:  # noqa: BLE001 — report, never raise
         res["error"] = f"{type(exc).__name__}: {exc}"
     res["total_s"] = round(time.time() - t0, 1)
@@ -105,7 +121,48 @@ def main() -> int:
     return 0
 
 
-def _run_mode(spec, res, devs, t0) -> None:
+def _config_owns_profile(spec) -> bool:
+    """True when this attempt's scenario config accepts ``profile_dir``
+    and therefore runs its own scoped capture + phase attribution
+    (sim/profile.py).  The child must not wrap such an attempt in a
+    second whole-process jax.profiler trace (nested traces error out),
+    and the resulting metrics carry a parsed ``phase_profile`` block
+    instead of a raw, unattributed trace directory."""
+    import inspect
+
+    try:
+        from corrosion_tpu.sim import runner
+
+        name = (
+            "config_write_storm_verified"
+            if spec.get("mode") == "storm"
+            else spec.get("fn", "")
+        )
+        fn = getattr(runner, name, None)
+        if fn is None:
+            return False
+        return "profile_dir" in inspect.signature(fn).parameters
+    except Exception:  # noqa: BLE001 — capture ownership never gates
+        return False
+
+
+def _attach_phase_profile(res, prof_dir) -> None:
+    """Post-capture phase attribution for child-owned traces: when the
+    profile dir already holds a ``phase_map.json`` (staged by a caller
+    or written by an earlier rung into the same dir), fold the trace
+    into a phase_profile record.  Never gates the result — failures
+    land in ``xla_profile_error`` like every other profiling mishap."""
+    if not os.path.exists(os.path.join(prof_dir, "phase_map.json")):
+        return
+    try:
+        from corrosion_tpu.sim import profile as prof
+
+        res["phase_profile"] = prof.parse_phase_profile(prof_dir)
+    except Exception as exc:  # noqa: BLE001
+        res["xla_profile_error"] = f"{type(exc).__name__}: {exc}"
+
+
+def _run_mode(spec, res, devs, t0, profile_dir=None) -> None:
     import jax
 
     if spec["mode"] == "preflight":
@@ -133,7 +190,8 @@ def _run_mode(spec, res, devs, t0) -> None:
         # warmup happens inside (microbench warmup + an AOT prime of
         # the convergence loop), so no separate warmup call here.
         m = config_write_storm_verified(
-            seed=1, n_nodes=n, n_payloads=p, mesh=mesh
+            seed=1, n_nodes=n, n_payloads=p, mesh=mesh,
+            profile_dir=profile_dir,
         )
         # setup = everything before the measured run (compile + the
         # per-round microbench); subtract the RAW wall, not the
@@ -155,7 +213,10 @@ def _run_mode(spec, res, devs, t0) -> None:
         from corrosion_tpu.sim import runner
 
         fn = getattr(runner, spec["fn"])
-        m = fn(seed=int(spec.get("seed", 0)), **spec.get("kwargs", {}))
+        kwargs = dict(spec.get("kwargs", {}))
+        if profile_dir:
+            kwargs["profile_dir"] = profile_dir
+        m = fn(seed=int(spec.get("seed", 0)), **kwargs)
         res["metrics"] = m
         res["ok"] = True
 

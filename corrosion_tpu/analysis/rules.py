@@ -1,4 +1,4 @@
-"""The corrolint rule catalog, CT001–CT009.
+"""The corrolint rule catalog, CT001–CT010.
 
 Every rule is distilled from a bug this repo actually shipped and then
 fixed (doc/lint.md carries the full incident write-ups):
@@ -29,6 +29,10 @@ fixed (doc/lint.md carries the full incident write-ups):
   asyncio network primitive in ``agent/`` with no wait_for/timeout
   bound parks its task forever against a degraded-not-dead peer (the
   ``slow`` fault kind injects exactly that stall on purpose).
+- CT010 — ISSUE 16's attribution-decay class: a ``jax.named_scope``
+  string (or ``phase_scope`` key) in the sim tier that isn't in the
+  sim/profile.py ``PHASES`` registry silently dumps its device time
+  into the unattributed residual of the phase ledger.
 """
 
 from __future__ import annotations
@@ -663,6 +667,98 @@ class UnboundedNetworkAwait(Rule):
         yield from visit(fn, False)
 
 
+PROFILE_FILE = "corrosion_tpu/sim/profile.py"
+
+
+def phase_registry(ctx: LintContext) -> Optional[Tuple[str, Set[str]]]:
+    """(scope prefix, registered phase keys) read from the AST of
+    sim/profile.py — never by importing it.  The registry dict and the
+    prefix are pure literals by contract (profile.py documents that
+    CT010 depends on it); None when the file or either literal is
+    missing, in which case the rule stays silent rather than flagging
+    the whole sim tier on a parse hiccup."""
+    sf = ctx.get(PROFILE_FILE)
+    if sf is None or sf.tree is None:
+        return None
+    phases = _module_assign(sf, "PHASES")
+    prefix = _module_assign(sf, "_SCOPE_PREFIX")
+    try:
+        keys = set(ast.literal_eval(phases)) if phases is not None else None
+        pre = ast.literal_eval(prefix) if prefix is not None else None
+    except (ValueError, SyntaxError):
+        return None
+    if not keys or not isinstance(pre, str):
+        return None
+    return pre, keys
+
+
+class UnregisteredPhaseScope(Rule):
+    """CT010: every profiling annotation in the sim tier must use a
+    registered phase.  The phase-attribution ledger (ISSUE 16,
+    sim/profile.py) attributes device time to the scope strings the
+    kernels emit; a ``jax.named_scope("...")`` string outside the
+    ``PHASES`` registry — or a ``phase_scope("...")`` key that isn't
+    registered — silently lands its ops in the unattributed residual
+    until the PROFILE_BASELINE gate trips on a machine far from the
+    edit.  profile.py itself is exempt (it implements the registry and
+    composes the scope string dynamically)."""
+
+    code = "CT010"
+    name = "unregistered-phase-scope"
+    incident = (
+        "ISSUE 16: unregistered scope strings decay the cost ledger "
+        "into the unattributed residual, failing the profile baseline "
+        "one nightly later instead of at review time"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        reg = phase_registry(ctx)
+        if reg is None:
+            return
+        prefix, keys = reg
+        valid_scopes = {prefix + k for k in keys}
+        for sf in ctx.under(*SIM_TIER):
+            if sf.tree is None or sf.relpath == PROFILE_FILE:
+                continue
+            idx = ModuleIndex(sf)
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                arg = node.args[0]
+                if not (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                ):
+                    continue
+                dotted = idx.canonical(node.func) or ""
+                if dotted == "jax.named_scope":
+                    if arg.value not in valid_scopes:
+                        yield (
+                            sf.relpath,
+                            node.lineno,
+                            f"jax.named_scope({arg.value!r}) is not a "
+                            "registered phase scope — its device time "
+                            "lands in the unattributed residual; use "
+                            f"phase_scope(<key>) with a key from "
+                            "sim/profile.py PHASES (or register a new "
+                            "phase there)",
+                        )
+                elif dotted.endswith("profile.phase_scope") or dotted.endswith(
+                    "profile.scope_name"
+                ):
+                    if arg.value not in keys:
+                        fn_name = dotted.rsplit(".", 1)[-1]
+                        yield (
+                            sf.relpath,
+                            node.lineno,
+                            f"{fn_name}({arg.value!r}): unregistered "
+                            "phase key (registered: "
+                            f"{', '.join(sorted(keys))}) — register it "
+                            "in sim/profile.py PHASES so the ledger "
+                            "and the baseline gate know the phase",
+                        )
+
+
 RULES = [
     UnalignedU8Draw,
     HostSyncInKernel,
@@ -672,4 +768,5 @@ RULES = [
     BroadExceptSwallow,
     UnboundedQueueInHostTier,
     UnboundedNetworkAwait,
+    UnregisteredPhaseScope,
 ]

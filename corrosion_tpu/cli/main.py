@@ -451,6 +451,16 @@ _SIM_SCENARIOS = {
     # rounds/wire ratios vs the baseline point, plus a storm-scale
     # PeerSwap sampler cell (the convergence × wire-bytes Pareto)
     "protocol-frontier": "config_protocol_frontier",
+    # the phase-attribution rung (ISSUE 16): one forced-packed
+    # storm-aspect round under a scoped jax.profiler capture, folded
+    # into the named-phase cost ledger, cross-checked against the
+    # interleaved telemetry A/B — the capture `sim profile compare`
+    # gates against doc/experiments/PROFILE_BASELINE.json
+    "phase-profile": "config_phase_profile",
+    # static memory budgets (ISSUE 16): compiled.memory_analysis() for
+    # the committed rungs via abstract (eval_shape) lowering — no state
+    # is allocated, so the 1M-node budget costs compile time only
+    "memory-budget": "config_memory_budget",
 }
 
 
@@ -476,6 +486,12 @@ def cmd_sim(args) -> int:
         # the registry and its resolved-knob rendering are plain dicts
         # (corrosion_tpu.proto imports no accelerator runtime)
         return cmd_proto(args)
+    if args.scenario == "profile":
+        # phase-attribution ledger tooling (ISSUE 16): show / compare /
+        # baseline are pure JSON→text transforms over records the rungs
+        # already emitted — dispatched before the platform setup so the
+        # nightly profile gate never imports jax
+        return cmd_profile(args)
     # honor JAX_PLATFORMS even when an accelerator plugin would win over
     # the env var (jax.config takes precedence) — tests set cpu to keep
     # subprocess sims off the contended real chip
@@ -516,11 +532,25 @@ def cmd_sim(args) -> int:
         # optional XLA profiler capture around the run (jax.profiler
         # TensorBoard trace into DIR) — covers scenario AND campaign
         # runs; the bench storm rungs use the same hook via
-        # BENCH_XLA_PROFILE
-        import jax
+        # BENCH_XLA_PROFILE.  Scenarios whose config fn accepts
+        # ``profile_dir`` (ISSUE 16) own the capture themselves — a
+        # scoped trace + phase map + parsed phase_profile block in the
+        # record — so no outer trace is started for them (nested
+        # jax.profiler traces error out); _run_sim_scenario threads the
+        # dir through instead.
+        config_owns = False
+        if args.scenario in _SIM_SCENARIOS:
+            import inspect
 
-        jax.profiler.start_trace(args.xla_profile)
-        profiling = args.xla_profile
+            from ..sim import runner as _runner
+
+            _fn = getattr(_runner, _SIM_SCENARIOS[args.scenario])
+            config_owns = "profile_dir" in inspect.signature(_fn).parameters
+        if not config_owns:
+            import jax
+
+            jax.profiler.start_trace(args.xla_profile)
+            profiling = args.xla_profile
     try:
         if args.scenario == "campaign":
             return cmd_campaign(args)
@@ -649,6 +679,11 @@ def _run_sim_scenario(args) -> int:
         return 2
     if (args.telemetry or args.trace_out) and "telemetry" in params:
         kwargs["telemetry"] = True
+    # phase-attribution capture (ISSUE 16): configs that take
+    # `profile_dir` own the scoped trace + phase map + parsed ledger
+    # (cmd_sim skipped the outer jax.profiler trace for them)
+    if args.xla_profile and "profile_dir" in params:
+        kwargs["profile_dir"] = args.xla_profile
     trace_out = args.trace_out
     base_seed = args.seed if args.seed is not None else 0
     n_seeds = args.seeds or 1
@@ -881,6 +916,148 @@ def cmd_proto(args) -> int:
     print(f"  overlay:  {json.dumps(kw, sort_keys=True)}")
     print(f"  resolved: {json.dumps(resolved, sort_keys=True)}")
     return 0
+
+
+def _load_profile_record(path: str):
+    """Load a phase_profile record from any of its carriers: a raw
+    record (``kind == "phase_profile"``), a scenario/bench record with
+    a ``phase_profile`` key, or a bench_child result file (the block
+    rides ``metrics``).  Returns (record, memory_budget_or_None,
+    carrier_doc)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise SystemExit(f"error: {path} is not a JSON object")
+    if doc.get("kind") in ("phase_profile", "profile_baseline"):
+        return doc, None, doc
+    for carrier in (doc, doc.get("metrics")):
+        if isinstance(carrier, dict) and isinstance(
+            carrier.get("phase_profile"), dict
+        ):
+            return (
+                carrier["phase_profile"],
+                carrier.get("memory_budget"),
+                carrier,
+            )
+    raise SystemExit(
+        f"error: no phase_profile record in {path} (expected a raw "
+        "record, a scenario record with a phase_profile block, or a "
+        "bench_child result)"
+    )
+
+
+def cmd_profile(args) -> int:
+    """`sim profile show|compare|baseline` (ISSUE 16): render, gate,
+    and band phase-attribution ledgers.  Entirely jax-free — inputs
+    are the JSON records the rungs emit, so the nightly profile gate
+    runs in milliseconds without touching a backend.
+
+    - ``show --in FILE [--json]``: phase ledger + memory-budget tables
+      (FILE may be a record, a rung output, or a committed baseline).
+    - ``compare --baseline FILE --candidate FILE [--json]``: gate the
+      candidate's phase fractions against the baseline bands; exit 1
+      on any violation (the profile-smoke CI job's gate).
+    - ``baseline --candidate RECORD --out FILE``: band a measured
+      record into a committable baseline (regeneration after a
+      justified shift; review the diff before committing).
+    """
+    from ..sim import profile as prof
+
+    sub = args.campaign_cmd
+    if sub == "show":
+        if not args.in_path:
+            raise SystemExit("sim profile show needs --in FILE")
+        with open(args.in_path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and isinstance(doc.get("budgets"), list):
+            # a config_memory_budget document: one table per rung
+            if args.json:
+                print(json.dumps(doc, default=float))
+                return 0
+            hbm = doc.get("hbm_bytes_per_chip")
+            if hbm:
+                print(f"hbm capacity per chip: {float(hbm) / 1e9:.1f} GB")
+            for rung in doc["budgets"]:
+                print(prof.render_memory_table(rung))
+            return 0
+        rec, mem, _carrier = _load_profile_record(args.in_path)
+        if args.json:
+            out = {"phase_profile": rec}
+            if mem:
+                out["memory_budget"] = mem
+            print(json.dumps(out, default=float))
+            return 0
+        if rec.get("kind") == "profile_baseline":
+            print(
+                "profile baseline  "
+                f"scenario={rec.get('scenario', '?')}"
+            )
+            for name, band in sorted(rec.get("phases", {}).items()):
+                tol = float(band.get("tol", prof.DEFAULT_PHASE_TOL))
+                print(f"  {name:<12} {float(band['frac']):>7.1%} ± {tol:.1%}")
+            cap = rec.get("unattributed_frac_max")
+            if cap is not None:
+                print(f"  unattributed ceiling {float(cap):.1%}")
+            return 0
+        print(prof.render_phase_table(rec))
+        if mem:
+            print(prof.render_memory_table(mem))
+        return 0
+    if sub == "compare":
+        if not (args.baseline and args.candidate):
+            raise SystemExit(
+                "sim profile compare needs --baseline FILE "
+                "--candidate FILE"
+            )
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if base.get("kind") != "profile_baseline":
+            raise SystemExit(
+                f"error: {args.baseline} is not a profile_baseline "
+                "document"
+            )
+        cand, _mem, _carrier = _load_profile_record(args.candidate)
+        failures = prof.compare_profiles(base, cand)
+        if args.json:
+            print(json.dumps({"ok": not failures, "failures": failures}))
+        else:
+            print(prof.render_compare(base, cand, failures))
+        return 1 if failures else 0
+    if sub == "baseline":
+        if not (args.candidate and args.out):
+            raise SystemExit(
+                "sim profile baseline needs --candidate RECORD "
+                "--out FILE"
+            )
+        cand, _mem, carrier = _load_profile_record(args.candidate)
+        # carry the rung's shape + telemetry cross-check fields so the
+        # committed baseline documents what it was measured on
+        extra = {
+            k: carrier[k]
+            for k in (
+                "n_nodes", "n_payloads", "k_rounds", "round_path",
+                "telemetry_frac", "telemetry_scoped_frac",
+                "telemetry_smeared_frac", "telemetry_frac_expected",
+                "telemetry_frac_delta",
+            )
+            if k in carrier
+        }
+        tol = args.tol if args.tol is not None else prof.DEFAULT_PHASE_TOL
+        doc = prof.baseline_from_profile(
+            cand, scenario="phase-profile", tol=tol, extra=extra
+        )
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1, default=float)
+            f.write("\n")
+        print(f"wrote {args.out}")
+        return 0
+    print(
+        "usage: sim profile show --in FILE [--json] | "
+        "sim profile compare --baseline FILE --candidate FILE [--json] "
+        "| sim profile baseline --candidate RECORD --out FILE",
+        file=sys.stderr,
+    )
+    return 2
 
 
 def cmd_trace(args) -> int:
@@ -1382,19 +1559,21 @@ def build_parser() -> argparse.ArgumentParser:
         "`sim campaign run|compare|report` for declarative seed-ensemble "
         "campaigns, `sim trace show` for flight-recorder artifacts, "
         "`sim topo show` for topology families, `sim proto show` for "
-        "protocol-variant families, or `sim lint` for the corrolint "
-        "static-analysis gate (doc/lint.md)",
+        "protocol-variant families, `sim profile show|compare|baseline` "
+        "for phase-attribution ledgers (doc/telemetry/profiling.md), or "
+        "`sim lint` for the corrolint static-analysis gate (doc/lint.md)",
     )
     sm.add_argument(
         "scenario",
         choices=sorted(_SIM_SCENARIOS)
-        + ["campaign", "trace", "topo", "proto", "lint"],
+        + ["campaign", "trace", "topo", "proto", "profile", "lint"],
     )
     sm.add_argument(
         "campaign_cmd", nargs="?",
-        choices=["run", "compare", "report", "show"],
-        help="campaign action (scenario=campaign), or `show` "
-        "(scenario=trace | topo | proto)",
+        choices=["run", "compare", "report", "show", "baseline"],
+        help="campaign action (scenario=campaign), `show` "
+        "(scenario=trace | topo | proto | profile), or "
+        "`compare`/`baseline` (scenario=profile)",
     )
     # default None so "explicitly given" is detectable: campaign run
     # must distinguish `--seed 0` (override to one seed) from "no seed
@@ -1465,6 +1644,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sm.add_argument("--candidate", help="campaign compare: candidate artifact")
     sm.add_argument(
+        "--tol", type=float, default=None,
+        help="profile baseline: per-phase fraction tolerance "
+        "(default 0.05; widen to absorb box scheduling variance)",
+    )
+    sm.add_argument(
         "--telemetry", action="store_true",
         help="flight recorder (ISSUE 5): record in-kernel per-round "
         "telemetry (scenario runs gain a summary block; campaign run "
@@ -1502,7 +1686,9 @@ def build_parser() -> argparse.ArgumentParser:
     sm.add_argument(
         "--xla-profile", metavar="DIR",
         help="capture a jax.profiler (TensorBoard) trace of the run "
-        "into DIR",
+        "into DIR; scenarios with phase attribution (ISSUE 16) also "
+        "write DIR/phase_map.json and attach a parsed phase_profile "
+        "block to the record",
     )
     sm.add_argument(
         "--format", choices=["text", "json"], default="text",

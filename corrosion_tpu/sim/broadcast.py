@@ -237,88 +237,99 @@ def broadcast_step(
     # trace time.  The packed kernel computes the SAME quantities from
     # identical-valued tensors with identical reduction shapes, so the
     # two paths' channels agree bit-for-bit (test_telemetry pins it).
+    from .profile import phase_scope
     from .telemetry import WireTel
 
-    send_frames = jnp.sum(sending, axis=-1, dtype=jnp.int32)  # [N]
-    # exact i32 per-node byte totals — the identical integers the packed
-    # twin computes on words, so the f32 fold below matches bit-for-bit
-    send_bytes = jnp.sum(
-        jnp.where(sending, meta.nbytes[None, :], 0), axis=-1,
-        dtype=jnp.int32,
-    )  # [N]
-    okf = ok.reshape(n, f)
-    frames = jnp.sum(
-        jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32
-    )
-    dropped = jnp.int32(0)
-    if _tel_loss:
-        if p % 32 == 0:
-            # word-domain count of loss hits on eligible live frames —
-            # the packed kernel's formula on identical values
-            from .packed import pack_bits
-
-            w = p // 32
-            hit = pack_bits(drop).reshape(n, f, w) & pack_bits(sending)[
-                :, None, :
-            ] & jnp.where(
-                okf[:, :, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
-            )
-            dropped = jnp.sum(
-                jax.lax.population_count(hit), dtype=jnp.int32
-            )
-        else:  # outside the word envelope: small P, plain reduce
-            dropped = jnp.sum(
-                ok.reshape(n, f, 1) & drop.reshape(n, f, p)
-                & sending[:, None, :],
-                dtype=jnp.int32,
-            )
-    bytes_out = jnp.sum(
-        jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
-    )
-    if cfg.dissemination == "push-pull":
-        # the pull responses are wire traffic too (the exchange's cost
-        # side of the Pareto): same fold shapes as the push direction,
-        # responder-side per-node stats gathered by dst — the packed
-        # twin computes the identical integers on words, so the
-        # channels stay bit-equal across kernels
-        okpf = ok_pull.reshape(n, f)
-        frames = frames + jnp.sum(
-            jnp.where(okpf, send_frames[dst].reshape(n, f), 0),
+    # innermost-wins "telemetry" scope (profile.py): these folds are
+    # flight-recorder cost, not broadcast cost, and the ledger's
+    # telemetry fraction is cross-checked against the interleaved
+    # overhead measurement
+    with phase_scope("telemetry"):
+        send_frames = jnp.sum(sending, axis=-1, dtype=jnp.int32)  # [N]
+        # exact i32 per-node byte totals — the identical integers the
+        # packed twin computes on words, so the f32 fold below matches
+        # bit-for-bit
+        send_bytes = jnp.sum(
+            jnp.where(sending, meta.nbytes[None, :], 0), axis=-1,
             dtype=jnp.int32,
+        )  # [N]
+        okf = ok.reshape(n, f)
+        frames = jnp.sum(
+            jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32
         )
-        bytes_out = bytes_out + jnp.sum(
-            jnp.where(
-                okpf,
-                send_bytes[dst].astype(jnp.float32).reshape(n, f),
-                0.0,
-            )
-        )
+        dropped = jnp.int32(0)
         if _tel_loss:
             if p % 32 == 0:
+                # word-domain count of loss hits on eligible live frames
+                # — the packed kernel's formula on identical values
                 from .packed import pack_bits
 
                 w = p // 32
-                hitp = pack_bits(drop_pull).reshape(n, f, w) & pack_bits(
+                hit = pack_bits(drop).reshape(n, f, w) & pack_bits(
                     sending
-                )[dst].reshape(n, f, w) & jnp.where(
-                    okpf[:, :, None], jnp.uint32(0xFFFFFFFF),
+                )[:, None, :] & jnp.where(
+                    okf[:, :, None], jnp.uint32(0xFFFFFFFF),
                     jnp.uint32(0),
                 )
-                dropped = dropped + jnp.sum(
-                    jax.lax.population_count(hitp), dtype=jnp.int32
+                dropped = jnp.sum(
+                    jax.lax.population_count(hit), dtype=jnp.int32
                 )
-            else:
-                dropped = dropped + jnp.sum(
-                    ok_pull.reshape(n, f, 1) & drop_pull.reshape(n, f, p)
-                    & sending[dst].reshape(n, f, p),
+            else:  # outside the word envelope: small P, plain reduce
+                dropped = jnp.sum(
+                    ok.reshape(n, f, 1) & drop.reshape(n, f, p)
+                    & sending[:, None, :],
                     dtype=jnp.int32,
                 )
-    tel = WireTel(
-        frames=frames,
-        bytes=bytes_out,
-        dropped=dropped,
-        cut=cut,
-    )
+        bytes_out = jnp.sum(
+            jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
+        )
+        if cfg.dissemination == "push-pull":
+            # the pull responses are wire traffic too (the exchange's
+            # cost side of the Pareto): same fold shapes as the push
+            # direction, responder-side per-node stats gathered by dst —
+            # the packed twin computes the identical integers on words,
+            # so the channels stay bit-equal across kernels
+            okpf = ok_pull.reshape(n, f)
+            frames = frames + jnp.sum(
+                jnp.where(okpf, send_frames[dst].reshape(n, f), 0),
+                dtype=jnp.int32,
+            )
+            bytes_out = bytes_out + jnp.sum(
+                jnp.where(
+                    okpf,
+                    send_bytes[dst].astype(jnp.float32).reshape(n, f),
+                    0.0,
+                )
+            )
+            if _tel_loss:
+                if p % 32 == 0:
+                    from .packed import pack_bits
+
+                    w = p // 32
+                    hitp = pack_bits(drop_pull).reshape(
+                        n, f, w
+                    ) & pack_bits(sending)[dst].reshape(
+                        n, f, w
+                    ) & jnp.where(
+                        okpf[:, :, None], jnp.uint32(0xFFFFFFFF),
+                        jnp.uint32(0),
+                    )
+                    dropped = dropped + jnp.sum(
+                        jax.lax.population_count(hitp), dtype=jnp.int32
+                    )
+                else:
+                    dropped = dropped + jnp.sum(
+                        ok_pull.reshape(n, f, 1)
+                        & drop_pull.reshape(n, f, p)
+                        & sending[dst].reshape(n, f, p),
+                        dtype=jnp.int32,
+                    )
+        tel = WireTel(
+            frames=frames,
+            bytes=bytes_out,
+            dropped=dropped,
+            cut=cut,
+        )
     return state, tel
 
 

@@ -29,6 +29,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from .profile import phase_scope
 from .state import SimConfig
 
 
@@ -51,11 +52,20 @@ def extract_gaps(
     they are the head-catchup range of `compute_available_needs`.
 
     Pure gather/scatter + cumsum — one fused XLA pass per round.
+    Self-scoped ``corro.gaps`` (profile.py) so the interval machinery
+    attributes to the gap-tracking ledger line from every caller.
     """
+    with phase_scope("gaps"):
+        if touched.shape[2] <= 32:
+            return _extract_gaps_words(touched, heads, cfg)
+        return _extract_gaps_dense(touched, heads, cfg)
+
+
+def _extract_gaps_dense(
+    touched: jnp.ndarray, heads: jnp.ndarray, cfg: SimConfig
+) -> GapTensors:
     n, a, v = touched.shape
     k = cfg.gap_slots
-    if v <= 32:
-        return _extract_gaps_words(touched, heads, cfg)
     v_idx = jnp.arange(1, v + 1, dtype=jnp.int32)  # 1-based versions
 
     missing = (~touched) & (v_idx[None, None, :] <= heads[:, :, None])

@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .profile import phase_scope
 from .state import ALIVE, PayloadMeta, SimConfig, SimState
 from .swim import sample_member_targets
 from .topology import (
@@ -556,56 +557,61 @@ def broadcast_packed(
     # exists at trace time — bit-equal traces, none of the hot-path cost
     from .telemetry import WireTel, word_byte_totals
 
-    send_frames = jnp.sum(
-        jax.lax.population_count(sending), axis=-1, dtype=jnp.int32
-    )  # [N]
-    send_bytes = word_byte_totals(sending, meta.nbytes)  # i32[N], exact
-    okf = ok.reshape(n, f)
-    frames = jnp.sum(
-        jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32
-    )
-    dropped = jnp.int32(0)
-    if _tel_loss:
-        dw = pack_bits(drop).reshape(n, f, sending.shape[-1])
-        hit = dw & sending[:, None, :] & jnp.where(
-            okf[:, :, None], ONES, U32(0)
+    # innermost-wins "telemetry" scope: flight-recorder cost, pulled out
+    # of the broadcast ledger line (the dense kernel does the same)
+    with phase_scope("telemetry"):
+        send_frames = jnp.sum(
+            jax.lax.population_count(sending), axis=-1, dtype=jnp.int32
+        )  # [N]
+        send_bytes = word_byte_totals(sending, meta.nbytes)  # i32[N]
+        okf = ok.reshape(n, f)
+        frames = jnp.sum(
+            jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32
         )
-        dropped = jnp.sum(jax.lax.population_count(hit), dtype=jnp.int32)
-    bytes_out = jnp.sum(
-        jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
-    )
-    if cfg.dissemination == "push-pull":
-        # pull-direction wire accounting — the dense kernel's fold
-        # shapes on word-derived integers (send_frames/send_bytes are
-        # the identical values), so the channels stay bit-equal
-        okpf = ok_pull.reshape(n, f)
-        frames = frames + jnp.sum(
-            jnp.where(okpf, send_frames[dst].reshape(n, f), 0),
-            dtype=jnp.int32,
-        )
-        bytes_out = bytes_out + jnp.sum(
-            jnp.where(
-                okpf,
-                send_bytes[dst].astype(jnp.float32).reshape(n, f),
-                0.0,
-            )
-        )
+        dropped = jnp.int32(0)
         if _tel_loss:
-            w = sending.shape[-1]
-            hitp = pack_bits(drop_pull).reshape(n, f, w) & sending[
-                dst
-            ].reshape(n, f, w) & jnp.where(
-                okpf[:, :, None], ONES, U32(0)
+            dw = pack_bits(drop).reshape(n, f, sending.shape[-1])
+            hit = dw & sending[:, None, :] & jnp.where(
+                okf[:, :, None], ONES, U32(0)
             )
-            dropped = dropped + jnp.sum(
-                jax.lax.population_count(hitp), dtype=jnp.int32
+            dropped = jnp.sum(
+                jax.lax.population_count(hit), dtype=jnp.int32
             )
-    tel = WireTel(
-        frames=frames,
-        bytes=bytes_out,
-        dropped=dropped,
-        cut=cut,
-    )
+        bytes_out = jnp.sum(
+            jnp.where(okf, send_bytes.astype(jnp.float32)[:, None], 0.0)
+        )
+        if cfg.dissemination == "push-pull":
+            # pull-direction wire accounting — the dense kernel's fold
+            # shapes on word-derived integers (send_frames/send_bytes
+            # are the identical values), so the channels stay bit-equal
+            okpf = ok_pull.reshape(n, f)
+            frames = frames + jnp.sum(
+                jnp.where(okpf, send_frames[dst].reshape(n, f), 0),
+                dtype=jnp.int32,
+            )
+            bytes_out = bytes_out + jnp.sum(
+                jnp.where(
+                    okpf,
+                    send_bytes[dst].astype(jnp.float32).reshape(n, f),
+                    0.0,
+                )
+            )
+            if _tel_loss:
+                w = sending.shape[-1]
+                hitp = pack_bits(drop_pull).reshape(n, f, w) & sending[
+                    dst
+                ].reshape(n, f, w) & jnp.where(
+                    okpf[:, :, None], ONES, U32(0)
+                )
+                dropped = dropped + jnp.sum(
+                    jax.lax.population_count(hitp), dtype=jnp.int32
+                )
+        tel = WireTel(
+            frames=frames,
+            bytes=bytes_out,
+            dropped=dropped,
+            cut=cut,
+        )
     return out, tel
 
 
@@ -721,48 +727,57 @@ def packed_round_step(
         # shared verbatim with round.round_step
         from ..topo.sampler import peerswap_step
 
-        state = peerswap_step(state, cfg, topo, k_swap, faults)
+        with phase_scope("sampler"):
+            state = peerswap_step(state, cfg, topo, k_swap, faults)
 
     have0_w = carry.have  # pre-round holdings (delivered-count base)
-    carry, injected_p = inject_packed(
-        carry, injected_p, state.t, meta, cfg, state.alive
-    )
-    if trace is None:
-        carry = broadcast_packed(
-            carry, injected_p, state, cfg, topo, region, k_bcast, meta,
-            faults, done=done,
+    with phase_scope("inject"):
+        carry, injected_p = inject_packed(
+            carry, injected_p, state.t, meta, cfg, state.alive
         )
-    else:
-        carry, wire = broadcast_packed(
-            carry, injected_p, state, cfg, topo, region, k_bcast, meta,
-            faults, telem=True, done=done,
-        )
+    with phase_scope("broadcast"):
+        if trace is None:
+            carry = broadcast_packed(
+                carry, injected_p, state, cfg, topo, region, k_bcast,
+                meta, faults, done=done,
+            )
+        else:
+            carry, wire = broadcast_packed(
+                carry, injected_p, state, cfg, topo, region, k_bcast,
+                meta, faults, telem=True, done=done,
+            )
     # sync writes ring slots t+1.., deliver pops slot t: no ordering
     # hazard (round.round_step's contract; compile_plan validated
     # 1 + fault delay < n_delay_slots)
-    if trace is None:
-        carry, countdown, backoff = sync_packed(
-            carry, state, cfg, topo, k_sync, meta, faults, done=done
-        )
-    else:
-        carry, countdown, backoff, stel = sync_packed(
-            carry, state, cfg, topo, k_sync, meta, faults, telem=True,
-            done=done,
-        )
+    with phase_scope("sync"):
+        if trace is None:
+            carry, countdown, backoff = sync_packed(
+                carry, state, cfg, topo, k_sync, meta, faults, done=done
+            )
+        else:
+            carry, countdown, backoff, stel = sync_packed(
+                carry, state, cfg, topo, k_sync, meta, faults,
+                telem=True, done=done,
+            )
     state = state._replace(sync_countdown=countdown, sync_backoff=backoff)
-    carry = deliver_packed(carry, state.t, cfg)
+    with phase_scope("deliver"):
+        carry = deliver_packed(carry, state.t, cfg)
 
     from .swim import swim_step
 
-    state = swim_step(state, cfg, topo, k_swim, faults)
+    with phase_scope("swim"):
+        state = swim_step(state, cfg, topo, k_swim, faults)
 
-    touched = group_grid(carry.have, cfg, "any")  # [N, A, V]
-    heads = version_heads(touched)
-    gaps = extract_gaps(touched, heads, cfg)
-    state = state._replace(heads=heads, gap_lo=gaps.lo, gap_hi=gaps.hi)
-    overflow_frac = jnp.maximum(
-        metrics.overflow_frac, gaps.overflow.mean(dtype=jnp.float32)
-    )
+    with phase_scope("gaps"):
+        touched = group_grid(carry.have, cfg, "any")  # [N, A, V]
+        heads = version_heads(touched)
+        gaps = extract_gaps(touched, heads, cfg)
+        state = state._replace(
+            heads=heads, gap_lo=gaps.lo, gap_hi=gaps.hi
+        )
+        overflow_frac = jnp.maximum(
+            metrics.overflow_frac, gaps.overflow.mean(dtype=jnp.float32)
+        )
 
     # convergence record on WORDS: comp/act are group-uniform (every
     # chunk bit of a version carries the version's value), so the grid
@@ -770,46 +785,50 @@ def packed_round_step(
     # nodes of comp words, node_done = "every payload bit satisfied".
     # Exactly the dense formulas per bit; the equivalence suite compares
     # the resulting metrics every round.
-    up = state.alive == ALIVE
-    c = cfg.chunks_per_version
-    comp_w = all_chunks_words(carry.have, cfg)  # [N, W]
-    act_w = _smear_groups(
-        _fold_any(injected_p, c) & _group_low_bits_mask(c), c
-    )  # [W]
-    masked = jnp.where(up[:, None], comp_w, ONES)
-    # AND-fold over the NODE axis — the mesh-sharded axis.  A bitwise
-    # u32 reduction is a custom GSPMD reduction computation XLA:CPU
-    # rejects (UNIMPLEMENTED), so go through the PRED plane: unpack to
-    # bool, jnp.all over nodes (a supported reduce_and collective),
-    # re-pack.  Bit-identical to lax.reduce(bitwise_and); [N,P] bool is
-    # the same footprint the dense path's comp grid already pays.
-    payload_done = (
-        jnp.all(unpack_bits(masked, cfg.n_payloads), axis=0)
-        & unpack_bits(act_w, cfg.n_payloads)
-    )  # [P]
-    coverage_at = jnp.where(
-        (metrics.coverage_at < 0) & payload_done, state.t, metrics.coverage_at
-    )
-    node_done = ((comp_w | ~act_w[None, :]) == ONES).all(axis=1) & up
-    all_injected = jnp.all(meta.round <= state.t)
-    converged_at = jnp.where(
-        (metrics.converged_at < 0) & node_done & all_injected,
-        state.t,
-        metrics.converged_at,
-    )
-
-    # delivery-order invariant (ISSUE 11): the dense round's check on
-    # the packed path's version grids — `touched` is already
-    # materialized above; the completeness grid is variant-only cost
-    # (a trace-time branch, ordering="none" carries the constant 0)
-    order_violations = metrics.order_violations
-    if cfg.ordering != "none":
-        from .invariants import order_violation_count
-
-        comp_g = group_grid(carry.have, cfg, "all")  # [N, A, V]
-        order_violations = order_violations + order_violation_count(
-            touched, comp_g, meta, cfg
+    with phase_scope("converge"):
+        up = state.alive == ALIVE
+        c = cfg.chunks_per_version
+        comp_w = all_chunks_words(carry.have, cfg)  # [N, W]
+        act_w = _smear_groups(
+            _fold_any(injected_p, c) & _group_low_bits_mask(c), c
+        )  # [W]
+        masked = jnp.where(up[:, None], comp_w, ONES)
+        # AND-fold over the NODE axis — the mesh-sharded axis.  A
+        # bitwise u32 reduction is a custom GSPMD reduction computation
+        # XLA:CPU rejects (UNIMPLEMENTED), so go through the PRED plane:
+        # unpack to bool, jnp.all over nodes (a supported reduce_and
+        # collective), re-pack.  Bit-identical to
+        # lax.reduce(bitwise_and); [N,P] bool is the same footprint the
+        # dense path's comp grid already pays.
+        payload_done = (
+            jnp.all(unpack_bits(masked, cfg.n_payloads), axis=0)
+            & unpack_bits(act_w, cfg.n_payloads)
+        )  # [P]
+        coverage_at = jnp.where(
+            (metrics.coverage_at < 0) & payload_done,
+            state.t,
+            metrics.coverage_at,
         )
+        node_done = ((comp_w | ~act_w[None, :]) == ONES).all(axis=1) & up
+        all_injected = jnp.all(meta.round <= state.t)
+        converged_at = jnp.where(
+            (metrics.converged_at < 0) & node_done & all_injected,
+            state.t,
+            metrics.converged_at,
+        )
+
+        # delivery-order invariant (ISSUE 11): the dense round's check
+        # on the packed path's version grids — `touched` is already
+        # materialized above; the completeness grid is variant-only cost
+        # (a trace-time branch, ordering="none" carries the constant 0)
+        order_violations = metrics.order_violations
+        if cfg.ordering != "none":
+            from .invariants import order_violation_count
+
+            comp_g = group_grid(carry.have, cfg, "all")  # [N, A, V]
+            order_violations = order_violations + order_violation_count(
+                touched, comp_g, meta, cfg
+            )
 
     out_metrics = RunMetrics(
         coverage_at=coverage_at,
@@ -824,23 +843,24 @@ def packed_round_step(
             word_coverage_delivered,
         )
 
-        susp, dn = swim_belief_counts(state, cfg)
-        coverage, delivered = word_coverage_delivered(
-            carry.have, have0_w, up, cfg.n_payloads
-        )
-        trace = record_round(
-            trace,
-            state.t,
-            coverage=coverage,
-            delivered=delivered,
-            up_nodes=jnp.sum(up, dtype=jnp.int32),
-            wire=wire,
-            sync=stel,
-            swim_suspect=susp,
-            swim_down=dn,
-            gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
-            every=cfg.trace_every,
-        )
+        with phase_scope("telemetry"):
+            susp, dn = swim_belief_counts(state, cfg)
+            coverage, delivered = word_coverage_delivered(
+                carry.have, have0_w, up, cfg.n_payloads
+            )
+            trace = record_round(
+                trace,
+                state.t,
+                coverage=coverage,
+                delivered=delivered,
+                up_nodes=jnp.sum(up, dtype=jnp.int32),
+                wire=wire,
+                sync=stel,
+                swim_suspect=susp,
+                swim_down=dn,
+                gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
+                every=cfg.trace_every,
+            )
     state = state._replace(t=state.t + 1)
     if trace is not None:
         return state, carry, injected_p, out_metrics, trace
@@ -1285,13 +1305,17 @@ def sync_packed(
     # match bit-for-bit
     from .telemetry import SyncTel, word_bit_counts
 
-    counts = word_bit_counts(granted, cfg.n_payloads)  # i32[P]
-    tel = SyncTel(
-        sessions=jnp.sum(ok, dtype=jnp.int32),
-        refused=refused_cnt,
-        frames=jnp.sum(counts, dtype=jnp.int32),
-        bytes=jnp.dot(
-            counts.astype(jnp.float32), meta.nbytes.astype(jnp.float32)
-        ),
-    )
+    # innermost-wins "telemetry" scope: flight-recorder cost, pulled out
+    # of the sync ledger line (the dense kernel does the same)
+    with phase_scope("telemetry"):
+        counts = word_bit_counts(granted, cfg.n_payloads)  # i32[P]
+        tel = SyncTel(
+            sessions=jnp.sum(ok, dtype=jnp.int32),
+            refused=refused_cnt,
+            frames=jnp.sum(counts, dtype=jnp.int32),
+            bytes=jnp.dot(
+                counts.astype(jnp.float32),
+                meta.nbytes.astype(jnp.float32),
+            ),
+        )
     return out + (tel,)

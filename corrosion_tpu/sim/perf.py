@@ -28,7 +28,7 @@ machinery ran; BENCH_DIAG.json records both raw and corrected walls.
 from __future__ import annotations
 
 import time
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -41,6 +41,14 @@ from .topology import Topology, regions
 # this framework can run on sustains 4 TB/s of HBM writes; a measured
 # per-round wall implying more is a broken measurement, not a fast chip.
 HBM_BYTES_PER_S_CEILING = 4e12
+
+# Conservative single-chip HBM CAPACITY floor (bytes): v5e carries
+# 16 GB, larger chips more — a rung whose compiled memory budget
+# (profile.memory_budget: argument+output+temp−alias) exceeds
+# capacity × n_devices cannot run on the floor chip and verify_wall
+# flags it (ISSUE 16: the committed 100k/1M budgets feed this check,
+# and ROADMAP item 2's 10M plan is sized against the same record).
+HBM_BYTES_CAPACITY_PER_CHIP = 16e9
 
 
 def carry_write_bytes(cfg: SimConfig, packed: bool = False) -> int:
@@ -207,6 +215,14 @@ def _per_round_runner(
             np.asarray(out[2].coverage[0, 0])
         return time.monotonic() - t0
 
+    # the phase-attribution rung (profile.py) needs the SAME jitted
+    # body this microbench times: it lowers+compiles it for the HLO
+    # text (the op→phase map) and memory_analysis(), then executes it
+    # under the profiler capture — exposing the pieces keeps the
+    # profiled program and the timed program one and the same
+    run_once.k_rounds_fn = k_rounds_fn
+    run_once.args = (state, metrics)
+    run_once.k_rounds = k_rounds
     return run_once
 
 
@@ -289,6 +305,7 @@ def verify_wall(
     cfg: SimConfig,
     n_devices: int = 1,
     packed: bool = False,
+    mem_budget: Optional[Dict[str, object]] = None,
 ) -> Tuple[float, Dict[str, object]]:
     """Cross-check a full-run wall and return (defensible_wall, report).
 
@@ -299,6 +316,14 @@ def verify_wall(
       is an async artifact; the defensible wall is rounds × per_round.
     - If full_wall is >3× above, the run carried overhead (compile,
       tunnel stall); full_wall stands (conservative) but is flagged.
+
+    ``mem_budget`` (a `profile.memory_budget` record, or None) extends
+    the report with the compiled executable's measured HBM CAPACITY
+    demand: ``fits_hbm`` says whether peak_bytes_est fits the
+    conservative per-chip floor × n_devices.  Capacity doesn't change
+    the defensible wall (it bounds feasibility, not time), so the
+    verdict string is untouched; a non-fitting budget is flagged in
+    ``memory_flag`` for the rung record to surface.
     """
     min_round = analytic_min_round_s(cfg, n_devices, packed)
     expected = rounds * per_round_s
@@ -311,6 +336,18 @@ def verify_wall(
         "rounds_x_per_round_s": round(expected, 4),
         "full_run_wall_s": round(full_wall_s, 4),
     }
+    if mem_budget is not None:
+        cap = HBM_BYTES_CAPACITY_PER_CHIP * max(1, n_devices)
+        peak = int(mem_budget.get("peak_bytes_est", 0))
+        report["memory_budget"] = mem_budget
+        report["hbm_capacity_bytes"] = int(cap)
+        report["fits_hbm"] = peak <= cap
+        if peak > cap:
+            report["memory_flag"] = (
+                f"peak {peak / 1e9:.2f} GB exceeds the "
+                f"{cap / 1e9:.0f} GB conservative capacity of "
+                f"{n_devices} chip(s)"
+            )
     if per_round_s < min_round:
         report["verdict"] = "hbm-bound-violated"
         report["consistency_ratio"] = None

@@ -1,0 +1,664 @@
+"""Phase-attribution profiler — the named-scope cost ledger (ISSUE 16).
+
+The round kernels annotate their phases with `jax.named_scope` strings
+from the `PHASES` registry below (`corro.<phase>`).  The scopes are
+METADATA-ONLY: they ride the HLO `op_name` metadata and change no
+computation, so every pinned digest (dense==packed, solo==vmapped==
+sharded, proto families) stays byte-identical with annotations compiled
+in — tests/sim/test_profile.py pins that, and corrolint CT010 keeps the
+kernel scopes and this registry from drifting apart.
+
+Attribution is a TWO-PART join, because the profiler's trace-event file
+does not carry scope names on CPU/TPU device ops — events only carry
+``args.hlo_op`` (the HLO instruction name) and ``args.hlo_module``:
+
+1. at capture time, the caller saves the compiled executable's HLO text
+   (`lowered.compile().as_text()`), and `write_phase_map` extracts each
+   instruction's ``metadata={op_name="..."}`` path into an op → phase
+   map (`phase_map.json`, next to the capture);
+2. offline — JAX-FREE, so `sim profile show|compare` and the nightly
+   gate run without a backend — `parse_phase_profile` joins the trace's
+   device ops against that map and folds op time into per-phase seconds
+   and fractions.
+
+Innermost scope wins (the `sampler` scope nested inside `sync`/`swim`
+attributes the member draws to the sampler), container ops (`while`,
+`conditional`, `call` — whose spans cover their body ops' spans) are
+excluded from the fold so the loop wrapper never double-counts its body,
+and any device time in a captured module that carries NO registered
+scope is reported LOUDLY as the unattributed residual (the acceptance
+bar: < 15% on the 25k packed storm baseline).
+
+Wall-clock never enters the record's gated fields: phase FRACTIONS are
+banded (doc/experiments/PROFILE_BASELINE.json), absolute seconds are
+informational, and run digests exclude the profile block entirely.
+
+The memory side: `memory_budget` snapshots `compiled.memory_analysis()`
+(argument/output/temp/alias bytes) per rung shape — committed for the
+100k and 1M rungs (doc/experiments/MEMORY_BUDGET.json) and consumed by
+`perf.verify_wall`'s HBM bound as a capacity check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Phase registry.
+#
+# BOTH assignments below must stay PURE LITERALS: corrolint CT010 parses
+# them with `ast.literal_eval` (no jax, no import of this module) to
+# learn the registered scope strings, and flags any `jax.named_scope`
+# string in the sim tier that is not `_SCOPE_PREFIX + <key>` here.  An
+# unregistered scope would not be a crash — it would silently inflate
+# the unattributed residual, which is exactly the failure mode the lint
+# exists to catch.
+# ---------------------------------------------------------------------------
+
+_SCOPE_PREFIX = "corro."
+
+PHASES = {
+    "sampler": "peer-sampler target draws (PeerSwap ticks + member sampling)",
+    "inject": "payload injection (writer commits entering the system)",
+    "broadcast": "broadcast scatter (fan-out sends into the delay ring)",
+    "sync": "anti-entropy sync gather (needs, grants, ring writes, backoff)",
+    "deliver": "delay-ring pop and holdings merge",
+    "swim": "SWIM probe/suspicion/gossip membership pass",
+    "gaps": "bookkeeping refresh (touched/heads/gap interval extraction)",
+    "converge": "convergence record (coverage/converged-at metrics)",
+    "telemetry": "flight-recorder counters (RoundTrace channels)",
+}
+
+# Fallback attribution for ops whose scope path XLA DROPPED: the
+# scatter expander (and friends) rebuild instructions keeping only the
+# inner computation's short op_name + source_file, so a `corro.sampler`
+# scatter resurfaces as `/max @ pswim.py:298`.  Files listed here are
+# SINGLE-PHASE kernels — an op sourced from one of them belongs to that
+# phase whenever its op_name carries no registered scope.  Multi-phase
+# files (round.py, packed.py, faults.py, state.py) are deliberately
+# absent: guessing there would silently misattribute, and the loud
+# residual is the honest answer.
+FILE_PHASE_HINTS = {
+    "broadcast.py": "broadcast",
+    "gaps.py": "gaps",
+    "pswim.py": "sampler",
+    "swim.py": "swim",
+    "sync.py": "sync",
+    "telemetry.py": "telemetry",
+}
+
+# Multi-phase files need FUNCTION-level hints: source_line → enclosing
+# top-level `def` (resolved by reading the source at capture time) →
+# phase.  Only the four packed phase kernels are listed; the pack/
+# unpack envelope and shared word utilities stay unhinted — their time
+# belongs to whoever fused them, or honestly to the residual.
+FUNC_PHASE_HINTS = {
+    "packed.py": {
+        "inject_packed": "inject",
+        "broadcast_packed": "broadcast",
+        "sync_packed": "sync",
+        "deliver_packed": "deliver",
+    },
+}
+
+# default band half-width for committed baselines (fraction points) and
+# the loud-residual ceiling the 25k storm baseline is accepted against
+DEFAULT_PHASE_TOL = 0.05
+DEFAULT_UNATTRIBUTED_MAX = 0.15
+
+# The xplane → trace.json converter silently drops device events past
+# ~1M; a capture that dense has biased fractions and must not band a
+# baseline.  One captured round has to fit under this — the profile
+# rung captures a k_rounds=1 body for exactly that reason.
+TRACE_EVENT_CAP = 950_000
+
+# HLO opcodes whose trace span COVERS their body ops' spans — summing
+# them alongside their children would double-count the whole loop
+_CONTAINER_OPS = frozenset(
+    {"while", "conditional", "call", "async-start", "async-update",
+     "async-done"}
+)
+
+
+def scope_name(phase: str) -> str:
+    """The `jax.named_scope` string for a registered phase key."""
+    if phase not in PHASES:
+        raise KeyError(
+            f"unregistered profiler phase {phase!r}; add it to "
+            f"corrosion_tpu/sim/profile.py PHASES (corrolint CT010 "
+            f"enforces the registry)"
+        )
+    return _SCOPE_PREFIX + phase
+
+
+def phase_scope(phase: str):
+    """Context manager annotating traced ops with a registered phase.
+
+    Metadata-only by construction (`jax.named_scope` changes op_name
+    metadata, never the computation); ``CORRO_PHASE_SCOPES=0`` disables
+    annotation entirely (a nullcontext) so the byte-identity test can
+    compile both variants and compare executables.  jax is imported
+    lazily — this module stays importable on the jax-free CLI paths.
+    """
+    name = scope_name(phase)  # registry check even when disabled
+    if os.environ.get("CORRO_PHASE_SCOPES", "1") == "0":
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def trace_capture(profile_dir: str):
+    """Profiler capture window (`jax.profiler.start_trace/stop_trace`)
+    with the stop riding a finally, so a crashing captured region still
+    flushes the trace it exists to explain."""
+    import jax
+
+    os.makedirs(profile_dir, exist_ok=True)
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield profile_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# Capture-time op → phase map (needs the compiled HLO text, not jax).
+# ---------------------------------------------------------------------------
+
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_MODULE_RE = re.compile(r"^HloModule\s+([\w.\-]+)", re.M)
+_SCOPE_RE = re.compile(re.escape(_SCOPE_PREFIX) + r"([A-Za-z0-9_]+)")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*\(")
+_CALLS_RE = re.compile(r"calls=%([\w.\-]+)")
+_SOURCE_RE = re.compile(r'source_file="([^"]*)"')
+_SOURCELINE_RE = re.compile(r"source_line=(\d+)")
+
+_DEF_CACHE: Dict[str, List[Tuple[int, str]]] = {}
+
+
+def _func_at_line(path: str, lineno: int) -> Optional[str]:
+    """Name of the top-level `def` enclosing ``lineno`` in ``path``
+    (used to resolve FUNC_PHASE_HINTS at capture time, where the repo
+    source exists; returns None when the file is unreadable — the
+    offline parser never needs it, the hints are baked into the map)."""
+    defs = _DEF_CACHE.get(path)
+    if defs is None:
+        defs = []
+        try:
+            with open(path) as f:
+                for i, line in enumerate(f, 1):
+                    dm = re.match(r"def\s+(\w+)", line)
+                    if dm:
+                        defs.append((i, dm.group(1)))
+        except OSError:
+            pass
+        _DEF_CACHE[path] = defs
+    name = None
+    for start, fn in defs:
+        if start > lineno:
+            break
+        name = fn
+    return name
+
+
+def _opcode_of(rhs: str) -> Optional[str]:
+    """Opcode of an HLO instruction right-hand side: skip the result
+    type (possibly a parenthesised tuple type with nested parens), then
+    take the identifier before the operand list's '('."""
+    rhs = rhs.lstrip()
+    if rhs.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rhs):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    rhs = rhs[i + 1:].lstrip()
+                    break
+        else:
+            return None
+    else:
+        sp = rhs.find(" ")
+        if sp > 0:
+            rhs = rhs[sp + 1:].lstrip()
+    m = re.match(r"([\w\-]+)\(", rhs)
+    return m.group(1) if m else None
+
+
+def hlo_op_phase_map(
+    hlo_text: str,
+) -> Tuple[Optional[str], Dict[str, Dict[str, object]]]:
+    """Extract (module_name, {instruction_name: {phase?, container?}})
+    from a compiled executable's HLO text.
+
+    Every instruction gets an entry — an empty dict means "in this
+    module but carries no registered scope", which the parser must count
+    as unattributed rather than silently dropping.  Innermost (last)
+    ``corro.<phase>`` occurrence in the op_name path wins.  On the rare
+    duplicate instruction name across computations, a phased entry is
+    never overwritten by an unphased one (fusion-internal instructions
+    share the namespace but never execute as trace events).
+
+    XLA's optimization pipeline strips or rewrites the scope path on
+    many ops, so attribution falls back in three steps, each of which
+    can relabel a dropped scope but never move time between phases:
+
+    - ``source_file`` hint: the scatter expander rebuilds instructions
+      keeping only the inner computation's short op_name + source file
+      (`/max @ pswim.py:298`); `FILE_PHASE_HINTS` lists the
+      single-phase kernel files.
+    - UNANIMOUS-context inheritance, iterated to fixpoint: an op with
+      no scope inherits a phase when the computation it calls
+      (``calls=%fused_computation.N``) or the computation it is a
+      member of resolves to exactly ONE phase.  A scatter's expanded
+      while-body is unanimous (all its phased members came from the
+      one scattered op), so its loop glue — the `add`/`copy`/
+      index-fusion thunks that dominate CPU trace time — lands on the
+      right phase; the outer round body is multi-phase, so its glue
+      stays in the loud residual rather than being guessed at.
+    """
+    m = _MODULE_RE.search(hlo_text)
+    module = m.group(1) if m else None
+    ops: Dict[str, Dict[str, object]] = {}
+    members: Dict[str, List[str]] = {}  # comp -> instruction names
+    calls: Dict[str, str] = {}  # instruction -> called computation
+    comp_of: Dict[str, str] = {}  # instruction -> enclosing computation
+    comp = ""
+    for line in hlo_text.splitlines():
+        cm = _COMP_RE.match(line)
+        if cm:
+            comp = cm.group(1)
+            continue
+        im = _INSTR_RE.match(line)
+        if not im:
+            continue
+        name, rhs = im.groups()
+        entry: Dict[str, object] = {}
+        om = _OPNAME_RE.search(line)
+        if om:
+            hits = _SCOPE_RE.findall(om.group(1))
+            for h in reversed(hits):  # innermost scope wins
+                if h in PHASES:
+                    entry["phase"] = h
+                    break
+        if "phase" not in entry:
+            sm = _SOURCE_RE.search(line)
+            if sm:
+                src = sm.group(1)
+                base = src.rsplit("/", 1)[-1]
+                hint = FILE_PHASE_HINTS.get(base)
+                if hint is None and base in FUNC_PHASE_HINTS:
+                    lm = _SOURCELINE_RE.search(line)
+                    if lm:
+                        fn = _func_at_line(src, int(lm.group(1)))
+                        hint = FUNC_PHASE_HINTS[base].get(fn)
+                if hint:
+                    entry["phase"] = hint
+        if _opcode_of(rhs) in _CONTAINER_OPS:
+            entry["container"] = True
+        else:
+            callm = _CALLS_RE.search(rhs)
+            if callm:
+                calls[name] = callm.group(1)
+        old = ops.get(name)
+        if old is None or ("phase" in entry or "phase" not in old):
+            ops[name] = entry
+            members.setdefault(comp, []).append(name)
+            comp_of[name] = comp
+
+    def _unanimous(comp_name: str) -> Optional[str]:
+        found = {
+            ops[n]["phase"]
+            for n in members.get(comp_name, ())
+            if "phase" in ops[n]
+        }
+        return found.pop() if len(found) == 1 else None
+
+    changed = True
+    while changed:
+        changed = False
+        uni = {c: _unanimous(c) for c in members}
+        for name, entry in ops.items():
+            if "phase" in entry or entry.get("container"):
+                continue
+            phase = uni.get(calls[name]) if name in calls else None
+            if phase is None:
+                phase = uni.get(comp_of.get(name, ""))
+            if phase is not None:
+                entry["phase"] = phase
+                changed = True
+    return module, ops
+
+
+def write_phase_map(
+    profile_dir: str, hlo_texts: Iterable[str]
+) -> str:
+    """Write ``phase_map.json`` next to a profiler capture, from the
+    compiled HLO text(s) of the executables that ran under the capture
+    window.  The offline parser joins trace events against this file."""
+    modules: Dict[str, Dict[str, Dict[str, object]]] = {}
+    for text in hlo_texts:
+        module, ops = hlo_op_phase_map(text)
+        if module is None:
+            continue
+        modules.setdefault(module, {}).update(ops)
+    doc = {
+        "kind": "phase_map",
+        "prefix": _SCOPE_PREFIX,
+        "phases": sorted(PHASES),
+        "modules": modules,
+    }
+    path = os.path.join(profile_dir, "phase_map.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Offline trace parsing (jax-free).
+# ---------------------------------------------------------------------------
+
+
+def find_trace_file(profile_dir: str) -> str:
+    """Newest trace-event file under a profiler capture directory
+    (`plugins/profile/<ts>/<host>.trace.json.gz` in current jax)."""
+    cands: List[str] = []
+    for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+        cands.extend(
+            glob.glob(os.path.join(profile_dir, pat), recursive=True)
+        )
+    if not cands:
+        raise FileNotFoundError(
+            f"no *.trace.json[.gz] under {profile_dir!r} — was the "
+            "profiler capture flushed (stop_trace)?"
+        )
+    return max(cands, key=os.path.getmtime)
+
+
+def load_trace_events(trace_path: str) -> List[dict]:
+    opener = gzip.open if trace_path.endswith(".gz") else open
+    with opener(trace_path, "rt") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    if not isinstance(events, list):
+        raise ValueError(f"{trace_path!r}: traceEvents is not a list")
+    return events
+
+
+def parse_phase_profile(
+    profile_dir: str,
+    phase_map: Optional[dict] = None,
+    trace_path: Optional[str] = None,
+) -> Dict[str, object]:
+    """Fold a profiler capture into the deterministic ``phase_profile``
+    record: per-phase device seconds + fraction, with the unattributed
+    residual reported loudly (top offending ops by time included).
+
+    Only complete-duration ("X") events whose ``args.hlo_module`` is in
+    the phase map are folded — the capture window may also contain other
+    modules (warmup jits, harness glue), which are NOT this ledger's
+    subject.  Container ops are skipped (their spans cover their body).
+    Absolute seconds are informational; the committed baseline bands
+    FRACTIONS only, so the record is wall-insensitive by construction.
+    """
+    if phase_map is None:
+        map_path = os.path.join(profile_dir, "phase_map.json")
+        with open(map_path) as f:
+            phase_map = json.load(f)
+    if trace_path is None:
+        trace_path = find_trace_file(profile_dir)
+    modules = phase_map.get("modules", {})
+    per: Dict[str, float] = {k: 0.0 for k in phase_map.get(
+        "phases", sorted(PHASES)
+    )}
+    unattr = 0.0
+    unattr_ops: Dict[str, float] = {}
+    total = 0.0
+    n_events = 0
+    for ev in load_trace_events(trace_path):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        mod = args.get("hlo_module")
+        if mod not in modules:
+            continue
+        op = args.get("hlo_op") or ev.get("name")
+        info = modules[mod].get(op)
+        if info is not None and info.get("container"):
+            continue
+        dur_s = float(ev.get("dur", 0)) * 1e-6  # trace durs are µs
+        total += dur_s
+        n_events += 1
+        phase = info.get("phase") if info is not None else None
+        if phase in per:
+            per[phase] += dur_s
+        else:
+            unattr += dur_s
+            unattr_ops[op] = unattr_ops.get(op, 0.0) + dur_s
+    top_unattr = sorted(
+        unattr_ops.items(), key=lambda kv: (-kv[1], kv[0])
+    )[:8]
+    return {
+        "kind": "phase_profile",
+        "trace_file": os.path.basename(trace_path),
+        "modules": sorted(modules),
+        "device_events": n_events,
+        # the trace converter DROPS events past ~1M — a saturated
+        # capture has biased fractions, and the compare gate refuses it
+        "trace_saturated": n_events >= TRACE_EVENT_CAP,
+        "total_s": round(total, 6),
+        "phases": {
+            name: {
+                "s": round(s, 6),
+                "frac": round(s / total, 4) if total > 0 else 0.0,
+            }
+            for name, s in per.items()
+        },
+        "unattributed": {
+            "s": round(unattr, 6),
+            "frac": round(unattr / total, 4) if total > 0 else 0.0,
+            "top_ops": [
+                {"op": op, "s": round(s, 6)} for op, s in top_unattr
+            ],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Baselines and comparison (jax-free; the nightly profile-smoke gate).
+# ---------------------------------------------------------------------------
+
+
+def baseline_from_profile(
+    record: Dict[str, object],
+    scenario: str,
+    tol: float = DEFAULT_PHASE_TOL,
+    unattributed_frac_max: float = DEFAULT_UNATTRIBUTED_MAX,
+    extra: Optional[Dict[str, object]] = None,
+) -> Dict[str, object]:
+    """Band a measured ``phase_profile`` into a committable baseline:
+    per-phase fraction ± tol, plus the unattributed ceiling.  Seconds
+    and walls are deliberately NOT banded (the gate must hold across
+    machines; only the phase SHAPE is claimed)."""
+    doc: Dict[str, object] = {
+        "kind": "profile_baseline",
+        "scenario": scenario,
+        "phases": {
+            name: {"frac": rec["frac"], "tol": tol}
+            for name, rec in sorted(record["phases"].items())
+        },
+        "unattributed_frac_max": unattributed_frac_max,
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def compare_profiles(
+    baseline: Dict[str, object], candidate: Dict[str, object]
+) -> List[str]:
+    """Gate a candidate ``phase_profile`` against a committed baseline.
+    Returns the list of violations (empty = pass).  Fractions only —
+    a faster or slower machine shifts every phase's seconds together
+    and leaves the fractions (and this gate) alone."""
+    failures: List[str] = []
+    if candidate.get("trace_saturated"):
+        failures.append(
+            f"trace saturated ({candidate.get('device_events')} device "
+            f"events >= {TRACE_EVENT_CAP} converter cap) — fractions "
+            "are biased; capture fewer rounds or a smaller shape"
+        )
+    cand_phases = candidate.get("phases", {})
+    for name, band in sorted(baseline.get("phases", {}).items()):
+        base = float(band["frac"])
+        tol = float(band.get("tol", DEFAULT_PHASE_TOL))
+        got = float(cand_phases.get(name, {}).get("frac", 0.0))
+        if abs(got - base) > tol:
+            failures.append(
+                f"phase {name}: frac {got:.4f} outside "
+                f"{base:.4f} ± {tol:.4f}"
+            )
+    cap = baseline.get("unattributed_frac_max")
+    if cap is not None:
+        got = float(
+            candidate.get("unattributed", {}).get("frac", 1.0)
+        )
+        if got > float(cap):
+            failures.append(
+                f"unattributed residual {got:.4f} exceeds the "
+                f"{float(cap):.4f} ceiling (a kernel grew an "
+                "unregistered scope? see corrolint CT010)"
+            )
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# Memory budgets (compiled.memory_analysis() snapshots).
+# ---------------------------------------------------------------------------
+
+_MEM_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def memory_budget(compiled, label: Optional[str] = None) -> Dict[str, object]:
+    """Snapshot a compiled executable's memory analysis into the
+    ``memory_budget`` record `verify_wall` consumes: argument / output /
+    temp / alias bytes plus the peak-device estimate (arguments and
+    outputs double-count donated aliases, hence the subtraction)."""
+    ma = compiled.memory_analysis()
+    rec: Dict[str, object] = {"kind": "memory_budget"}
+    if label is not None:
+        rec["label"] = label
+    for field in _MEM_FIELDS:
+        rec[field.replace("_size_in_bytes", "_bytes")] = int(
+            getattr(ma, field, 0) or 0
+        )
+    rec["peak_bytes_est"] = (
+        rec["argument_bytes"]
+        + rec["output_bytes"]
+        + rec["temp_bytes"]
+        - rec["alias_bytes"]
+    )
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Rendering (the `sim profile show|compare` tables; jax-free).
+# ---------------------------------------------------------------------------
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def render_phase_table(record: Dict[str, object]) -> str:
+    """The phase ledger as an aligned text table, largest phase first,
+    residual last and flagged when it breaches the default ceiling."""
+    lines = [
+        f"phase ledger  ({record.get('device_events', 0)} device ops, "
+        f"{float(record.get('total_s', 0.0)) * 1e3:.1f} ms device time, "
+        f"trace {record.get('trace_file', '?')})",
+        f"  {'phase':<12} {'seconds':>10} {'frac':>7}",
+    ]
+    phases = record.get("phases", {})
+    for name, rec in sorted(
+        phases.items(), key=lambda kv: (-kv[1]["s"], kv[0])
+    ):
+        lines.append(
+            f"  {name:<12} {rec['s']:>10.4f} {rec['frac']:>7.1%}"
+        )
+    un = record.get("unattributed", {"s": 0.0, "frac": 0.0})
+    flag = (
+        "  <-- above the "
+        f"{DEFAULT_UNATTRIBUTED_MAX:.0%} ceiling"
+        if un.get("frac", 0.0) > DEFAULT_UNATTRIBUTED_MAX
+        else ""
+    )
+    lines.append(
+        f"  {'unattributed':<12} {un['s']:>10.4f} "
+        f"{un['frac']:>7.1%}{flag}"
+    )
+    for op in un.get("top_ops", [])[:4]:
+        lines.append(f"    residual op {op['op']}: {op['s']:.4f}s")
+    return "\n".join(lines)
+
+
+def render_memory_table(record: Dict[str, object]) -> str:
+    label = record.get("label")
+    head = "memory budget" + (f"  [{label}]" if label else "")
+    rows = [head]
+    for key in (
+        "argument_bytes", "output_bytes", "temp_bytes", "alias_bytes",
+        "generated_code_bytes", "peak_bytes_est",
+    ):
+        if key in record:
+            rows.append(f"  {key:<22} {_fmt_bytes(record[key]):>12}")
+    return "\n".join(rows)
+
+
+def render_compare(
+    baseline: Dict[str, object],
+    candidate: Dict[str, object],
+    failures: Sequence[str],
+) -> str:
+    lines = [
+        f"baseline scenario: {baseline.get('scenario', '?')}",
+        f"  {'phase':<12} {'baseline':>9} {'candidate':>10} {'tol':>6}",
+    ]
+    cand_phases = candidate.get("phases", {})
+    for name, band in sorted(baseline.get("phases", {}).items()):
+        got = cand_phases.get(name, {}).get("frac", 0.0)
+        lines.append(
+            f"  {name:<12} {band['frac']:>9.1%} {got:>10.1%} "
+            f"{band.get('tol', DEFAULT_PHASE_TOL):>6.1%}"
+        )
+    un = candidate.get("unattributed", {}).get("frac", 0.0)
+    cap = baseline.get("unattributed_frac_max", DEFAULT_UNATTRIBUTED_MAX)
+    lines.append(f"  unattributed {un:.1%} (ceiling {cap:.1%})")
+    if failures:
+        lines.append("FAIL:")
+        lines.extend(f"  - {f}" for f in failures)
+    else:
+        lines.append("OK: candidate within every baseline band")
+    return "\n".join(lines)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from .broadcast import broadcast_step, deliver_step, inject_step
 from .gaps import extract_gaps
+from .profile import phase_scope
 from .state import (
     ALIVE,
     PayloadMeta,
@@ -124,76 +125,89 @@ def round_step(
         # swap messages ride the same reachability/fault seam as probes
         from ..topo.sampler import peerswap_step
 
-        state = peerswap_step(state, cfg, topo, k_swap, faults)
+        with phase_scope("sampler"):
+            state = peerswap_step(state, cfg, topo, k_swap, faults)
 
     have0 = state.have  # pre-round holdings (the delivered-count base)
-    state = inject_step(state, meta, cfg)
-    if trace is None:
-        state = broadcast_step(
-            state, meta, cfg, topo, region, k_bcast, faults
-        )
-    else:
-        state, wire = broadcast_step(
-            state, meta, cfg, topo, region, k_bcast, faults, telem=True
-        )
+    with phase_scope("inject"):
+        state = inject_step(state, meta, cfg)
+    with phase_scope("broadcast"):
+        if trace is None:
+            state = broadcast_step(
+                state, meta, cfg, topo, region, k_bcast, faults
+            )
+        else:
+            state, wire = broadcast_step(
+                state, meta, cfg, topo, region, k_bcast, faults,
+                telem=True,
+            )
     # sync pulls granted in round t land in ring slot t+1+fault_delay
     # (≠ slot t: compile_plan/validate guarantee 1+delay < n_delay_slots),
     # so deliver_step can pop slot t AFTER sync_step without ordering
     # hazards — the bi-stream RTT plus any FaultPlan latency
-    if trace is None:
-        state = sync_step(state, meta, cfg, topo, k_sync, faults)
-    else:
-        state, stel = sync_step(
-            state, meta, cfg, topo, k_sync, faults, telem=True
-        )
-    state = deliver_step(state, cfg)
-    state = swim_step(state, cfg, topo, k_swim, faults)
+    with phase_scope("sync"):
+        if trace is None:
+            state = sync_step(state, meta, cfg, topo, k_sync, faults)
+        else:
+            state, stel = sync_step(
+                state, meta, cfg, topo, k_sync, faults, telem=True
+            )
+    with phase_scope("deliver"):
+        state = deliver_step(state, cfg)
+    with phase_scope("swim"):
+        state = swim_step(state, cfg, topo, k_swim, faults)
 
     # refresh the advertised bookkeeping tensors from this round's chunk
     # arrivals (generate_sync's snapshot; next round's sync reads them)
-    touched = touched_versions(state.have, cfg)  # [N, A, V]
-    heads = version_heads(touched)  # [N, A]
-    gaps = extract_gaps(touched, heads, cfg)
-    state = state._replace(heads=heads, gap_lo=gaps.lo, gap_hi=gaps.hi)
-    overflow_frac = jnp.maximum(
-        metrics.overflow_frac, gaps.overflow.mean(dtype=jnp.float32)
-    )
+    with phase_scope("gaps"):
+        touched = touched_versions(state.have, cfg)  # [N, A, V]
+        heads = version_heads(touched)  # [N, A]
+        gaps = extract_gaps(touched, heads, cfg)
+        state = state._replace(
+            heads=heads, gap_lo=gaps.lo, gap_hi=gaps.hi
+        )
+        overflow_frac = jnp.maximum(
+            metrics.overflow_frac, gaps.overflow.mean(dtype=jnp.float32)
+        )
 
     # convergence bookkeeping: a node holds a version only when EVERY
     # chunk arrived (the fully-buffered apply gate, util.rs:986-1005);
     # only versions that actually entered the system count (a dead
     # origin's commits never existed cluster-wide)
-    up = state.alive == ALIVE  # [N]
-    comp = complete_versions(state.have, cfg)  # [N, A, V]
-    act = version_active(state.injected, cfg)  # [A, V]
+    with phase_scope("converge"):
+        up = state.alive == ALIVE  # [N]
+        comp = complete_versions(state.have, cfg)  # [N, A, V]
+        act = version_active(state.injected, cfg)  # [A, V]
 
-    version_done = (
-        jnp.all(comp | ~up[:, None, None], axis=0) & act
-    )  # [A, V] applied at every up node
-    payload_done = grid_to_payload(version_done, cfg)  # [P]
-    coverage_at = jnp.where(
-        (metrics.coverage_at < 0) & payload_done, state.t, metrics.coverage_at
-    )
-    node_done = jnp.all(comp | ~act[None], axis=(1, 2)) & up  # [N]
-    all_injected = jnp.all(meta.round <= state.t)
-    converged_at = jnp.where(
-        (metrics.converged_at < 0) & node_done & all_injected,
-        state.t,
-        metrics.converged_at,
-    )
-
-    # delivery-order invariant (ISSUE 11): counted on-device every round
-    # of an ordering-variant run — `touched`/`comp` are already
-    # materialized above, so the check is pure grid algebra.  A
-    # trace-time branch: ordering="none" compiles the pre-change program
-    # and carries the constant 0.
-    order_violations = metrics.order_violations
-    if cfg.ordering != "none":
-        from .invariants import order_violation_count
-
-        order_violations = order_violations + order_violation_count(
-            touched, comp, meta, cfg
+        version_done = (
+            jnp.all(comp | ~up[:, None, None], axis=0) & act
+        )  # [A, V] applied at every up node
+        payload_done = grid_to_payload(version_done, cfg)  # [P]
+        coverage_at = jnp.where(
+            (metrics.coverage_at < 0) & payload_done,
+            state.t,
+            metrics.coverage_at,
         )
+        node_done = jnp.all(comp | ~act[None], axis=(1, 2)) & up  # [N]
+        all_injected = jnp.all(meta.round <= state.t)
+        converged_at = jnp.where(
+            (metrics.converged_at < 0) & node_done & all_injected,
+            state.t,
+            metrics.converged_at,
+        )
+
+        # delivery-order invariant (ISSUE 11): counted on-device every
+        # round of an ordering-variant run — `touched`/`comp` are
+        # already materialized above, so the check is pure grid algebra.
+        # A trace-time branch: ordering="none" compiles the pre-change
+        # program and carries the constant 0.
+        order_violations = metrics.order_violations
+        if cfg.ordering != "none":
+            from .invariants import order_violation_count
+
+            order_violations = order_violations + order_violation_count(
+                touched, comp, meta, cfg
+            )
 
     out_metrics = RunMetrics(
         coverage_at=coverage_at,
@@ -208,43 +222,44 @@ def round_step(
             word_coverage_delivered,
         )
 
-        if cfg.n_payloads % 32 == 0:
-            # word-domain counters (pack once, 32 shifted reductions):
-            # ~10× cheaper than the bool pass, and the exact integers
-            # the packed round computes on its native words
-            from .packed import pack_bits
+        with phase_scope("telemetry"):
+            if cfg.n_payloads % 32 == 0:
+                # word-domain counters (pack once, 32 shifted
+                # reductions): ~10× cheaper than the bool pass, and the
+                # exact integers the packed round computes on its words
+                from .packed import pack_bits
 
-            coverage, delivered = word_coverage_delivered(
-                pack_bits(state.have),
-                pack_bits(have0),
-                up,
-                cfg.n_payloads,
+                coverage, delivered = word_coverage_delivered(
+                    pack_bits(state.have),
+                    pack_bits(have0),
+                    up,
+                    cfg.n_payloads,
+                )
+            else:
+                # P outside the word envelope (e.g. membership configs'
+                # single payload) — small by construction, the bool pass
+                # is fine and the packed path can't run here anyway
+                held = state.have > 0
+                coverage = jnp.sum(
+                    held & up[:, None], axis=0, dtype=jnp.int32
+                )
+                delivered = jnp.sum(
+                    held & ~(have0 > 0), axis=0, dtype=jnp.int32
+                )
+            susp, dn = swim_belief_counts(state, cfg)
+            trace = record_round(
+                trace,
+                state.t,
+                coverage=coverage,
+                delivered=delivered,
+                up_nodes=jnp.sum(up, dtype=jnp.int32),
+                wire=wire,
+                sync=stel,
+                swim_suspect=susp,
+                swim_down=dn,
+                gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
+                every=cfg.trace_every,
             )
-        else:
-            # P outside the word envelope (e.g. membership configs'
-            # single payload) — small by construction, the bool pass
-            # is fine and the packed path can't run here anyway
-            held = state.have > 0
-            coverage = jnp.sum(
-                held & up[:, None], axis=0, dtype=jnp.int32
-            )
-            delivered = jnp.sum(
-                held & ~(have0 > 0), axis=0, dtype=jnp.int32
-            )
-        susp, dn = swim_belief_counts(state, cfg)
-        trace = record_round(
-            trace,
-            state.t,
-            coverage=coverage,
-            delivered=delivered,
-            up_nodes=jnp.sum(up, dtype=jnp.int32),
-            wire=wire,
-            sync=stel,
-            swim_suspect=susp,
-            swim_down=dn,
-            gap_overflow=jnp.sum(gaps.overflow, dtype=jnp.int32),
-            every=cfg.trace_every,
-        )
     state = state._replace(t=state.t + 1)
     if trace is not None:
         return state, out_metrics, trace

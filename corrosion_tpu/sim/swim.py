@@ -31,6 +31,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .profile import phase_scope
 from .state import ALIVE, DOWN, SUSPECT, SimConfig, SimState
 from .topology import Topology
 
@@ -71,32 +72,40 @@ def sample_member_targets(
     survivors into the first slots.  Uncoupled or oracle-membership
     runs skip only the belief filter (ground-truth delivery masks still
     apply).
+
+    The whole draw is scoped ``corro.sampler`` (profile.py): it runs
+    nested inside the sync/swim phases, and innermost-wins attribution
+    pulls the member draws out of them into the sampler ledger line —
+    every variant (uniform, PeerSwap view, partial view) included.
     """
-    if cfg.swim_partial_view and cfg.couple_membership:
-        from .pswim import psample_member_targets
+    with phase_scope("sampler"):
+        if cfg.swim_partial_view and cfg.couple_membership:
+            from .pswim import psample_member_targets
 
-        return psample_member_targets(state, cfg, key, count)
-    if cfg.peer_sampler == "peerswap":
-        # the pluggable peer-selection seam (ISSUE 9): candidates come
-        # from the node's PeerSwap view instead of a uniform draw; the
-        # filters and compaction below are shared.  A trace-time branch
-        # — the uniform default compiles the exact legacy kernel.
-        from ..topo.sampler import psample_view_targets
+            return psample_member_targets(state, cfg, key, count)
+        if cfg.peer_sampler == "peerswap":
+            # the pluggable peer-selection seam (ISSUE 9): candidates
+            # come from the node's PeerSwap view instead of a uniform
+            # draw; the filters and compaction below are shared.  A
+            # trace-time branch — the uniform default compiles the exact
+            # legacy kernel.
+            from ..topo.sampler import psample_view_targets
 
-        return psample_view_targets(state, cfg, key, count)
-    n = state.alive.shape[0]
-    # 4× oversample: with fraction d of members believed DOWN, expected
-    # filled slots ≈ 4·count·(1-d) — still ≥ count at d=0.75, so coupled
-    # runs don't starve fanout beyond what the reference's pick-from-list
-    # sampling would (it only falls short when the live list itself is)
-    over = 4 * count
-    cand = jax.random.randint(key, (over, n), 0, n, jnp.int32)
-    me = jnp.arange(n, dtype=jnp.int32)[None, :]
-    valid = cand != me
-    if cfg.swim_full_view and cfg.couple_membership:
-        valid &= state.view[me, cand] != DOWN
-    valid &= ~_dup_before(cand, valid)
-    return _compact_targets(cand, valid, count)
+            return psample_view_targets(state, cfg, key, count)
+        n = state.alive.shape[0]
+        # 4× oversample: with fraction d of members believed DOWN,
+        # expected filled slots ≈ 4·count·(1-d) — still ≥ count at
+        # d=0.75, so coupled runs don't starve fanout beyond what the
+        # reference's pick-from-list sampling would (it only falls short
+        # when the live list itself is)
+        over = 4 * count
+        cand = jax.random.randint(key, (over, n), 0, n, jnp.int32)
+        me = jnp.arange(n, dtype=jnp.int32)[None, :]
+        valid = cand != me
+        if cfg.swim_full_view and cfg.couple_membership:
+            valid &= state.view[me, cand] != DOWN
+        valid &= ~_dup_before(cand, valid)
+        return _compact_targets(cand, valid, count)
 
 
 def _dup_before(cand: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from .gaps import gaps_to_mask
+from .profile import phase_scope
 from .state import (
     PayloadMeta,
     SimConfig,
@@ -184,12 +185,23 @@ def sync_step(
                 refused_cnt = jnp.sum(ok & refused, dtype=jnp.int32)
             ok &= ~refused
 
-    need = edge_needs(state, cfg, src, dst, regular_fanout=s) & ok[:, None]  # [E, P]
+    # self-scoped "sync" (nested inside the round's scope — same phase,
+    # so attribution is unchanged there) so direct microbench callers
+    # (doc/experiments/round_phase_profile.py) attribute the hot
+    # needs/grant pipeline too
+    with phase_scope("sync"):
+        need = (
+            edge_needs(state, cfg, src, dst, regular_fanout=s)
+            & ok[:, None]
+        )  # [E, P]
 
-    # oldest-first budget: the payload axis is version-major BY
-    # CONSTRUCTION (uniform_payloads), so index order is already global
-    # (version, actor) request order — no per-round permutation needed
-    granted = budget_prefix_mask(need, cfg.sync_budget_bytes, meta.nbytes)
+        # oldest-first budget: the payload axis is version-major BY
+        # CONSTRUCTION (uniform_payloads), so index order is already
+        # global (version, actor) request order — no per-round
+        # permutation needed
+        granted = budget_prefix_mask(
+            need, cfg.sync_budget_bytes, meta.nbytes
+        )
     if telem:
         # pin ONE materialization (the packed twin does the same): the
         # telemetry grant counts below add a reduce consumer to
@@ -264,13 +276,19 @@ def sync_step(
     # so both paths' sync channels agree bit-for-bit
     from .telemetry import SyncTel
 
-    counts = jnp.sum(granted, axis=0, dtype=jnp.int32)  # [P]
-    tel = SyncTel(
-        sessions=jnp.sum(ok, dtype=jnp.int32),
-        refused=refused_cnt,
-        frames=jnp.sum(counts, dtype=jnp.int32),
-        bytes=jnp.dot(
-            counts.astype(jnp.float32), meta.nbytes.astype(jnp.float32)
-        ),
-    )
+    # innermost scope wins: these reductions are TELEMETRY cost even
+    # though they live in the sync kernel — the ledger's telemetry
+    # fraction is what the ±5-point cross-check against
+    # measure_overhead_pair's interleaved number gates on
+    with phase_scope("telemetry"):
+        counts = jnp.sum(granted, axis=0, dtype=jnp.int32)  # [P]
+        tel = SyncTel(
+            sessions=jnp.sum(ok, dtype=jnp.int32),
+            refused=refused_cnt,
+            frames=jnp.sum(counts, dtype=jnp.int32),
+            bytes=jnp.dot(
+                counts.astype(jnp.float32),
+                meta.nbytes.astype(jnp.float32),
+            ),
+        )
     return state, tel

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .profile import phase_scope
 from .state import ALIVE, DOWN, SUSPECT, PayloadMeta, SimConfig, SimState
 from .topology import Topology, regions
 
@@ -217,24 +218,27 @@ def record_round(
     sync; `crashes`/`wipes` ride `record_node_faults` instead (the
     RoundFaults slice lives in the run loop, not the round step).
     ``every`` > 1 routes non-sample rounds to the scratch row
-    (`_trace_row`); 1 writes row t exactly as before."""
-    row = _trace_row(trace, t, every)
-    return trace._replace(
-        coverage=trace.coverage.at[row].set(coverage),
-        delivered=trace.delivered.at[row].set(delivered),
-        up_nodes=trace.up_nodes.at[row].set(up_nodes),
-        bcast_bytes=trace.bcast_bytes.at[row].set(wire.bytes),
-        bcast_frames=trace.bcast_frames.at[row].set(wire.frames),
-        bcast_dropped=trace.bcast_dropped.at[row].set(wire.dropped),
-        bcast_cut=trace.bcast_cut.at[row].set(wire.cut),
-        sync_bytes=trace.sync_bytes.at[row].set(sync.bytes),
-        sync_frames=trace.sync_frames.at[row].set(sync.frames),
-        sync_sessions=trace.sync_sessions.at[row].set(sync.sessions),
-        sync_refused=trace.sync_refused.at[row].set(sync.refused),
-        swim_suspect=trace.swim_suspect.at[row].set(swim_suspect),
-        swim_down=trace.swim_down.at[row].set(swim_down),
-        gap_overflow=trace.gap_overflow.at[row].set(gap_overflow),
-    )
+    (`_trace_row`); 1 writes row t exactly as before.  Self-scoped
+    ``corro.telemetry`` (profile.py): the row writes are flight-recorder
+    cost wherever the caller sits in the phase tree."""
+    with phase_scope("telemetry"):
+        row = _trace_row(trace, t, every)
+        return trace._replace(
+            coverage=trace.coverage.at[row].set(coverage),
+            delivered=trace.delivered.at[row].set(delivered),
+            up_nodes=trace.up_nodes.at[row].set(up_nodes),
+            bcast_bytes=trace.bcast_bytes.at[row].set(wire.bytes),
+            bcast_frames=trace.bcast_frames.at[row].set(wire.frames),
+            bcast_dropped=trace.bcast_dropped.at[row].set(wire.dropped),
+            bcast_cut=trace.bcast_cut.at[row].set(wire.cut),
+            sync_bytes=trace.sync_bytes.at[row].set(sync.bytes),
+            sync_frames=trace.sync_frames.at[row].set(sync.frames),
+            sync_sessions=trace.sync_sessions.at[row].set(sync.sessions),
+            sync_refused=trace.sync_refused.at[row].set(sync.refused),
+            swim_suspect=trace.swim_suspect.at[row].set(swim_suspect),
+            swim_down=trace.swim_down.at[row].set(swim_down),
+            gap_overflow=trace.gap_overflow.at[row].set(gap_overflow),
+        )
 
 
 def record_node_faults(

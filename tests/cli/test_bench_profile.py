@@ -1,0 +1,177 @@
+"""bench_child XLA-profile capture path + bench preflight backoff
+(ISSUE 16 satellites).
+
+Pins the BENCH_XLA_PROFILE contract at the child seam:
+
+- a plain (non-config-owned) attempt wraps the run in a whole-attempt
+  ``jax.profiler`` trace and flushes a trace file into the given dir;
+- when a ``phase_map.json`` is staged alongside, the child folds the
+  trace into a parsed ``phase_profile`` record;
+- capture failures NEVER gate the attempt — both the start_trace
+  failure and the post-capture parse failure land in
+  ``xla_profile_error`` while ``ok`` stays true;
+- capture ownership: rungs whose runner config accepts ``profile_dir``
+  run their own scoped capture, so the child must not nest an outer
+  trace around them (``_config_owns_profile``).
+
+Plus the preflight retry trail: exponential backoff bounded by
+BENCH_PREFLIGHT_BACKOFF_CAP_S, every attempt recorded in
+``_diag["preflight_attempts"]``.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+import bench_child  # noqa: E402
+
+from corrosion_tpu.sim import profile as prof  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Capture ownership (jax-free).
+# ---------------------------------------------------------------------------
+
+
+def test_config_owns_profile_matrix():
+    # the storm rung's verified config runs its own scoped capture
+    assert bench_child._config_owns_profile({"mode": "storm"}) is True
+    # so does the dedicated phase-profile rung
+    assert bench_child._config_owns_profile(
+        {"mode": "aux", "fn": "config_phase_profile"}
+    ) is True
+    # preflight has no config at all → child-owned outer trace
+    assert bench_child._config_owns_profile({"mode": "preflight"}) is False
+    # unknown fn never gates (ownership check is best-effort)
+    assert bench_child._config_owns_profile(
+        {"mode": "aux", "fn": "config_does_not_exist"}
+    ) is False
+
+
+# ---------------------------------------------------------------------------
+# In-process child runs (preflight mode: one tiny matmul).
+# ---------------------------------------------------------------------------
+
+
+def _run_child(monkeypatch, tmp_path, extra_spec=None):
+    out = str(tmp_path / "res.json")
+    spec = {"mode": "preflight", "out": out}
+    spec.update(extra_spec or {})
+    monkeypatch.setattr(sys, "argv", ["bench_child.py", json.dumps(spec)])
+    assert bench_child.main() == 0
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_child_captures_trace_without_map(monkeypatch, tmp_path):
+    pdir = str(tmp_path / "xla_prof")
+    res = _run_child(monkeypatch, tmp_path, {"xla_profile": pdir})
+    assert res["ok"] is True
+    assert res["xla_profile"] == pdir
+    assert "xla_profile_error" not in res
+    # the trace flushed where the offline parser will look for it
+    assert os.path.exists(prof.find_trace_file(pdir))
+    # no staged phase_map → no attribution attempted
+    assert "phase_profile" not in res
+
+
+def test_child_attaches_phase_profile_with_staged_map(monkeypatch, tmp_path):
+    pdir = str(tmp_path / "xla_prof")
+    os.makedirs(pdir)
+    # a staged map whose module won't match this attempt's ops: the fold
+    # still runs and returns a well-formed (all-residual-zero) record —
+    # a stale map attributes nothing rather than lying
+    prof.write_phase_map(pdir, [
+        'HloModule jit_other\n\nENTRY %main (p0: f32[2]) -> f32[2] {\n'
+        '  %x = f32[2] add(f32[2] %p0, f32[2] %p0), '
+        'metadata={op_name="jit(r)/corro.sync/add"}\n}\n'
+    ])
+    res = _run_child(monkeypatch, tmp_path, {"xla_profile": pdir})
+    assert res["ok"] is True
+    assert "xla_profile_error" not in res
+    rec = res["phase_profile"]
+    assert rec["kind"] == "phase_profile"
+    assert set(rec["phases"]) == set(prof.PHASES)
+    assert rec["device_events"] == 0
+
+
+def test_child_surfaces_parse_failure_without_gating(monkeypatch, tmp_path):
+    pdir = str(tmp_path / "xla_prof")
+    os.makedirs(pdir)
+    # corrupt staged map → parse_phase_profile raises → recorded, run ok
+    with open(os.path.join(pdir, "phase_map.json"), "w") as f:
+        f.write("{not json")
+    res = _run_child(monkeypatch, tmp_path, {"xla_profile": pdir})
+    assert res["ok"] is True
+    assert "phase_profile" not in res
+    assert "JSONDecodeError" in res["xla_profile_error"]
+
+
+def test_child_surfaces_start_trace_failure_without_gating(
+    monkeypatch, tmp_path
+):
+    import jax
+
+    def boom(*a, **kw):
+        raise RuntimeError("profiler backend unavailable")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    pdir = str(tmp_path / "xla_prof")
+    res = _run_child(monkeypatch, tmp_path, {"xla_profile": pdir})
+    # the attempt itself still lands
+    assert res["ok"] is True
+    assert res["xla_profile_error"].startswith("RuntimeError")
+    assert "xla_profile" not in res and "phase_profile" not in res
+
+
+# ---------------------------------------------------------------------------
+# Preflight retry trail (bench.py, jax-free).
+# ---------------------------------------------------------------------------
+
+
+def _reset_diag(monkeypatch):
+    monkeypatch.setitem(bench._diag, "attempts", [])
+    monkeypatch.setitem(bench._diag, "preflight_attempts", [])
+    monkeypatch.setattr(bench, "_write_diag", lambda: None)
+    monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+
+
+def test_preflight_backoff_trail_bounded(monkeypatch):
+    _reset_diag(monkeypatch)
+    monkeypatch.setenv("BENCH_PREFLIGHT_BACKOFF_CAP_S", "3")
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    monkeypatch.setattr(
+        bench, "run_child",
+        lambda spec, timeout: {"ok": False, "error": "boom", "wall_s": 0.1},
+    )
+    assert bench.preflight() is None
+    trail = bench._diag["preflight_attempts"]
+    assert [t["attempt"] for t in trail] == [1, 2, 3, 4]
+    assert all(t["ok"] is False and t["error"] == "boom" for t in trail)
+    # exponential, clamped at the cap — a dead backend can't eat the
+    # storm budget in sleeps
+    assert [t["backoff_s"] for t in trail] == [1.0, 2.0, 3.0, 3.0]
+    assert sleeps == [1.0, 2.0, 3.0, 3.0]
+
+
+def test_preflight_trail_records_success(monkeypatch):
+    _reset_diag(monkeypatch)
+    calls = {"n": 0}
+
+    def flaky(spec, timeout):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return {"ok": False, "error": "tunnel wedge", "wall_s": 0.2}
+        return {"ok": True, "platform": "cpu", "wall_s": 0.3}
+
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "run_child", flaky)
+    assert bench.preflight() == ("", "cpu")
+    trail = bench._diag["preflight_attempts"]
+    assert len(trail) == 2
+    assert trail[0]["ok"] is False and trail[0]["backoff_s"] == 1.0
+    assert trail[1]["ok"] is True and "backoff_s" not in trail[1]
