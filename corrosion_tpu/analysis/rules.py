@@ -33,6 +33,11 @@ fixed (doc/lint.md carries the full incident write-ups):
   string (or ``phase_scope`` key) in the sim tier that isn't in the
   sim/profile.py ``PHASES`` registry silently dumps its device time
   into the unattributed residual of the phase ledger.
+- CT011 — ISSUE 19's second-pass class: a per-bit reduction loop over
+  round-kernel state words (a reduction whose operand right-shifts the
+  words by a ``range(32)`` loop variable) re-traverses the full array
+  32 times — the exact counter anti-pattern the fused one-pass
+  traversal (sim/fused.py) removed; only the oracle there may keep it.
 """
 
 from __future__ import annotations
@@ -759,6 +764,101 @@ class UnregisteredPhaseScope(Rule):
                         )
 
 
+#: the fused one-pass traversal module (ISSUE 19) — the ONLY sanctioned
+#: home for per-bit loop forms: it keeps them as the CORRO_FUSED_ROUND
+#: legacy oracle that tests/sim/test_fused.py holds the fused forms to
+FUSED_FILE = "corrosion_tpu/sim/fused.py"
+
+_REDUCTION_CALLS = {"jax.numpy.sum", "numpy.sum"}
+
+
+def _is_range32(iter_node: ast.AST) -> bool:
+    """``range(32)`` as a literal call — the bit-lane unroll shape."""
+    return (
+        isinstance(iter_node, ast.Call)
+        and isinstance(iter_node.func, ast.Name)
+        and iter_node.func.id == "range"
+        and len(iter_node.args) == 1
+        and isinstance(iter_node.args[0], ast.Constant)
+        and iter_node.args[0].value == 32
+    )
+
+
+def _range32_loops(
+    tree: ast.AST,
+) -> Iterable[Tuple[str, List[ast.AST]]]:
+    """(loop variable name, body nodes to search) for every
+    ``for ... in range(32)`` statement and comprehension generator."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            if _is_range32(node.iter) and isinstance(node.target, ast.Name):
+                yield node.target.id, list(node.body)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                if _is_range32(gen.iter) and isinstance(gen.target, ast.Name):
+                    yield gen.target.id, [node.elt]
+
+
+def _shifts_by(call: ast.Call, var: str) -> bool:
+    """The call's operand right-shifts something by the loop variable
+    (directly, ``w >> j``, or wrapped, ``w >> jnp.uint32(j)``)."""
+    for sub in ast.walk(call):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.RShift):
+            for leaf in ast.walk(sub.right):
+                if isinstance(leaf, ast.Name) and leaf.id == var:
+                    return True
+    return False
+
+
+class PerBitReductionLoop(Rule):
+    """CT011: no per-bit reduction loops over round-kernel state words
+    outside the fused traversal helpers.  A reduction whose operand
+    right-shifts the u32 words by the loop variable of a ``range(32)``
+    loop re-reads the full array once per bit — 32 memory passes where
+    the one-pass bit-plane expansion in sim/fused.py does one (ISSUE
+    19; the shape XLA fuses into a single traversal).  fused.py itself
+    is exempt: it keeps the loop forms as the ``CORRO_FUSED_ROUND``
+    legacy oracle the equality tests pin the fused forms against."""
+
+    code = "CT011"
+    name = "per-bit-reduction-loop"
+    incident = (
+        "ISSUE 19: telemetry counters re-walked the round's packed "
+        "words as 32 shifted reductions each — a second full memory "
+        "pass per round that held packed telemetry overhead at ~20%"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Tuple[str, int, str]]:
+        for sf in ctx.under(*SIM_TIER):
+            if sf.tree is None or sf.relpath == FUSED_FILE:
+                continue
+            idx = ModuleIndex(sf)
+            for var, roots in _range32_loops(sf.tree):
+                for root in roots:
+                    for node in ast.walk(root):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        dotted = idx.canonical(node.func) or ""
+                        is_sum = dotted in _REDUCTION_CALLS or (
+                            isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "sum"
+                        )
+                        if not (is_sum and _shifts_by(node, var)):
+                            continue
+                        yield (
+                            sf.relpath,
+                            node.lineno,
+                            f"per-bit reduction in a range(32) loop "
+                            f"(sum over words >> {var}) re-traverses "
+                            "the full state array once per bit — 32 "
+                            "memory passes; use the one-pass helpers "
+                            "in sim/fused.py (word_bit_counts / "
+                            "word_byte_totals / word_send_stats) or "
+                            "add a SWAR/byte-LUT helper there — only "
+                            "fused.py may keep the legacy oracle form",
+                        )
+
+
 RULES = [
     UnalignedU8Draw,
     HostSyncInKernel,
@@ -769,4 +869,5 @@ RULES = [
     UnboundedQueueInHostTier,
     UnboundedNetworkAwait,
     UnregisteredPhaseScope,
+    PerBitReductionLoop,
 ]

@@ -1042,6 +1042,21 @@ def cmd_profile(args) -> int:
             )
             if k in carrier
         }
+        if args.phase_max:
+            caps = {}
+            for item in args.phase_max:
+                name, sep, val = item.partition("=")
+                try:
+                    cap = float(val) if sep else None
+                except ValueError:
+                    cap = None
+                if not name or cap is None or not 0.0 < cap <= 1.0:
+                    raise SystemExit(
+                        f"error: --phase-max wants PHASE=FRAC with "
+                        f"FRAC in (0, 1], got {item!r}"
+                    )
+                caps[name] = cap
+            extra["phase_frac_max"] = caps
         tol = args.tol if args.tol is not None else prof.DEFAULT_PHASE_TOL
         doc = prof.baseline_from_profile(
             cand, scenario="phase-profile", tol=tol, extra=extra
@@ -1647,6 +1662,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--tol", type=float, default=None,
         help="profile baseline: per-phase fraction tolerance "
         "(default 0.05; widen to absorb box scheduling variance)",
+    )
+    sm.add_argument(
+        "--phase-max", action="append", metavar="PHASE=FRAC",
+        help="profile baseline: one-sided phase-fraction CEILING "
+        "(repeatable), written into the baseline as phase_frac_max — "
+        "unlike the two-sided ± tol bands, a ceiling only pages when "
+        "the phase GROWS (the fused-round gate pins corro.telemetry "
+        "below its pre-fusion share; ISSUE 19)",
     )
     sm.add_argument(
         "--telemetry", action="store_true",

@@ -230,13 +230,16 @@ def broadcast_step(
     state = state._replace(inflight=inflight, relay_left=relay_left)
     if not telem:
         return state
-    # wire telemetry off the hot path: transmitted frames/bytes fold
-    # per-NODE sending stats (one [N, P] pass) over the [E]-shaped edge
-    # mask — no extra [E, P] traversal; the drop count packs the loss
-    # mask to words and popcounts, and only when a loss class exists at
-    # trace time.  The packed kernel computes the SAME quantities from
-    # identical-valued tensors with identical reduction shapes, so the
-    # two paths' channels agree bit-for-bit (test_telemetry pins it).
+    # wire telemetry off the hot path: per-node transmitted frames AND
+    # byte totals come out of ONE pass over the `sending` bools
+    # (fused.dense_send_stats — the same loads the ring scatter's `sent`
+    # mask consumed), folded over the [E]-shaped edge mask — no extra
+    # [E, P] traversal; the drop count packs the loss mask to words and
+    # popcounts, and only when a loss class exists at trace time.  The
+    # packed kernel computes the SAME quantities from identical-valued
+    # tensors with identical reduction shapes, so the two paths'
+    # channels agree bit-for-bit (test_telemetry pins it).
+    from .fused import dense_send_stats
     from .profile import phase_scope
     from .telemetry import WireTel
 
@@ -245,14 +248,10 @@ def broadcast_step(
     # telemetry fraction is cross-checked against the interleaved
     # overhead measurement
     with phase_scope("telemetry"):
-        send_frames = jnp.sum(sending, axis=-1, dtype=jnp.int32)  # [N]
-        # exact i32 per-node byte totals — the identical integers the
-        # packed twin computes on words, so the f32 fold below matches
+        # exact i32 per-node totals — the identical integers the packed
+        # twin computes on words, so the f32 fold below matches
         # bit-for-bit
-        send_bytes = jnp.sum(
-            jnp.where(sending, meta.nbytes[None, :], 0), axis=-1,
-            dtype=jnp.int32,
-        )  # [N]
+        send_frames, send_bytes = dense_send_stats(sending, meta.nbytes)
         okf = ok.reshape(n, f)
         frames = jnp.sum(
             jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32

@@ -1,0 +1,244 @@
+"""One-pass fused traversal helpers for the round kernels (ISSUE 19).
+
+The flight-recorder counters used to be a SECOND trip over the round's
+hottest tensors: `word_bit_counts` issued 32 separate shifted reductions
+over the u32 payload words, `word_byte_totals` accumulated a 32-iteration
+Python loop of masked sums, and the broadcast kernels computed per-node
+frame and byte totals as two independent passes over the same ``sending``
+buffer.  At the 100k storm shape that second trip was the bulk of the
+~20% telemetry-on overhead (doc/experiments/PROFILE_BASELINE.json's
+``corro.telemetry`` ledger line).
+
+This module holds BOTH forms of every traversal:
+
+- the **fused** form — a formulation whose total memory traffic is a
+  small constant number of trips over the words instead of one per bit.
+  Two building blocks, picked per reduction direction and verified on
+  the 25k-node bench shape (where XLA CPU *materializes* the naive
+  ``[..., W, 32]`` bit-plane broadcast instead of fusing it, making the
+  textbook one-pass expression 4x SLOWER than the loops it replaced):
+
+  * **SWAR nibble accumulators** for cross-row bit-position counts
+    (`word_bit_counts`): fifteen rows sum into packed 4-bit lanes of a
+    u32 (a nibble saturates at 15), four shifted lane groups cover all
+    32 bit positions, and the 15x-smaller partials finish in i32.  Four
+    reads of the words replace 32 — measured 4.1x faster at [25k, 16].
+  * **byte-LUT folds** for within-row weighted totals
+    (`word_byte_totals`, the bytes half of `word_send_stats`): a
+    ``[4W, 256]`` table maps (byte position, byte value) to the exact
+    i32 sum of that byte's selected payload sizes; one shift-extracted
+    byte view plus one gather replaces the 32-iteration masked
+    accumulation — measured 2.4x faster at [25k, 16].
+
+- the **legacy** form — the exact per-bit loops the fused expressions
+  replaced, kept verbatim as the reference oracle.
+
+Both forms produce the SAME exact integers: every intermediate is exact
+integer arithmetic (nibble lanes cannot overflow at chunk 15, table
+entries are i32 partial sums of the same addends), i32 addition is
+associative and commutative, and the final f32 folds consume
+identically-valued i32 inputs — so every pinned digest (dense==packed
+bit-equality, proto families, solo==vmapped==mesh-sharded byte-identity,
+campaign baselines) is unmoved by the seam position.
+
+The seam: ``CORRO_FUSED_ROUND`` is read at TRACE TIME (like profile.py's
+``CORRO_PHASE_SCOPES``), default ON; ``=0`` selects the legacy oracle.
+The env var is not part of the jit cache key — tests toggling it must
+``jax.clear_caches()`` between settings (tests/sim/test_fused.py and the
+proto-family matrix in tests/sim/test_proto.py do).
+
+corrolint CT011 flags the legacy anti-pattern — a per-bit reduction loop
+over round-kernel state words — everywhere EXCEPT this module: the loops
+below are the oracle and the only sanctioned home for that shape.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# numpy on purpose (see packed.ONES): module-level jnp constants would be
+# created inside whichever trace first imports this module and leak as
+# tracers into every later jit; numpy arrays convert per-use
+_NIBBLE_LANES = np.uint32(0x11111111)  # bit j of every nibble: lane group j
+_NIBBLE_CHUNK = 15  # a 4-bit lane saturates at 15 rows
+_NIBBLE_UNPACK = np.arange(8, dtype=np.uint32) * np.uint32(4)
+_BYTE_SHIFTS = (np.arange(4, dtype=np.uint32) * np.uint32(8))
+# [256, 8] bit matrix of byte values — the LUT builder's static half
+_BYTE_BITS = (
+    (np.arange(256, dtype=np.uint32)[:, None] >> np.arange(8, dtype=np.uint32))
+    & np.uint32(1)
+).astype(np.int32)
+
+
+def fused_round_enabled() -> bool:
+    """Trace-time seam: fused one-pass traversals (default) vs the legacy
+    per-bit-loop oracle.  Mirrors profile.py's CORRO_PHASE_SCOPES
+    discipline — read when the kernel TRACES, not when it runs, and
+    invisible to the jit cache key (toggle + jax.clear_caches in tests)."""
+    return os.environ.get("CORRO_FUSED_ROUND", "1") != "0"
+
+
+def _byte_view(words: jnp.ndarray) -> jnp.ndarray:
+    """i32[..., 4W] shift-extracted byte view of u32 words, little-endian
+    within each word (byte b holds bits 8b..8b+7 = payloads 32k+8b..+7).
+    Shifts, not ``bitcast_convert_type``: the narrowing bitcast's minor-
+    dim ordering is backend-defined, and it measured slower on CPU."""
+    by = (words[..., None] >> _BYTE_SHIFTS) & np.uint32(0xFF)
+    return by.reshape(words.shape[:-1] + (words.shape[-1] * 4,)).astype(
+        jnp.int32
+    )
+
+
+def _byte_weight_table(nbytes: jnp.ndarray, w: int) -> jnp.ndarray:
+    """i32[4W, 256] fold table: entry [b, v] is the exact i32 sum of
+    payload sizes selected by byte value ``v`` at byte position ``b``.
+    4W*256 entries from a [P] vector — building it is noise next to one
+    traversal of the words it saves."""
+    nbb = nbytes.astype(jnp.int32).reshape(w * 4, 8)
+    return jnp.dot(nbb, jnp.asarray(_BYTE_BITS.T))
+
+
+# -- per-payload bit counts (coverage / delivered / sync grant counts) -------
+
+
+def word_bit_counts(words: jnp.ndarray, n_payloads: int) -> jnp.ndarray:
+    """i32[P] per-bit-position set counts over the leading (node or edge)
+    axis of u32 payload words — the per-payload coverage/delivered/grant
+    counters.  Fused: SWAR nibble accumulators — rows sum 15 at a time
+    into packed 4-bit lanes (4 shifted reads of the words instead of 32),
+    then the 15x-smaller u32 partials unpack and finish in i32.  Legacy:
+    32 separate shifted reductions.  Same exact integers either way: a
+    nibble lane counts at most 15 ones, so no lane ever carries into its
+    neighbour, and i32 addition is order-insensitive."""
+    # NOTE: callers whose ``words`` is a large fused expression must pin
+    # it with lax.optimization_barrier AT THE SOURCE (so every consumer
+    # shares one materialization) — a barrier here would pin a private
+    # copy and duplicate the producer pipeline instead
+    if fused_round_enabled():
+        n, w = words.shape
+        # head/tail split, NOT pad-and-concat: padding n to a multiple of
+        # 15 would pay a full-array copy (an extra memory pass — the very
+        # thing this module removes) whenever 15 ∤ n, which includes the
+        # bench shapes (25600, 100000).  The remainder rows run through
+        # the same lane trick as one short chunk (< 15 rows cannot carry
+        # either), and a prefix slice fuses where a concat never does.
+        g15 = (n // _NIBBLE_CHUNK) * _NIBBLE_CHUNK
+        grouped = words[:g15].reshape(-1, _NIBBLE_CHUNK, w)
+        # [4, G, W] u32: lane group j's nibble k counts bit position
+        # j + 4k over its 15-row group
+        accs = jnp.stack(
+            [
+                jnp.sum((grouped >> np.uint32(lane)) & _NIBBLE_LANES, axis=1)
+                for lane in range(4)
+            ]
+        )
+        # unpack all 8 nibbles at once over the 15x-smaller partials and
+        # finish in i32; [4, W, 8] → [W, 8, 4] flattens as 4k + lane = bit
+        nibs = (accs[..., None] >> _NIBBLE_UNPACK) & np.uint32(0xF)
+        part = jnp.sum(nibs, axis=1, dtype=jnp.int32)
+        if g15 < n:
+            tail = words[g15:][None]  # one short chunk [1, n-g15, W]
+            taccs = jnp.stack(
+                [
+                    jnp.sum((tail >> np.uint32(lane)) & _NIBBLE_LANES, axis=1)
+                    for lane in range(4)
+                ]
+            )
+            tnibs = (taccs[..., None] >> _NIBBLE_UNPACK) & np.uint32(0xF)
+            part = part + jnp.sum(tnibs, axis=1, dtype=jnp.int32)
+        return jnp.transpose(part, (1, 2, 0)).reshape(n_payloads)
+    one = jnp.uint32(1)
+    cols = [
+        jnp.sum((words >> jnp.uint32(j)) & one, axis=0, dtype=jnp.int32)
+        for j in range(32)  # corrolint: disable=CT011 — the legacy oracle
+    ]
+    return jnp.stack(cols, axis=-1).reshape(n_payloads)  # [W, 32] → [P]
+
+
+# -- masked per-row byte totals (wire-byte accounting) -----------------------
+
+
+def word_byte_totals(words: jnp.ndarray, nbytes: jnp.ndarray) -> jnp.ndarray:
+    """i32[...] masked per-row byte totals of u32 bit-words — the packed
+    twin of ``where(granted, nbytes, 0).sum(-1)``: exact integer totals
+    wherever a row's selected bytes stay under i32 (every current
+    scenario: the payload-size validator caps P·64 KiB well below the
+    exactness envelope the budget kernels already assume), so the packed
+    and dense byte channels agree bit-for-bit before the final f32 fold.
+    Fused: one byte-LUT gather — each of the row's 4W bytes indexes its
+    own 256-entry column of exact i32 partial sums, one trip over the
+    words; legacy: a 32-iteration accumulation loop."""
+    w = words.shape[-1]
+    if fused_round_enabled():
+        table = _byte_weight_table(nbytes, w)
+        picked = table[jnp.arange(w * 4), _byte_view(words)]
+        return jnp.sum(picked, axis=-1)
+    nb = nbytes.astype(jnp.int32).reshape(w, 32)
+    tot = jnp.zeros(words.shape[:-1], jnp.int32)
+    for j in range(32):  # corrolint: disable=CT011 — the legacy oracle
+        bit = ((words >> j) & jnp.uint32(1)).astype(jnp.int32)
+        tot = tot + (bit * nb[None, :, j]).sum(axis=-1)
+    return tot
+
+
+# -- combined per-node send stats (frames + bytes from the same loads) -------
+
+
+def word_send_stats(
+    sending: jnp.ndarray, nbytes: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(frames i32[N], bytes i32[N]) per-node wire totals of a packed
+    send set ``sending[N, W]`` — what broadcast telemetry folds over the
+    edge mask.  Fused: a word popcount for frames plus the byte-LUT fold
+    for bytes — two compact trips over the words the governor just
+    produced, replacing the legacy popcount + 32-iteration byte loop
+    (33 trips)."""
+    frames = jnp.sum(
+        jax.lax.population_count(sending), axis=-1, dtype=jnp.int32
+    )
+    return frames, word_byte_totals(sending, nbytes)
+
+
+def grant_fold(
+    counts: jnp.ndarray, nbytes: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(frames i32, bytes f32) from per-payload sync grant counts — the
+    ONE final fold both sync kernels perform on their [P] count vector
+    (the dense kernel's counts come from a single bool reduction, the
+    packed kernel's from `word_bit_counts`; the integers are identical,
+    so this shared fold keeps the sync channels bit-equal by
+    construction).  [P]-shaped inputs: no traversal to fuse, the point
+    is structural sharing."""
+    return (
+        jnp.sum(counts, dtype=jnp.int32),
+        jnp.dot(
+            counts.astype(jnp.float32), nbytes.astype(jnp.float32)
+        ),
+    )
+
+
+def dense_send_stats(
+    sending: jnp.ndarray, nbytes: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense twin of `word_send_stats`: (frames i32[N], bytes i32[N])
+    from a bool send set ``sending[N, P]``.  Fused: one i32 cast shared
+    by both reductions (one pass — ``where(sending, nbytes, 0)`` equals
+    ``sending * nbytes`` exactly for bool masks and i32 sizes); legacy:
+    two independent masked reductions over the bools.  Identical
+    integers to the packed twin on identical-valued send sets, so the
+    dense and packed wire channels stay bit-equal."""
+    if fused_round_enabled():
+        sb = sending.astype(jnp.int32)  # shared producer for both folds
+        frames = jnp.sum(sb, axis=-1)
+        byte_tot = jnp.sum(sb * nbytes.astype(jnp.int32)[None, :], axis=-1)
+        return frames, byte_tot
+    frames = jnp.sum(sending, axis=-1, dtype=jnp.int32)
+    byte_tot = jnp.sum(
+        jnp.where(sending, nbytes[None, :], 0), axis=-1, dtype=jnp.int32
+    )
+    return frames, byte_tot

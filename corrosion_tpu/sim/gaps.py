@@ -76,31 +76,58 @@ def _extract_gaps_dense(
     # run index (1-based) at every position of its run
     rank = jnp.cumsum(start, axis=2, dtype=jnp.int32)
 
-    # select run boundaries into K slots with K static masked reductions
-    # (runs beyond K contribute 0).  A scatter into [N*A, K] did this
-    # job before, but a 12.8M-element random scatter cost ~300 ms/round
-    # on CPU at the 100k storm shape and scatters are the weakest op on
-    # TPU too — K is small and static, so K fused compare+select+reduce
-    # passes over the V axis beat it on both platforms (r4 profile:
-    # 343 ms → see BENCH_DIAG), with identical results: each (row, slot)
-    # receives AT MOST one boundary, so a masked max ≡ the scatter.
-    los = []
-    his = []
-    for slot_k in range(k):
-        in_slot = rank == slot_k + 1
-        los.append(
-            jnp.where(start & in_slot, v_idx[None, None, :], 0).max(axis=2)
+    # select run boundaries into K slots (runs beyond K contribute 0).
+    # A scatter into [N*A, K] did this job before, but a 12.8M-element
+    # random scatter cost ~300 ms/round on CPU at the 100k storm shape
+    # and scatters are the weakest op on TPU too; the r4 rewrite used K
+    # static masked reductions instead (r4 profile: 343 ms → see
+    # BENCH_DIAG).  Since ISSUE 19 the default is ONE-PASS: both
+    # boundary selections reduce a virtual [N, A, V, K] slot expansion
+    # (XLA fuses the compare+select into the reduce loop — each is one
+    # traversal of the V axis), and the overflow clamp's last-missing
+    # scan rides the SAME hi reduction as a K+1-th column.  Identical
+    # results either way: each (row, slot) receives AT MOST one
+    # boundary, so a masked max ≡ the scatter, and the largest missing
+    # version is always a run END (its successor is non-missing or
+    # absent), so max-over-ends == max-over-missing.  The legacy
+    # 2K+1-reduction form stays behind CORRO_FUSED_ROUND as the oracle
+    # (tests/sim/test_fused.py holds the two equal).
+    from .fused import fused_round_enabled
+
+    overflow = rank[:, :, -1] > k
+    if fused_round_enabled():
+        slot_ids = jnp.arange(1, k + 1, dtype=jnp.int32)  # [K]
+        in_slot = rank[:, :, :, None] == slot_ids  # [N, A, V, K] virtual
+        vcol = v_idx[None, None, :, None]
+        lo = jnp.where(start[..., None] & in_slot, vcol, 0).max(axis=2)
+        # hi + last_missing in one reduction: column K's mask is every
+        # run end, whose max IS the last missing version
+        in_slot_ext = jnp.concatenate(
+            [in_slot, jnp.ones(in_slot.shape[:3] + (1,), bool)], axis=-1
         )
-        his.append(
-            jnp.where(end & in_slot, v_idx[None, None, :], 0).max(axis=2)
-        )
-    lo = jnp.stack(los, axis=-1)  # [N, A, K]
-    hi = jnp.stack(his, axis=-1)
+        hi_ext = jnp.where(
+            end[..., None] & in_slot_ext, vcol, 0
+        ).max(axis=2)  # [N, A, K+1]
+        hi, last_missing = hi_ext[..., :k], hi_ext[..., k]
+    else:
+        los = []
+        his = []
+        for slot_k in range(k):
+            in_slot = rank == slot_k + 1
+            los.append(
+                jnp.where(
+                    start & in_slot, v_idx[None, None, :], 0
+                ).max(axis=2)
+            )
+            his.append(
+                jnp.where(end & in_slot, v_idx[None, None, :], 0).max(axis=2)
+            )
+        lo = jnp.stack(los, axis=-1)  # [N, A, K]
+        hi = jnp.stack(his, axis=-1)
+        last_missing = (missing * v_idx[None, None, :]).max(axis=2)  # [N, A]
 
     # overflow clamp: merge runs K.. into slot K-1 by extending its hi to
     # the last missing version (over-covers; see module docstring)
-    overflow = rank[:, :, -1] > k
-    last_missing = (missing * v_idx[None, None, :]).max(axis=2)  # [N, A]
     hi = hi.at[:, :, k - 1].set(
         jnp.where(overflow, last_missing, hi[:, :, k - 1])
     )

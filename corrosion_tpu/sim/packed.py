@@ -551,19 +551,20 @@ def broadcast_packed(
         return out
     # wire telemetry — same quantities as broadcast_step's telem branch
     # from identical-valued tensors (elig8 == the dense `sending`):
-    # per-node frame counts ride a word popcount, per-node bytes exact
-    # i32 word totals, and the drop count packs the (barrier-pinned)
+    # per-node frames AND bytes come out of ONE pass over the governor's
+    # send words (fused.word_send_stats — the same loads the ring-slot
+    # update consumed), and the drop count packs the (barrier-pinned)
     # loss mask to words + popcounts, emitted only when a loss class
     # exists at trace time — bit-equal traces, none of the hot-path cost
-    from .telemetry import WireTel, word_byte_totals
+    from .fused import word_send_stats
+    from .telemetry import WireTel
 
     # innermost-wins "telemetry" scope: flight-recorder cost, pulled out
     # of the broadcast ledger line (the dense kernel does the same)
     with phase_scope("telemetry"):
-        send_frames = jnp.sum(
-            jax.lax.population_count(sending), axis=-1, dtype=jnp.int32
-        )  # [N]
-        send_bytes = word_byte_totals(sending, meta.nbytes)  # i32[N]
+        send_frames, send_bytes = word_send_stats(
+            sending, meta.nbytes
+        )  # i32[N] each, one traversal
         okf = ok.reshape(n, f)
         frames = jnp.sum(
             jnp.where(okf, send_frames[:, None], 0), dtype=jnp.int32
@@ -1298,24 +1299,24 @@ def sync_packed(
     )
     if not telem:
         return out
-    # session telemetry in the word domain: per-PAYLOAD grant counts via
-    # 32 shifted reductions over the [E, W] words (`word_bit_counts`) —
-    # the exact integers the dense kernel sums over its [E, P] bools —
+    # session telemetry in the word domain: per-PAYLOAD grant counts in
+    # ONE reduction over the [E, W] words (`fused.word_bit_counts`; the
+    # legacy 32-shifted-reduction oracle sits behind CORRO_FUSED_ROUND)
+    # — the exact integers the dense kernel sums over its [E, P] bools —
     # then the identical [P]-shaped f32 dot, so both paths' channels
     # match bit-for-bit
+    from .fused import grant_fold
     from .telemetry import SyncTel, word_bit_counts
 
     # innermost-wins "telemetry" scope: flight-recorder cost, pulled out
     # of the sync ledger line (the dense kernel does the same)
     with phase_scope("telemetry"):
         counts = word_bit_counts(granted, cfg.n_payloads)  # i32[P]
+        frames, byte_tot = grant_fold(counts, meta.nbytes)
         tel = SyncTel(
             sessions=jnp.sum(ok, dtype=jnp.int32),
             refused=refused_cnt,
-            frames=jnp.sum(counts, dtype=jnp.int32),
-            bytes=jnp.dot(
-                counts.astype(jnp.float32),
-                meta.nbytes.astype(jnp.float32),
-            ),
+            frames=frames,
+            bytes=byte_tot,
         )
     return out + (tel,)

@@ -86,6 +86,10 @@ PHASES = {
 # residual is the honest answer.
 FILE_PHASE_HINTS = {
     "broadcast.py": "broadcast",
+    # the fused traversal helpers (ISSUE 19) are only ever called from
+    # telemetry consumers — counter math is flight-recorder cost even
+    # when the expression fuses into a kernel's word pass
+    "fused.py": "telemetry",
     "gaps.py": "gaps",
     "pswim.py": "sampler",
     "swim.py": "swim",
@@ -491,7 +495,10 @@ def baseline_from_profile(
     """Band a measured ``phase_profile`` into a committable baseline:
     per-phase fraction ± tol, plus the unattributed ceiling.  Seconds
     and walls are deliberately NOT banded (the gate must hold across
-    machines; only the phase SHAPE is claimed)."""
+    machines; only the phase SHAPE is claimed).  ``extra`` merges
+    caller keys into the doc — notably ``phase_frac_max`` (one-sided
+    per-phase ceilings, e.g. the ISSUE 19 telemetry-collapse proof),
+    which `compare_profiles` enforces alongside the two-sided bands."""
     doc: Dict[str, object] = {
         "kind": "profile_baseline",
         "scenario": scenario,
@@ -529,6 +536,22 @@ def compare_profiles(
             failures.append(
                 f"phase {name}: frac {got:.4f} outside "
                 f"{base:.4f} ± {tol:.4f}"
+            )
+    # one-sided phase ceilings (``phase_frac_max``, an ISSUE 19 baseline
+    # key): unlike the two-sided bands above, a ceiling encodes "this
+    # phase COLLAPSED into the traversal and must stay collapsed" — the
+    # telemetry ceiling is the mechanical proof a future counter
+    # unfusion regresses red instead of drifting inside a wide band
+    for name, cap in sorted(
+        (baseline.get("phase_frac_max") or {}).items()
+    ):
+        got = float(cand_phases.get(name, {}).get("frac", 0.0))
+        if got > float(cap):
+            failures.append(
+                f"phase {name}: frac {got:.4f} exceeds the "
+                f"{float(cap):.4f} phase_frac_max ceiling (a "
+                "counter-unfusion regression? see doc/telemetry/"
+                "profiling.md, fused round)"
             )
     cap = baseline.get("unattributed_frac_max")
     if cap is not None:
@@ -652,6 +675,13 @@ def render_compare(
         lines.append(
             f"  {name:<12} {band['frac']:>9.1%} {got:>10.1%} "
             f"{band.get('tol', DEFAULT_PHASE_TOL):>6.1%}"
+        )
+    for name, pcap in sorted(
+        (baseline.get("phase_frac_max") or {}).items()
+    ):
+        got = cand_phases.get(name, {}).get("frac", 0.0)
+        lines.append(
+            f"  ceiling {name}: {got:.1%} (max {float(pcap):.1%})"
         )
     un = candidate.get("unattributed", {}).get("frac", 0.0)
     cap = baseline.get("unattributed_frac_max", DEFAULT_UNATTRIBUTED_MAX)

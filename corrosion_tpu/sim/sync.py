@@ -271,9 +271,11 @@ def sync_step(
     if not telem:
         return state
     # session telemetry: per-PAYLOAD grant counts are exact i32 (≤ E per
-    # payload), then one [P]-shaped f32 dot against the size vector —
-    # the identical fold the packed kernel performs on its word counts,
-    # so both paths' sync channels agree bit-for-bit
+    # payload) from ONE pass over the grant bools, then the shared
+    # `fused.grant_fold` — the identical [P]-shaped fold the packed
+    # kernel performs on its word counts, so both paths' sync channels
+    # agree bit-for-bit by construction
+    from .fused import grant_fold
     from .telemetry import SyncTel
 
     # innermost scope wins: these reductions are TELEMETRY cost even
@@ -282,13 +284,11 @@ def sync_step(
     # measure_overhead_pair's interleaved number gates on
     with phase_scope("telemetry"):
         counts = jnp.sum(granted, axis=0, dtype=jnp.int32)  # [P]
+        frames, byte_tot = grant_fold(counts, meta.nbytes)
         tel = SyncTel(
             sessions=jnp.sum(ok, dtype=jnp.int32),
             refused=refused_cnt,
-            frames=jnp.sum(counts, dtype=jnp.int32),
-            bytes=jnp.dot(
-                counts.astype(jnp.float32),
-                meta.nbytes.astype(jnp.float32),
-            ),
+            frames=frames,
+            bytes=byte_tot,
         )
     return state, tel

@@ -279,22 +279,12 @@ def wire_loss_active(topo, faults) -> bool:
     return faults.loss_thr.shape[0] > 0
 
 
-def word_bit_counts(words: jnp.ndarray, n_payloads: int) -> jnp.ndarray:
-    """i32[P] per-bit-position set counts over the leading (node) axis
-    of u32 payload words — the per-payload coverage/delivered counters.
-    32 shifted [N, W] reductions instead of an unpack-to-bool pass: same
-    exact integers, ~10× cheaper at storm shape (the bool intermediate
-    was the single hottest telemetry term)."""
-    # NOTE: callers whose ``words`` is a large fused expression must pin
-    # it with lax.optimization_barrier AT THE SOURCE (so every consumer
-    # shares one materialization) — a barrier here would pin a private
-    # copy and duplicate the producer pipeline instead
-    one = jnp.uint32(1)
-    cols = [
-        jnp.sum((words >> jnp.uint32(j)) & one, axis=0, dtype=jnp.int32)
-        for j in range(32)
-    ]
-    return jnp.stack(cols, axis=-1).reshape(n_payloads)  # [W, 32] → [P]
+# the traversal counters live in sim/fused.py since ISSUE 19 — one
+# fused memory pass by default, the legacy per-bit loops as the oracle
+# behind the CORRO_FUSED_ROUND seam.  Re-exported here because this
+# module is the flight recorder's public face (both round kernels and
+# the tests import the counters from telemetry).
+from .fused import word_bit_counts, word_byte_totals  # noqa: E402,F401
 
 
 def word_coverage_delivered(
@@ -308,9 +298,9 @@ def word_coverage_delivered(
     implementation both the dense and packed round kernels record, so
     the tested dense==packed bit-equality of these channels cannot
     drift between two copies.  The barrier pins the two masked buffers
-    at the source (one cheap elementwise pass each) so the 32 shifted
-    reductions re-read small L2-resident buffers instead of recomputing
-    the masks per shift."""
+    at the source (one cheap elementwise pass each) so the fused count
+    traversals re-read small L2-resident buffers instead of recomputing
+    the masks per trip."""
     cov_w, del_w = jax.lax.optimization_barrier((
         jnp.where(up[:, None], held_w, jnp.uint32(0)),
         held_w & ~held0_w,
@@ -319,23 +309,6 @@ def word_coverage_delivered(
         word_bit_counts(cov_w, n_payloads),
         word_bit_counts(del_w, n_payloads),
     )
-
-
-def word_byte_totals(words: jnp.ndarray, nbytes: jnp.ndarray) -> jnp.ndarray:
-    """i32[...] masked per-row byte totals of u32 bit-words — the packed
-    twin of ``where(granted, nbytes, 0).sum(-1)``: exact integer totals
-    wherever a row's selected bytes stay under i32 (every current
-    scenario: the payload-size validator caps P·64 KiB well below the
-    exactness envelope the budget kernels already assume), so the packed
-    and dense byte channels agree bit-for-bit before the final f32
-    fold."""
-    w = words.shape[-1]
-    nb = nbytes.astype(jnp.int32).reshape(w, 32)
-    tot = jnp.zeros(words.shape[:-1], jnp.int32)
-    for j in range(32):
-        bit = ((words >> j) & jnp.uint32(1)).astype(jnp.int32)
-        tot = tot + (bit * nb[None, :, j]).sum(axis=-1)
-    return tot
 
 
 # -- the membership-churn driver (runner configs #2/#2b, engine-routed) ------

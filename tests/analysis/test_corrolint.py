@@ -927,3 +927,127 @@ def test_ct010_pragma_suppresses(repo):
     )
     res = lint(repo, UnregisteredPhaseScope)
     assert res.clean and res.suppressed == 1
+
+
+# -- CT011 per-bit-reduction-loop ----------------------------------------------
+
+
+def test_ct011_flags_loop_and_comprehension_forms(repo):
+    from corrosion_tpu.analysis.rules import PerBitReductionLoop
+
+    write(
+        repo,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import jax.numpy as jnp
+
+        def bit_counts(words):
+            cols = [
+                jnp.sum((words >> jnp.uint32(j)) & 1, axis=0)
+                for j in range(32)
+            ]
+            return jnp.stack(cols, axis=-1)
+
+        def byte_totals(words, nb):
+            tot = jnp.zeros(words.shape[0], jnp.int32)
+            for j in range(32):
+                bit = ((words >> jnp.uint32(j)) & 1).sum(axis=-1)
+                tot = tot + bit * nb[j]
+            return tot
+        """,
+    )
+    res = lint(repo, PerBitReductionLoop)
+    assert [f.rule for f in res.findings] == ["CT011"] * 2
+    assert "32 memory passes" in res.findings[0].message
+    assert "sim/fused.py" in res.findings[0].message
+
+
+def test_ct011_one_pass_and_out_of_scope_forms_clean(repo):
+    from corrosion_tpu.analysis.rules import PerBitReductionLoop
+
+    write(
+        repo,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import jax.numpy as jnp
+
+        _SHIFTS = jnp.arange(32)
+
+        def fused_counts(words):
+            # a single reduction over a bit-plane axis: not a range(32)
+            # loop, so out of the rule's shape even outside fused.py
+            return jnp.sum((words[..., None] >> _SHIFTS) & 1, axis=0)
+
+        def elementwise_accumulate(words):
+            # 32 iterations but NO reduction call — an elementwise
+            # accumulation pattern (budget prefix walk), not a re-read
+            acc = jnp.zeros_like(words, jnp.int32)
+            for j in range(32):
+                acc = acc + ((words >> jnp.uint32(j)) & 1)
+            return acc
+
+        def pack(bits):
+            # left shift builds words; only >> re-reads per bit
+            tot = 0
+            for j in range(32):
+                tot = tot + (bits[..., j].astype(jnp.uint32) << j).sum()
+            return tot
+
+        def small_unroll(words, k):
+            # non-32 static unroll (gap slots): different loop class
+            outs = [
+                jnp.sum((words >> jnp.uint32(j)) & 1) for j in range(8)
+            ]
+            return outs
+        """,
+    )
+    write(
+        repo,
+        "corrosion_tpu/agent/hostside.py",
+        """
+        def host_popcount(words):
+            return sum((int(w) >> j) & 1 for j in range(32) for w in words)
+        """,
+    )
+    assert lint(repo, PerBitReductionLoop).clean
+
+
+def test_ct011_fused_module_keeps_the_oracle(repo):
+    from corrosion_tpu.analysis.rules import PerBitReductionLoop
+
+    # sim/fused.py is the one sanctioned home for the legacy loop form:
+    # it is the CORRO_FUSED_ROUND oracle the fused forms are pinned to
+    write(
+        repo,
+        "corrosion_tpu/sim/fused.py",
+        """
+        import jax.numpy as jnp
+
+        def word_bit_counts_legacy(words):
+            cols = [
+                jnp.sum((words >> jnp.uint32(j)) & 1, axis=0)
+                for j in range(32)
+            ]
+            return jnp.stack(cols, axis=-1)
+        """,
+    )
+    assert lint(repo, PerBitReductionLoop).clean
+
+
+def test_ct011_pragma_suppresses(repo):
+    from corrosion_tpu.analysis.rules import PerBitReductionLoop
+
+    write(
+        repo,
+        "corrosion_tpu/sim/kern.py",
+        """
+        import jax.numpy as jnp
+
+        def diag_counts(words):
+            # corrolint: disable=CT011 — one-shot diagnostic, not a round kernel
+            cols = [jnp.sum(words >> j) for j in range(32)]
+            return cols
+        """,
+    )
+    res = lint(repo, PerBitReductionLoop)
+    assert res.clean and res.suppressed == 1

@@ -1,0 +1,131 @@
+"""Fused one-pass traversals vs their legacy per-bit-loop oracles.
+
+The ISSUE 19 seam (``CORRO_FUSED_ROUND``) keeps both forms of every
+counter traversal in `sim/fused.py`; these tests hold them EXACTLY equal
+on randomized inputs — the property every pinned digest in the tree
+stands on.  All calls here are eager (unjitted), so the env toggle takes
+effect per call with no cache clearing; the jitted end-to-end matrix
+(telemetry on/off × fused on/off through the full proto round) lives in
+tests/sim/test_proto.py.
+"""
+
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import fused
+from corrosion_tpu.sim.gaps import _extract_gaps_dense
+
+
+def _words(rng, shape):
+    return jnp.asarray(
+        rng.integers(0, 2**32, size=shape, dtype=np.uint32)
+    )
+
+
+def _toggle(monkeypatch, fn, *args):
+    """(fused_result, legacy_result) of ``fn(*args)`` across the seam."""
+    monkeypatch.setenv("CORRO_FUSED_ROUND", "1")
+    hot = fn(*args)
+    monkeypatch.setenv("CORRO_FUSED_ROUND", "0")
+    cold = fn(*args)
+    return hot, cold
+
+
+def _assert_tree_equal(a, b):
+    fa = a if isinstance(a, tuple) else (a,)
+    fb = b if isinstance(b, tuple) else (b,)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_bit_counts_fused_equals_legacy_and_reference(monkeypatch):
+    rng = np.random.default_rng(7)
+    words = _words(rng, (37, 3))  # N=37 rows, W=3 → P=96
+    hot, cold = _toggle(monkeypatch, fused.word_bit_counts, words, 96)
+    _assert_tree_equal(hot, cold)
+    # independent bit-level reference
+    w = np.asarray(words)
+    bits = (w[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ref = bits.sum(axis=0).reshape(96).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(hot), ref)
+
+
+def test_byte_totals_fused_equals_legacy_and_reference(monkeypatch):
+    rng = np.random.default_rng(11)
+    words = _words(rng, (9, 2))  # P=64
+    nbytes = jnp.asarray(
+        rng.integers(1, 70_000, size=64).astype(np.int32)
+    )
+    hot, cold = _toggle(monkeypatch, fused.word_byte_totals, words, nbytes)
+    _assert_tree_equal(hot, cold)
+    w = np.asarray(words)
+    bits = (
+        (w[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(np.int64)
+    ref = (bits * np.asarray(nbytes).reshape(2, 32)).sum(
+        axis=(1, 2)
+    ).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(hot), ref)
+
+
+def test_word_send_stats_fused_equals_legacy(monkeypatch):
+    rng = np.random.default_rng(13)
+    sending = _words(rng, (23, 4))  # P=128
+    nbytes = jnp.asarray(
+        rng.integers(1, 9000, size=128).astype(np.int32)
+    )
+    hot, cold = _toggle(
+        monkeypatch, fused.word_send_stats, sending, nbytes
+    )
+    _assert_tree_equal(hot, cold)
+    # frames must equal the popcount reference
+    ref_frames = np.array(
+        [bin(int(x)).count("1") for x in np.asarray(sending).reshape(-1)]
+    ).reshape(23, 4).sum(axis=1)
+    np.testing.assert_array_equal(np.asarray(hot[0]), ref_frames)
+
+
+def test_dense_send_stats_fused_equals_legacy(monkeypatch):
+    rng = np.random.default_rng(17)
+    sending = jnp.asarray(rng.random((19, 50)) < 0.4)
+    nbytes = jnp.asarray(
+        rng.integers(1, 9000, size=50).astype(np.int32)
+    )
+    hot, cold = _toggle(
+        monkeypatch, fused.dense_send_stats, sending, nbytes
+    )
+    _assert_tree_equal(hot, cold)
+    s = np.asarray(sending)
+    np.testing.assert_array_equal(np.asarray(hot[0]), s.sum(axis=1))
+    np.testing.assert_array_equal(
+        np.asarray(hot[1]), (s * np.asarray(nbytes)[None, :]).sum(axis=1)
+    )
+
+
+@pytest.mark.parametrize("density", [0.15, 0.5, 0.9])
+def test_extract_gaps_dense_fused_equals_legacy(monkeypatch, density):
+    """The one-pass slot expansion (lo/hi/last-missing in two fused
+    reductions) against the legacy 2K+1-reduction form, on patterns
+    dense enough to overflow the K slots."""
+    rng = np.random.default_rng(int(density * 100))
+    n, a, v, k = 11, 3, 70, 4  # V > 32 forces the dense gaps path
+    touched = jnp.asarray(rng.random((n, a, v)) < density)
+    heads = jnp.asarray(
+        (np.asarray(touched) * np.arange(1, v + 1)).max(axis=2)
+    ).astype(jnp.int32)
+    cfg = types.SimpleNamespace(gap_slots=k)
+    hot, cold = _toggle(
+        monkeypatch, _extract_gaps_dense, touched, heads, cfg
+    )
+    np.testing.assert_array_equal(np.asarray(hot.lo), np.asarray(cold.lo))
+    np.testing.assert_array_equal(np.asarray(hot.hi), np.asarray(cold.hi))
+    np.testing.assert_array_equal(
+        np.asarray(hot.overflow), np.asarray(cold.overflow)
+    )
+    # at high density with tiny K the clamp must actually fire somewhere
+    if density <= 0.5:
+        assert bool(np.asarray(hot.overflow).any())

@@ -216,6 +216,35 @@ def test_compare_gate_bands_and_residual(tmp_path):
     assert len(fails) == 1 and "CT010" in fails[0]
 
 
+def test_compare_gate_phase_frac_ceiling(tmp_path):
+    """The ISSUE 19 one-sided ceiling: a baseline carrying
+    phase_frac_max pages when the capped phase GROWS past its cap —
+    and only then (shrinking below the two-sided band's floor is the
+    band's business, not the ceiling's)."""
+    pdir = _write_capture(
+        tmp_path, [_ev("draw", 900.0), _ev("synced", 100.0)]
+    )
+    rec = prof.parse_phase_profile(pdir)
+    base = prof.baseline_from_profile(
+        rec, scenario="t", tol=0.5,
+        extra={"phase_frac_max": {"sync": 0.15}},
+    )
+    assert base["phase_frac_max"] == {"sync": 0.15}
+    assert prof.compare_profiles(base, rec) == []
+    grown = json.loads(json.dumps(rec))
+    grown["phases"]["sync"]["frac"] = 0.2
+    fails = prof.compare_profiles(base, grown)
+    assert len(fails) == 1
+    assert "phase_frac_max" in fails[0] and "sync" in fails[0]
+    # a capped phase that is absent from the candidate counts as zero
+    missing = json.loads(json.dumps(rec))
+    del missing["phases"]["sync"]
+    assert prof.compare_profiles(base, missing) == []
+    # the ceiling renders in the human compare output
+    out = prof.render_compare(base, grown, fails)
+    assert "ceiling" in out
+
+
 def test_render_tables_smoke(tmp_path):
     pdir = _write_capture(
         tmp_path, [_ev("draw", 600.0), _ev("mystery", 400.0)]

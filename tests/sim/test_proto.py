@@ -178,6 +178,52 @@ def test_variant_packed_matches_dense(fam):
         )
 
 
+def test_fused_matrix_never_perturbs_state_or_trace(monkeypatch):
+    """ISSUE 19 matrix: telemetry {off, on} × CORRO_FUSED_ROUND {1, 0}
+    on the push-pull family (the richest kernel seam).  Two pins per
+    cell: packed stays bit-identical to dense, and flipping the fusion
+    seam moves NOTHING — not the state (fusion must not perturb RNG
+    draw order), not the metrics, not a single telemetry channel (the
+    fused counters are integer-identical to the loop oracles, not just
+    close).  The seam is read at trace time, so each flip clears the
+    jit caches."""
+    kw = dict(n_nodes=48, n_payloads=32, n_writers=2, fanout=3)
+    kw.update(family_proto("push-pull"))
+    cfg = dataclasses.replace(SimConfig(**kw), packed_min_cells=0)
+    dense_cfg = dataclasses.replace(cfg, allow_packed=False)
+    meta = uniform_payloads(cfg, inject_every=1)
+    topo = Topology(loss=0.1)
+    out = {}
+    for fused in ("1", "0"):
+        monkeypatch.setenv("CORRO_FUSED_ROUND", fused)
+        jax.clear_caches()
+        for telemetry in (False, True):
+            packed = run_to_convergence(
+                new_sim(cfg, 5), meta, cfg, topo, 400, telemetry=telemetry
+            )
+            dense = run_to_convergence(
+                new_sim(dense_cfg, 5), meta, dense_cfg, topo, 400,
+                telemetry=telemetry,
+            )
+            for x, y in zip(jax.tree.leaves(packed), jax.tree.leaves(dense)):
+                np.testing.assert_array_equal(
+                    np.asarray(x), np.asarray(y),
+                    err_msg=f"packed != dense (fused={fused}, "
+                            f"telemetry={telemetry})",
+                )
+            out[fused, telemetry] = packed
+    jax.clear_caches()  # drop the fused=0 traces before later tests
+    for telemetry in (False, True):
+        hot = jax.tree.leaves(out["1", telemetry])
+        cold = jax.tree.leaves(out["0", telemetry])
+        assert len(hot) == len(cold)
+        for x, y in zip(hot, cold):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y),
+                err_msg=f"fused flip moved results (telemetry={telemetry})",
+            )
+
+
 def test_variant_runs_are_deterministic():
     cfg = _cfg("push-pull")
     meta = uniform_payloads(cfg, inject_every=1)
